@@ -1,0 +1,7 @@
+"""Public facade (mirrors the reference's root package ``goworld.go:34-256``).
+
+Populated incrementally as subsystems land; everything exported here is part
+of the stable user-facing API.
+"""
+
+__all__: list = []

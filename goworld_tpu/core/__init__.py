@@ -1,0 +1,20 @@
+"""Device-side entity state (SoA) and the jitted per-tick step function.
+
+This is the TPU replacement for the reference's per-entity heap objects and
+single-goroutine message loop (``engine/entity/Entity.go``,
+``components/game/GameService.go:77-190``): one Space's entire population is
+a pytree of fixed-capacity arrays, and one compiled step advances every
+entity at once.
+"""
+
+from goworld_tpu.core.state import SpaceState, WorldConfig, create_state
+from goworld_tpu.core.step import TickInputs, TickOutputs, make_tick
+
+__all__ = [
+    "SpaceState",
+    "WorldConfig",
+    "create_state",
+    "TickInputs",
+    "TickOutputs",
+    "make_tick",
+]

@@ -1,0 +1,152 @@
+"""Fixed-capacity SoA entity state for one Space shard.
+
+Reference being rebuilt: ``engine/entity/EntityManager.go`` keeps
+``map[EntityID]*Entity`` with per-entity structs holding position, yaw, attrs,
+client binding, AOI sets (``Entity.go:44-70``). Here the whole population is
+a structure-of-arrays pytree of JAX arrays with a static capacity; entity
+identity on device is (slot, generation), and the host's EntityManager maps
+16-char EntityIDs to slots (free-list allocation is host-side — dynamic
+create/destroy never changes array shapes, so the step function compiles
+once).
+
+Hot attrs (hp, mp, level, ...) live in a dense f32[N, A] block with a dirty
+bitmask driving client attr sync; cold/nested attrs stay host-side in the
+MapAttr/ListAttr tree (:mod:`goworld_tpu.entity.attrs`) — the dual
+representation called out in ``SURVEY.md#7``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from goworld_tpu.ops.aoi import GridSpec
+from goworld_tpu.utils import consts
+
+
+@dataclasses.dataclass(frozen=True)
+class WorldConfig:
+    """Static per-Space configuration (hashable; closed over by jit)."""
+
+    capacity: int = consts.DEFAULT_CAPACITY
+    attr_width: int = 8                       # hot-attr columns (<= 32)
+    grid: GridSpec = GridSpec(radius=50.0)
+    dt: float = 1.0 / consts.TICK_HZ
+    npc_speed: float = 5.0
+    turn_prob: float = 0.05                   # random-walk heading change/tick
+    behavior: str = "random_walk"             # or "mlp" (models.npc_policy)
+    enter_cap: int = consts.DEFAULT_EVENT_CAP
+    leave_cap: int = consts.DEFAULT_EVENT_CAP
+    sync_cap: int = consts.DEFAULT_SYNC_CAP
+    attr_sync_cap: int = consts.DEFAULT_EVENT_CAP
+    input_cap: int = consts.DEFAULT_INPUT_CAP
+
+    @property
+    def bounds_min(self) -> tuple[float, float, float]:
+        g = self.grid
+        return (g.origin_x, -1e9, g.origin_z)
+
+    @property
+    def bounds_max(self) -> tuple[float, float, float]:
+        g = self.grid
+        return (g.origin_x + g.extent_x, 1e9, g.origin_z + g.extent_z)
+
+
+@struct.dataclass
+class SpaceState:
+    """One Space's population as SoA arrays (a pytree; leaves on device)."""
+
+    pos: jax.Array          # f32[N, 3]
+    yaw: jax.Array          # f32[N]
+    vel: jax.Array          # f32[N, 3]
+    alive: jax.Array        # bool[N]
+    npc_moving: jax.Array   # bool[N]  entity moves by velocity integration
+    has_client: jax.Array   # bool[N]
+    client_gate: jax.Array  # i32[N]   owning gate id (-1 none)
+    type_id: jax.Array      # i32[N]
+    gen: jax.Array          # i32[N]   slot generation (stale-handle guard)
+    hot_attrs: jax.Array    # f32[N, A]
+    attr_dirty: jax.Array   # u32[N]   bitmask over attr columns
+    nbr: jax.Array          # i32[N, k] sorted AOI neighbor list (sentinel N)
+    nbr_cnt: jax.Array      # i32[N]
+    dirty: jax.Array        # bool[N]  moved this tick (syncInfoFlag analog)
+    rng: jax.Array          # PRNG key
+    tick: jax.Array         # i32 scalar
+
+
+def create_state(cfg: WorldConfig, seed: int = 0) -> SpaceState:
+    n, a, k = cfg.capacity, cfg.attr_width, cfg.grid.k
+    return SpaceState(
+        pos=jnp.zeros((n, 3), jnp.float32),
+        yaw=jnp.zeros((n,), jnp.float32),
+        vel=jnp.zeros((n, 3), jnp.float32),
+        alive=jnp.zeros((n,), bool),
+        npc_moving=jnp.zeros((n,), bool),
+        has_client=jnp.zeros((n,), bool),
+        client_gate=jnp.full((n,), -1, jnp.int32),
+        type_id=jnp.zeros((n,), jnp.int32),
+        gen=jnp.zeros((n,), jnp.int32),
+        hot_attrs=jnp.zeros((n, a), jnp.float32),
+        attr_dirty=jnp.zeros((n,), jnp.uint32),
+        nbr=jnp.full((n, k), n, jnp.int32),
+        nbr_cnt=jnp.zeros((n,), jnp.int32),
+        dirty=jnp.zeros((n,), bool),
+        rng=jax.random.PRNGKey(seed),
+        tick=jnp.zeros((), jnp.int32),
+    )
+
+
+def spawn(
+    state: SpaceState,
+    slot: int,
+    *,
+    pos,
+    yaw: float = 0.0,
+    type_id: int = 0,
+    npc_moving: bool = False,
+    has_client: bool = False,
+    client_gate: int = -1,
+    hot_attrs=None,
+) -> SpaceState:
+    """Host-side spawn into a free slot (infrequent; not on the hot path).
+
+    The reference creates entities via ``createEntity``
+    (``EntityManager.go:201``); here a spawn is a handful of .at[] updates —
+    the slot choice (free list) lives in the host EntityManager.
+    """
+    if hot_attrs is None:
+        hot_attrs = jnp.zeros(
+            (state.hot_attrs.shape[1],), jnp.float32
+        )  # fresh occupant never inherits the previous entity's attrs
+    upd = dict(
+        pos=state.pos.at[slot].set(jnp.asarray(pos, jnp.float32)),
+        yaw=state.yaw.at[slot].set(yaw),
+        vel=state.vel.at[slot].set(0.0),
+        alive=state.alive.at[slot].set(True),
+        npc_moving=state.npc_moving.at[slot].set(npc_moving),
+        has_client=state.has_client.at[slot].set(has_client),
+        client_gate=state.client_gate.at[slot].set(client_gate),
+        type_id=state.type_id.at[slot].set(type_id),
+        gen=state.gen.at[slot].add(1),
+        dirty=state.dirty.at[slot].set(True),
+        hot_attrs=state.hot_attrs.at[slot].set(
+            jnp.asarray(hot_attrs, jnp.float32)
+        ),
+        attr_dirty=state.attr_dirty.at[slot].set(jnp.uint32(0)),
+    )
+    return state.replace(**upd)
+
+
+def despawn(state: SpaceState, slot: int) -> SpaceState:
+    """Host-side destroy (``destroyEntity``, ``Entity.go:631-651``)."""
+    return state.replace(
+        alive=state.alive.at[slot].set(False),
+        has_client=state.has_client.at[slot].set(False),
+        client_gate=state.client_gate.at[slot].set(-1),
+        npc_moving=state.npc_moving.at[slot].set(False),
+        dirty=state.dirty.at[slot].set(False),
+        attr_dirty=state.attr_dirty.at[slot].set(jnp.uint32(0)),
+    )

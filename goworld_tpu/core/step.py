@@ -1,0 +1,168 @@
+"""The per-Space tick step: one jitted function per tick per Space shard.
+
+This composes the kernels in :mod:`goworld_tpu.ops` into the TPU analog of
+the reference game process's serve loop (``components/game/GameService.go:
+77-190``): apply client inputs -> run behaviors -> integrate movement ->
+AOI sweep -> interest deltas -> sync/attr record collection. All inputs and
+outputs are fixed-capacity arrays so the function compiles exactly once per
+(WorldConfig) and the host drives it at tick rate.
+
+The reference processes each of these as separate per-entity events spread
+over 5 ms timer ticks; here one compiled program advances the entire Space,
+and "events" (AOI enter/leave, sync records, attr deltas) come back as
+bounded arrays the host/gateway fans out to clients
+(:mod:`goworld_tpu.net.gate`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from goworld_tpu.core.state import SpaceState, WorldConfig
+from goworld_tpu.models.npc_policy import MLPPolicy, build_obs, policy_accel
+from goworld_tpu.models.random_walk import random_walk_step
+from goworld_tpu.ops.aoi import grid_neighbors
+from goworld_tpu.ops.delta import interest_delta, masked_pairs
+from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
+from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
+
+
+@struct.dataclass
+class TickInputs:
+    """Per-tick host->device batch (client position syncs; fixed capacity).
+
+    The reference batches the same 16-byte records gate->dispatcher->game
+    (``GateService.go:402-429``, ``DispatcherService.go:770-808``).
+    """
+
+    pos_sync_idx: jax.Array   # i32[IC] target slots
+    pos_sync_vals: jax.Array  # f32[IC, 4] x,y,z,yaw
+    pos_sync_n: jax.Array     # i32 scalar
+
+    @staticmethod
+    def empty(cfg: WorldConfig) -> "TickInputs":
+        ic = cfg.input_cap
+        return TickInputs(
+            pos_sync_idx=jnp.zeros((ic,), jnp.int32),
+            pos_sync_vals=jnp.zeros((ic, 4), jnp.float32),
+            pos_sync_n=jnp.zeros((), jnp.int32),
+        )
+
+
+@struct.dataclass
+class TickOutputs:
+    """Per-tick device->host batch (all fixed capacity; counts are true
+    demand and may exceed capacity — the host watches for overflow)."""
+
+    enter_w: jax.Array   # i32[EC] watcher slots
+    enter_j: jax.Array   # i32[EC] entered-neighbor slots
+    enter_n: jax.Array   # i32
+    leave_w: jax.Array
+    leave_j: jax.Array
+    leave_n: jax.Array
+    sync_w: jax.Array    # i32[SC] watcher slots (has_client only)
+    sync_j: jax.Array    # i32[SC] subject slots
+    sync_vals: jax.Array  # f32[SC, 4]
+    sync_n: jax.Array
+    attr_e: jax.Array    # i32[AC] entity slots
+    attr_i: jax.Array    # i32[AC] attr column
+    attr_v: jax.Array    # f32[AC]
+    attr_n: jax.Array
+    alive_count: jax.Array  # i32
+
+
+def make_tick(cfg: WorldConfig):
+    """Build the jitted tick function for a WorldConfig.
+
+    Returns ``tick(state, inputs, policy) -> (state, outputs)``; ``policy``
+    is an :class:`MLPPolicy` when ``cfg.behavior == 'mlp'`` else ``None``.
+    """
+
+    @jax.jit
+    def tick(
+        state: SpaceState, inputs: TickInputs, policy: MLPPolicy | None
+    ) -> tuple[SpaceState, TickOutputs]:
+        n = cfg.capacity
+
+        # 1. client inputs (scatter).
+        pos, yaw, touched = apply_pos_inputs(
+            state.pos, state.yaw,
+            inputs.pos_sync_idx, inputs.pos_sync_vals, inputs.pos_sync_n,
+        )
+
+        # 2. behaviors (vectorized; MXU when behavior == 'mlp').
+        rng, k_behave = jax.random.split(state.rng)
+        if cfg.behavior == "mlp":
+            obs = build_obs(
+                pos, state.vel, yaw, state.nbr, state.nbr_cnt,
+                (cfg.grid.extent_x, cfg.grid.extent_z),
+            )
+            accel = policy_accel(policy, obs)
+            vel = state.vel + accel * cfg.dt
+            # cap speed by XZ magnitude (not per-axis) so diagonal movers
+            # respect cfg.npc_speed like any other heading
+            speed = jnp.sqrt(vel[:, 0] ** 2 + vel[:, 2] ** 2 + 1e-12)
+            scale = jnp.minimum(1.0, cfg.npc_speed / speed)
+            vel = vel * scale[:, None]
+            vel = jnp.where(state.npc_moving[:, None], vel, 0.0)
+        else:
+            vel = random_walk_step(
+                k_behave, state.vel, state.npc_moving,
+                cfg.npc_speed, cfg.turn_prob,
+            )
+
+        # 3. integrate + world clamp.
+        pos, moved = integrate(
+            pos, vel, state.npc_moving, cfg.dt,
+            cfg.bounds_min, cfg.bounds_max,
+        )
+        # state.dirty carries host-set pending force-syncs (spawn marks the
+        # new entity dirty so watchers get its position, the syncInfoFlag
+        # analog — Entity.go:1189-1205); consumed here, cleared below.
+        dirty = (moved | touched | state.dirty) & state.alive
+
+        # 4. AOI sweep (the go-aoi XZList replacement).
+        nbr, nbr_cnt = grid_neighbors(cfg.grid, pos, state.alive)
+
+        # 5. interest deltas -> bounded enter/leave pair lists.
+        enter_mask, leave_mask = interest_delta(state.nbr, nbr, n)
+        enter_w, enter_j, enter_n = masked_pairs(enter_mask, nbr, cfg.enter_cap)
+        leave_w, leave_j, leave_n = masked_pairs(
+            leave_mask, state.nbr, cfg.leave_cap
+        )
+
+        # 6. position sync records (CollectEntitySyncInfos analog).
+        sync_w, sync_j, sync_vals, sync_n = collect_sync(
+            nbr, dirty, state.has_client, pos, yaw, cfg.sync_cap
+        )
+
+        # 7. hot-attr deltas.
+        attr_e, attr_i, attr_v, attr_n = collect_attr_deltas(
+            state.hot_attrs, state.attr_dirty, cfg.attr_sync_cap
+        )
+
+        new_state = state.replace(
+            pos=pos,
+            yaw=yaw,
+            vel=vel,
+            nbr=nbr,
+            nbr_cnt=nbr_cnt,
+            dirty=jnp.zeros_like(state.dirty),
+            attr_dirty=jnp.zeros_like(state.attr_dirty),
+            rng=rng,
+            tick=state.tick + 1,
+        )
+        outputs = TickOutputs(
+            enter_w=enter_w, enter_j=enter_j, enter_n=enter_n,
+            leave_w=leave_w, leave_j=leave_j, leave_n=leave_n,
+            sync_w=sync_w, sync_j=sync_j, sync_vals=sync_vals, sync_n=sync_n,
+            attr_e=attr_e, attr_i=attr_i, attr_v=attr_v, attr_n=attr_n,
+            alive_count=state.alive.sum().astype(jnp.int32),
+        )
+        return new_state, outputs
+
+    return tick

@@ -1,0 +1,17 @@
+"""Entity behavior models — the batched replacement for per-entity AI code.
+
+In the reference, NPC behavior is interpreted per entity per timer tick
+(``examples/unity_demo/Monster.go:32-100``: 100 ms AI timer + 30 ms move
+tick over ``InterestedIn``). Here behaviors are vectorized functions over the
+whole SoA population, selected per entity type, so the MXU does the work:
+
+* :mod:`goworld_tpu.models.random_walk` — the bot-swarm movement model used
+  by the reference's CI workload (``examples/test_client/ClientBot.go:214``).
+* :mod:`goworld_tpu.models.npc_policy` — a bf16 MLP policy over local
+  observations (the "fused NPC behavior kernel", BASELINE config 5).
+"""
+
+from goworld_tpu.models.npc_policy import MLPPolicy, init_policy, policy_accel
+from goworld_tpu.models.random_walk import random_walk_step
+
+__all__ = ["MLPPolicy", "init_policy", "policy_accel", "random_walk_step"]

@@ -1,0 +1,85 @@
+"""bf16 MLP behavior policy over local observations (MXU path).
+
+The reference runs arbitrary Go per NPC per AI tick
+(``examples/unity_demo/Monster.go:32-100``); a TPU framework instead wants
+"kernelizable" behaviors expressed as one batched network evaluation
+(BASELINE config 5, the fused NPC behavior kernel). The observation builder
+summarises AOI context (neighbor count, mean neighbor offset from the
+neighbor lists) so the policy can chase/flee — a batched analog of the
+Monster's "pick a target in InterestedIn" loop.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+OBS_DIM = 10
+
+
+@struct.dataclass
+class MLPPolicy:
+    w1: jax.Array  # bf16[OBS_DIM, H]
+    b1: jax.Array  # bf16[H]
+    w2: jax.Array  # bf16[H, H]
+    b2: jax.Array  # bf16[H]
+    w3: jax.Array  # bf16[H, 3]
+    b3: jax.Array  # bf16[3]
+
+
+def init_policy(key: jax.Array, hidden: int = 128) -> MLPPolicy:
+    k1, k2, k3 = jax.random.split(key, 3)
+    dt = jnp.bfloat16
+
+    def dense(k, i, o):
+        return (jax.random.normal(k, (i, o), dt) * (1.0 / jnp.sqrt(i))).astype(dt)
+
+    return MLPPolicy(
+        w1=dense(k1, OBS_DIM, hidden),
+        b1=jnp.zeros((hidden,), dt),
+        w2=dense(k2, hidden, hidden),
+        b2=jnp.zeros((hidden,), dt),
+        w3=dense(k3, hidden, 3),
+        b3=jnp.zeros((3,), dt),
+    )
+
+
+def build_obs(
+    pos: jax.Array,
+    vel: jax.Array,
+    yaw: jax.Array,
+    nbr: jax.Array,
+    nbr_cnt: jax.Array,
+    world_extent: tuple[float, float],
+) -> jax.Array:
+    """f32[N, OBS_DIM]: normalized pos, vel, yaw sin/cos, neighbor summary."""
+    n, k = nbr.shape
+    valid = nbr != n
+    nbr_c = jnp.minimum(nbr, n - 1)
+    npos = pos[nbr_c]                                   # [N, k, 3]
+    offs = jnp.where(valid[:, :, None], npos - pos[:, None, :], 0.0)
+    cnt = jnp.maximum(nbr_cnt, 1).astype(jnp.float32)
+    mean_off = offs.sum(axis=1) / cnt[:, None]
+    ex, ez = world_extent
+    return jnp.concatenate(
+        [
+            pos[:, :1] / ex,
+            pos[:, 2:3] / ez,
+            vel / 10.0,
+            jnp.sin(yaw)[:, None],
+            jnp.cos(yaw)[:, None],
+            (nbr_cnt.astype(jnp.float32) / k)[:, None],
+            mean_off[:, ::2] / 100.0,                    # x, z mean offset
+        ],
+        axis=1,
+    )
+
+
+def policy_accel(params: MLPPolicy, obs: jax.Array) -> jax.Array:
+    """Batched MLP forward; returns f32[N, 3] acceleration."""
+    x = obs.astype(jnp.bfloat16)
+    x = jnp.tanh(x @ params.w1 + params.b1)
+    x = jnp.tanh(x @ params.w2 + params.b2)
+    out = x @ params.w3 + params.b3
+    return out.astype(jnp.float32)

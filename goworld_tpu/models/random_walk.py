@@ -1,0 +1,33 @@
+"""Random-walk movement model (the reference CI workload's motion).
+
+Reference: bots move with 50% probability every 100 ms by a random step
+(``examples/test_client/ClientBot.go:214-227``); unity_demo Monsters pick a
+random nearby target. Here: every tick each moving entity keeps its heading,
+and with ``turn_prob`` picks a fresh uniform heading; speed is constant.
+Vectorized over the whole population in one fused elementwise block.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def random_walk_step(
+    key: jax.Array,
+    vel: jax.Array,
+    moving: jax.Array,
+    speed: float,
+    turn_prob: float,
+) -> jax.Array:
+    """Return updated velocities f32[N,3] (y velocity stays 0)."""
+    n = vel.shape[0]
+    k_turn, k_head = jax.random.split(key)
+    turn = jax.random.uniform(k_turn, (n,)) < turn_prob
+    heading = jax.random.uniform(k_head, (n,), minval=0.0, maxval=2.0 * jnp.pi)
+    new_vel = jnp.stack(
+        [jnp.cos(heading) * speed, jnp.zeros(n), jnp.sin(heading) * speed],
+        axis=1,
+    )
+    pick_new = (turn | (jnp.sum(jnp.abs(vel), axis=1) < 1e-6)) & moving
+    return jnp.where(pick_new[:, None], new_vel, vel)

@@ -1,0 +1,14 @@
+"""Device-side kernels (jit/XLA, with Pallas variants for the hot paths).
+
+These replace the reference's per-entity interpreted hot loops:
+
+* :mod:`goworld_tpu.ops.aoi` — batched AOI neighbor search (the reference
+  delegates to the ``go-aoi`` XZList skip-list sweep, ``go.mod:27``,
+  ``engine/entity/Space.go:105``).
+* :mod:`goworld_tpu.ops.delta` — interest-set enter/leave deltas (the
+  reference fires per-entity ``OnEnterAOI/OnLeaveAOI`` callbacks,
+  ``Entity.go:227-246``).
+* :mod:`goworld_tpu.ops.sync` — sync-record collection (the reference's
+  ``CollectEntitySyncInfos`` double loop, ``Entity.go:1208-1267``).
+* :mod:`goworld_tpu.ops.integrate` — movement integration.
+"""

@@ -1,0 +1,68 @@
+"""Interest-set enter/leave deltas from consecutive neighbor lists.
+
+Reference behavior: the AOI manager fires ``OnEnterAOI``/``OnLeaveAOI``
+callbacks per entity pair as entities move (``engine/entity/Entity.go:227-246``
+maintains ``InterestedIn``/``InterestedBy`` sets and drives client
+create/destroy-entity messages from them).
+
+TPU-first redesign: neighbor lists are sorted fixed-width rows
+(int32[N, k], sentinel-padded — see :mod:`goworld_tpu.ops.aoi`), so the delta
+between tick t-1 and t is a vectorized sorted-set difference per row
+(searchsorted membership test), and the pair lists surfaced to the host are
+capacity-bounded, fixed-shape arrays extracted with ``flatnonzero(size=...)``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from goworld_tpu.ops.extract import bounded_extract
+
+
+def _not_in(a: jax.Array, b: jax.Array, sentinel) -> jax.Array:
+    """Per-row mask over b: True where b's entry is valid and absent from a.
+
+    Both a and b are int32[N, k], ascending, padded with sentinel.
+    """
+    k = a.shape[1]
+    pos = jax.vmap(jnp.searchsorted)(a, b)
+    pos_c = jnp.minimum(pos, k - 1)
+    found = jnp.take_along_axis(a, pos_c, axis=1) == b
+    return (b != sentinel) & ~found
+
+
+def interest_delta(
+    old_nbr: jax.Array, new_nbr: jax.Array, sentinel
+) -> tuple[jax.Array, jax.Array]:
+    """Masks of entered (over new_nbr) and left (over old_nbr) neighbors."""
+    enter_mask = _not_in(old_nbr, new_nbr, sentinel)
+    leave_mask = _not_in(new_nbr, old_nbr, sentinel)
+    return enter_mask, leave_mask
+
+
+@partial(jax.jit, static_argnums=2)
+def masked_pairs(
+    mask: jax.Array, values: jax.Array, cap: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Extract up to ``cap`` (row, value) pairs where mask is set.
+
+    Args:
+      mask: bool[N, k].
+      values: int32[N, k] (e.g. neighbor slot ids).
+      cap: static output capacity.
+
+    Returns:
+      (watcher int32[cap], subject int32[cap], count int32). Entries past
+      ``count`` are -1. ``count`` is the TRUE number of set bits — if it
+      exceeds cap the surplus pairs were dropped (host can widen caps and
+      recompile; same spirit as the reference's bounded pending queues,
+      ``consts.go:26-28``).
+    """
+    k = mask.shape[1]
+    flat, valid, count = bounded_extract(mask, cap)
+    watcher = jnp.where(valid, flat // k, -1)
+    subject = jnp.where(valid, values.ravel()[flat], -1)
+    return watcher, subject, count

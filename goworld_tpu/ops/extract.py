@@ -1,0 +1,24 @@
+"""Shared bounded-extraction idiom: flatten a boolean mask into up to ``cap``
+flat indices plus a validity mask and the TRUE demand count.
+
+Overflow contract (used by delta pair lists, sync records, attr deltas):
+``count`` is the real number of set bits; if it exceeds ``cap`` the surplus
+is dropped and the host can widen caps and recompile — the batched analog of
+the reference's bounded pending queues (``consts.go:26-28``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def bounded_extract(
+    mask: jax.Array, cap: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (flat int32[cap] indices into mask.ravel(), valid bool[cap],
+    count int32). Entries past ``count`` point at 0 and are invalid."""
+    flat = jnp.flatnonzero(mask.ravel(), size=cap, fill_value=0)
+    count = mask.sum().astype(jnp.int32)
+    valid = jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(count, cap)
+    return flat.astype(jnp.int32), valid, count

@@ -1,0 +1,70 @@
+"""Movement integration + client position-sync application.
+
+Reference behavior: client position updates arrive as 16-byte records and are
+applied per entity (``syncPositionYawFromClient`` -> ``space.move``,
+``Entity.go:430-435``, ``GameService.go:395-407``); NPC movement is per-entity
+timer callbacks (e.g. ``examples/unity_demo/Monster.go:32-100``).
+
+TPU-first: both are batched array ops inside the tick — a scatter for client
+inputs, a fused velocity integrate + world clamp for everything else.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def apply_pos_inputs(
+    pos: jax.Array,
+    yaw: jax.Array,
+    idx: jax.Array,
+    vals: jax.Array,
+    n_inputs: jax.Array,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Scatter client position syncs into the SoA.
+
+    Args:
+      pos: f32[N,3]; yaw: f32[N].
+      idx: int32[IC] target slots (padded; entries >= n_inputs ignored).
+      vals: f32[IC,4] (x, y, z, yaw).
+      n_inputs: int32 number of valid records.
+
+    Returns: (pos, yaw, touched bool[N]).
+    """
+    n = pos.shape[0]
+    ic = idx.shape[0]
+    valid = (
+        (jnp.arange(ic, dtype=jnp.int32) < n_inputs)
+        & (idx >= 0)
+        & (idx < n)  # out-of-range records are dropped, never clamped onto
+    )                # an unrelated entity's slot
+    safe_idx = jnp.where(valid, idx, n)  # n = drop row
+    pos2 = pos.at[safe_idx, :].set(vals[:, :3], mode="drop")
+    yaw2 = yaw.at[safe_idx].set(vals[:, 3], mode="drop")
+    touched = (
+        jnp.zeros(n, bool).at[safe_idx].set(valid, mode="drop")
+    )
+    return pos2, yaw2, touched
+
+
+def integrate(
+    pos: jax.Array,
+    vel: jax.Array,
+    moving: jax.Array,
+    dt: float,
+    bounds_min: tuple[float, float, float],
+    bounds_max: tuple[float, float, float],
+) -> tuple[jax.Array, jax.Array]:
+    """pos += vel*dt for moving entities, clamped to world bounds.
+
+    Returns (new_pos, moved bool[N]).
+    """
+    step = jnp.where(moving[:, None], vel * dt, 0.0)
+    new_pos = jnp.clip(
+        pos + step,
+        jnp.asarray(bounds_min, pos.dtype),
+        jnp.asarray(bounds_max, pos.dtype),
+    )
+    moved = jnp.any(jnp.abs(new_pos - pos) > 1e-7, axis=1)
+    return new_pos, moved

@@ -1,0 +1,91 @@
+"""Sync-record collection — the reference's hot loop, batched.
+
+Reference behavior: every ``position_sync_interval_ms`` the game loop runs
+``CollectEntitySyncInfos`` (``engine/entity/Entity.go:1208-1267``): for each
+entity whose position/yaw changed (``syncInfoFlag``), for each watcher in its
+``InterestedBy`` set that has a client, append a (clientid, entityid, x, y,
+z, yaw) record to that client's gate packet. This O(dirty x watchers) double
+loop is the throughput ceiling of the reference game process
+(``SURVEY.md#3.4``).
+
+TPU-first redesign: one masked-gather kernel. ``watch[i, j]`` = watcher i has
+a client AND neighbor j of i is dirty -> flatten to a capacity-bounded record
+array. AOI interest is symmetric under a uniform per-space radius (the common
+case in the reference's examples), so ``InterestedBy == InterestedIn`` and the
+neighbor list serves both directions.
+
+Attr deltas ride the same shape: hot attrs are an f32[N, A] SoA block with a
+per-entity dirty bitmask; changed (entity, attr) cells flatten into a second
+bounded record array (the reference instead walks the MapAttr tree per
+mutation and packs per-client packets, ``Entity.go:814-917``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from goworld_tpu.ops.extract import bounded_extract
+
+
+@partial(jax.jit, static_argnums=5)
+def collect_sync(
+    nbr: jax.Array,
+    dirty: jax.Array,
+    has_client: jax.Array,
+    pos: jax.Array,
+    yaw: jax.Array,
+    cap: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Collect position/yaw sync records for client-owning watchers.
+
+    Args:
+      nbr: int32[N, k] sorted neighbor lists (sentinel N).
+      dirty: bool[N] moved-this-tick mask.
+      has_client: bool[N] watcher owns a connected client.
+      pos: f32[N, 3]; yaw: f32[N].
+      cap: static max records.
+
+    Returns:
+      watcher int32[cap], subject int32[cap], vals f32[cap, 4] (x,y,z,yaw),
+      count int32 (true demand; may exceed cap).
+    """
+    n, k = nbr.shape
+    sentinel = n
+    valid_nbr = nbr != sentinel
+    nbr_c = jnp.minimum(nbr, n - 1)
+    watch = has_client[:, None] & valid_nbr & dirty[nbr_c]
+
+    flat, valid, count = bounded_extract(watch, cap)
+    watcher = jnp.where(valid, flat // k, -1)
+    subject_raw = nbr_c.ravel()[flat]
+    subject = jnp.where(valid, subject_raw, -1)
+    sub_c = jnp.minimum(subject_raw, n - 1)
+    vals = jnp.concatenate([pos[sub_c], yaw[sub_c, None]], axis=1)
+    vals = jnp.where(valid[:, None], vals, 0.0)
+    return watcher, subject, vals, count
+
+
+@partial(jax.jit, static_argnums=2)
+def collect_attr_deltas(
+    hot_attrs: jax.Array, attr_dirty: jax.Array, cap: int
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Flatten dirty (entity, attr) cells into bounded records.
+
+    Args:
+      hot_attrs: f32[N, A]; attr_dirty: uint32[N] bitmask over A<=32 attrs.
+      cap: static max records.
+
+    Returns:
+      entity int32[cap], attr_idx int32[cap], value f32[cap], count int32.
+    """
+    n, a = hot_attrs.shape
+    bits = (attr_dirty[:, None] >> jnp.arange(a, dtype=jnp.uint32)) & 1
+    mask = bits.astype(bool)
+    flat, valid, count = bounded_extract(mask, cap)
+    ent = jnp.where(valid, flat // a, -1)
+    attr_idx = jnp.where(valid, flat % a, -1)
+    value = jnp.where(valid, hot_attrs.ravel()[flat], 0.0)
+    return ent, attr_idx, value, count

@@ -1,0 +1,5 @@
+"""Runtime utilities: ids, logging, constants, config.
+
+TPU-native rebuild of the reference's ``engine/{common,uuid,gwlog,consts,
+config}`` utility layer.
+"""

@@ -1,0 +1,45 @@
+"""Framework-wide tunables.
+
+Reference parity: ``engine/consts/consts.go:7-113`` centralises every
+compile-time tunable (tick intervals, buffer sizes, queue caps, timeouts,
+debug switches). We keep the same idea — one module, documented values —
+with TPU-specific additions (kernel capacity caps).
+"""
+
+# --- tick / timing ------------------------------------------------------
+TICK_HZ = 60                      # device tick rate target (reference games
+                                  # tick timers every 5ms, position sync every
+                                  # 100ms; our device tick subsumes both)
+HOST_TICK_INTERVAL = 0.005        # host service loop resolution (consts.go:32)
+POSITION_SYNC_INTERVAL_MS = 100   # client<->server sync cadence default
+                                  # (goworld.ini.sample:50,75)
+
+# --- kernel capacity defaults ------------------------------------------
+DEFAULT_CAPACITY = 16384          # entity slots per space shard
+DEFAULT_MAX_NEIGHBORS = 64        # K: AOI interest cap per entity
+DEFAULT_CELL_CAP = 32             # max candidates considered per grid cell
+DEFAULT_EVENT_CAP = 4096          # enter/leave events surfaced per tick
+DEFAULT_SYNC_CAP = 16384          # sync records surfaced per tick
+DEFAULT_INPUT_CAP = 4096          # client position-sync inputs per tick
+DEFAULT_ROW_BLOCK = 32768         # AOI row-block size (memory ceiling knob)
+
+# --- queues / backpressure (reference consts.go:26-28) -----------------
+MAX_PENDING_PACKETS_PER_GAME = 1_000_000
+MAX_PENDING_PACKETS_PER_ENTITY = 1_000
+
+# --- timeouts (reference consts.go:58-64) ------------------------------
+MIGRATE_TIMEOUT = 60.0
+LOAD_TIMEOUT = 60.0
+FREEZE_BLOCK_TIMEOUT = 10.0
+
+# --- persistence ---------------------------------------------------------
+DEFAULT_SAVE_INTERVAL = 300.0     # reference read_config.go:28 (5 min)
+
+# --- debug switches (reference consts.go:76-89) ------------------------
+DEBUG_PACKETS = False
+DEBUG_SPACES = False
+OPTIMIZE_LOCAL_ENTITY_CALL = True  # set False in tests to force the full
+                                   # routed path (reference consts.go:7)
+
+# --- networking ----------------------------------------------------------
+SUPERVISOR_STARTED_TAG = "GOWORLD_TPU_PROCESS_STARTED"  # consts.go:108-112

@@ -1,0 +1,77 @@
+"""Entity / client identifier generation.
+
+Reference parity: GoWorld represents ``EntityID``/``ClientID`` as 16-char
+strings (``engine/common/types.go:9-46``) produced from a 12-byte
+Mongo-ObjectId-style uuid — 4B unix time, 3B machine, 2B pid, 3B counter —
+base64-encoded to 16 chars (``engine/common/uuid/uuid.go:27-60``), plus a
+deterministic variant used for per-game nil-space ids
+(``engine/entity/space_ops.go:33-47``).
+
+We keep the same wire format (16-char url-safe base64 of 12 bytes) so that
+ids stay fixed-width on the wire and sortable-by-creation-time, but device
+kernels never see these strings: the host maps ``EntityID`` <-> (space shard,
+slot, generation) and ships only int32 slot indices to the TPU.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import socket
+import struct
+import threading
+import time
+
+ENTITYID_LENGTH = 16  # chars on the wire, = base64(12 bytes)
+
+_counter_lock = threading.Lock()
+_counter = int.from_bytes(os.urandom(3), "big")
+
+_machine = hashlib.md5(socket.gethostname().encode()).digest()[:3]
+_pid = struct.pack(">H", os.getpid() & 0xFFFF)
+
+
+def _b64_12(raw: bytes) -> str:
+    assert len(raw) == 12
+    return base64.urlsafe_b64encode(raw).decode("ascii")  # 16 chars, no pad
+
+
+def gen_entity_id() -> str:
+    """Generate a fresh 16-char EntityID (time+machine+pid+counter)."""
+    global _counter
+    with _counter_lock:
+        _counter = (_counter + 1) & 0xFFFFFF
+        cnt = _counter
+    raw = (
+        struct.pack(">I", int(time.time()) & 0xFFFFFFFF)
+        + _machine
+        + _pid
+        + cnt.to_bytes(3, "big")
+    )
+    return _b64_12(raw)
+
+
+def gen_fixed_id(key: str) -> str:
+    """Deterministic EntityID from a string key.
+
+    Used for nil-space ids so every process derives the same id for game N,
+    like the reference's ``GenFixedUUID`` (``uuid.go``/``space_ops.go:41``).
+    """
+    return _b64_12(hashlib.sha256(key.encode()).digest()[:12])
+
+
+def nil_space_id(game_id: int) -> str:
+    return gen_fixed_id(f"goworld_tpu.nilspace.{game_id}")
+
+
+def is_valid_entity_id(eid: str) -> bool:
+    if not isinstance(eid, str) or len(eid) != ENTITYID_LENGTH:
+        return False
+    try:
+        raw = base64.urlsafe_b64decode(eid)
+    except Exception:
+        return False
+    # canonical ids are exactly base64(12 bytes), so no '=' padding and a
+    # 12-byte decode; reject anything gen_entity_id could not have produced
+    return len(raw) == 12 and "=" not in eid

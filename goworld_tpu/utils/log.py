@@ -1,0 +1,51 @@
+"""Leveled logging with per-process source tags.
+
+Reference parity: ``engine/gwlog`` (zap-based; level from config/flag,
+stderr + file, per-process source tag like ``game1``, ``TraceError`` dumps a
+stack — ``gwlog.go:47-120``, ``binutil.go:50-66``). Here: thin wrappers over
+:mod:`logging` so the rest of the framework has one import point.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import traceback
+
+_root = logging.getLogger("goworld_tpu")
+_source = "?"
+
+
+def setup(source: str, level: str = "info", logfile: str | None = None) -> None:
+    """Configure logging for this process. ``source`` tags every line."""
+    global _source
+    _source = source
+    _root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    _root.handlers.clear()
+    fmt = logging.Formatter(
+        f"%(asctime)s %(levelname).1s {source} %(name)s: %(message)s"
+    )
+    h: logging.Handler = logging.StreamHandler(sys.stderr)
+    h.setFormatter(fmt)
+    _root.addHandler(h)
+    if logfile:
+        fh = logging.FileHandler(logfile)
+        fh.setFormatter(fmt)
+        _root.addHandler(fh)
+    _root.propagate = False
+
+
+def get(name: str) -> logging.Logger:
+    return _root.getChild(name)
+
+
+def trace_error(msg: str, *args) -> None:
+    """Log an error with a stack trace (reference ``gwlog.TraceError``)."""
+    _root.error(msg, *args)
+    _root.error("stack:\n%s", "".join(traceback.format_stack()[:-1]))
+
+
+debug = _root.debug
+info = _root.info
+warning = _root.warning
+error = _root.error

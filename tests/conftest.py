@@ -1,0 +1,15 @@
+"""Test env: force CPU with 8 virtual devices so mesh/sharding tests run
+without TPU hardware (the multi-node-without-a-cluster capability noted in
+SURVEY.md#4). Must run before jax is imported anywhere."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the ambient env sets axon (TPU)
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

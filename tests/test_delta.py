@@ -1,0 +1,63 @@
+"""Interest delta (enter/leave) kernel vs python set difference.
+
+Reference semantics: OnEnterAOI/OnLeaveAOI pair events, Entity.go:227-246."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from goworld_tpu.ops.delta import interest_delta, masked_pairs
+
+
+def make_rows(rng, n, k):
+    """Random sorted sentinel-padded neighbor rows."""
+    rows = np.full((n, k), n, np.int32)
+    for i in range(n):
+        cnt = rng.integers(0, k + 1)
+        vals = rng.choice(n, size=cnt, replace=False)
+        rows[i, :cnt] = np.sort(vals)
+    return rows
+
+
+def test_delta_matches_sets():
+    rng = np.random.default_rng(0)
+    n, k = 50, 8
+    old = make_rows(rng, n, k)
+    new = make_rows(rng, n, k)
+    enter_mask, leave_mask = interest_delta(
+        jnp.asarray(old), jnp.asarray(new), n
+    )
+    enter_mask, leave_mask = np.asarray(enter_mask), np.asarray(leave_mask)
+    for i in range(n):
+        so, sn = set(old[i][old[i] < n]), set(new[i][new[i] < n])
+        got_enter = set(new[i][enter_mask[i]].tolist())
+        got_leave = set(old[i][leave_mask[i]].tolist())
+        assert got_enter == sn - so
+        assert got_leave == so - sn
+
+
+def test_no_delta_when_equal():
+    rng = np.random.default_rng(1)
+    rows = make_rows(rng, 20, 6)
+    e, l = interest_delta(jnp.asarray(rows), jnp.asarray(rows), 20)
+    assert not np.asarray(e).any()
+    assert not np.asarray(l).any()
+
+
+def test_masked_pairs_extraction():
+    mask = np.zeros((4, 3), bool)
+    vals = np.arange(12, dtype=np.int32).reshape(4, 3)
+    mask[1, 2] = mask[3, 0] = True
+    w, j, cnt = masked_pairs(jnp.asarray(mask), jnp.asarray(vals), 8)
+    w, j = np.asarray(w), np.asarray(j)
+    assert int(cnt) == 2
+    pairs = {(int(w[i]), int(j[i])) for i in range(2)}
+    assert pairs == {(1, 5), (3, 9)}
+    assert (w[2:] == -1).all() and (j[2:] == -1).all()
+
+
+def test_masked_pairs_overflow_reports_true_count():
+    mask = np.ones((4, 4), bool)
+    vals = np.zeros((4, 4), np.int32)
+    w, j, cnt = masked_pairs(jnp.asarray(mask), jnp.asarray(vals), 5)
+    assert int(cnt) == 16      # true demand
+    assert (np.asarray(w) >= 0).sum() == 5  # only cap extracted

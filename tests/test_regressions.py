@@ -1,0 +1,89 @@
+"""Regression tests for review findings on the kernel core."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from goworld_tpu.core import TickInputs, WorldConfig, create_state, make_tick
+from goworld_tpu.core.state import despawn, spawn
+from goworld_tpu.ops.aoi import GridSpec
+from goworld_tpu.ops.integrate import apply_pos_inputs
+from goworld_tpu.utils.ids import gen_entity_id, is_valid_entity_id
+
+
+def cfg64():
+    return WorldConfig(
+        capacity=64,
+        grid=GridSpec(radius=10.0, extent_x=100.0, extent_z=100.0,
+                      k=16, cell_cap=32, row_block=64),
+    )
+
+
+def test_spawned_stationary_entity_is_synced_once():
+    """spawn() marks dirty -> watchers must get one sync record even though
+    the new entity never moves (the syncInfoFlag analog)."""
+    cfg = cfg64()
+    tick = make_tick(cfg)
+    st = create_state(cfg)
+    st = spawn(st, 0, pos=(50.0, 0, 50.0), has_client=True)
+    st, _ = tick(st, TickInputs.empty(cfg), None)
+    st = spawn(st, 1, pos=(52.0, 0, 50.0))  # stationary, no client
+    st, out = tick(st, TickInputs.empty(cfg), None)
+    pairs = {(int(w), int(j)) for w, j in
+             zip(np.asarray(out.sync_w)[: int(out.sync_n)],
+                 np.asarray(out.sync_j)[: int(out.sync_n)])}
+    assert (0, 1) in pairs
+    # flag consumed: next tick, no further records for the stationary entity
+    st, out = tick(st, TickInputs.empty(cfg), None)
+    assert int(out.sync_n) == 0
+
+
+def test_out_of_range_input_index_dropped_not_clamped():
+    pos = jnp.zeros((4, 3))
+    yaw = jnp.zeros((4,))
+    idx = jnp.array([-5, 9999, 2], jnp.int32)
+    vals = jnp.tile(jnp.array([[7.0, 8.0, 9.0, 1.0]]), (3, 1))
+    p2, y2, touched = apply_pos_inputs(pos, yaw, idx, vals, jnp.int32(3))
+    p2, touched = np.asarray(p2), np.asarray(touched)
+    assert np.allclose(p2[0], 0) and np.allclose(p2[3], 0)  # not clamped onto
+    assert np.allclose(p2[2], [7, 8, 9])                    # valid applied
+    assert touched.tolist() == [False, False, True, False]
+
+
+def test_despawn_clears_attr_dirty_and_spawn_resets_attrs():
+    cfg = cfg64()
+    tick = make_tick(cfg)
+    st = create_state(cfg)
+    st = spawn(st, 0, pos=(10.0, 0, 10.0), hot_attrs=[5.0] * cfg.attr_width)
+    st = st.replace(attr_dirty=st.attr_dirty.at[0].set(jnp.uint32(1)))
+    st = despawn(st, 0)
+    st, out = tick(st, TickInputs.empty(cfg), None)
+    assert int(out.attr_n) == 0          # no ghost attr records
+    st = spawn(st, 0, pos=(10.0, 0, 10.0))  # reuse slot without hot_attrs
+    assert np.allclose(np.asarray(st.hot_attrs[0]), 0.0)  # no inheritance
+    assert int(st.gen[0]) == 2
+
+
+def test_entity_id_validation_strict():
+    assert is_valid_entity_id(gen_entity_id())
+    assert not is_valid_entity_id("AAAAAAAAAAAA====")  # padded, 9-byte decode
+    assert not is_valid_entity_id("short")
+    assert not is_valid_entity_id("x" * 17)
+    assert not is_valid_entity_id("!" * 16)
+
+
+def test_mlp_speed_capped_by_magnitude():
+    import jax
+    from goworld_tpu.models.npc_policy import init_policy
+
+    cfg = cfg64()
+    cfg = WorldConfig(**{**cfg.__dict__, "behavior": "mlp", "npc_speed": 3.0})
+    tick = make_tick(cfg)
+    st = create_state(cfg)
+    for s in range(4):
+        st = spawn(st, s, pos=(50.0, 0, 50.0 + s), npc_moving=True)
+    policy = init_policy(jax.random.PRNGKey(1))
+    for _ in range(50):
+        st, _ = tick(st, TickInputs.empty(cfg), policy)
+    v = np.asarray(st.vel[:4])
+    speed = np.sqrt(v[:, 0] ** 2 + v[:, 2] ** 2)
+    assert (speed <= 3.0 + 1e-4).all()
