@@ -1,0 +1,145 @@
+"""Benchmark: entity ticks/sec/chip at 1M entities (BASELINE.md metric).
+
+Runs the full single-shard world tick — client-input scatter, random-walk
+behavior, movement integration, grid AOI sweep, interest deltas, sync-record
++ attr-delta collection — on one chip at 1M entities (the reference's CI
+soak tops out at 200 bots over 9 processes; it publishes no benchmark
+numbers, see BASELINE.md).
+
+The timed region is a ``lax.scan`` over BENCH_TICKS ticks entirely on
+device with ONE host readback at the end (the axon tunnel has very high
+per-transfer latency; per-tick readback would measure the tunnel, not the
+chip). Per-tick outputs are reduced to checksums inside the scan so XLA
+cannot dead-code-eliminate the collection kernels.
+
+vs_baseline: the driver-set north star is 1M entities @ 60 ticks/s on a
+v5e-8 => 7.5M entity-ticks/sec/chip. value/7.5e6 > 1.0 beats it.
+
+Env knobs: BENCH_N (default 1_048_576), BENCH_TICKS (default 20),
+BENCH_CLIENT_FRAC (default 0.01).
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from goworld_tpu.core.state import SpaceState, WorldConfig  # noqa: E402
+from goworld_tpu.core.step import TickInputs, tick_body  # noqa: E402
+from goworld_tpu.ops.aoi import GridSpec  # noqa: E402
+
+N = int(os.environ.get("BENCH_N", 1_048_576))
+T = int(os.environ.get("BENCH_TICKS", 20))
+CLIENT_FRAC = float(os.environ.get("BENCH_CLIENT_FRAC", 0.01))
+BASELINE_ENTITY_TICKS_PER_CHIP = 7.5e6
+
+
+def build():
+    # ~12 avg Chebyshev neighbors at radius 50 (north-star AOI density)
+    extent = float(int((N * 10000 / 12) ** 0.5))
+    cfg = WorldConfig(
+        capacity=N,
+        grid=GridSpec(
+            radius=50.0, extent_x=extent, extent_z=extent,
+            k=32, cell_cap=32,
+            row_block=min(N, 65536),
+        ),
+        npc_speed=5.0,
+        enter_cap=65536, leave_cap=65536,
+        sync_cap=65536, attr_sync_cap=4096, input_cap=4096,
+    )
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3, k4 = jax.random.split(key, 4)
+    pos = jnp.stack(
+        [
+            jax.random.uniform(k1, (N,), maxval=extent),
+            jnp.zeros(N),
+            jax.random.uniform(k2, (N,), maxval=extent),
+        ],
+        axis=1,
+    )
+    st = SpaceState(
+        pos=pos,
+        yaw=jnp.zeros(N),
+        vel=jnp.zeros((N, 3)),
+        alive=jnp.ones(N, bool),
+        npc_moving=jnp.ones(N, bool),
+        has_client=jax.random.uniform(k3, (N,)) < CLIENT_FRAC,
+        client_gate=jnp.zeros(N, jnp.int32),
+        type_id=jnp.zeros(N, jnp.int32),
+        gen=jnp.zeros(N, jnp.int32),
+        hot_attrs=jnp.zeros((N, 8)),
+        attr_dirty=jnp.zeros(N, jnp.uint32),
+        nbr=jnp.full((N, cfg.grid.k), N, jnp.int32),
+        nbr_cnt=jnp.zeros(N, jnp.int32),
+        dirty=jnp.zeros(N, bool),
+        rng=jax.random.PRNGKey(1),
+        tick=jnp.zeros((), jnp.int32),
+    )
+    # steady stream of client position syncs (input-scatter path stays hot)
+    inputs = TickInputs(
+        pos_sync_idx=jax.random.randint(k4, (cfg.input_cap,), 0, N),
+        pos_sync_vals=jnp.concatenate(
+            [
+                jax.random.uniform(k4, (cfg.input_cap, 3), maxval=extent),
+                jnp.zeros((cfg.input_cap, 1)),
+            ],
+            axis=1,
+        ),
+        pos_sync_n=jnp.asarray(cfg.input_cap, jnp.int32),
+    )
+    return cfg, st, inputs
+
+
+def main():
+    cfg, st, inputs = build()
+
+    def one_tick(state, _):
+        state, out = tick_body(cfg, state, inputs, None)
+        checks = (
+            out.enter_n + out.leave_n + out.sync_n + out.attr_n,
+            out.sync_vals.sum(),
+            out.alive_count,
+        )
+        return state, checks
+
+    @jax.jit
+    def run(state):
+        return lax.scan(one_tick, state, None, length=T)
+
+    # compile + warm up (first scan execution)
+    st_w, _ = run(st)
+    jax.block_until_ready(st_w)
+
+    t0 = time.perf_counter()
+    st2, checks = run(st)
+    jax.block_until_ready(st2)
+    elapsed = time.perf_counter() - t0
+
+    ticks_per_sec = T / elapsed
+    value = N * ticks_per_sec
+    print(
+        json.dumps(
+            {
+                "metric": "entity_ticks_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "entity-ticks/s/chip",
+                "vs_baseline": round(value / BASELINE_ENTITY_TICKS_PER_CHIP, 3),
+                "entities": N,
+                "ticks_per_sec": round(ticks_per_sec, 2),
+                "tick_ms": round(1000.0 * elapsed / T, 2),
+                "ticks_timed": T,
+                "device": str(jax.devices()[0]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

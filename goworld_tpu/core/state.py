@@ -116,6 +116,12 @@ def spawn(
     The reference creates entities via ``createEntity``
     (``EntityManager.go:201``); here a spawn is a handful of .at[] updates —
     the slot choice (free list) lives in the host EntityManager.
+
+    IMPORTANT free-list contract: do not reuse a slot in the same tick it
+    was despawned — the slot's stale neighbor list must survive one tick so
+    the previous occupant's AOI leave events fire on the next interest diff
+    (the host EntityManager quarantines freed slots for one tick; the device
+    migration path does the same via ``insert_arrivals(quarantine=...)``).
     """
     if hot_attrs is None:
         hot_attrs = jnp.zeros(

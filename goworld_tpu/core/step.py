@@ -75,6 +75,114 @@ class TickOutputs:
     alive_count: jax.Array  # i32
 
 
+def compute_velocity(
+    cfg: WorldConfig,
+    key: jax.Array,
+    pos: jax.Array,
+    yaw: jax.Array,
+    state: SpaceState,
+    policy: MLPPolicy | None,
+    world_extent: tuple[float, float],
+    nbr: jax.Array | None = None,
+    nbr_cnt: jax.Array | None = None,
+) -> jax.Array:
+    """Per-entity velocity update for cfg.behavior (shared by the single-
+    space tick and the megaspace shard step). ``nbr``/``nbr_cnt`` are the
+    LOCAL-slot neighbor lists for the MLP observation; pass None when they
+    are unavailable (e.g. megaspace state holds global ids)."""
+    if cfg.behavior == "mlp":
+        n = pos.shape[0]
+        if nbr is None:
+            nbr = jnp.full((n, cfg.grid.k), n, jnp.int32)
+            nbr_cnt = jnp.zeros((n,), jnp.int32)
+        obs = build_obs(pos, state.vel, yaw, nbr, nbr_cnt, world_extent)
+        accel = policy_accel(policy, obs)
+        vel = state.vel + accel * cfg.dt
+        # cap speed by XZ magnitude (not per-axis) so diagonal movers
+        # respect cfg.npc_speed like any other heading
+        speed = jnp.sqrt(vel[:, 0] ** 2 + vel[:, 2] ** 2 + 1e-12)
+        vel = vel * jnp.minimum(1.0, cfg.npc_speed / speed)[:, None]
+        return jnp.where(state.npc_moving[:, None], vel, 0.0)
+    return random_walk_step(
+        key, state.vel, state.npc_moving, cfg.npc_speed, cfg.turn_prob
+    )
+
+
+def tick_body(
+    cfg: WorldConfig,
+    state: SpaceState,
+    inputs: TickInputs,
+    policy: MLPPolicy | None,
+) -> tuple[SpaceState, TickOutputs]:
+    """Un-jitted single-Space tick (reused by the shard_map'd multi-space
+    step in :mod:`goworld_tpu.parallel.step`). See :func:`make_tick`."""
+    n = cfg.capacity
+
+    # 1. client inputs (scatter).
+    pos, yaw, touched = apply_pos_inputs(
+        state.pos, state.yaw,
+        inputs.pos_sync_idx, inputs.pos_sync_vals, inputs.pos_sync_n,
+    )
+
+    # 2. behaviors (vectorized; MXU when behavior == 'mlp').
+    rng, k_behave = jax.random.split(state.rng)
+    vel = compute_velocity(
+        cfg, k_behave, pos, yaw, state, policy,
+        (cfg.grid.extent_x, cfg.grid.extent_z),
+        nbr=state.nbr, nbr_cnt=state.nbr_cnt,
+    )
+
+    # 3. integrate + world clamp.
+    pos, moved = integrate(
+        pos, vel, state.npc_moving, cfg.dt,
+        cfg.bounds_min, cfg.bounds_max,
+    )
+    # state.dirty carries host-set pending force-syncs (spawn marks the
+    # new entity dirty so watchers get its position, the syncInfoFlag
+    # analog — Entity.go:1189-1205); consumed here, cleared below.
+    dirty = (moved | touched | state.dirty) & state.alive
+
+    # 4. AOI sweep (the go-aoi XZList replacement).
+    nbr, nbr_cnt = grid_neighbors(cfg.grid, pos, state.alive)
+
+    # 5. interest deltas -> bounded enter/leave pair lists.
+    enter_mask, leave_mask = interest_delta(state.nbr, nbr, n)
+    enter_w, enter_j, enter_n = masked_pairs(enter_mask, nbr, cfg.enter_cap)
+    leave_w, leave_j, leave_n = masked_pairs(
+        leave_mask, state.nbr, cfg.leave_cap
+    )
+
+    # 6. position sync records (CollectEntitySyncInfos analog).
+    sync_w, sync_j, sync_vals, sync_n = collect_sync(
+        nbr, dirty, state.has_client, pos, yaw, cfg.sync_cap
+    )
+
+    # 7. hot-attr deltas.
+    attr_e, attr_i, attr_v, attr_n = collect_attr_deltas(
+        state.hot_attrs, state.attr_dirty, cfg.attr_sync_cap
+    )
+
+    new_state = state.replace(
+        pos=pos,
+        yaw=yaw,
+        vel=vel,
+        nbr=nbr,
+        nbr_cnt=nbr_cnt,
+        dirty=jnp.zeros_like(state.dirty),
+        attr_dirty=jnp.zeros_like(state.attr_dirty),
+        rng=rng,
+        tick=state.tick + 1,
+    )
+    outputs = TickOutputs(
+        enter_w=enter_w, enter_j=enter_j, enter_n=enter_n,
+        leave_w=leave_w, leave_j=leave_j, leave_n=leave_n,
+        sync_w=sync_w, sync_j=sync_j, sync_vals=sync_vals, sync_n=sync_n,
+        attr_e=attr_e, attr_i=attr_i, attr_v=attr_v, attr_n=attr_n,
+        alive_count=state.alive.sum().astype(jnp.int32),
+    )
+    return new_state, outputs
+
+
 def make_tick(cfg: WorldConfig):
     """Build the jitted tick function for a WorldConfig.
 
@@ -86,83 +194,8 @@ def make_tick(cfg: WorldConfig):
     def tick(
         state: SpaceState, inputs: TickInputs, policy: MLPPolicy | None
     ) -> tuple[SpaceState, TickOutputs]:
-        n = cfg.capacity
-
-        # 1. client inputs (scatter).
-        pos, yaw, touched = apply_pos_inputs(
-            state.pos, state.yaw,
-            inputs.pos_sync_idx, inputs.pos_sync_vals, inputs.pos_sync_n,
-        )
-
-        # 2. behaviors (vectorized; MXU when behavior == 'mlp').
-        rng, k_behave = jax.random.split(state.rng)
-        if cfg.behavior == "mlp":
-            obs = build_obs(
-                pos, state.vel, yaw, state.nbr, state.nbr_cnt,
-                (cfg.grid.extent_x, cfg.grid.extent_z),
-            )
-            accel = policy_accel(policy, obs)
-            vel = state.vel + accel * cfg.dt
-            # cap speed by XZ magnitude (not per-axis) so diagonal movers
-            # respect cfg.npc_speed like any other heading
-            speed = jnp.sqrt(vel[:, 0] ** 2 + vel[:, 2] ** 2 + 1e-12)
-            scale = jnp.minimum(1.0, cfg.npc_speed / speed)
-            vel = vel * scale[:, None]
-            vel = jnp.where(state.npc_moving[:, None], vel, 0.0)
-        else:
-            vel = random_walk_step(
-                k_behave, state.vel, state.npc_moving,
-                cfg.npc_speed, cfg.turn_prob,
-            )
-
-        # 3. integrate + world clamp.
-        pos, moved = integrate(
-            pos, vel, state.npc_moving, cfg.dt,
-            cfg.bounds_min, cfg.bounds_max,
-        )
-        # state.dirty carries host-set pending force-syncs (spawn marks the
-        # new entity dirty so watchers get its position, the syncInfoFlag
-        # analog — Entity.go:1189-1205); consumed here, cleared below.
-        dirty = (moved | touched | state.dirty) & state.alive
-
-        # 4. AOI sweep (the go-aoi XZList replacement).
-        nbr, nbr_cnt = grid_neighbors(cfg.grid, pos, state.alive)
-
-        # 5. interest deltas -> bounded enter/leave pair lists.
-        enter_mask, leave_mask = interest_delta(state.nbr, nbr, n)
-        enter_w, enter_j, enter_n = masked_pairs(enter_mask, nbr, cfg.enter_cap)
-        leave_w, leave_j, leave_n = masked_pairs(
-            leave_mask, state.nbr, cfg.leave_cap
-        )
-
-        # 6. position sync records (CollectEntitySyncInfos analog).
-        sync_w, sync_j, sync_vals, sync_n = collect_sync(
-            nbr, dirty, state.has_client, pos, yaw, cfg.sync_cap
-        )
-
-        # 7. hot-attr deltas.
-        attr_e, attr_i, attr_v, attr_n = collect_attr_deltas(
-            state.hot_attrs, state.attr_dirty, cfg.attr_sync_cap
-        )
-
-        new_state = state.replace(
-            pos=pos,
-            yaw=yaw,
-            vel=vel,
-            nbr=nbr,
-            nbr_cnt=nbr_cnt,
-            dirty=jnp.zeros_like(state.dirty),
-            attr_dirty=jnp.zeros_like(state.attr_dirty),
-            rng=rng,
-            tick=state.tick + 1,
-        )
-        outputs = TickOutputs(
-            enter_w=enter_w, enter_j=enter_j, enter_n=enter_n,
-            leave_w=leave_w, leave_j=leave_j, leave_n=leave_n,
-            sync_w=sync_w, sync_j=sync_j, sync_vals=sync_vals, sync_n=sync_n,
-            attr_e=attr_e, attr_i=attr_i, attr_v=attr_v, attr_n=attr_n,
-            alive_count=state.alive.sum().astype(jnp.int32),
-        )
-        return new_state, outputs
+        return tick_body(cfg, state, inputs, policy)
 
     return tick
+
+

@@ -88,9 +88,12 @@ def cell_ids(spec: GridSpec, pos: jax.Array, alive: jax.Array) -> jax.Array:
     return jnp.where(alive, cid, spec.cells_x * spec.cells_z)
 
 
-@partial(jax.jit, static_argnums=0)
+@partial(jax.jit, static_argnums=(0, 3))
 def grid_neighbors(
-    spec: GridSpec, pos: jax.Array, alive: jax.Array
+    spec: GridSpec,
+    pos: jax.Array,
+    alive: jax.Array,
+    query_rows: int | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Compute AOI neighbor lists for every entity.
 
@@ -99,12 +102,17 @@ def grid_neighbors(
       pos: float32[N, 3] positions (x, y, z); AOI uses x and z only,
         matching the reference's XZList manager.
       alive: bool[N] slot-occupied mask.
+      query_rows: if set, only rows [0, query_rows) get neighbor lists while
+        all N entities remain candidates — megaspaces append ghost rows at
+        the end that must be visible but never watch
+        (:mod:`goworld_tpu.parallel.megaspace`).
 
     Returns:
-      nbr: int32[N, k] neighbor slot ids, ascending, padded with sentinel N.
-      cnt: int32[N] number of valid neighbors per row.
+      nbr: int32[Q, k] neighbor slot ids, ascending, padded with sentinel N.
+      cnt: int32[Q] number of valid neighbors per row. (Q = query_rows or N)
     """
     n = pos.shape[0]
+    q = n if query_rows is None else query_rows
     k = spec.k
     cc = spec.cell_cap
     sentinel = n
@@ -160,9 +168,9 @@ def grid_neighbors(
         nbr_b = jnp.sort(nbr_b, axis=1)                      # ascending ids
         return nbr_b, ok.sum(axis=1).astype(jnp.int32)
 
-    nblocks = -(-n // spec.row_block)
+    nblocks = -(-q // spec.row_block)
     padded = nblocks * spec.row_block
-    all_rows = jnp.minimum(jnp.arange(padded, dtype=jnp.int32), n - 1)
+    all_rows = jnp.minimum(jnp.arange(padded, dtype=jnp.int32), q - 1)
     blocks = all_rows.reshape(nblocks, spec.row_block)
     if nblocks == 1:
         nbr, cnt = row_block(blocks[0])
@@ -170,7 +178,7 @@ def grid_neighbors(
         nbr, cnt = lax.map(row_block, blocks)
         nbr = nbr.reshape(padded, k)
         cnt = cnt.reshape(padded)
-    return nbr[:n], cnt[:n]
+    return nbr[:q], cnt[:q]
 
 
 def neighbors_oracle(pos, alive, radius):

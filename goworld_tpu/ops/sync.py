@@ -41,11 +41,17 @@ def collect_sync(
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Collect position/yaw sync records for client-owning watchers.
 
+    The subject id space may be LARGER than the watcher row space: for
+    sharded megaspaces (:mod:`goworld_tpu.parallel.megaspace`) neighbor ids
+    index the extended local+ghost population, so ``dirty``/``pos``/``yaw``
+    have P >= N entries and the sentinel is P (derived from ``pos``), while
+    ``has_client`` indexes the N local watcher rows.
+
     Args:
-      nbr: int32[N, k] sorted neighbor lists (sentinel N).
-      dirty: bool[N] moved-this-tick mask.
+      nbr: int32[N, k] sorted neighbor lists (ids in [0, P), sentinel P).
+      dirty: bool[P] subject moved-this-tick mask.
       has_client: bool[N] watcher owns a connected client.
-      pos: f32[N, 3]; yaw: f32[N].
+      pos: f32[P, 3]; yaw: f32[P].
       cap: static max records.
 
     Returns:
@@ -53,16 +59,17 @@ def collect_sync(
       count int32 (true demand; may exceed cap).
     """
     n, k = nbr.shape
-    sentinel = n
+    p = pos.shape[0]
+    sentinel = p
     valid_nbr = nbr != sentinel
-    nbr_c = jnp.minimum(nbr, n - 1)
+    nbr_c = jnp.minimum(nbr, p - 1)
     watch = has_client[:, None] & valid_nbr & dirty[nbr_c]
 
     flat, valid, count = bounded_extract(watch, cap)
     watcher = jnp.where(valid, flat // k, -1)
     subject_raw = nbr_c.ravel()[flat]
     subject = jnp.where(valid, subject_raw, -1)
-    sub_c = jnp.minimum(subject_raw, n - 1)
+    sub_c = jnp.minimum(subject_raw, p - 1)
     vals = jnp.concatenate([pos[sub_c], yaw[sub_c, None]], axis=1)
     vals = jnp.where(valid[:, None], vals, 0.0)
     return watcher, subject, vals, count

@@ -1,0 +1,38 @@
+"""Mesh sharding: spaces pinned to TPU cores, collectives instead of sockets.
+
+This layer replaces the reference's cluster-communication backend — the
+N-dispatcher sharded star over TCP (``engine/dispatchercluster``,
+``components/dispatcher``) — *within* a TPU mesh:
+
+* space-per-core sharding via ``jax.shard_map`` (:mod:`.step`) — the analog
+  of P1/P2 horizontal scaling (``SURVEY.md#2.4``),
+* entity migration as an ``all_to_all`` row exchange at tick boundaries
+  (:mod:`.migrate`) — replacing the dispatcher's block-and-queue migration
+  protocol (``DispatcherService.go:850-891``),
+* giant sharded Spaces with ring/halo AOI ghost exchange over ``ppermute``
+  (:mod:`.halo`) — the long-context analog (``SURVEY.md#5.7``),
+* global barriers/counters via ``psum``.
+
+Because the mesh is synchronous, migration needs no per-entity blocking
+router: emigrant rows leave and arrive inside one compiled step, and the
+host re-points EntityID -> (space, slot) from the arrival records.
+"""
+
+from goworld_tpu.parallel.mesh import make_mesh, create_multi_state, shard_state
+from goworld_tpu.parallel.step import (
+    MultiTickInputs,
+    MultiTickOutputs,
+    make_multi_tick,
+)
+from goworld_tpu.parallel.megaspace import MegaConfig, make_mega_tick
+
+__all__ = [
+    "make_mesh",
+    "create_multi_state",
+    "shard_state",
+    "MultiTickInputs",
+    "MultiTickOutputs",
+    "make_multi_tick",
+    "MegaConfig",
+    "make_mega_tick",
+]

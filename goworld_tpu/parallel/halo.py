@@ -1,0 +1,88 @@
+"""Ring/halo AOI ghost exchange for Spaces sharded across devices.
+
+The reference cannot shard one Space: a space lives wholly on one game
+process and user code caps its population (``doc.go:12-14``,
+``SpaceService.go:14`` <=100 avatars/space). The rebuild's flagship upgrade
+(``SURVEY.md#5.7``) is a Space whose entity SoA spans the mesh as spatial
+tiles along x; AOI then needs each tile to see the ``radius``-wide strips of
+its left/right neighbor tiles. Structurally identical to ring attention's
+block rotation: bounded ghost buffers rotate over ICI with ``ppermute``
+while each shard computes locally.
+
+Ghost buffers are fixed capacity ``halo_cap``; entities in a boundary strip
+beyond the cap are dropped from the neighbor's view that tick (the AOI-limit
+tradeoff again — size halo_cap for the worst expected strip density).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from goworld_tpu.ops.extract import bounded_extract
+
+
+def exchange_halo(
+    axis: str,
+    n_dev: int,
+    pos: jax.Array,        # f32[N, 3] (global coords)
+    yaw: jax.Array,
+    dirty: jax.Array,      # bool[N]
+    alive: jax.Array,
+    tile_w: float,
+    radius: float,
+    halo_cap: int,
+):
+    """Ship boundary strips to lateral neighbor tiles.
+
+    Returns a ghost block of size 2*halo_cap (left-neighbor ghosts then
+    right-neighbor ghosts): (gpos f32[2H,3], gyaw f32[2H], gdirty bool[2H],
+    gvalid bool[2H], ggid i32[2H] global entity ids = owner_dev * N + slot),
+    plus ``strip_demand`` i32: the true occupancy of this shard's fuller
+    boundary strip (host alarm when it exceeds halo_cap — ghosts beyond the
+    cap were invisible to the neighbor tile this tick).
+    """
+    n = pos.shape[0]
+    d = lax.axis_index(axis)
+    tile_min = d.astype(jnp.float32) * tile_w
+    x = pos[:, 0]
+
+    def pack(mask):
+        flat, valid, demand = bounded_extract(mask, halo_cap)
+        slots = jnp.where(valid, flat, n - 1)
+        return (
+            jnp.where(valid[:, None], pos[slots], 0.0),
+            jnp.where(valid, yaw[slots], 0.0),
+            dirty[slots] & valid,
+            valid,
+            jnp.where(valid, d * n + slots, -1),
+        ), demand
+
+    left_pack, left_demand = pack(alive & (x < tile_min + radius))
+    right_pack, right_demand = pack(alive & (x >= tile_min + tile_w - radius))
+    # edge tiles don't ship their outward strip — exclude it from the
+    # capacity alarm so a crowd at the world border can't trigger a false
+    # "widen halo_cap" recompile
+    strip_demand = jnp.maximum(
+        jnp.where(d > 0, left_demand, 0),
+        jnp.where(d < n_dev - 1, right_demand, 0),
+    )
+
+    # my left strip is a ghost for tile d-1; my right strip for tile d+1.
+    # Non-periodic: edge tiles receive zeros (gvalid False).
+    to_left = [(i, i - 1) for i in range(1, n_dev)]
+    to_right = [(i, i + 1) for i in range(n_dev - 1)]
+    from_right = jax.tree.map(
+        lambda t: lax.ppermute(t, axis, to_left), left_pack
+    )
+    from_left = jax.tree.map(
+        lambda t: lax.ppermute(t, axis, to_right), right_pack
+    )
+
+    gpos = jnp.concatenate([from_left[0], from_right[0]])
+    gyaw = jnp.concatenate([from_left[1], from_right[1]])
+    gdirty = jnp.concatenate([from_left[2], from_right[2]])
+    gvalid = jnp.concatenate([from_left[3], from_right[3]])
+    ggid = jnp.concatenate([from_left[4], from_right[4]])
+    return gpos, gyaw, gdirty, gvalid, ggid, strip_demand

@@ -1,0 +1,247 @@
+"""One giant Space sharded across the mesh as spatial tiles (megaspace).
+
+The reference's scaling unit is the Space pinned to one process; population
+per space is capped in user code (``SpaceService.go:14``). A megaspace
+removes that ceiling: entities live in x-interval tiles (device d owns
+``x in [d*tile_w, (d+1)*tile_w)``), AOI sees across tile borders via the
+ring/halo ghost exchange (:mod:`goworld_tpu.parallel.halo`), and entities
+crossing a border migrate automatically through the all_to_all row exchange
+(:mod:`goworld_tpu.parallel.migrate`) — no EnterSpace call, no dispatcher.
+
+Identity across the megaspace is the global id ``gid = shard * N + slot``.
+Neighbor lists in state hold gids (sentinel ``n_dev * N``), so interest
+deltas stay stable while ghost buffer order changes tick to tick, and
+enter/leave/sync records emitted to the host reference gids directly.
+
+BASELINE config 4 (64 spaces / 1M entities over ICI) is this module at
+n_dev=64; config 2 is :mod:`goworld_tpu.core.step` at n_dev=1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax.sharding import Mesh, PartitionSpec as P
+
+from goworld_tpu.core.state import SpaceState, WorldConfig
+from goworld_tpu.core.step import TickOutputs, compute_velocity
+from goworld_tpu.ops.aoi import grid_neighbors
+from goworld_tpu.ops.delta import interest_delta, masked_pairs
+from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
+from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
+from goworld_tpu.parallel import migrate as mig
+from goworld_tpu.parallel.halo import exchange_halo
+from goworld_tpu.parallel.mesh import SPACE_AXIS
+from goworld_tpu.parallel.step import MultiTickInputs
+
+
+@dataclasses.dataclass(frozen=True)
+class MegaConfig:
+    """Static megaspace configuration.
+
+    ``cfg.grid`` describes the TILE-LOCAL grid in shifted coordinates:
+    origin 0, ``extent_x = tile_w + 2 * radius`` (one halo margin on each
+    side), ``extent_z`` = the world's z extent.
+    """
+
+    cfg: WorldConfig
+    n_dev: int
+    tile_w: float
+    halo_cap: int = 1024
+    migrate_cap: int = 256
+
+    def __post_init__(self):
+        g = self.cfg.grid
+        expected = self.tile_w + 2.0 * g.radius
+        if abs(g.extent_x - expected) > 1e-6:
+            raise ValueError(
+                f"grid.extent_x must be tile_w + 2*radius = {expected}, "
+                f"got {g.extent_x}"
+            )
+        if g.origin_x != 0.0 or g.origin_z != 0.0:
+            raise ValueError(
+                "megaspace grids use tile-shifted coordinates; "
+                "grid.origin_x/origin_z must be 0"
+            )
+
+    @property
+    def world_x(self) -> float:
+        return self.tile_w * self.n_dev
+
+    @property
+    def gid_sentinel(self) -> int:
+        return self.n_dev * self.cfg.capacity
+
+
+@struct.dataclass
+class MegaTickOutputs:
+    base: TickOutputs          # j ids are GLOBAL gids; w are local slots
+    arr_tag: jax.Array         # i32[n_dev, n_dev*mcap]: old gid of arrival
+    arr_slot: jax.Array        # i32[n_dev, n_dev*mcap]: new local slot
+    arr_n: jax.Array           # i32[n_dev]
+    migrate_dropped: jax.Array  # i32[n_dev]
+    migrate_demand: jax.Array  # i32[n_dev, n_dev] true per-dest emigrants
+                               # (alarm when > migrate_cap: surplus entities
+                               # linger on the wrong tile with degraded AOI)
+    halo_demand: jax.Array     # i32[n_dev] boundary strip occupancy (alarm
+                               # when > halo_cap)
+    global_alive: jax.Array    # i32[n_dev]
+
+
+def create_mega_state(mc: MegaConfig, seed: int = 0) -> SpaceState:
+    """Stacked per-tile state with GLOBAL-id neighbor lists."""
+    from goworld_tpu.parallel.mesh import create_multi_state
+
+    st = create_multi_state(mc.cfg, mc.n_dev, seed)
+    return st.replace(
+        nbr=jnp.full_like(st.nbr, mc.gid_sentinel),
+        nbr_cnt=jnp.zeros_like(st.nbr_cnt),
+    )
+
+
+def make_mega_tick(mc: MegaConfig, mesh: Mesh):
+    """Build the jitted megaspace step. Signature matches make_multi_tick:
+    ``step(states, inputs, policy) -> (states, MegaTickOutputs)`` with
+    leading [n_dev] axes; ``inputs.migrate_target`` is ignored (tile
+    migration is automatic from position)."""
+    cfg = mc.cfg
+    n = cfg.capacity
+    n_dev = mc.n_dev
+    radius = cfg.grid.radius
+    gsent = mc.gid_sentinel
+
+    def shard_fn(state, inputs: MultiTickInputs, policy):
+        state = jax.tree.map(lambda x: x[0], state)
+        inputs = jax.tree.map(lambda x: x[0], inputs)
+        d = jax.lax.axis_index(SPACE_AXIS)
+        tile_min = d.astype(jnp.float32) * mc.tile_w
+
+        # 1. client inputs (global coords), behaviors, integrate over the
+        #    WHOLE world extent (not the tile: movers cross borders freely).
+        pos, yaw, touched = apply_pos_inputs(
+            state.pos, state.yaw,
+            inputs.base.pos_sync_idx, inputs.base.pos_sync_vals,
+            inputs.base.pos_sync_n,
+        )
+        rng, k_behave = jax.random.split(state.rng)
+        # state.nbr holds GLOBAL gids here, not valid local gather indices —
+        # nbr=None gives the MLP a neighbor-free observation (neighbor-aware
+        # mega policies need the ghost block; TODO).
+        vel = compute_velocity(
+            cfg, k_behave, pos, yaw, state, policy,
+            (mc.world_x, cfg.grid.extent_z), nbr=None, nbr_cnt=None,
+        )
+        pos, moved = integrate(
+            pos, vel, state.npc_moving, cfg.dt,
+            (0.0, -1e9, 0.0), (mc.world_x, 1e9, cfg.grid.extent_z),
+        )
+        state = state.replace(pos=pos, yaw=yaw, vel=vel, rng=rng)
+        pre_dirty = (moved | touched | state.dirty) & state.alive
+
+        # 2. automatic tile migration from position.
+        tgt = jnp.clip(
+            jnp.floor(pos[:, 0] / mc.tile_w).astype(jnp.int32), 0, n_dev - 1
+        )
+        tgt = jnp.where(state.alive & (tgt != d), tgt, -1)
+        tag = d * n + jnp.arange(n, dtype=jnp.int32)   # old gid as tag
+        fbuf, ibuf, departed, mig_demand = mig.pack_emigrants(
+            state, tgt, tag, n_dev, mc.migrate_cap
+        )
+        state = mig.despawn_departed(state, departed)
+        pre_dirty &= ~departed
+        fbuf = jax.lax.all_to_all(fbuf, SPACE_AXIS, 0, 0, tiled=True)
+        ibuf = jax.lax.all_to_all(ibuf, SPACE_AXIS, 0, 0, tiled=True)
+        state, arr_tag, arr_slot, arr_n, dropped = mig.insert_arrivals(
+            state, fbuf, ibuf, nbr_sentinel=gsent, quarantine=departed
+        )
+        dirty = pre_dirty | state.dirty   # arrivals force-sync
+
+        # 3. halo ghost exchange (ring ppermute).
+        gpos, gyaw, gdirty, gvalid, ggid, halo_demand = exchange_halo(
+            SPACE_AXIS, n_dev, state.pos, state.yaw, dirty, state.alive,
+            mc.tile_w, radius, mc.halo_cap,
+        )
+
+        # 4. AOI over the extended local+ghost population, in tile-shifted
+        #    coordinates so the static grid covers [0, tile_w + 2R).
+        pos_ext = jnp.concatenate([state.pos, gpos])
+        shift = jnp.array([tile_min - radius, 0.0, 0.0], jnp.float32)
+        alive_ext = jnp.concatenate([state.alive, gvalid])
+        # ghosts are candidates but never watchers: query only local rows
+        nbr_ext, nbr_cnt = grid_neighbors(
+            cfg.grid, pos_ext - shift, alive_ext, query_rows=n
+        )
+
+        # 5. translate to stable GLOBAL ids, diff against previous tick.
+        gid_ext = jnp.concatenate(
+            [d * n + jnp.arange(n, dtype=jnp.int32), ggid]
+        )
+        p_ext = n + 2 * mc.halo_cap
+        nbr_gid = jnp.where(
+            nbr_ext == p_ext, gsent,
+            gid_ext[jnp.minimum(nbr_ext, p_ext - 1)],
+        )
+        nbr_gid = jnp.sort(nbr_gid, axis=1)
+        enter_mask, leave_mask = interest_delta(state.nbr, nbr_gid, gsent)
+        enter_w, enter_j, enter_n = masked_pairs(
+            enter_mask, nbr_gid, cfg.enter_cap
+        )
+        leave_w, leave_j, leave_n = masked_pairs(
+            leave_mask, state.nbr, cfg.leave_cap
+        )
+
+        # 6. sync records over the extended population; subjects -> gids.
+        dirty_ext = jnp.concatenate([dirty, gdirty])
+        yaw_ext = jnp.concatenate([state.yaw, gyaw])
+        sync_w, sync_j, sync_vals, sync_n = collect_sync(
+            nbr_ext, dirty_ext, state.has_client, pos_ext, yaw_ext,
+            cfg.sync_cap,
+        )
+        sync_j = jnp.where(
+            sync_j >= 0, gid_ext[jnp.clip(sync_j, 0, p_ext - 1)], -1
+        )
+
+        # 7. attr deltas (local only; ghosts' attrs sync on their own shard).
+        attr_e, attr_i, attr_v, attr_n = collect_attr_deltas(
+            state.hot_attrs, state.attr_dirty, cfg.attr_sync_cap
+        )
+
+        global_alive = jax.lax.psum(
+            state.alive.sum().astype(jnp.int32), SPACE_AXIS
+        )
+        state = state.replace(
+            nbr=nbr_gid,
+            nbr_cnt=nbr_cnt,
+            dirty=jnp.zeros_like(state.dirty),
+            attr_dirty=jnp.zeros_like(state.attr_dirty),
+            tick=state.tick + 1,
+        )
+        outputs = MegaTickOutputs(
+            base=TickOutputs(
+                enter_w=enter_w, enter_j=enter_j, enter_n=enter_n,
+                leave_w=leave_w, leave_j=leave_j, leave_n=leave_n,
+                sync_w=sync_w, sync_j=sync_j, sync_vals=sync_vals,
+                sync_n=sync_n,
+                attr_e=attr_e, attr_i=attr_i, attr_v=attr_v, attr_n=attr_n,
+                alive_count=state.alive.sum().astype(jnp.int32),
+            ),
+            arr_tag=arr_tag, arr_slot=arr_slot, arr_n=arr_n,
+            migrate_dropped=dropped,
+            migrate_demand=mig_demand,
+            halo_demand=halo_demand,
+            global_alive=global_alive,
+        )
+        state = jax.tree.map(lambda x: x[None], state)
+        outputs = jax.tree.map(lambda x: x[None], outputs)
+        return state, outputs
+
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(P(SPACE_AXIS), P(SPACE_AXIS), P()),
+        out_specs=(P(SPACE_AXIS), P(SPACE_AXIS)),
+    )
+    return jax.jit(mapped)

@@ -1,0 +1,174 @@
+"""Entity migration as an all_to_all row exchange at tick boundaries.
+
+Reference protocol being replaced: ``EnterSpace`` on a remote space runs a
+3-phase dance — query the space's game, block the entity's packet queue at
+the dispatcher (60 s timeout), msgpack all attrs + timers, destroy, recreate
+on the target game, unblock (``Entity.go:956-1115``,
+``DispatcherService.go:834-891``). The blocking router exists because the
+processes are asynchronous.
+
+A synchronous mesh needs none of that: each shard packs up to ``cap``
+emigrant SoA rows per destination into a fixed ``[n_dev, cap, F]`` buffer,
+one ``lax.all_to_all`` moves every buffer simultaneously over ICI, and each
+shard scatters arrivals into free slots — all inside the compiled step.
+In-flight RPCs re-route host-side using the (tag -> new slot) arrival records
+the step emits; there is no window where the entity is addressable in two
+places because the move is atomic within the tick.
+
+Cold host-side entity state (nested attrs, timers) travels on the host lane
+keyed by the same migration tag (:mod:`goworld_tpu.entity` stages it), so
+the device path moves only hot SoA rows.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from goworld_tpu.core.state import SpaceState
+from goworld_tpu.ops.extract import bounded_extract
+
+# int-lane fields per migrating row
+I_TYPE, I_HAS_CLIENT, I_CLIENT_GATE, I_TAG, I_NPC_MOVING, I_VALID = range(6)
+I_FIELDS = 6
+
+
+def pack_emigrants(
+    state: SpaceState,
+    target: jax.Array,   # i32[N]: destination shard, -1 = stay
+    tag: jax.Array,      # i32[N]: host-assigned migration tag
+    n_dev: int,
+    cap: int,
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Build per-destination send buffers and the departed mask.
+
+    Returns:
+      fbuf: f32[n_dev, cap, 7+A] (pos, yaw, vel, hot_attrs)
+      ibuf: i32[n_dev, cap, I_FIELDS]
+      departed: bool[N] rows actually packed (despawn them locally)
+      demand: i32[n_dev] true per-destination emigrant counts (may exceed cap;
+        surplus entities stay put this tick and retry next tick — bounded
+        buffers are the backpressure, like the reference's pending queue caps)
+    """
+    n = state.pos.shape[0]
+    emigrate = (target >= 0) & (target < n_dev) & state.alive
+    dst_mask = (
+        target[None, :] == jnp.arange(n_dev, dtype=jnp.int32)[:, None]
+    ) & emigrate[None, :]                                       # [D, N]
+
+    flat, valid, demand = jax.vmap(
+        partial(bounded_extract, cap=cap)
+    )(dst_mask)                                                 # [D, cap]
+    slots = jnp.where(valid, flat, n - 1)                       # safe gather
+
+    fbuf = jnp.concatenate(
+        [
+            state.pos[slots],                                   # [D, cap, 3]
+            state.yaw[slots][..., None],
+            state.vel[slots],
+            state.hot_attrs[slots],
+        ],
+        axis=-1,
+    )
+    fbuf = jnp.where(valid[..., None], fbuf, 0.0)
+    ibuf = jnp.stack(
+        [
+            state.type_id[slots],
+            state.has_client[slots].astype(jnp.int32),
+            state.client_gate[slots],
+            tag[slots],
+            state.npc_moving[slots].astype(jnp.int32),
+            valid.astype(jnp.int32),
+        ],
+        axis=-1,
+    )
+    ibuf = jnp.where(valid[..., None], ibuf, 0)
+
+    drop_slots = jnp.where(valid, flat, n)                      # n = no-op row
+    departed = (
+        jnp.zeros(n, bool).at[drop_slots.ravel()].set(True, mode="drop")
+    )
+    return fbuf, ibuf, departed, demand
+
+
+def despawn_departed(state: SpaceState, departed: jax.Array) -> SpaceState:
+    keep = ~departed
+    return state.replace(
+        alive=state.alive & keep,
+        has_client=state.has_client & keep,
+        npc_moving=state.npc_moving & keep,
+        dirty=state.dirty & keep,
+        client_gate=jnp.where(departed, -1, state.client_gate),
+        attr_dirty=jnp.where(departed, jnp.uint32(0), state.attr_dirty),
+    )
+
+
+def insert_arrivals(
+    state: SpaceState,
+    fbuf: jax.Array,     # f32[n_dev, cap, 7+A] (post-all_to_all: from each src)
+    ibuf: jax.Array,     # i32[n_dev, cap, I_FIELDS]
+    nbr_sentinel: int,
+    quarantine: jax.Array | None = None,
+) -> tuple[SpaceState, jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Scatter arriving rows into free slots.
+
+    ``quarantine`` (bool[N]) marks slots freed THIS tick (departed
+    emigrants): they are excluded from reuse for one tick so their stale
+    interest lists still produce the previous occupant's leave events on the
+    next diff — otherwise those leaves would be dropped or kept depending on
+    free-slot pressure (the reference always fires OnLeaveAOI on destroy,
+    ``Entity.go:631-651``).
+
+    Returns (state, arr_tag i32[D*cap], arr_slot i32[D*cap], arr_n i32,
+    dropped i32). arr_slot is -1 past arr_n. ``dropped`` counts arrivals
+    that found no free slot (host must treat as fatal capacity misconfig).
+    """
+    n = state.pos.shape[0]
+    a = state.hot_attrs.shape[1]
+    d, cap, _ = fbuf.shape
+    total = d * cap
+
+    f = fbuf.reshape(total, 7 + a)
+    i = ibuf.reshape(total, I_FIELDS)
+    arr_valid = i[:, I_VALID] > 0
+
+    free_mask = ~state.alive
+    if quarantine is not None:
+        free_mask &= ~quarantine
+    free_flat, free_valid, free_cnt = bounded_extract(free_mask, total)
+    rank = jnp.cumsum(arr_valid.astype(jnp.int32)) - 1         # [total]
+    can = arr_valid & (rank < jnp.minimum(free_cnt, total)) & (rank >= 0)
+    slot = jnp.where(can, free_flat[jnp.clip(rank, 0, total - 1)], n)
+
+    st = state.replace(
+        pos=state.pos.at[slot].set(f[:, 0:3], mode="drop"),
+        yaw=state.yaw.at[slot].set(f[:, 3], mode="drop"),
+        vel=state.vel.at[slot].set(f[:, 4:7], mode="drop"),
+        hot_attrs=state.hot_attrs.at[slot].set(f[:, 7:], mode="drop"),
+        type_id=state.type_id.at[slot].set(i[:, I_TYPE], mode="drop"),
+        has_client=state.has_client.at[slot].set(
+            i[:, I_HAS_CLIENT] > 0, mode="drop"
+        ),
+        client_gate=state.client_gate.at[slot].set(
+            i[:, I_CLIENT_GATE], mode="drop"
+        ),
+        npc_moving=state.npc_moving.at[slot].set(
+            i[:, I_NPC_MOVING] > 0, mode="drop"
+        ),
+        alive=state.alive.at[slot].set(True, mode="drop"),
+        dirty=state.dirty.at[slot].set(True, mode="drop"),
+        gen=state.gen.at[slot].add(1, mode="drop"),
+        attr_dirty=state.attr_dirty.at[slot].set(jnp.uint32(0), mode="drop"),
+        # stale interest of the slot's previous occupant must not produce
+        # phantom enter/leave diffs for the newcomer
+        nbr=state.nbr.at[slot].set(nbr_sentinel, mode="drop"),
+        nbr_cnt=state.nbr_cnt.at[slot].set(0, mode="drop"),
+    )
+    arr_n = can.sum().astype(jnp.int32)
+    dropped = (arr_valid & ~can).sum().astype(jnp.int32)
+    order = jnp.argsort(~can)                  # compact accepted to front
+    arr_tag = jnp.where(can, i[:, I_TAG], -1)[order]
+    arr_slot = jnp.where(can, slot, -1)[order]
+    return st, arr_tag, arr_slot, arr_n, dropped

@@ -1,0 +1,265 @@
+"""Multi-space and megaspace sharding tests on the 8-device CPU mesh.
+
+Covers the TPU replacements for the reference's distributed machinery:
+all_to_all entity migration (vs the dispatcher's block-and-queue protocol,
+DispatcherService.go:850-891), ring-halo cross-tile AOI (SURVEY.md#5.7),
+and psum global stats."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from goworld_tpu.core import TickInputs, WorldConfig
+from goworld_tpu.core.state import spawn
+from goworld_tpu.ops.aoi import GridSpec
+from goworld_tpu.parallel import (
+    MegaConfig,
+    MultiTickInputs,
+    create_multi_state,
+    make_mesh,
+    make_multi_tick,
+    make_mega_tick,
+)
+from goworld_tpu.parallel.megaspace import create_mega_state
+
+D = 8
+
+
+def small_cfg(**kw):
+    base = dict(
+        capacity=32,
+        grid=GridSpec(radius=10.0, extent_x=100.0, extent_z=100.0,
+                      k=8, cell_cap=32, row_block=32),
+    )
+    base.update(kw)
+    return WorldConfig(**base)
+
+
+def spawn_on(states, dev, slot, **kw):
+    one = jax.tree.map(lambda x: x[dev], states)
+    one = spawn(one, slot, **kw)
+    return jax.tree.map(
+        lambda full, new: full.at[dev].set(new), states, one
+    )
+
+
+class TestMultiSpace:
+    def test_independent_spaces_tick(self):
+        cfg = small_cfg()
+        mesh = make_mesh(D)
+        step = make_multi_tick(cfg, mesh, migrate_cap=4)
+        st = create_multi_state(cfg, D)
+        st = spawn_on(st, 0, 0, pos=(50.0, 0, 50.0))
+        st = spawn_on(st, 0, 1, pos=(52.0, 0, 50.0))
+        st = spawn_on(st, 3, 0, pos=(50.0, 0, 50.0))
+        st, out = step(st, MultiTickInputs.empty(cfg, D), None)
+        assert int(out.global_alive[0]) == 3
+        assert (np.asarray(out.global_alive) == 3).all()
+        # AOI is per-space: shard 0 sees a pair, shard 3 sees nobody
+        assert int(out.base.enter_n[0]) == 2
+        assert int(out.base.enter_n[3]) == 0
+
+    def test_migration_moves_entity_and_reports_mapping(self):
+        cfg = small_cfg()
+        mesh = make_mesh(D)
+        step = make_multi_tick(cfg, mesh, migrate_cap=4)
+        st = create_multi_state(cfg, D)
+        st = spawn_on(st, 1, 5, pos=(20.0, 0, 30.0), type_id=7,
+                      has_client=True, client_gate=2,
+                      hot_attrs=[9.0] * cfg.attr_width)
+        inp = MultiTickInputs.empty(cfg, D)
+        inp = inp.replace(
+            migrate_target=inp.migrate_target.at[1, 5].set(6),
+            migrate_tag=inp.migrate_tag.at[1, 5].set(12345),
+        )
+        st, out = step(st, inp, None)
+        # departed from shard 1
+        assert not bool(st.alive[1, 5])
+        # arrived on shard 6 with mapping record
+        assert int(out.arr_n[6]) == 1
+        tag = int(np.asarray(out.arr_tag[6])[0])
+        slot = int(np.asarray(out.arr_slot[6])[0])
+        assert tag == 12345 and slot >= 0
+        assert bool(st.alive[6, slot])
+        assert int(st.type_id[6, slot]) == 7
+        assert bool(st.has_client[6, slot])
+        assert int(st.client_gate[6, slot]) == 2
+        assert np.allclose(np.asarray(st.hot_attrs[6, slot]), 9.0)
+        assert np.allclose(np.asarray(st.pos[6, slot]), [20.0, 0, 30.0])
+        assert (np.asarray(out.global_alive) == 1).all()
+        assert int(out.migrate_dropped.sum()) == 0
+        # nothing arrived anywhere else
+        for dd in range(D):
+            if dd != 6:
+                assert int(out.arr_n[dd]) == 0
+
+    def test_migration_capacity_backpressure(self):
+        cfg = small_cfg()
+        mesh = make_mesh(D)
+        step = make_multi_tick(cfg, mesh, migrate_cap=2)
+        st = create_multi_state(cfg, D)
+        for s in range(5):  # 5 emigrants, cap 2 -> 3 stay behind
+            st = spawn_on(st, 0, s, pos=(10.0 + s, 0, 10.0))
+        inp = MultiTickInputs.empty(cfg, D)
+        for s in range(5):
+            inp = inp.replace(
+                migrate_target=inp.migrate_target.at[0, s].set(2),
+                migrate_tag=inp.migrate_tag.at[0, s].set(100 + s),
+            )
+        st, out = step(st, inp, None)
+        assert int(out.arr_n[2]) == 2
+        assert int(np.asarray(out.migrate_demand)[0, 2]) == 5
+        assert int(np.asarray(st.alive[0]).sum()) == 3  # surplus stayed
+        assert (np.asarray(out.global_alive) == 5).all()
+
+
+class TestMegaspace:
+    def mega(self, **kw):
+        cfg = small_cfg(
+            capacity=32,
+            grid=GridSpec(radius=10.0, extent_x=120.0, extent_z=100.0,
+                          k=8, cell_cap=32, row_block=96),
+            **kw,
+        )
+        return MegaConfig(cfg=cfg, n_dev=D, tile_w=100.0, halo_cap=16,
+                          migrate_cap=4)
+
+    def test_cross_tile_aoi_enters(self):
+        mc = self.mega()
+        mesh = make_mesh(D)
+        step = make_mega_tick(mc, mesh)
+        st = create_mega_state(mc)
+        # entity A on tile 2 at x=295 (5 from border), B on tile 3 at x=302
+        st = spawn_on(st, 2, 0, pos=(295.0, 0, 50.0))
+        st = spawn_on(st, 3, 0, pos=(302.0, 0, 50.0))
+        st, out = step(st, MultiTickInputs.empty(mc.cfg, D), None)
+        gid_a = 2 * mc.cfg.capacity + 0
+        gid_b = 3 * mc.cfg.capacity + 0
+        enters2 = {(int(w), int(j)) for w, j in
+                   zip(np.asarray(out.base.enter_w[2])[: int(out.base.enter_n[2])],
+                       np.asarray(out.base.enter_j[2])[: int(out.base.enter_n[2])])}
+        enters3 = {(int(w), int(j)) for w, j in
+                   zip(np.asarray(out.base.enter_w[3])[: int(out.base.enter_n[3])],
+                       np.asarray(out.base.enter_j[3])[: int(out.base.enter_n[3])])}
+        assert (0, gid_b) in enters2          # A sees B across the border
+        assert (0, gid_a) in enters3          # B sees A across the border
+        assert int(out.halo_demand[2]) == 1 and int(out.halo_demand[3]) == 1
+
+    def test_cross_tile_sync_records(self):
+        mc = self.mega()
+        mesh = make_mesh(D)
+        step = make_mega_tick(mc, mesh)
+        st = create_mega_state(mc)
+        st = spawn_on(st, 2, 0, pos=(295.0, 0, 50.0), has_client=True)
+        st = spawn_on(st, 3, 1, pos=(302.0, 0, 50.0), npc_moving=True)
+        inp = MultiTickInputs.empty(mc.cfg, D)
+        st, out = step(st, inp, None)
+        st, out = step(st, inp, None)  # mover moves -> dirty ghost
+        gid_mover = 3 * mc.cfg.capacity + 1
+        w = np.asarray(out.base.sync_w[2])[: int(out.base.sync_n[2])]
+        j = np.asarray(out.base.sync_j[2])[: int(out.base.sync_n[2])]
+        assert int(out.base.sync_n[2]) >= 1
+        assert set(w.tolist()) == {0}
+        assert gid_mover in set(j.tolist())
+        # record position matches the mover's true state on its own shard
+        row = list(j.tolist()).index(gid_mover)
+        vals = np.asarray(out.base.sync_vals[2])[row]
+        assert np.allclose(vals[:3], np.asarray(st.pos[3, 1]), atol=1e-5)
+
+    def test_border_crossing_auto_migrates(self):
+        mc = self.mega()
+        mesh = make_mesh(D)
+        step = make_mega_tick(mc, mesh)
+        st = create_mega_state(mc)
+        st = spawn_on(st, 4, 3, pos=(401.0, 0, 50.0), type_id=9)
+        # teleport it across the border into tile 3 via client input
+        inp = MultiTickInputs.empty(mc.cfg, D)
+        base = inp.base
+        base = base.replace(
+            pos_sync_idx=base.pos_sync_idx.at[4, 0].set(3),
+            pos_sync_vals=base.pos_sync_vals.at[4, 0].set(
+                jnp.array([399.0, 0.0, 50.0, 0.0])),
+            pos_sync_n=base.pos_sync_n.at[4].set(1),
+        )
+        st, out = step(st, inp.replace(base=base), None)
+        assert not bool(st.alive[4, 3])
+        assert int(out.arr_n[3]) == 1
+        old_gid = 4 * mc.cfg.capacity + 3
+        assert int(np.asarray(out.arr_tag[3])[0]) == old_gid
+        new_slot = int(np.asarray(out.arr_slot[3])[0])
+        assert bool(st.alive[3, new_slot])
+        assert int(st.type_id[3, new_slot]) == 9
+        assert np.allclose(np.asarray(st.pos[3, new_slot]),
+                           [399.0, 0, 50.0])
+        assert (np.asarray(out.global_alive) == 1).all()
+
+    def test_mega_matches_oracle_at_density(self):
+        """Random world over all 8 tiles: cross-check the full neighbor
+        graph (via enter events on tick 1) against the NumPy oracle."""
+        mc = self.mega()
+        mesh = make_mesh(D)
+        step = make_mega_tick(mc, mesh)
+        st = create_mega_state(mc)
+        rng = np.random.default_rng(0)
+        gids, all_pos = [], {}
+        for i in range(40):
+            x = rng.uniform(0, 800.0)
+            z = rng.uniform(0, 100.0)
+            dev = min(int(x // 100.0), D - 1)  # spawn on the owning tile so
+            slot = int(np.asarray(st.alive[dev]).argmin())  # gids are stable
+            st = spawn_on(st, dev, slot, pos=(x, 0.0, z))
+            gid = dev * mc.cfg.capacity + slot
+            gids.append(gid)
+            all_pos[gid] = (x, z)
+        st, out = step(st, MultiTickInputs.empty(mc.cfg, D), None)
+        got = set()
+        for dev in range(D):
+            en = int(out.base.enter_n[dev])
+            for w, j in zip(np.asarray(out.base.enter_w[dev])[:en],
+                            np.asarray(out.base.enter_j[dev])[:en]):
+                got.add((dev * mc.cfg.capacity + int(w), int(j)))
+        expect = set()
+        for a in gids:
+            for b in gids:
+                if a == b:
+                    continue
+                dx = abs(all_pos[a][0] - all_pos[b][0])
+                dz = abs(all_pos[a][1] - all_pos[b][1])
+                if max(dx, dz) <= 10.0:
+                    expect.add((a, b))
+        assert got == expect
+
+
+class TestMigrationQuarantine:
+    def test_same_tick_slot_reuse_blocked(self):
+        """A slot freed by emigration this tick must NOT be handed to an
+        arrival in the same tick — its stale interest list still owes the
+        previous occupant's leave events (insert_arrivals quarantine)."""
+        cfg = small_cfg(capacity=4)  # tiny shard: slots 0-3
+        mesh = make_mesh(D)
+        step = make_multi_tick(cfg, mesh, migrate_cap=2)
+        st = create_multi_state(cfg, D)
+        # shard 1: fill slots 0,1,2 -> only slot 3 free
+        for s in range(3):
+            st = spawn_on(st, 1, s, pos=(10.0 + s, 0, 10.0))
+        # shard 0: one entity that will migrate INTO shard 1
+        st = spawn_on(st, 0, 0, pos=(5.0, 0, 5.0))
+        inp = MultiTickInputs.empty(cfg, D)
+        # same tick: shard1/slot1 leaves for shard 2; shard0/slot0 -> shard 1
+        inp = inp.replace(
+            migrate_target=inp.migrate_target.at[1, 1].set(2)
+                                             .at[0, 0].set(1),
+            migrate_tag=inp.migrate_tag.at[1, 1].set(11).at[0, 0].set(22),
+        )
+        st, out = step(st, inp, None)
+        assert int(out.arr_n[1]) == 1
+        slot = int(np.asarray(out.arr_slot[1])[0])
+        assert slot == 3, f"arrival must use the pre-existing free slot, got {slot}"
+        assert not bool(st.alive[1, 1])   # departed slot stays empty
+        # next tick: the departed entity's leave events fire on shard 1
+        st, out = step(st, MultiTickInputs.empty(cfg, D), None)
+        leaves = {(int(w), int(j)) for w, j in
+                  zip(np.asarray(out.base.leave_w[1])[: int(out.base.leave_n[1])],
+                      np.asarray(out.base.leave_j[1])[: int(out.base.leave_n[1])])}
+        assert (0, 1) in leaves and (2, 1) in leaves
