@@ -66,6 +66,14 @@ class MegaConfig:
                 "megaspace grids use tile-shifted coordinates; "
                 "grid.origin_x/origin_z must be 0"
             )
+        if g.radius > self.tile_w:
+            # The halo exchange is one ring hop each way: an AOI radius
+            # wider than a tile would need neighbors-of-neighbors, which
+            # never arrive — interest events silently missing.
+            raise ValueError(
+                f"grid.radius ({g.radius}) must be <= tile_w "
+                f"({self.tile_w}) for adjacent-tile halo exchange"
+            )
 
     @property
     def world_x(self) -> float:
@@ -110,6 +118,11 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
     cfg = mc.cfg
     n = cfg.capacity
     n_dev = mc.n_dev
+    if mesh.devices.size != n_dev:
+        raise ValueError(
+            f"MegaConfig.n_dev={n_dev} but mesh has {mesh.devices.size} "
+            "devices; tile ownership and ring neighbors would disagree"
+        )
     radius = cfg.grid.radius
     gsent = mc.gid_sentinel
 

@@ -23,6 +23,15 @@ SPACE_AXIS = "space"
 def make_mesh(n_devices: int | None = None) -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices:
+            # A short mesh would make shard_map hand each device a
+            # [k>1, ...] block whose shard_fn only ticks row 0 — spaces
+            # silently dropped. Fail loudly instead.
+            raise ValueError(
+                f"make_mesh({n_devices}) but only {len(devs)} device(s) "
+                "available; set XLA_FLAGS=--xla_force_host_platform_device_"
+                "count=N JAX_PLATFORMS=cpu for simulated meshes"
+            )
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (SPACE_AXIS,))
 

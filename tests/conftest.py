@@ -12,4 +12,14 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The container's sitecustomize imports jax at interpreter startup (to
+# register the axon TPU plugin), which binds jax_platforms=axon BEFORE this
+# conftest runs — the env override above is then too late and every mesh
+# test would silently run on the single TPU device. jax.config.update still
+# works as long as no backend client has been created, so force it here.
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
