@@ -1,0 +1,30 @@
+"""Host-side entity programming model.
+
+The GoWorld user model — entity classes with lifecycle hooks, reactive
+attrs, timers, location-transparent RPC, spaces, migration
+(``engine/entity/``) — kept as Python objects that *stage* their mutations
+into per-tick device batches and receive AOI/sync events back from the
+jitted step (:mod:`goworld_tpu.core.step`).
+"""
+
+from goworld_tpu.entity.attrs import AttrDelta, ListAttr, MapAttr
+from goworld_tpu.entity.entity import Entity, GameClient
+from goworld_tpu.entity.manager import World
+from goworld_tpu.entity.registry import EntityTypeDesc, Registry
+from goworld_tpu.entity.space import Space
+from goworld_tpu.entity.timer import Crontab, PostQueue, TimerQueue
+
+__all__ = [
+    "AttrDelta",
+    "ListAttr",
+    "MapAttr",
+    "Entity",
+    "GameClient",
+    "World",
+    "EntityTypeDesc",
+    "Registry",
+    "Space",
+    "Crontab",
+    "PostQueue",
+    "TimerQueue",
+]

@@ -1,0 +1,1081 @@
+"""World — the host-side entity manager and tick driver.
+
+Reference being rebuilt: ``engine/entity/EntityManager.go`` (type registry,
+id->entity maps, create/load/restore, RPC entry — ``:155-434``) fused with
+the game process's serve loop (``components/game/GameService.go:77-190``):
+the reference interleaves per-entity work across 5 ms timer ticks; here the
+host stages all mutations between ticks, flushes them as vectorized scatters,
+runs ONE jitted device step for all spaces, and fans the step's event arrays
+back out to Python hooks and client messages.
+
+Slot lifecycle contract (the "dynamic entities on static shapes" hard part,
+``SURVEY.md#7``): a slot freed by a host despawn is flushed before the step,
+so its watchers' leave events fire in THAT step; the slot returns to the
+free set after those events are processed. A slot freed by an in-step
+migration departure gets its leave events one step later, so it is released
+one tick later (``_release_next``).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from goworld_tpu.core.state import SpaceState, WorldConfig
+from goworld_tpu.core.step import TickInputs, tick_body
+from goworld_tpu.entity.attrs import AttrDelta, load_into, make_root
+from goworld_tpu.entity.entity import Entity, GameClient
+from goworld_tpu.entity.registry import (
+    RF_OTHER_CLIENT,
+    RF_OWN_CLIENT,
+    Registry,
+)
+from goworld_tpu.entity.space import Space
+from goworld_tpu.entity.timer import Crontab, PostQueue, TimerQueue
+from goworld_tpu.parallel.mesh import create_multi_state
+from goworld_tpu.utils import consts, ids, log
+
+logger = log.get("world")
+
+
+def _make_local_tick(cfg: WorldConfig):
+    """jit(vmap(tick_body)) over stacked spaces on ONE device — the
+    single-process analog of the mesh's shard_map step."""
+
+    @jax.jit
+    def step(state, inputs, policy):
+        return jax.vmap(
+            lambda s, i: tick_body(cfg, s, i, policy)
+        )(state, inputs)
+
+    return step
+
+
+class World:
+    """Hosts every entity of one game process (= one device or one mesh).
+
+    Parameters:
+      cfg: per-space device config (shared by all spaces).
+      n_spaces: number of AOI shards in the stacked state.
+      mesh: optional jax Mesh; when given, spaces shard over its "space"
+        axis and cross-space migration rides all_to_all
+        (:mod:`goworld_tpu.parallel.step`); when None, everything runs on
+        the default device under vmap.
+      clock: injectable time source for timers (tests pass virtual time).
+    """
+
+    def __init__(
+        self,
+        cfg: WorldConfig,
+        n_spaces: int = 1,
+        *,
+        mesh=None,
+        game_id: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+        seed: int = 0,
+        migrate_cap: int = 256,
+    ):
+        self.cfg = cfg
+        self.n_spaces = n_spaces
+        self.game_id = game_id
+        self.registry = Registry()
+        self.mesh = mesh
+        self.state: SpaceState = create_multi_state(cfg, n_spaces, seed=seed)
+        self.policy = None  # MLPPolicy when cfg.behavior == 'mlp'
+        if mesh is not None:
+            from goworld_tpu.parallel.mesh import shard_state
+            from goworld_tpu.parallel.step import make_multi_tick
+
+            if mesh.devices.size != n_spaces:
+                raise ValueError(
+                    f"mesh has {mesh.devices.size} devices but "
+                    f"n_spaces={n_spaces}"
+                )
+            self.state = shard_state(self.state, mesh)
+            self._step = make_multi_tick(cfg, mesh, migrate_cap=migrate_cap)
+        else:
+            self._step = _make_local_tick(cfg)
+
+        # host object model
+        self.entities: dict[str, Entity] = {}
+        self.spaces: dict[str, Space] = {}
+        self._slot_owner: list[dict[int, str]] = [
+            {} for _ in range(n_spaces)
+        ]
+        self._free: list[set[int]] = [
+            set(range(cfg.capacity)) for _ in range(n_spaces)
+        ]
+        self._shard_space: list[str | None] = [None] * n_spaces
+        self.nil_space: Space | None = None
+
+        # runtime utils
+        self.timers = TimerQueue(clock)
+        self.post_q = PostQueue()
+        self.crontab = Crontab()
+        self.tick_count = 0
+
+        # staging buffers (flushed as vectorized scatters each tick)
+        self._staged_spawn: list[tuple[int, int, dict]] = []
+        self._staged_despawn: list[tuple[int, int]] = []
+        self._staged_hot: list[tuple[int, int, int, float]] = []
+        self._staged_moving: list[tuple[int, int, bool]] = []
+        self._staged_client: list[tuple[int, int, bool, int]] = []
+        self._staged_pos: dict[tuple[int, int], Entity] = {}
+        # (src_shard, src_slot, dst_shard, eid) — device-migration requests
+        self._staged_migrate: list[tuple[int, int, int, str]] = []
+        self._migrate_tags: dict[int, tuple[str, int, int]] = {}
+        self._release_now: list[tuple[int, int]] = []
+        self._release_next: list[tuple[int, int]] = []
+
+        # attr journaling
+        self._dirty_attr_entities: dict[str, list[AttrDelta]] = {}
+
+        # per-tick device read cache
+        self._pos_cache: np.ndarray | None = None
+        self._yaw_cache: np.ndarray | None = None
+
+        # pluggable sinks (the gateway overrides these; defaults capture)
+        self.client_messages: list[tuple[int, str, dict]] = []
+        self.client_sink: Callable[[int, str, dict], None] | None = None
+        self.filtered_sink = None  # set by the gateway (stage 3)
+        self.remote_router = None  # cross-process RPC hook
+        self.storage = None        # persistence backend (stage 6)
+        self.service_mgr = None    # sharded services (stage 5)
+        self.op_stats: dict[str, float] = defaultdict(float)
+
+    # ==================================================================
+    # registration / creation
+    # ==================================================================
+    def register_entity(self, name: str, cls, **kw):
+        return self.registry.register(name, cls, **kw)
+
+    def register_space(self, name: str, cls, **kw):
+        if not issubclass(cls, Space):
+            raise TypeError(f"{cls} must subclass Space")
+        return self.registry.register(name, cls, is_space=True, **kw)
+
+    def _attach(self, e: Entity, eid: str) -> None:
+        e.id = eid
+        e.world = self
+        e.attrs = make_root(lambda d, _e=e: self._on_attr_delta(_e, d))
+
+    def create_nil_space(self) -> Space:
+        """The per-game anchor space (reference ``space_ops.go:33-47``)."""
+        if "NilSpace" not in self.registry:
+            self.registry.register("NilSpace", Space, is_space=True,
+                                   use_aoi=False)
+        sp = Space()
+        sp._type_desc = self.registry.get("NilSpace")
+        self._attach(sp, ids.nil_space_id(self.game_id))
+        sp.is_nil_space = True
+        self.entities[sp.id] = sp
+        self.spaces[sp.id] = sp
+        self.nil_space = sp
+        return sp
+
+    def create_space(
+        self, type_name: str, *, use_aoi: bool | None = None, **attrs
+    ) -> Space:
+        desc = self.registry.get(type_name)
+        if not desc.is_space:
+            raise TypeError(f"{type_name} is not a space type")
+        sp: Space = desc.cls()
+        sp._type_desc = desc
+        self._attach(sp, ids.gen_entity_id())
+        aoi = desc.use_aoi if use_aoi is None else use_aoi
+        if aoi:
+            try:
+                shard = self._shard_space.index(None)
+            except ValueError:
+                raise RuntimeError(
+                    f"no free shard for AOI space ({self.n_spaces} in use); "
+                    "raise n_spaces"
+                ) from None
+            self._shard_space[shard] = sp.id
+            sp.shard = shard
+        self.entities[sp.id] = sp
+        self.spaces[sp.id] = sp
+        for k, v in attrs.items():
+            sp.attrs[k] = v
+        sp.OnInit()
+        sp.OnSpaceInit()
+        sp.OnAttrsReady()
+        sp.OnCreated()
+        sp.OnSpaceCreated()
+        return sp
+
+    def create_entity(
+        self,
+        type_name: str,
+        *,
+        space: Space | None = None,
+        pos=(0.0, 0.0, 0.0),
+        eid: str | None = None,
+        client: GameClient | None = None,
+        attrs: dict | None = None,
+        moving: bool = False,
+    ) -> Entity:
+        """Reference ``createEntity`` (``EntityManager.go:201``)."""
+        desc = self.registry.get(type_name)
+        if desc.is_space:
+            raise TypeError(f"use create_space for space type {type_name}")
+        e: Entity = desc.cls()
+        e._type_desc = desc
+        new_id = eid or ids.gen_entity_id()
+        if new_id in self.entities:
+            raise ValueError(f"entity id collision: {new_id}")
+        self._attach(e, new_id)
+        self.entities[e.id] = e
+        if attrs:
+            load_into(e.attrs, attrs)
+        e.OnInit()
+        e.OnAttrsReady()
+        space = space or self.nil_space
+        if space is not None:
+            self._enter_space_local(e, space, pos, moving=moving)
+        if client is not None:
+            self.set_entity_client(e, client)
+        e.OnCreated()
+        return e
+
+    def load_entity(self, type_name: str, eid: str,
+                    cb: Callable[[Entity | None], None] | None = None) -> None:
+        """Async load from storage (reference ``loadEntityLocally``,
+        ``EntityManager.go:307``). Requires a storage backend."""
+        if self.storage is None:
+            raise RuntimeError("no storage backend configured")
+        if eid in self.entities:
+            if cb:
+                # .get at drain time: the entity may be destroyed between
+                # this call and the post-queue drain
+                self.post_q.post(lambda: cb(self.entities.get(eid)))
+            return
+
+        def _loaded(data: dict | None) -> None:
+            if data is None:
+                logger.warning("load_entity %s %s: not found", type_name, eid)
+                if cb:
+                    cb(None)
+                return
+            if eid in self.entities:  # raced a concurrent load/create
+                if cb:
+                    cb(self.entities[eid])
+                return
+            e = self.create_entity(type_name, eid=eid, attrs=data)
+            e.OnRestored()
+            if cb:
+                cb(e)
+
+        self.storage.load(type_name, eid, _loaded)
+
+    # ==================================================================
+    # slot management
+    # ==================================================================
+    def _alloc_slot(self, shard: int, eid: str) -> int:
+        try:
+            slot = self._free[shard].pop()
+        except KeyError:
+            raise RuntimeError(
+                f"space shard {shard} is full ({self.cfg.capacity} slots)"
+            ) from None
+        self._slot_owner[shard][slot] = eid
+        return slot
+
+    def _owner_entity(self, shard: int, slot: int) -> Entity | None:
+        eid = self._slot_owner[shard].get(slot)
+        return self.entities.get(eid) if eid is not None else None
+
+    def _drop_staged_for(self, shard: int, slot: int) -> None:
+        """Forget pending writes aimed at a row being despawned."""
+        self._staged_hot = [
+            x for x in self._staged_hot if (x[0], x[1]) != (shard, slot)
+        ]
+        self._staged_moving = [
+            x for x in self._staged_moving if (x[0], x[1]) != (shard, slot)
+        ]
+        self._staged_client = [
+            x for x in self._staged_client if (x[0], x[1]) != (shard, slot)
+        ]
+        self._staged_pos.pop((shard, slot), None)
+
+    # ==================================================================
+    # space enter / leave / migration
+    # ==================================================================
+    def enter_space(self, e: Entity, space_id: str, pos) -> None:
+        """Reference ``EnterSpace`` (``Entity.go:956-973``): local fast
+        path, or a staged device migration when both spaces are AOI shards
+        (replacing the dispatcher block-and-queue protocol,
+        ``DispatcherService.go:850-891``)."""
+        target = self.spaces.get(space_id)
+        if target is None:
+            raise KeyError(f"space {space_id} not found in this world")
+        if e.space is target:
+            e.set_position(pos)
+            return
+        src = e.space
+        if (
+            src is not None and src.shard is not None
+            and target.shard is not None and e.slot is not None
+        ):
+            e.OnMigrateOut()
+            self._staged_migrate.append(
+                (src.shard, e.slot, target.shard, e.id)
+            )
+            self._drop_staged_for(src.shard, e.slot)
+            src.members.discard(e.id)
+            e.OnLeaveSpace(src)
+            src.OnEntityLeaveSpace(e)
+            # during the migration window the entity has NO device row it
+            # may address: slot ownership of the source row is kept (for
+            # its leave events) in _staged_migrate/_migrate_tags, and
+            # e.slot is re-pointed from the arrival records
+            e._migrating = (src.shard, e.slot, target.shard)
+            e.slot = None
+            e.space = target
+            target.members.add(e.id)
+            e._pending_pos = tuple(map(float, pos))
+        else:
+            self.post_q.post(
+                lambda: self._move_space_host(e, target, pos)
+            )
+
+    def _move_space_host(self, e: Entity, target: Space, pos) -> None:
+        if e.destroyed:
+            return
+        self._leave_space_host(e)
+        self._enter_space_local(e, target, pos)
+
+    def _leave_space_host(self, e: Entity) -> None:
+        src = e.space
+        if src is None:
+            self._cancel_migration(e)
+            return
+        src.members.discard(e.id)
+        if e.slot is not None:
+            self._drop_staged_for(src.shard, e.slot)
+            self._staged_despawn.append((src.shard, e.slot))
+            e.slot = None
+        self._cancel_migration(e)
+        e.space = None
+        e.OnLeaveSpace(src)
+        src.OnEntityLeaveSpace(e)
+
+    def _cancel_migration(self, e: Entity) -> None:
+        """Abort an in-window migration (reference ``cancelEnterSpace``,
+        ``Entity.go:1014-1023``): despawn the still-live source row."""
+        mig = getattr(e, "_migrating", None)
+        if mig is None:
+            return
+        src_sh, src_sl, _dst = mig
+        e._migrating = None
+        self._staged_migrate = [
+            m for m in self._staged_migrate if m[3] != e.id
+        ]
+        self._staged_despawn.append((src_sh, src_sl))
+
+    def _enter_space_local(
+        self, e: Entity, space: Space, pos, moving: bool = False
+    ) -> None:
+        e.space = space
+        space.members.add(e.id)
+        if space.shard is not None:
+            slot = self._alloc_slot(space.shard, e.id)
+            e.slot = slot
+            hot = [0.0] * self.cfg.attr_width
+            for name, col in e._type_desc.hot_attrs.items():
+                v = e.attrs.get(name)
+                if isinstance(v, (int, float)) and not isinstance(v, bool):
+                    hot[col] = float(v)
+            self._staged_spawn.append((space.shard, slot, dict(
+                pos=tuple(map(float, pos)),
+                yaw=0.0,
+                type_id=e._type_desc.type_id,
+                npc_moving=moving,
+                has_client=e.client is not None,
+                client_gate=e.client.gate_id if e.client else -1,
+                hot=hot,
+            )))
+        e._pending_pos = tuple(map(float, pos))
+        e.OnEnterSpace()
+        space.OnEntityEnterSpace(e)
+
+    def destroy_entity(self, e: Entity) -> None:
+        """Reference ``destroyEntity`` (``Entity.go:631-651``)."""
+        if e.destroyed:
+            return
+        e.destroyed = True
+        try:
+            e.OnDestroy()
+        except Exception:
+            logger.exception("OnDestroy failed for %s", e)
+        if e._type_desc.is_persistent and self.storage is not None:
+            self.save_entity(e)
+        if e.client is not None:
+            self.set_entity_client(e, None)
+        for tid in list(e.timer_ids):
+            self.timers.cancel(tid)
+        e.timer_ids.clear()
+        if isinstance(e, Space):
+            # evict members into the nil space (despawns their rows) so a
+            # new space claiming this shard never sees ghost entities
+            for mid in list(e.members):
+                m = self.entities.get(mid)
+                if m is None or m is e:
+                    continue
+                if self.nil_space is not None:
+                    self._move_space_host(m, self.nil_space, m.position)
+                else:
+                    self._leave_space_host(m)
+            if e.shard is not None:
+                self._shard_space[e.shard] = None
+            e.OnSpaceDestroy()
+            self.spaces.pop(e.id, None)
+        had_slot = e.slot is not None
+        self._leave_space_host(e)
+        if not had_slot:
+            # never on device: nothing will reference it again
+            self.entities.pop(e.id, None)
+        # else: the host object stays mapped until the leave events
+        # referencing its slot have been processed (_process_outputs)
+
+    # ==================================================================
+    # staging entry points (called by Entity)
+    # ==================================================================
+    def stage_pos_set(self, e: Entity) -> None:
+        if e.slot is not None and e.space is not None \
+                and e.space.shard is not None:
+            self._staged_pos[(e.space.shard, e.slot)] = e
+
+    def set_moving(self, e: Entity, moving: bool) -> None:
+        if e.slot is not None and e.space is not None \
+                and e.space.shard is not None:
+            self._staged_moving.append((e.space.shard, e.slot, moving))
+
+    def stage_hot(self, e: Entity, col: int, val: float) -> None:
+        if e.slot is not None and e.space is not None \
+                and e.space.shard is not None:
+            self._staged_hot.append((e.space.shard, e.slot, col, val))
+
+    def set_entity_client(self, e: Entity, client: GameClient | None) -> None:
+        """Reference ``SetClient`` (``Entity.go:678-720``): bind/unbind and
+        send the client its own entity + currently visible neighbors
+        (``GameClient.go:37-53``: player gets Client attrs, neighbors get
+        AllClients attrs)."""
+        old = e.client
+        e.client = client
+        if e.slot is not None and e.space is not None:
+            self._staged_client.append((
+                e.space.shard, e.slot,
+                client is not None,
+                client.gate_id if client is not None else -1,
+            ))
+        if old is not None and client is None:
+            old.send({"type": "destroy_entity", "eid": e.id,
+                      "is_player": True})
+            e.OnClientDisconnected()
+        elif client is not None:
+            client.send({
+                "type": "create_entity", "eid": e.id,
+                "etype": e.type_name, "is_player": True,
+                "attrs": e.get_client_data(),
+                "pos": list(e.position), "yaw": e.yaw,
+            })
+            for nid in e.interested_in:
+                n = self.entities.get(nid)
+                if n is not None:
+                    client.send({
+                        "type": "create_entity", "eid": n.id,
+                        "etype": n.type_name, "is_player": False,
+                        "attrs": n.get_all_clients_data(),
+                        "pos": list(n.position), "yaw": n.yaw,
+                    })
+            e.OnClientConnected()
+
+    # ==================================================================
+    # attr deltas
+    # ==================================================================
+    def _on_attr_delta(self, e: Entity, d: AttrDelta) -> None:
+        self._dirty_attr_entities.setdefault(e.id, []).append(d)
+        root_key = d.path[0] if d.path else None
+        col = e._type_desc.hot_attrs.get(root_key)
+        if col is not None and isinstance(d.value, (int, float)) \
+                and not isinstance(d.value, bool):
+            self.stage_hot(e, col, float(d.value))
+
+    def _apply_device_attr(self, e: Entity, name: str, v: float) -> None:
+        """Write a kernel-mutated hot attr into the host tree WITHOUT
+        echoing it back to the device (it already holds the value), while
+        still journaling the change for client fan-out."""
+        cb = e.attrs._root_cb
+        e.attrs._root_cb = None
+        try:
+            e.attrs[name] = v
+        finally:
+            e.attrs._root_cb = cb
+        self._dirty_attr_entities.setdefault(e.id, []).append(
+            AttrDelta((name,), "set", v)
+        )
+
+    def _drain_attr_journals(self) -> None:
+        for eid, deltas in self._dirty_attr_entities.items():
+            e = self.entities.get(eid)
+            if e is None or e.destroyed:
+                continue
+            desc = e._type_desc
+            own: list = []
+            others: list = []
+            for d in deltas:
+                aud = desc.audience_of(d.path[0]) if d.path else None
+                rec = {"path": list(d.path), "op": d.op, "value": d.value}
+                if aud == "all_clients":
+                    own.append(rec)
+                    others.append(rec)
+                elif aud == "client":
+                    own.append(rec)
+            if own and e.client is not None:
+                e.client.send({"type": "attrs", "eid": eid, "deltas": own})
+            if others and e.interested_by:
+                for wid in e.interested_by:
+                    w = self.entities.get(wid)
+                    if w is not None and w.client is not None:
+                        w.client.send(
+                            {"type": "attrs", "eid": eid, "deltas": others}
+                        )
+        self._dirty_attr_entities.clear()
+
+    # ==================================================================
+    # RPC
+    # ==================================================================
+    def call(self, eid: str, method: str, *args,
+             from_client: str | None = None) -> None:
+        """Reference ``entity.Call`` (``EntityManager.go:399-412``):
+        local-optimized post, else the remote router (the dispatcher-hop
+        analog, provided by the deployment layer)."""
+        e = self.entities.get(eid)
+        if e is not None and consts.OPTIMIZE_LOCAL_ENTITY_CALL:
+            self.post_q.post(
+                lambda: self._invoke(e, method, args, from_client)
+            )
+        elif self.remote_router is not None:
+            self.remote_router(eid, method, args, from_client)
+        elif e is not None:  # local, but forced through the routed path
+            self.post_q.post(
+                lambda: self._invoke(e, method, args, from_client)
+            )
+        else:
+            logger.warning("call %s.%s: entity not found", eid, method)
+
+    def _invoke(self, e: Entity, method: str, args: tuple,
+                from_client: str | None) -> None:
+        if e.destroyed:
+            return
+        desc = e._type_desc.rpc_descs.get(method)
+        if desc is None:
+            logger.warning("%s has no RPC method %s", e, method)
+            return
+        if from_client is not None:
+            own = e.client is not None and e.client.client_id == from_client
+            need = RF_OWN_CLIENT if own else RF_OTHER_CLIENT
+            if not desc.flags & need:
+                logger.warning(
+                    "client %s not allowed to call %s.%s",
+                    from_client, e, method,
+                )
+                return
+        try:
+            getattr(e, method)(*args)
+        except Exception:
+            logger.exception("RPC %s.%s failed", e, method)
+
+    def call_service(self, name: str, method: str, *args,
+                     shard_key: str | None = None) -> None:
+        if self.service_mgr is None:
+            raise RuntimeError("service manager not configured")
+        self.service_mgr.call(name, method, args, shard_key=shard_key)
+
+    def call_filtered_clients(self, key, op, val, method, args) -> None:
+        if self.filtered_sink is None:
+            logger.warning("call_filtered_clients: no gateway attached")
+            return
+        self.filtered_sink(key, op, val, method, args)
+
+    # ==================================================================
+    # timers
+    # ==================================================================
+    def add_entity_timer(self, e: Entity, delay: float, interval: float,
+                         cb_or_method, args: tuple) -> int:
+        if isinstance(cb_or_method, str):
+            # method-name timers are migration/freeze-safe (Entity.go:271)
+            return self.timers.add(
+                delay, interval=interval, method=cb_or_method,
+                args=(e.id,) + args,
+            )
+        return self.timers.add(
+            delay, interval=interval,
+            cb=lambda: None if e.destroyed else cb_or_method(*args),
+        )
+
+    def _fire_timer(self, t) -> None:
+        if t.method is not None:
+            eid = t.args[0]
+            e = self.entities.get(eid)
+            if e is None or e.destroyed:
+                return
+            fn = getattr(e, t.method, None)
+            if fn is None:
+                logger.warning("timer method %s missing on %s", t.method, e)
+                return
+            fn(*t.args[1:])
+        elif t.cb is not None:
+            t.cb()
+
+    # ==================================================================
+    # client message sink
+    # ==================================================================
+    def send_to_client(self, gate_id: int, client_id: str, msg: dict) -> None:
+        if self.client_sink is not None:
+            self.client_sink(gate_id, client_id, msg)
+        else:
+            self.client_messages.append((gate_id, client_id, msg))
+
+    # ==================================================================
+    # persistence
+    # ==================================================================
+    def save_entity(self, e: Entity) -> None:
+        if self.storage is None or not e._type_desc.is_persistent:
+            return
+        self.storage.save(e.type_name, e.id, e.get_persistent_data())
+
+    # ==================================================================
+    # the tick
+    # ==================================================================
+    def tick(self) -> None:
+        self.timers.tick(self._fire_timer)
+        self.crontab.tick()
+        self.post_q.tick()
+        inputs = self._flush_staging()
+        self._pos_cache = self._yaw_cache = None
+        t0 = time.perf_counter()
+        self.state, outs = self._step(self.state, inputs, self.policy)
+        outs = jax.device_get(outs)
+        self.op_stats["device_step_s"] = time.perf_counter() - t0
+        self._process_outputs(outs)
+        self._drain_attr_journals()
+        self.post_q.tick()
+        self.tick_count += 1
+
+    # -- staging flush --------------------------------------------------
+    def _flush_staging(self):
+        cfg = self.cfg
+
+        # local-path migrations become a host repack (read row -> respawn
+        # at destination) BEFORE the scatter flush below applies them
+        if self._staged_migrate and self.mesh is None:
+            for sh_, sl_, dst, eid in self._staged_migrate:
+                e = self.entities.get(eid)
+                if e is None or e.destroyed:
+                    continue
+                e._migrating = None
+                st = self.state
+                row = jax.device_get({
+                    "pos": st.pos[sh_, sl_], "yaw": st.yaw[sh_, sl_],
+                    "type_id": st.type_id[sh_, sl_],
+                    "npc_moving": st.npc_moving[sh_, sl_],
+                    "has_client": st.has_client[sh_, sl_],
+                    "client_gate": st.client_gate[sh_, sl_],
+                    "hot": st.hot_attrs[sh_, sl_],
+                })
+                new_slot = self._alloc_slot(dst, eid)
+                pend = e._pending_pos or tuple(
+                    np.asarray(row["pos"]).tolist()
+                )
+                self._staged_spawn.append((dst, new_slot, dict(
+                    pos=pend, yaw=float(row["yaw"]),
+                    type_id=int(row["type_id"]),
+                    npc_moving=bool(row["npc_moving"]),
+                    has_client=bool(row["has_client"]),
+                    client_gate=int(row["client_gate"]),
+                    hot=np.asarray(row["hot"]).tolist(),
+                )))
+                # old slot: despawn now; owner mapping stays for this
+                # step's leave events, slot frees after processing
+                self._staged_despawn.append((sh_, sl_))
+                e.slot = new_slot
+                e._pending_pos = pend
+                # attr writes made during the migration window are only in
+                # the host tree; overwrite the repacked row's hot columns
+                for name, col in e._type_desc.hot_attrs.items():
+                    v = e.attrs.get(name)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        self._staged_hot.append((dst, new_slot, col,
+                                                 float(v)))
+                e.OnMigrateIn()
+                e.OnEnterSpace()
+                tgt_id = self._shard_space[dst]
+                tgt = self.spaces.get(tgt_id) if tgt_id else None
+                if tgt is not None:
+                    tgt.OnEntityEnterSpace(e)
+            self._staged_migrate.clear()
+
+        st = self.state
+        if self._staged_spawn:
+            sh = np.array([s for s, _, _ in self._staged_spawn], np.int32)
+            sl = np.array([s for _, s, _ in self._staged_spawn], np.int32)
+            d = [v for _, _, v in self._staged_spawn]
+            st = st.replace(
+                pos=st.pos.at[(sh, sl)].set(
+                    np.array([x["pos"] for x in d], np.float32)),
+                yaw=st.yaw.at[(sh, sl)].set(
+                    np.array([x["yaw"] for x in d], np.float32)),
+                vel=st.vel.at[(sh, sl)].set(0.0),
+                alive=st.alive.at[(sh, sl)].set(True),
+                npc_moving=st.npc_moving.at[(sh, sl)].set(
+                    np.array([x["npc_moving"] for x in d])),
+                has_client=st.has_client.at[(sh, sl)].set(
+                    np.array([x["has_client"] for x in d])),
+                client_gate=st.client_gate.at[(sh, sl)].set(
+                    np.array([x["client_gate"] for x in d], np.int32)),
+                type_id=st.type_id.at[(sh, sl)].set(
+                    np.array([x["type_id"] for x in d], np.int32)),
+                gen=st.gen.at[(sh, sl)].add(1),
+                dirty=st.dirty.at[(sh, sl)].set(True),
+                hot_attrs=st.hot_attrs.at[(sh, sl)].set(
+                    np.array([x["hot"] for x in d], np.float32)),
+                attr_dirty=st.attr_dirty.at[(sh, sl)].set(np.uint32(0)),
+            )
+            # the device row now holds the spawn position; clear the host
+            # mirror so Entity.position tracks the live row (unless a
+            # newer set_position is staged — that loop clears its own)
+            for shard_, slot_, data in self._staged_spawn:
+                if (shard_, slot_) in self._staged_pos:
+                    continue
+                e_ = self._owner_entity(shard_, slot_)
+                if e_ is not None:
+                    e_._pending_pos = None
+                    e_._pending_yaw = None
+            self._staged_spawn.clear()
+
+        if self._staged_despawn:
+            sh = np.array([s for s, _ in self._staged_despawn], np.int32)
+            sl = np.array([s for _, s in self._staged_despawn], np.int32)
+            st = st.replace(
+                alive=st.alive.at[(sh, sl)].set(False),
+                has_client=st.has_client.at[(sh, sl)].set(False),
+                client_gate=st.client_gate.at[(sh, sl)].set(-1),
+                npc_moving=st.npc_moving.at[(sh, sl)].set(False),
+                dirty=st.dirty.at[(sh, sl)].set(False),
+            )
+            self._release_now.extend(self._staged_despawn)
+            self._staged_despawn.clear()
+
+        if self._staged_hot:
+            sh = np.array([x[0] for x in self._staged_hot], np.int32)
+            sl = np.array([x[1] for x in self._staged_hot], np.int32)
+            co = np.array([x[2] for x in self._staged_hot], np.int32)
+            va = np.array([x[3] for x in self._staged_hot], np.float32)
+            st = st.replace(
+                hot_attrs=st.hot_attrs.at[(sh, sl, co)].set(va)
+            )
+            self._staged_hot.clear()
+
+        if self._staged_moving:
+            sh = np.array([x[0] for x in self._staged_moving], np.int32)
+            sl = np.array([x[1] for x in self._staged_moving], np.int32)
+            mv = np.array([x[2] for x in self._staged_moving])
+            st = st.replace(npc_moving=st.npc_moving.at[(sh, sl)].set(mv))
+            self._staged_moving.clear()
+
+        if self._staged_client:
+            sh = np.array([x[0] for x in self._staged_client], np.int32)
+            sl = np.array([x[1] for x in self._staged_client], np.int32)
+            hc = np.array([x[2] for x in self._staged_client])
+            cg = np.array([x[3] for x in self._staged_client], np.int32)
+            st = st.replace(
+                has_client=st.has_client.at[(sh, sl)].set(hc),
+                client_gate=st.client_gate.at[(sh, sl)].set(cg),
+            )
+            self._staged_client.clear()
+
+        # position-sync inputs -> TickInputs [S, IC]
+        ic = cfg.input_cap
+        idx = np.zeros((self.n_spaces, ic), np.int32)
+        vals = np.zeros((self.n_spaces, ic, 4), np.float32)
+        counts = np.zeros((self.n_spaces,), np.int32)
+        for (shard, slot), e in self._staged_pos.items():
+            c = counts[shard]
+            if c >= ic:
+                logger.warning("pos-sync input overflow on shard %d", shard)
+                continue
+            p = e._pending_pos or e.position
+            y = e._pending_yaw if e._pending_yaw is not None else 0.0
+            idx[shard, c] = slot
+            vals[shard, c] = (p[0], p[1], p[2], y)
+            counts[shard] = c + 1
+            e._pending_pos = None
+            e._pending_yaw = None
+        self._staged_pos.clear()
+        base = TickInputs(
+            pos_sync_idx=jnp.asarray(idx),
+            pos_sync_vals=jnp.asarray(vals),
+            pos_sync_n=jnp.asarray(counts),
+        )
+        self.state = st
+
+        if self.mesh is None:
+            return base
+
+        from goworld_tpu.parallel.step import MultiTickInputs
+
+        mt = np.full((self.n_spaces, cfg.capacity), -1, np.int32)
+        tags = np.full((self.n_spaces, cfg.capacity), -1, np.int32)
+        self._migrate_tags = {}
+        for i, (sh_, sl_, dst, eid) in enumerate(self._staged_migrate):
+            mt[sh_, sl_] = dst
+            tags[sh_, sl_] = i
+            self._migrate_tags[i] = (eid, sh_, sl_)
+        self._staged_migrate.clear()
+        return MultiTickInputs(
+            base=base,
+            migrate_target=jnp.asarray(mt),
+            migrate_tag=jnp.asarray(tags),
+        )
+
+    # -- output processing ----------------------------------------------
+    def _process_outputs(self, outs) -> None:
+        if self.mesh is not None:
+            base = outs.base
+        else:
+            base = outs
+        cfg = self.cfg
+        for shard in range(self.n_spaces):
+            en = int(base.enter_n[shard])
+            if en > cfg.enter_cap:
+                logger.warning(
+                    "shard %d enter overflow: %d > %d", shard, en,
+                    cfg.enter_cap,
+                )
+            for w, j in zip(
+                np.asarray(base.enter_w[shard])[: min(en, cfg.enter_cap)],
+                np.asarray(base.enter_j[shard])[: min(en, cfg.enter_cap)],
+            ):
+                we = self._owner_entity(shard, int(w))
+                je = self._owner_entity(shard, int(j))
+                if we is None or je is None:
+                    continue
+                we.interested_in.add(je.id)
+                je.interested_by.add(we.id)
+                try:
+                    we.OnEnterAOI(je)
+                except Exception:
+                    logger.exception("OnEnterAOI failed")
+                if we.client is not None and not je.destroyed:
+                    we.client.send({
+                        "type": "create_entity", "eid": je.id,
+                        "etype": je.type_name, "is_player": False,
+                        "attrs": je.get_all_clients_data(),
+                        "pos": list(je.position), "yaw": je.yaw,
+                    })
+            ln = int(base.leave_n[shard])
+            if ln > cfg.leave_cap:
+                logger.warning(
+                    "shard %d leave overflow: %d > %d", shard, ln,
+                    cfg.leave_cap,
+                )
+            for w, j in zip(
+                np.asarray(base.leave_w[shard])[: min(ln, cfg.leave_cap)],
+                np.asarray(base.leave_j[shard])[: min(ln, cfg.leave_cap)],
+            ):
+                we = self._owner_entity(shard, int(w))
+                je = self._owner_entity(shard, int(j))
+                if we is None or je is None:
+                    continue
+                we.interested_in.discard(je.id)
+                je.interested_by.discard(we.id)
+                try:
+                    we.OnLeaveAOI(je)
+                except Exception:
+                    logger.exception("OnLeaveAOI failed")
+                if we.client is not None and not we.destroyed:
+                    we.client.send({
+                        "type": "destroy_entity", "eid": je.id,
+                        "is_player": False,
+                    })
+            # position sync records -> watching clients
+            sn = min(int(base.sync_n[shard]), cfg.sync_cap)
+            if sn:
+                ws = np.asarray(base.sync_w[shard])[:sn]
+                js = np.asarray(base.sync_j[shard])[:sn]
+                vs = np.asarray(base.sync_vals[shard])[:sn]
+                for w, j, v in zip(ws, js, vs):
+                    we = self._owner_entity(shard, int(w))
+                    je = self._owner_entity(shard, int(j))
+                    if we is None or we.client is None or je is None:
+                        continue
+                    we.client.send({
+                        "type": "sync", "eid": je.id,
+                        "pos": [float(v[0]), float(v[1]), float(v[2])],
+                        "yaw": float(v[3]),
+                    })
+            # device-side hot-attr deltas (kernel-mutated attrs)
+            an = min(int(base.attr_n[shard]), cfg.attr_sync_cap)
+            if an:
+                es = np.asarray(base.attr_e[shard])[:an]
+                cs = np.asarray(base.attr_i[shard])[:an]
+                vs = np.asarray(base.attr_v[shard])[:an]
+                for slot, col, v in zip(es, cs, vs):
+                    e = self._owner_entity(shard, int(slot))
+                    if e is None:
+                        continue
+                    for name, c in e._type_desc.hot_attrs.items():
+                        if c == int(col):
+                            self._apply_device_attr(e, name, float(v))
+                            break
+
+        if self.mesh is not None:
+            self._process_arrivals(outs)
+
+        # release slots whose leave events have now been processed
+        for shard, slot in self._release_now:
+            eid = self._slot_owner[shard].pop(slot, None)
+            self._free[shard].add(slot)
+            if eid is not None:
+                e = self.entities.get(eid)
+                if e is not None and e.destroyed:
+                    self.entities.pop(eid, None)
+        self._release_now = self._release_next
+        self._release_next = []
+
+    def _process_arrivals(self, outs) -> None:
+        """Mesh path: re-point migrated entities from the arrival records
+        (the analog of the dispatcher rewriting entityDispatchInfos,
+        ``DispatcherService.go:877-891``) and reconcile requests that did
+        not complete (capacity backpressure)."""
+        resolved: set[int] = set()
+        for shard in range(self.n_spaces):
+            an = int(outs.arr_n[shard])
+            for t, s in zip(
+                np.asarray(outs.arr_tag[shard])[:an],
+                np.asarray(outs.arr_slot[shard])[:an],
+            ):
+                info = self._migrate_tags.get(int(t))
+                if info is None:
+                    continue
+                resolved.add(int(t))
+                eid, src_sh, src_sl = info
+                e = self.entities.get(eid)
+                # source slot: owner cleared after its leave events fire
+                # NEXT step (the departure happened inside this step)
+                self._release_next.append((src_sh, src_sl))
+                if e is None:
+                    continue
+                e._migrating = None
+                e.slot = int(s)
+                self._slot_owner[shard][int(s)] = eid
+                self._free[shard].discard(int(s))
+                if e.destroyed:
+                    # destroyed mid-flight after the row already moved:
+                    # drop the arrived row
+                    self._staged_despawn.append((shard, int(s)))
+                    e.slot = None
+                    continue
+                # the arrived row carries source-tick pos/attrs; stage the
+                # requested destination position and any attr writes made
+                # during the migration window
+                if e._pending_pos is not None:
+                    self.stage_pos_set(e)
+                for name, col in e._type_desc.hot_attrs.items():
+                    v = e.attrs.get(name)
+                    if isinstance(v, (int, float)) \
+                            and not isinstance(v, bool):
+                        self.stage_hot(e, col, float(v))
+                e.OnMigrateIn()
+                e.OnEnterSpace()
+                tgt_id = self._shard_space[shard]
+                tgt = self.spaces.get(tgt_id) if tgt_id else None
+                if tgt is not None:
+                    tgt.OnEntityEnterSpace(e)
+            dropped = int(np.asarray(outs.migrate_dropped[shard]))
+            if dropped:
+                logger.warning("shard %d dropped %d migrants", shard, dropped)
+
+        # unresolved requests: either the emigrant stayed behind
+        # (pack capacity) or it was dropped at a full destination
+        for t, (eid, src_sh, src_sl) in self._migrate_tags.items():
+            if t in resolved:
+                continue
+            e = self.entities.get(eid)
+            if e is None:
+                continue
+            still_there = bool(np.asarray(self.state.alive[src_sh, src_sl]))
+            src_id = self._shard_space[src_sh]
+            src = self.spaces.get(src_id) if src_id else None
+            if still_there and src is not None:
+                # stayed behind (pack capacity): revert the host-side
+                # space move and retry next tick
+                intended = e.space
+                if intended is not None:
+                    intended.members.discard(eid)
+                e.space = src
+                src.members.add(eid)
+                e.slot = src_sl
+                e._migrating = None
+                logger.warning("migration of %s deferred (pack cap)", eid)
+                if intended is not None and intended.id in self.spaces:
+                    pos = e._pending_pos or (0.0, 0.0, 0.0)
+                    self.post_q.post(
+                        lambda e=e, sid=intended.id, pos=pos: (
+                            None if e.destroyed
+                            else self.enter_space(e, sid, pos)
+                        )
+                    )
+            else:
+                # departed but dropped at destination: respawn from host
+                # knowledge (hot attrs re-derived from the attr tree)
+                logger.error(
+                    "migrant %s dropped at full destination; respawning",
+                    eid,
+                )
+                self._slot_owner[src_sh].pop(src_sl, None)
+                self._free[src_sh].add(src_sl)
+                tgt = e.space
+                e.slot = None
+                e._migrating = None
+                if tgt is not None:
+                    tgt.members.discard(eid)
+                    e.space = None
+                    try:
+                        self._enter_space_local(
+                            e, tgt, e._pending_pos or (0.0, 0.0, 0.0)
+                        )
+                    except RuntimeError:
+                        # destination genuinely full: park in the nil
+                        # space rather than crashing the world loop
+                        logger.error(
+                            "respawn of %s failed (shard full); parked "
+                            "in nil space", eid,
+                        )
+                        if self.nil_space is not None:
+                            self._enter_space_local(
+                                e, self.nil_space,
+                                e._pending_pos or (0.0, 0.0, 0.0),
+                            )
+        self._migrate_tags = {}
+
+    # ==================================================================
+    # device reads
+    # ==================================================================
+    def read_pos(self, shard: int, slot: int) -> np.ndarray:
+        if self._pos_cache is None:
+            self._pos_cache = np.asarray(self.state.pos)
+        return self._pos_cache[shard, slot]
+
+    def read_yaw(self, shard: int, slot: int) -> float:
+        if self._yaw_cache is None:
+            self._yaw_cache = np.asarray(self.state.yaw)
+        return float(self._yaw_cache[shard, slot])

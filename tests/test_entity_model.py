@@ -1,0 +1,383 @@
+"""Host-side programming model tests: attrs, registry, timers, World.
+
+Mirrors the reference's unit tests for attr tree semantics
+(``engine/entity/attr_test.go``), plus integration of the host model with
+the device tick (enter/leave hooks, client messages, RPC, migration)."""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.core import WorldConfig
+from goworld_tpu.entity import (
+    Entity, GameClient, ListAttr, MapAttr, Space, World,
+)
+from goworld_tpu.entity.attrs import make_root
+from goworld_tpu.ops.aoi import GridSpec
+
+
+# ---------------------------------------------------------------------------
+# attrs
+# ---------------------------------------------------------------------------
+class TestAttrs:
+    def setup_method(self):
+        self.deltas = []
+        self.root = make_root(self.deltas.append)
+
+    def test_set_and_journal(self):
+        self.root["hp"] = 100
+        self.root["name"] = "bob"
+        assert self.root.get_int("hp") == 100
+        ops = [(d.path, d.op, d.value) for d in self.deltas]
+        assert ops == [(("hp",), "set", 100), (("name",), "set", "bob")]
+
+    def test_nested_paths(self):
+        bag = self.root.get_map("bag")
+        bag["gold"] = 5
+        items = bag.get_list("items")
+        items.append("sword")
+        paths = [d.path for d in self.deltas]
+        assert ("bag", "gold") in paths
+        assert ("bag", "items") in paths
+        assert self.deltas[-1].op == "append"
+        assert self.root.to_dict() == {
+            "bag": {"gold": 5, "items": ["sword"]}
+        }
+
+    def test_reparent_rejected(self):
+        m = MapAttr()
+        self.root["a"] = m
+        with pytest.raises(ValueError):
+            self.root["b"] = m
+
+    def test_type_canonicalization(self):
+        self.root["f"] = 1.5
+        self.root["i"] = np.int64(3) if hasattr(np, "int64") else 3
+        assert isinstance(self.root["f"], float)
+        self.root["d"] = {"x": 1}
+        assert isinstance(self.root["d"], MapAttr)
+        self.root["l"] = [1, 2]
+        assert isinstance(self.root["l"], ListAttr)
+
+    def test_list_ops(self):
+        l = self.root.get_list("l")
+        l.append(1)
+        l.append(2)
+        l.insert(0, 0)
+        assert l.to_list() == [0, 1, 2]
+        assert l.pop(0) == 0
+        assert l.to_list() == [1, 2]
+        l[1] = 9
+        assert l.to_list() == [1, 9]
+
+    def test_delete_and_filter(self):
+        self.root["keep"] = 1
+        self.root["drop"] = 2
+        del self.root["drop"]
+        assert "drop" not in self.root
+        assert self.root.to_dict_with_filter(lambda k: k == "keep") == {
+            "keep": 1
+        }
+
+
+# ---------------------------------------------------------------------------
+# world fixtures
+# ---------------------------------------------------------------------------
+class Monster(Entity):
+    ATTRS = {"hp": "allclients persistent hot:0", "secret": "persistent"}
+
+    def __init__(self):
+        super().__init__()
+        self.seen: list[str] = []
+        self.lost: list[str] = []
+
+    def OnEnterAOI(self, other):
+        self.seen.append(other.id)
+
+    def OnLeaveAOI(self, other):
+        self.lost.append(other.id)
+
+    def Hit(self, dmg):
+        self.attrs["hp"] = self.attrs.get_int("hp") - dmg
+
+
+class Avatar(Entity):
+    ATTRS = {"name": "client persistent", "level": "allclients"}
+
+    def __init__(self):
+        super().__init__()
+        self.greeted = []
+
+    def Greet_Client(self, text):
+        self.greeted.append(text)
+
+    def ServerOnly(self):
+        self.greeted.append("server")
+
+
+class MySpace(Space):
+    def __init__(self):
+        super().__init__()
+        self.entered = []
+
+    def OnEntityEnterSpace(self, entity):
+        self.entered.append(entity.id)
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def small_world(n_spaces=2, **kw):
+    cfg = WorldConfig(
+        capacity=64,
+        grid=GridSpec(radius=10.0, extent_x=100.0, extent_z=100.0,
+                      k=8, cell_cap=32, row_block=64),
+    )
+    clock = FakeClock()
+    w = World(cfg, n_spaces=n_spaces, clock=clock, **kw)
+    w.clock = clock
+    w.register_entity("Monster", Monster)
+    w.register_entity("Avatar", Avatar)
+    w.register_space("MySpace", MySpace)
+    w.create_nil_space()
+    return w
+
+
+# ---------------------------------------------------------------------------
+# world behavior
+# ---------------------------------------------------------------------------
+class TestWorld:
+    def test_create_and_aoi_hooks(self):
+        w = small_world()
+        sp = w.create_space("MySpace")
+        a = sp.create_entity("Monster", pos=(50, 0, 50))
+        b = sp.create_entity("Monster", pos=(52, 0, 50))
+        far = sp.create_entity("Monster", pos=(5, 0, 5))
+        assert sp.entered == [a.id, b.id, far.id]
+        assert sp.count_entities("Monster") == 3
+        w.tick()
+        assert b.id in a.interested_in and a.id in b.interested_in
+        assert a.id in b.seen and b.id in a.seen
+        assert far.seen == []
+        assert np.allclose(a.position, (50, 0, 50))
+
+    def test_client_messages_on_aoi(self):
+        w = small_world()
+        sp = w.create_space("MySpace")
+        av = w.create_entity("Avatar", space=sp, pos=(50, 0, 50),
+                             client=None)
+        av.set_client(GameClient(1, "client-1", w))
+        mon = sp.create_entity("Monster", pos=(51, 0, 50),
+                               attrs={"hp": 30})
+        w.tick()
+        msgs = [m for (_, cid, m) in w.client_messages if cid == "client-1"]
+        kinds = [m["type"] for m in msgs]
+        assert "create_entity" in kinds
+        ce = [m for m in msgs if m["type"] == "create_entity"
+              and m["eid"] == mon.id]
+        assert ce and ce[0]["attrs"] == {"hp": 30}  # AllClients view only
+        # monster moves -> sync record for the watching client
+        w.client_messages.clear()
+        mon.set_position((52, 0, 50))
+        w.tick()
+        w.tick()
+        syncs = [m for (_, cid, m) in w.client_messages
+                 if m["type"] == "sync" and m["eid"] == mon.id]
+        assert syncs, "client should receive sync for watched mover"
+
+    def test_attr_sync_audiences(self):
+        w = small_world()
+        sp = w.create_space("MySpace")
+        av = w.create_entity("Avatar", space=sp, pos=(50, 0, 50))
+        av.set_client(GameClient(1, "c-av", w))
+        mon = sp.create_entity("Monster", pos=(51, 0, 50),
+                               attrs={"hp": 30, "secret": 1})
+        w.tick()  # establish interest
+        w.client_messages.clear()
+        mon.attrs["hp"] = 25       # allclients -> watcher sees it
+        mon.attrs["secret"] = 2    # persistent only -> nobody sees it
+        w.tick()
+        attr_msgs = [m for (_, cid, m) in w.client_messages
+                     if m["type"] == "attrs"]
+        assert len(attr_msgs) == 1
+        assert attr_msgs[0]["eid"] == mon.id
+        assert attr_msgs[0]["deltas"] == [
+            {"path": ["hp"], "op": "set", "value": 25}
+        ]
+
+    def test_hot_attr_mirrors_to_device(self):
+        w = small_world()
+        sp = w.create_space("MySpace")
+        mon = sp.create_entity("Monster", pos=(50, 0, 50),
+                               attrs={"hp": 30})
+        w.tick()
+        assert float(w.state.hot_attrs[sp.shard, mon.slot, 0]) == 30.0
+        mon.attrs["hp"] = 12
+        w.tick()
+        assert float(w.state.hot_attrs[sp.shard, mon.slot, 0]) == 12.0
+
+    def test_rpc_permissions(self):
+        w = small_world()
+        av = w.create_entity("Avatar")
+        av.set_client(GameClient(1, "c-1", w))
+        w.call(av.id, "Greet_Client", "hi", from_client="c-1")
+        w.call(av.id, "ServerOnly", from_client="c-1")  # denied
+        w.call(av.id, "ServerOnly")  # server side ok
+        w.tick()
+        assert av.greeted == ["hi", "server"]
+
+    def test_timers(self):
+        w = small_world()
+        mon = w.create_entity("Monster", attrs={"hp": 10})
+        mon.add_callback(1.0, "Hit", 3)
+        tid = mon.add_timer(2.0, "Hit", 1)
+        w.clock.t = 1.1
+        w.tick()
+        assert mon.attrs.get_int("hp") == 7
+        w.clock.t = 4.2
+        w.tick()  # repeating timer fires once per tick call
+        w.clock.t = 6.2
+        w.tick()
+        assert mon.attrs.get_int("hp") == 5
+        mon.cancel_timer(tid)
+        w.clock.t = 10.0
+        w.tick()
+        assert mon.attrs.get_int("hp") == 5
+
+    def test_destroy_releases_slot_after_leave_events(self):
+        w = small_world()
+        sp = w.create_space("MySpace")
+        a = sp.create_entity("Monster", pos=(50, 0, 50))
+        b = sp.create_entity("Monster", pos=(51, 0, 50))
+        w.tick()
+        slot_b = b.slot
+        b.destroy()
+        assert b.destroyed
+        w.tick()  # leave events fire here
+        assert b.id in a.lost
+        assert b.id not in w.entities
+        assert slot_b in w._free[sp.shard]
+        assert not bool(w.state.alive[sp.shard, slot_b])
+
+    def test_enter_space_migration_local(self):
+        w = small_world(n_spaces=2)
+        sp1 = w.create_space("MySpace")
+        sp2 = w.create_space("MySpace")
+        a = sp1.create_entity("Monster", pos=(50, 0, 50),
+                              attrs={"hp": 44})
+        w.tick()
+        a.enter_space(sp2.id, (10, 0, 10))
+        w.tick()
+        assert a.space is sp2
+        assert a.id in sp2.members and a.id not in sp1.members
+        assert bool(w.state.alive[sp2.shard, a.slot])
+        assert float(w.state.hot_attrs[sp2.shard, a.slot, 0]) == 44.0
+        w.tick()
+        assert np.allclose(a.position, (10, 0, 10))
+
+    def test_give_client_to(self):
+        w = small_world()
+        acct = w.create_entity("Avatar")
+        acct.set_client(GameClient(2, "cli-9", w))
+        av = w.create_entity("Avatar")
+        acct.give_client_to(av)
+        assert acct.client is None
+        assert av.client is not None and av.client.client_id == "cli-9"
+        assert av.client.gate_id == 2
+
+    def test_moving_entity_position_tracks_device(self):
+        w = small_world()
+        sp = w.create_space("MySpace")
+        m = sp.create_entity("Monster", pos=(50, 0, 50), moving=True)
+        w.tick()
+        w.tick()
+        w.tick()
+        assert not np.allclose(m.position, (50, 0, 50)), \
+            "host position must track the integrated device row"
+
+    def test_attr_set_during_migration_window_is_safe(self):
+        """During enter_space's staged window the entity has no device
+        row; staged writes must not hit the source slot (now possibly
+        another entity's) nor a wrong shard."""
+        w = small_world(n_spaces=2)
+        sp1 = w.create_space("MySpace")
+        sp2 = w.create_space("MySpace")
+        a = sp1.create_entity("Monster", pos=(50, 0, 50),
+                              attrs={"hp": 5})
+        w.tick()
+        a.enter_space(sp2.id, (10, 0, 10))
+        assert a.slot is None  # no addressable row mid-window
+        a.attrs["hp"] = 99     # journaled, not staged to a wrong row
+        w.tick()
+        assert a.space is sp2 and a.slot is not None
+        w.tick()
+        assert float(w.state.hot_attrs[sp2.shard, a.slot, 0]) == 99.0
+
+    def test_space_destroy_evicts_members(self):
+        w = small_world(n_spaces=2)
+        sp = w.create_space("MySpace")
+        m = sp.create_entity("Monster", pos=(50, 0, 50))
+        w.tick()
+        shard = sp.shard
+        sp.destroy()
+        w.tick()
+        # member moved to nil space, its row despawned
+        assert m.space is w.nil_space
+        assert m.slot is None
+        assert int(np.asarray(w.state.alive[shard]).sum()) == 0
+        # shard is reusable without ghosts
+        sp2 = w.create_space("MySpace")
+        assert sp2.shard == shard
+        fresh = sp2.create_entity("Monster", pos=(50, 0, 50))
+        w.tick()
+        w.tick()
+        assert fresh.interested_in == set()
+
+    def test_nil_space_is_host_only(self):
+        w = small_world()
+        e = w.create_entity("Monster")  # defaults into nil space
+        assert e.space is w.nil_space
+        assert e.slot is None
+        w.tick()  # must not crash with host-only entities around
+
+
+class TestWorldMesh:
+    def test_mesh_migration_repoints_entity(self):
+        import jax
+        from goworld_tpu.parallel import make_mesh
+
+        if jax.device_count() < 8:
+            pytest.skip("needs 8 devices")
+        cfg = WorldConfig(
+            capacity=32,
+            grid=GridSpec(radius=10.0, extent_x=100.0, extent_z=100.0,
+                          k=8, cell_cap=32, row_block=32),
+        )
+        clock = FakeClock()
+        w = World(cfg, n_spaces=8, mesh=make_mesh(8), clock=clock,
+                  migrate_cap=4)
+        w.register_entity("Monster", Monster)
+        w.register_space("MySpace", MySpace)
+        w.create_nil_space()
+        spaces = [w.create_space("MySpace") for _ in range(8)]
+        a = spaces[0].create_entity("Monster", pos=(50, 0, 50),
+                                    attrs={"hp": 7})
+        b = spaces[0].create_entity("Monster", pos=(52, 0, 50))
+        w.tick()
+        assert b.id in a.interested_in
+        a.enter_space(spaces[5].id, (20, 0, 20))
+        w.tick()
+        assert a.space is spaces[5]
+        assert a.slot is not None
+        assert bool(w.state.alive[5, a.slot])
+        assert float(w.state.hot_attrs[5, a.slot, 0]) == 7.0
+        w.tick()  # leave events on the old shard fire now
+        assert a.id not in b.interested_in
+        assert np.allclose(a.position, (20, 0, 20))
+        # old slot released
+        assert 0 not in w._slot_owner[0] or \
+            w._slot_owner[0].get(0) != a.id
