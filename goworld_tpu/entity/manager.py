@@ -367,10 +367,17 @@ class World:
 
     def _cancel_migration(self, e: Entity) -> None:
         """Abort an in-window migration (reference ``cancelEnterSpace``,
-        ``Entity.go:1014-1023``): despawn the still-live source row."""
+        ``Entity.go:1014-1023``): despawn the still-live source row.
+
+        Only valid while the request is still staged host-side; once the
+        row is in flight on device (``_migrate_tags``), the source row has
+        already departed in-step and ``_process_arrivals`` reconciles via
+        ``e.destroyed`` instead."""
         mig = getattr(e, "_migrating", None)
         if mig is None:
             return
+        if not any(m[3] == e.id for m in self._staged_migrate):
+            return  # in flight on device; arrivals reconciliation owns it
         src_sh, src_sl, _dst = mig
         e._migrating = None
         self._staged_migrate = [
@@ -437,11 +444,14 @@ class World:
             self.spaces.pop(e.id, None)
         had_slot = e.slot is not None
         self._leave_space_host(e)
-        if not had_slot:
-            # never on device: nothing will reference it again
+        if not had_slot and e._migrating is None:
+            # never on device (and no row in flight): nothing will
+            # reference it again
             self.entities.pop(e.id, None)
         # else: the host object stays mapped until the leave events
-        # referencing its slot have been processed (_process_outputs)
+        # referencing its slot have been processed (_process_outputs), or
+        # until _process_arrivals drops its in-flight row (destroyed
+        # mid-migration)
 
     # ==================================================================
     # staging entry points (called by Entity)
@@ -615,10 +625,18 @@ class World:
                 delay, interval=interval, method=cb_or_method,
                 args=(e.id,) + args,
             )
-        return self.timers.add(
-            delay, interval=interval,
-            cb=lambda: None if e.destroyed else cb_or_method(*args),
+        box: dict[str, int] = {}
+
+        def _cb() -> None:
+            if interval <= 0:  # one-shot: forget the tid (no leak)
+                e.timer_ids.discard(box.get("tid", -1))
+            if not e.destroyed:
+                cb_or_method(*args)
+
+        box["tid"] = tid = self.timers.add(
+            delay, interval=interval, cb=_cb
         )
+        return tid
 
     def _fire_timer(self, t) -> None:
         if t.method is not None:
@@ -626,6 +644,8 @@ class World:
             e = self.entities.get(eid)
             if e is None or e.destroyed:
                 return
+            if t.interval <= 0:
+                e.timer_ids.discard(t.tid)
             fn = getattr(e, t.method, None)
             if fn is None:
                 logger.warning("timer method %s missing on %s", t.method, e)
@@ -676,31 +696,38 @@ class World:
         # local-path migrations become a host repack (read row -> respawn
         # at destination) BEFORE the scatter flush below applies them
         if self._staged_migrate and self.mesh is None:
-            for sh_, sl_, dst, eid in self._staged_migrate:
-                e = self.entities.get(eid)
-                if e is None or e.destroyed:
-                    continue
+            live = [
+                m for m in self._staged_migrate
+                if (e := self.entities.get(m[3])) is not None
+                and not e.destroyed
+            ]
+            # ONE batched gather for every migrating row (per-entity
+            # device_get would pay the transfer latency N times)
+            st = self.state
+            msh = np.array([m[0] for m in live], np.int32)
+            msl = np.array([m[1] for m in live], np.int32)
+            rows = jax.device_get({
+                "pos": st.pos[(msh, msl)], "yaw": st.yaw[(msh, msl)],
+                "type_id": st.type_id[(msh, msl)],
+                "npc_moving": st.npc_moving[(msh, msl)],
+                "has_client": st.has_client[(msh, msl)],
+                "client_gate": st.client_gate[(msh, msl)],
+                "hot": st.hot_attrs[(msh, msl)],
+            }) if live else None
+            for i, (sh_, sl_, dst, eid) in enumerate(live):
+                e = self.entities[eid]
                 e._migrating = None
-                st = self.state
-                row = jax.device_get({
-                    "pos": st.pos[sh_, sl_], "yaw": st.yaw[sh_, sl_],
-                    "type_id": st.type_id[sh_, sl_],
-                    "npc_moving": st.npc_moving[sh_, sl_],
-                    "has_client": st.has_client[sh_, sl_],
-                    "client_gate": st.client_gate[sh_, sl_],
-                    "hot": st.hot_attrs[sh_, sl_],
-                })
                 new_slot = self._alloc_slot(dst, eid)
                 pend = e._pending_pos or tuple(
-                    np.asarray(row["pos"]).tolist()
+                    np.asarray(rows["pos"][i]).tolist()
                 )
                 self._staged_spawn.append((dst, new_slot, dict(
-                    pos=pend, yaw=float(row["yaw"]),
-                    type_id=int(row["type_id"]),
-                    npc_moving=bool(row["npc_moving"]),
-                    has_client=bool(row["has_client"]),
-                    client_gate=int(row["client_gate"]),
-                    hot=np.asarray(row["hot"]).tolist(),
+                    pos=pend, yaw=float(rows["yaw"][i]),
+                    type_id=int(rows["type_id"][i]),
+                    npc_moving=bool(rows["npc_moving"][i]),
+                    has_client=bool(rows["has_client"][i]),
+                    client_gate=int(rows["client_gate"][i]),
+                    hot=np.asarray(rows["hot"][i]).tolist(),
                 )))
                 # old slot: despawn now; owner mapping stays for this
                 # step's leave events, slot frees after processing
@@ -807,19 +834,42 @@ class World:
         idx = np.zeros((self.n_spaces, ic), np.int32)
         vals = np.zeros((self.n_spaces, ic, 4), np.float32)
         counts = np.zeros((self.n_spaces,), np.int32)
-        for (shard, slot), e in self._staged_pos.items():
+        entries = list(self._staged_pos.items())
+        # a set_position without set_yaw must keep the current device yaw
+        # (apply_pos_inputs scatters all four lanes); batch-gather the
+        # fallback yaws in ONE transfer from the post-scatter state
+        need_yaw = [
+            (shard, slot) for (shard, slot), e in entries
+            if e._pending_yaw is None
+        ]
+        yaw_fb: dict[tuple[int, int], float] = {}
+        if need_yaw:
+            ysh = np.array([s for s, _ in need_yaw], np.int32)
+            ysl = np.array([s for _, s in need_yaw], np.int32)
+            got = jax.device_get(st.yaw[(ysh, ysl)])
+            yaw_fb = {k: float(v) for k, v in zip(need_yaw, got)}
+        overflow: dict[tuple[int, int], Entity] = {}
+        for (shard, slot), e in entries:
             c = counts[shard]
             if c >= ic:
-                logger.warning("pos-sync input overflow on shard %d", shard)
+                # keep it staged so the write lands next tick instead of
+                # silently diverging host (_pending_pos) from device
+                overflow[(shard, slot)] = e
                 continue
             p = e._pending_pos or e.position
-            y = e._pending_yaw if e._pending_yaw is not None else 0.0
+            y = e._pending_yaw if e._pending_yaw is not None \
+                else yaw_fb.get((shard, slot), 0.0)
             idx[shard, c] = slot
             vals[shard, c] = (p[0], p[1], p[2], y)
             counts[shard] = c + 1
             e._pending_pos = None
             e._pending_yaw = None
-        self._staged_pos.clear()
+        self._staged_pos = overflow
+        if overflow:
+            logger.warning(
+                "pos-sync input overflow: %d updates deferred a tick",
+                len(overflow),
+            )
         base = TickInputs(
             pos_sync_idx=jnp.asarray(idx),
             pos_sync_vals=jnp.asarray(vals),
@@ -1011,6 +1061,18 @@ class World:
                 continue
             e = self.entities.get(eid)
             if e is None:
+                continue
+            if e.destroyed:
+                # destroyed while unresolved: drop whichever row survived
+                # and forget the entity
+                if bool(np.asarray(self.state.alive[src_sh, src_sl])):
+                    self._staged_despawn.append((src_sh, src_sl))
+                else:
+                    self._slot_owner[src_sh].pop(src_sl, None)
+                    self._free[src_sh].add(src_sl)
+                    self.entities.pop(eid, None)
+                e.slot = None
+                e._migrating = None
                 continue
             still_there = bool(np.asarray(self.state.alive[src_sh, src_sl]))
             src_id = self._shard_space[src_sh]

@@ -1,0 +1,15 @@
+"""Networking: wire protocol, gate (client edge), dispatcher (router),
+game server host, and the bot client harness.
+
+Reference being rebuilt: ``engine/netutil`` (packet framing),
+``engine/proto`` (message space), ``components/{dispatcher,gate}`` and the
+game side of ``components/game`` (``GameService.go``), plus
+``examples/test_client`` (bot swarm).
+
+The device mesh replaces the dispatcher *within* one game process
+(:mod:`goworld_tpu.parallel`); this package is the *between-process* layer —
+multiple game processes, gates terminating client sockets, and a sharded
+dispatcher router — kept host-side exactly like the reference, but with the
+hot sync-record path batched into numpy arrays the device can consume
+directly (and a C++ codec for the byte-level encode/decode).
+"""

@@ -1,0 +1,277 @@
+"""Batch codec for the hot sync-record path — C++ via ctypes, numpy fallback.
+
+Reference being rebuilt: the per-record encode/decode loops of the position
+sync pipeline (``GateService.go:402-429``, ``DispatcherService.go:770-808``,
+``GameService.go:395-407``). The reference touches each 16-byte record in Go
+per packet hop; here whole batches are (de)serialised in one native call (or
+one numpy structured-array view), because the game host feeds the records
+straight into device input buffers.
+
+Public API (all batch-level):
+  encode_sync_batch(ids, vals) -> bytes           # N x 32B records
+  decode_sync_batch(buf) -> (ids S16[N], vals f32[N,4])
+  encode_client_sync_batch(cids, ids, vals) -> bytes   # N x 48B
+  decode_client_sync_batch(buf) -> (cids, ids, vals)
+  bucket_by_shard(shard_of, n_shards, capacity) -> (idx i32[S,cap], counts)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from goworld_tpu.utils import log
+
+logger = log.get("codec")
+
+SYNC_DTYPE = np.dtype([("eid", "S16"), ("v", "<f4", (4,))])
+CLIENT_SYNC_DTYPE = np.dtype(
+    [("cid", "S16"), ("eid", "S16"), ("v", "<f4", (4,))]
+)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "_packet_codec.so"))
+_build_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_tried = False
+
+
+def _build_native() -> bool:
+    src = os.path.join(_NATIVE_DIR, "packet_codec.cpp")
+    if not os.path.exists(src):
+        return False
+    try:
+        subprocess.run(
+            ["g++", "-O3", "-Wall", "-std=c++17", "-fPIC", "-shared",
+             "-o", _SO_PATH, src],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        logger.warning("native codec build failed (%s); using numpy path", e)
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    """Load (building if needed) the native codec; None -> numpy fallback."""
+    global _lib, _lib_tried
+    if _lib is not None or _lib_tried:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_tried:
+            return _lib
+        _lib_tried = True
+        if not os.path.exists(_SO_PATH) and not _build_native():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+        except OSError as e:
+            logger.warning("native codec load failed (%s)", e)
+            return None
+        c_char_p = ctypes.POINTER(ctypes.c_char)
+        f32_p = ctypes.POINTER(ctypes.c_float)
+        i32_p = ctypes.POINTER(ctypes.c_int32)
+        i64_p = ctypes.POINTER(ctypes.c_int64)
+        lib.encode_sync_records.argtypes = [
+            c_char_p, f32_p, ctypes.c_int32, c_char_p]
+        lib.decode_sync_records.argtypes = [
+            c_char_p, ctypes.c_int32, c_char_p, f32_p]
+        lib.encode_client_sync_records.argtypes = [
+            c_char_p, c_char_p, f32_p, ctypes.c_int32, c_char_p]
+        lib.decode_client_sync_records.argtypes = [
+            c_char_p, ctypes.c_int32, c_char_p, c_char_p, f32_p]
+        lib.scan_frames.argtypes = [
+            c_char_p, ctypes.c_int64, ctypes.c_int64, i64_p, i64_p,
+            ctypes.c_int32, i64_p]
+        lib.scan_frames.restype = ctypes.c_int32
+        lib.bucket_by_shard.argtypes = [
+            i32_p, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            i32_p, i32_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _as_id_array(ids) -> np.ndarray:
+    a = np.asarray(ids, dtype="S16")
+    return np.ascontiguousarray(a)
+
+
+def encode_sync_batch(ids, vals) -> bytes:
+    """ids: N 16-char ids (list[str] or S16 array); vals: f32[N,4]."""
+    ida = _as_id_array(ids)
+    va = np.ascontiguousarray(np.asarray(vals, np.float32).reshape(-1, 4))
+    n = ida.shape[0]
+    assert va.shape[0] == n
+    lib = _load()
+    out = np.empty(n * 32, np.uint8)
+    if lib is not None and n:
+        lib.encode_sync_records(
+            ida.ctypes.data_as(ctypes.POINTER(ctypes.c_char)),
+            va.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_char)),
+        )
+        return out.tobytes()
+    rec = np.empty(n, SYNC_DTYPE)
+    rec["eid"] = ida
+    rec["v"] = va
+    return rec.tobytes()
+
+
+def decode_sync_batch(buf: bytes | memoryview) -> tuple[np.ndarray, np.ndarray]:
+    """-> (ids S16[N], vals f32[N,4])."""
+    n, rem = divmod(len(buf), 32)
+    if rem:
+        raise ValueError(f"sync batch length {len(buf)} not a multiple of 32")
+    lib = _load()
+    if lib is not None and n:
+        raw = np.frombuffer(buf, np.uint8)
+        ids = np.empty(n, "S16")
+        vals = np.empty((n, 4), np.float32)
+        lib.decode_sync_records(
+            np.ascontiguousarray(raw).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_char)),
+            n,
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_char)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return ids, vals
+    rec = np.frombuffer(buf, SYNC_DTYPE)
+    return rec["eid"].copy(), rec["v"].copy()
+
+
+def encode_client_sync_batch(cids, ids, vals) -> bytes:
+    ca = _as_id_array(cids)
+    ida = _as_id_array(ids)
+    va = np.ascontiguousarray(np.asarray(vals, np.float32).reshape(-1, 4))
+    n = ca.shape[0]
+    if ida.shape[0] != n or va.shape[0] != n:
+        raise ValueError(
+            f"length mismatch: {n} cids, {ida.shape[0]} ids, "
+            f"{va.shape[0]} vals"
+        )
+    lib = _load()
+    if lib is not None and n:
+        out = np.empty(n * 48, np.uint8)
+        lib.encode_client_sync_records(
+            ca.ctypes.data_as(ctypes.POINTER(ctypes.c_char)),
+            ida.ctypes.data_as(ctypes.POINTER(ctypes.c_char)),
+            va.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            n,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_char)),
+        )
+        return out.tobytes()
+    rec = np.empty(n, CLIENT_SYNC_DTYPE)
+    rec["cid"] = ca
+    rec["eid"] = ida
+    rec["v"] = va
+    return rec.tobytes()
+
+
+def decode_client_sync_batch(
+    buf: bytes | memoryview,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    n, rem = divmod(len(buf), 48)
+    if rem:
+        raise ValueError(
+            f"client sync batch length {len(buf)} not a multiple of 48"
+        )
+    lib = _load()
+    if lib is not None and n:
+        raw = np.ascontiguousarray(np.frombuffer(buf, np.uint8))
+        cids = np.empty(n, "S16")
+        ids = np.empty(n, "S16")
+        vals = np.empty((n, 4), np.float32)
+        lib.decode_client_sync_records(
+            raw.ctypes.data_as(ctypes.POINTER(ctypes.c_char)),
+            n,
+            cids.ctypes.data_as(ctypes.POINTER(ctypes.c_char)),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_char)),
+            vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return cids, ids, vals
+    rec = np.frombuffer(buf, CLIENT_SYNC_DTYPE)
+    return rec["cid"].copy(), rec["eid"].copy(), rec["v"].copy()
+
+
+def bucket_by_shard(
+    shard_of: np.ndarray, n_shards: int, capacity: int
+) -> tuple[np.ndarray, np.ndarray]:
+    """Group record indices by shard (dispatcher re-batching analog).
+
+    shard_of: i32[N] with -1 meaning drop. Returns (idx i32[S,capacity],
+    counts i32[S]); overflow beyond capacity is dropped (callers size
+    capacity to the device input cap and warn on counts == capacity).
+    """
+    so = np.ascontiguousarray(np.asarray(shard_of, np.int32))
+    n = so.shape[0]
+    idx = np.zeros((n_shards, capacity), np.int32)
+    counts = np.zeros(n_shards, np.int32)
+    lib = _load()
+    if lib is not None and n:
+        lib.bucket_by_shard(
+            so.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            n, n_shards, capacity,
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        )
+        return idx, counts
+    for i in range(n):
+        s = so[i]
+        if 0 <= s < n_shards and counts[s] < capacity:
+            idx[s, counts[s]] = i
+            counts[s] += 1
+    return idx, counts
+
+
+def scan_frames(
+    buf: bytes | bytearray, max_payload: int = 32 * 1024 * 1024,
+    max_frames: int = 4096,
+) -> tuple[list[tuple[int, int]], int]:
+    """Find complete length-prefixed frames in a receive buffer.
+
+    Returns ([(payload_offset, payload_size), ...], consumed_bytes).
+    Raises ConnectionError on a malformed size prefix. (Used by sync-mode
+    receivers; asyncio paths use readexactly framing in packet.py.)
+    """
+    lib = _load()
+    if lib is not None:
+        raw = np.frombuffer(bytes(buf), np.uint8)
+        offs = np.empty(max_frames, np.int64)
+        sizes = np.empty(max_frames, np.int64)
+        consumed = np.zeros(1, np.int64)
+        cnt = lib.scan_frames(
+            np.ascontiguousarray(raw).ctypes.data_as(
+                ctypes.POINTER(ctypes.c_char)),
+            len(buf), max_payload,
+            offs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            max_frames,
+            consumed.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        )
+        if cnt < 0:
+            raise ConnectionError("malformed frame size")
+        return (
+            [(int(offs[i]), int(sizes[i])) for i in range(cnt)],
+            int(consumed[0]),
+        )
+    frames = []
+    pos = 0
+    n = len(buf)
+    while len(frames) < max_frames and pos + 4 <= n:
+        size = int.from_bytes(buf[pos:pos + 4], "little")
+        if size < 2 or size > max_payload:
+            raise ConnectionError("malformed frame size")
+        if pos + 4 + size > n:
+            break
+        frames.append((pos + 4, size))
+        pos += 4 + size
+    return frames, pos

@@ -1,0 +1,232 @@
+"""Binary packet framing: pooled packets + asyncio stream codec.
+
+Reference being rebuilt: ``engine/netutil/Packet.go`` (pooled little-endian
+buffer with Append/Read for u16/u32/float32/EntityID/VarStr/VarBytes/Data)
+and ``engine/netutil/PacketConnection.go`` (length-prefixed framing over
+TCP). Wire format kept in the same spirit:
+
+    [u32 payload_size][u16 msgtype][payload ...]        (little-endian)
+
+EntityIDs are fixed 16 ASCII bytes (:mod:`goworld_tpu.utils.ids`);
+structured args are msgpack (reference ``MsgPacker.go``); hot-path position
+sync records are fixed 32-byte binary records — 16B entity id + 4×f32
+x,y,z,yaw (reference ``proto.go:122`` SYNC_INFO_SIZE_PER_ENTITY plus the id
+prefix) — batch-encoded by :mod:`goworld_tpu.net.codec`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Any
+
+import msgpack
+
+from goworld_tpu.utils.ids import ENTITYID_LENGTH
+
+MAX_PAYLOAD_LENGTH = 32 * 1024 * 1024  # defensive cap (reference 16M-ish)
+_SIZE_FMT = struct.Struct("<I")
+_TYPE_FMT = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_U16 = struct.Struct("<H")
+_F32 = struct.Struct("<f")
+HEADER_SIZE = 4  # the u32 size prefix; msgtype counts into payload_size
+
+_pool: list["Packet"] = []
+_POOL_MAX = 256
+
+
+class Packet:
+    """A reusable binary message buffer (reference ``Packet.go``).
+
+    Append-side builds `[u16 msgtype][payload]`; read-side walks the same
+    bytes with a cursor. Use :func:`alloc` / :meth:`release` for pooling on
+    hot paths; plain construction also works.
+    """
+
+    __slots__ = ("buf", "rpos")
+
+    def __init__(self, data: bytes | bytearray | None = None):
+        self.buf = bytearray(data) if data is not None else bytearray()
+        self.rpos = 0
+
+    # -- lifecycle -------------------------------------------------------
+    @staticmethod
+    def alloc() -> "Packet":
+        if _pool:
+            return _pool.pop()
+        return Packet()
+
+    def release(self) -> None:
+        if len(_pool) < _POOL_MAX:
+            self.buf.clear()
+            self.rpos = 0
+            _pool.append(self)
+
+    # -- append side -----------------------------------------------------
+    def append_u8(self, v: int) -> None:
+        self.buf.append(v & 0xFF)
+
+    def append_bool(self, v: bool) -> None:
+        self.buf.append(1 if v else 0)
+
+    def append_u16(self, v: int) -> None:
+        self.buf += _U16.pack(v & 0xFFFF)
+
+    def append_u32(self, v: int) -> None:
+        self.buf += _U32.pack(v & 0xFFFFFFFF)
+
+    def append_f32(self, v: float) -> None:
+        self.buf += _F32.pack(v)
+
+    def append_bytes(self, b: bytes) -> None:
+        self.buf += b
+
+    def append_entity_id(self, eid: str) -> None:
+        b = eid.encode("ascii")
+        if len(b) != ENTITYID_LENGTH:
+            raise ValueError(f"bad entity id {eid!r}")
+        self.buf += b
+
+    def append_var_str(self, s: str) -> None:
+        self.append_var_bytes(s.encode("utf-8"))
+
+    def append_var_bytes(self, b: bytes) -> None:
+        self.append_u32(len(b))
+        self.buf += b
+
+    def append_data(self, obj: Any) -> None:
+        """msgpack-encode an arbitrary structure (reference ``AppendData``)."""
+        self.append_var_bytes(
+            msgpack.packb(obj, use_bin_type=True)
+        )
+
+    def append_args(self, args: tuple | list) -> None:
+        """Argument list: u16 count + one msgpack blob per arg (reference
+        ``AppendArgs`` packs each arg separately so the receiver can lazily
+        decode)."""
+        self.append_u16(len(args))
+        for a in args:
+            self.append_data(a)
+
+    # -- read side -------------------------------------------------------
+    def _take(self, n: int) -> memoryview:
+        if self.rpos + n > len(self.buf):
+            raise EOFError("packet underrun")
+        mv = memoryview(self.buf)[self.rpos:self.rpos + n]
+        self.rpos += n
+        return mv
+
+    def read_u8(self) -> int:
+        return self._take(1)[0]
+
+    def read_bool(self) -> bool:
+        return self._take(1)[0] != 0
+
+    def read_u16(self) -> int:
+        return _U16.unpack(self._take(2))[0]
+
+    def read_u32(self) -> int:
+        return _U32.unpack(self._take(4))[0]
+
+    def read_f32(self) -> float:
+        return _F32.unpack(self._take(4))[0]
+
+    def read_bytes(self, n: int) -> bytes:
+        return bytes(self._take(n))
+
+    def read_entity_id(self) -> str:
+        return bytes(self._take(ENTITYID_LENGTH)).decode("ascii")
+
+    def read_var_bytes(self) -> bytes:
+        n = self.read_u32()
+        return bytes(self._take(n))
+
+    def read_var_str(self) -> str:
+        return self.read_var_bytes().decode("utf-8")
+
+    def read_data(self) -> Any:
+        return msgpack.unpackb(self.read_var_bytes(), raw=False)
+
+    def read_args(self) -> list:
+        n = self.read_u16()
+        return [self.read_data() for _ in range(n)]
+
+    def remaining(self) -> int:
+        return len(self.buf) - self.rpos
+
+    def payload(self) -> bytes:
+        return bytes(self.buf)
+
+
+def new_packet(msgtype: int) -> Packet:
+    p = Packet.alloc()
+    p.append_u16(msgtype)
+    return p
+
+
+def frame(p: Packet) -> bytes:
+    """Wrap a packet's payload with the u32 size prefix for the wire."""
+    return _SIZE_FMT.pack(len(p.buf)) + bytes(p.buf)
+
+
+class PacketConnection:
+    """Framed packet IO over an asyncio stream (reference
+    ``PacketConnection.go``). Writes are buffered by the transport; reads
+    return (msgtype, Packet-positioned-after-msgtype)."""
+
+    def __init__(
+        self,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+    ):
+        self.reader = reader
+        self.writer = writer
+        self._closed = False
+
+    def send(self, p: Packet, release: bool = True) -> None:
+        if self._closed:
+            return
+        try:
+            self.writer.write(frame(p))
+        except (ConnectionError, RuntimeError):
+            self._closed = True
+        if release:
+            p.release()
+
+    async def drain(self) -> None:
+        if not self._closed:
+            try:
+                await self.writer.drain()
+            except ConnectionError:
+                self._closed = True
+
+    async def recv(self) -> tuple[int, Packet]:
+        hdr = await self.reader.readexactly(HEADER_SIZE)
+        (size,) = _SIZE_FMT.unpack(hdr)
+        if size < 2 or size > MAX_PAYLOAD_LENGTH:
+            raise ConnectionError(f"bad packet size {size}")
+        body = await self.reader.readexactly(size)
+        p = Packet(body)
+        msgtype = p.read_u16()
+        return msgtype, p
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            self.writer.close()
+            await self.writer.wait_closed()
+        except (ConnectionError, RuntimeError):
+            pass
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def peername(self):
+        try:
+            return self.writer.get_extra_info("peername")
+        except Exception:
+            return None

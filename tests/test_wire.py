@@ -1,0 +1,150 @@
+"""Wire layer: packet framing, proto pack/unpack, native/numpy codec parity.
+
+Mirrors the reference's serialization unit tests
+(``engine/netutil/MsgPacker_test.go``, packet round-trips).
+"""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.net import codec, proto
+from goworld_tpu.net.packet import Packet, frame, new_packet
+from goworld_tpu.utils import ids
+
+
+def test_packet_roundtrip_scalars():
+    p = new_packet(42)
+    p.append_bool(True)
+    p.append_u8(200)
+    p.append_u16(0xBEEF)
+    p.append_u32(0xDEADBEEF)
+    p.append_f32(1.5)
+    eid = ids.gen_entity_id()
+    p.append_entity_id(eid)
+    p.append_var_str("héllo wörld")
+    p.append_var_bytes(b"\x00\x01\x02")
+    p.append_data({"a": [1, 2.5, "x"], "b": None})
+    p.append_args((1, "two", [3.0], {"k": b"v"}))
+
+    q = Packet(bytes(p.buf))
+    assert q.read_u16() == 42
+    assert q.read_bool() is True
+    assert q.read_u8() == 200
+    assert q.read_u16() == 0xBEEF
+    assert q.read_u32() == 0xDEADBEEF
+    assert q.read_f32() == 1.5
+    assert q.read_entity_id() == eid
+    assert q.read_var_str() == "héllo wörld"
+    assert q.read_var_bytes() == b"\x00\x01\x02"
+    assert q.read_data() == {"a": [1, 2.5, "x"], "b": None}
+    assert q.read_args() == [1, "two", [3.0], {"k": b"v"}]
+    assert q.remaining() == 0
+
+
+def test_packet_underrun_raises():
+    p = Packet(b"\x01")
+    with pytest.raises(EOFError):
+        p.read_u32()
+
+
+def test_frame_and_scan():
+    packets = []
+    for i in range(5):
+        p = new_packet(proto.MT_HEARTBEAT)
+        p.append_u32(i)
+        packets.append(frame(p))
+    stream = b"".join(packets)
+    # a partial 6th packet at the tail
+    stream += packets[0][:5]
+    frames, consumed = codec.scan_frames(stream)
+    assert len(frames) == 5
+    assert consumed == sum(len(x) for x in packets)
+    for i, (off, size) in enumerate(frames):
+        q = Packet(stream[off:off + size])
+        assert q.read_u16() == proto.MT_HEARTBEAT
+        assert q.read_u32() == i
+
+
+def test_scan_malformed_raises():
+    bad = (10**9).to_bytes(4, "little") + b"xx"
+    with pytest.raises(ConnectionError):
+        codec.scan_frames(bad)
+
+
+def test_sync_batch_roundtrip():
+    n = 257
+    rng = np.random.default_rng(0)
+    eids = [ids.gen_entity_id() for _ in range(n)]
+    vals = rng.standard_normal((n, 4)).astype(np.float32)
+    buf = codec.encode_sync_batch(eids, vals)
+    assert len(buf) == n * proto.SYNC_RECORD_SIZE
+    out_ids, out_vals = codec.decode_sync_batch(buf)
+    assert [b.decode() for b in out_ids] == eids
+    np.testing.assert_array_equal(out_vals, vals)
+
+
+def test_client_sync_batch_roundtrip():
+    n = 63
+    rng = np.random.default_rng(1)
+    cids = [ids.gen_entity_id() for _ in range(n)]
+    eids = [ids.gen_entity_id() for _ in range(n)]
+    vals = rng.standard_normal((n, 4)).astype(np.float32)
+    buf = codec.encode_client_sync_batch(cids, eids, vals)
+    assert len(buf) == n * proto.CLIENT_SYNC_RECORD_SIZE
+    oc, oe, ov = codec.decode_client_sync_batch(buf)
+    assert [b.decode() for b in oc] == cids
+    assert [b.decode() for b in oe] == eids
+    np.testing.assert_array_equal(ov, vals)
+
+
+def test_native_numpy_parity():
+    """The C++ codec and the numpy fallback must produce identical bytes."""
+    if not codec.native_available():
+        pytest.skip("native codec unavailable")
+    n = 100
+    rng = np.random.default_rng(2)
+    eids = [ids.gen_entity_id() for _ in range(n)]
+    vals = rng.standard_normal((n, 4)).astype(np.float32)
+    native = codec.encode_sync_batch(eids, vals)
+    rec = np.empty(n, codec.SYNC_DTYPE)
+    rec["eid"] = np.asarray(eids, "S16")
+    rec["v"] = vals
+    assert native == rec.tobytes()
+
+
+def test_bucket_by_shard():
+    shard_of = np.array([0, 1, 0, 2, -1, 1, 0, 0], np.int32)
+    idx, counts = codec.bucket_by_shard(shard_of, 3, capacity=3)
+    assert counts.tolist() == [3, 2, 1]  # 4th shard-0 record dropped (cap)
+    assert idx[0, :3].tolist() == [0, 2, 6]
+    assert idx[1, :2].tolist() == [1, 5]
+    assert idx[2, :1].tolist() == [3]
+
+
+def test_proto_call_entity_method_roundtrip():
+    eid = ids.gen_entity_id()
+    cid = ids.gen_entity_id()
+    p = proto.pack_call_entity_method(eid, "TestMethod", (1, "a"), cid)
+    q = Packet(bytes(p.buf))
+    assert q.read_u16() == proto.MT_CALL_ENTITY_METHOD_FROM_CLIENT
+    assert q.read_entity_id() == eid
+    assert q.read_entity_id() == cid
+    assert q.read_var_str() == "TestMethod"
+    assert q.read_args() == [1, "a"]
+
+
+def test_proto_create_entity_on_client_roundtrip():
+    cid = ids.gen_entity_id()
+    eid = ids.gen_entity_id()
+    p = proto.pack_create_entity_on_client(
+        3, cid, eid, "Avatar", True, {"name": "bob"}, (1.0, 2.0, 3.0), 0.5
+    )
+    q = Packet(bytes(p.buf))
+    assert q.read_u16() == proto.MT_CREATE_ENTITY_ON_CLIENT
+    assert q.read_u16() == 3
+    assert q.read_entity_id() == cid
+    assert q.read_entity_id() == eid
+    assert q.read_var_str() == "Avatar"
+    assert q.read_bool() is True
+    assert [q.read_f32() for _ in range(4)] == [1.0, 2.0, 3.0, 0.5]
+    assert q.read_data() == {"name": "bob"}
