@@ -1,0 +1,199 @@
+"""Cluster configuration — one INI file shared by every process.
+
+Reference being rebuilt: ``engine/config`` (``read_config.go:40-118,238-330``
+and ``goworld.ini.sample``): a single ``goworld.ini`` read by dispatcher,
+game and gate processes alike, with ``[deployment]`` desired process counts
+(the readiness barrier), numbered sections ``[dispatcherN]``/``[gameN]``/
+``[gateN]``, and ``*_common`` sections providing inherited defaults.
+
+TPU additions live in the game sections: per-space device capacity, AOI
+radius, number of space shards, mesh axis size.
+"""
+
+from __future__ import annotations
+
+import configparser
+import dataclasses
+import os
+
+DEFAULT_CONFIG_PATHS = ("goworld_tpu.ini", "goworld.ini")
+
+
+@dataclasses.dataclass
+class DispatcherConfig:
+    host: str = "127.0.0.1"
+    port: int = 14000
+    http_port: int = 0
+
+
+@dataclasses.dataclass
+class GameConfig:
+    boot_entity: str = "Account"
+    save_interval: float = 300.0
+    position_sync_interval_ms: int = 100
+    ban_boot_entity: bool = False
+    http_port: int = 0
+    log_file: str = ""
+    log_level: str = "info"
+    # TPU execution knobs
+    capacity: int = 16384
+    n_spaces: int = 1
+    aoi_radius: float = 50.0
+    extent_x: float = 1000.0
+    extent_z: float = 1000.0
+    mesh_devices: int = 0  # 0 = single-device vmap path
+
+
+@dataclasses.dataclass
+class GateConfig:
+    host: str = "127.0.0.1"
+    port: int = 15000
+    ws_port: int = 0          # 0 = no websocket listener
+    compress: bool = False
+    heartbeat_timeout: float = 0.0  # 0 = disabled
+    position_sync_interval_ms: int = 100
+    log_file: str = ""
+    log_level: str = "info"
+
+
+@dataclasses.dataclass
+class StorageConfig:
+    kind: str = "filesystem"   # filesystem | memory
+    directory: str = "entity_storage"
+
+
+@dataclasses.dataclass
+class KVDBConfig:
+    kind: str = "filesystem"   # filesystem | memory
+    path: str = "kvdb_data"
+
+
+@dataclasses.dataclass
+class ClusterConfig:
+    dispatchers: dict[int, DispatcherConfig] = dataclasses.field(
+        default_factory=dict)
+    games: dict[int, GameConfig] = dataclasses.field(default_factory=dict)
+    gates: dict[int, GateConfig] = dataclasses.field(default_factory=dict)
+    storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
+    kvdb: KVDBConfig = dataclasses.field(default_factory=KVDBConfig)
+
+    @property
+    def desired_games(self) -> int:
+        return len(self.games)
+
+    @property
+    def desired_gates(self) -> int:
+        return len(self.gates)
+
+    def dispatcher_addrs(self) -> list[tuple[str, int]]:
+        return [
+            (d.host, d.port)
+            for _, d in sorted(self.dispatchers.items())
+        ]
+
+
+def _fill(dc, section) -> None:
+    """Assign section keys onto a dataclass, coercing by field type."""
+    types = {f.name: f.type for f in dataclasses.fields(dc)}
+    for key, raw in section.items():
+        if key not in types:
+            continue
+        t = types[key]
+        cur = getattr(dc, key)
+        if isinstance(cur, bool) or t == "bool":
+            val: object = raw.strip().lower() in ("1", "true", "yes", "on")
+        elif isinstance(cur, int):
+            val = int(raw)
+        elif isinstance(cur, float):
+            val = float(raw)
+        else:
+            val = raw
+        setattr(dc, key, val)
+
+
+def load(path: str | None = None) -> ClusterConfig:
+    """Load the cluster config (reference ``config.Get()``); falls back to
+    a 1-dispatcher/1-game/1-gate localhost layout when no file exists."""
+    cp = configparser.ConfigParser()
+    found = None
+    if path is not None:
+        found = path
+    else:
+        for cand in DEFAULT_CONFIG_PATHS:
+            if os.path.exists(cand):
+                found = cand
+                break
+    if found is not None:
+        with open(found) as f:
+            cp.read_file(f)
+
+    cfg = ClusterConfig()
+
+    def build(prefix: str, cls, store: dict) -> None:
+        common = cp[f"{prefix}_common"] if cp.has_section(
+            f"{prefix}_common") else {}
+        for name in cp.sections():
+            if name.startswith(prefix) and name[len(prefix):].isdigit():
+                idx = int(name[len(prefix):])
+                dc = cls()
+                _fill(dc, common)
+                _fill(dc, cp[name])
+                store[idx] = dc
+
+    build("dispatcher", DispatcherConfig, cfg.dispatchers)
+    build("game", GameConfig, cfg.games)
+    build("gate", GateConfig, cfg.gates)
+    if cp.has_section("storage"):
+        _fill(cfg.storage, cp["storage"])
+    if cp.has_section("kvdb"):
+        _fill(cfg.kvdb, cp["kvdb"])
+
+    if not cfg.dispatchers:
+        cfg.dispatchers[1] = DispatcherConfig()
+    if not cfg.games:
+        cfg.games[1] = GameConfig()
+    if not cfg.gates:
+        cfg.gates[1] = GateConfig()
+    return cfg
+
+
+def dumps_sample() -> str:
+    """A commented sample config (reference ``goworld.ini.sample``)."""
+    return """\
+# goworld_tpu cluster configuration (reference: goworld.ini.sample)
+# Every process reads this same file; numbered sections declare the
+# deployment (their count is the readiness barrier).
+
+[dispatcher1]
+host = 127.0.0.1
+port = 14000
+
+[game_common]
+boot_entity = Account
+position_sync_interval_ms = 100
+save_interval = 300
+# TPU execution
+capacity = 16384
+n_spaces = 1
+aoi_radius = 50.0
+extent_x = 1000.0
+extent_z = 1000.0
+
+[game1]
+
+[gate_common]
+host = 127.0.0.1
+compress = false
+heartbeat_timeout = 60
+
+[gate1]
+port = 15000
+
+[storage]
+kind = filesystem
+directory = entity_storage
+
+[kvdb]
+kind = filesystem
+path = kvdb_data
+"""

@@ -43,6 +43,35 @@ from goworld_tpu.utils import consts, ids, log
 logger = log.get("world")
 
 
+def _bucket(n: int, lo: int = 8) -> int:
+    """Next power-of-two >= n. Host->device scatter batches are padded to
+    bucket sizes so XLA compiles one executable per bucket instead of one
+    per distinct batch length (unpadded, every tick with a new staging
+    count pays a fresh compile — hundreds of ms each)."""
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+def _pad_scatter(sh: np.ndarray, sl: np.ndarray, capacity: int,
+                 *vals: np.ndarray):
+    """Pad index/value arrays to the bucket size; padded rows point at
+    slot=capacity (out of bounds) and are dropped by ``mode='drop'``."""
+    n = sh.shape[0]
+    b = _bucket(n)
+    if b == n:
+        return (sh, sl) + vals
+    pad = b - n
+    sh = np.concatenate([sh, np.zeros(pad, sh.dtype)])
+    sl = np.concatenate([sl, np.full(pad, capacity, sl.dtype)])
+    out = [
+        np.concatenate([v, np.zeros((pad,) + v.shape[1:], v.dtype)])
+        for v in vals
+    ]
+    return (sh, sl) + tuple(out)
+
+
 def _make_local_tick(cfg: WorldConfig):
     """jit(vmap(tick_body)) over stacked spaces on ONE device — the
     single-process analog of the mesh's shard_map step."""
@@ -142,10 +171,21 @@ class World:
         # pluggable sinks (the gateway overrides these; defaults capture)
         self.client_messages: list[tuple[int, str, dict]] = []
         self.client_sink: Callable[[int, str, dict], None] | None = None
+        # batched downstream sync: sync_sink(gate_id, cids, eids, vals)
+        # replaces per-record "sync" dicts when set (the game-server path)
+        self.sync_sink: Callable[[int, list, list, np.ndarray], None] | None \
+            = None
         self.filtered_sink = None  # set by the gateway (stage 3)
         self.remote_router = None  # cross-process RPC hook
+        # cross-process EnterSpace: called when the target space is not
+        # hosted here (reference requestMigrateTo, Entity.go:1006-1012)
+        self.remote_space_router: Callable[[Entity, str, tuple], None] | None \
+            = None
         self.storage = None        # persistence backend (stage 6)
         self.service_mgr = None    # sharded services (stage 5)
+        # cluster notifications (the game server wires these)
+        self.on_entity_created: Callable[[Entity], None] | None = None
+        self.on_entity_destroyed: Callable[[Entity], None] | None = None
         self.op_stats: dict[str, float] = defaultdict(float)
 
     # ==================================================================
@@ -241,6 +281,8 @@ class World:
         if client is not None:
             self.set_entity_client(e, client)
         e.OnCreated()
+        if self.on_entity_created is not None:
+            self.on_entity_created(e)
         return e
 
     def load_entity(self, type_name: str, eid: str,
@@ -313,6 +355,11 @@ class World:
         ``DispatcherService.go:850-891``)."""
         target = self.spaces.get(space_id)
         if target is None:
+            if self.remote_space_router is not None:
+                # the space lives on another game process: hand off to the
+                # cross-process migration protocol (SURVEY.md#3.5)
+                self.remote_space_router(e, space_id, tuple(map(float, pos)))
+                return
             raise KeyError(f"space {space_id} not found in this world")
         if e.space is target:
             e.set_position(pos)
@@ -452,6 +499,8 @@ class World:
         # referencing its slot have been processed (_process_outputs), or
         # until _process_arrivals drops its in-flight row (destroyed
         # mid-migration)
+        if self.on_entity_destroyed is not None:
+            self.on_entity_destroyed(e)
 
     # ==================================================================
     # staging entry points (called by Entity)
@@ -664,6 +713,70 @@ class World:
             self.client_messages.append((gate_id, client_id, msg))
 
     # ==================================================================
+    # cross-process migration (reference Entity.go:1060-1115,
+    # EntityManager.go:246-305 — GetMigrateData / restoreEntity)
+    # ==================================================================
+    def get_migrate_data(self, e: Entity) -> dict:
+        """Everything needed to recreate the entity on another game: all
+        attrs, client binding, pos/yaw, migration-safe timers."""
+        return {
+            "type": e.type_name,
+            "id": e.id,
+            "attrs": e.attrs.to_dict(),
+            "client": (
+                [e.client.gate_id, e.client.client_id]
+                if e.client is not None else None
+            ),
+            "pos": list(e.position),
+            "yaw": e.yaw,
+            "timers": self.timers.dump(list(e.timer_ids)),
+        }
+
+    def remove_for_migration(self, e: Entity) -> None:
+        """Tear down the local copy WITHOUT destroy semantics — no
+        OnDestroy, no persistence, no client destroy message (the client
+        binding travels in the migrate data; reference
+        ``destroyEntity(isMigrate=true)``, ``Entity.go:631-651``)."""
+        e.OnMigrateOut()
+        for tid in list(e.timer_ids):
+            self.timers.cancel(tid)
+        e.timer_ids.clear()
+        e.client = None  # quiet detach; the data carries the binding
+        e.destroyed = True
+        self._leave_space_host(e)
+        if e.slot is None and e._migrating is None:
+            self.entities.pop(e.id, None)
+
+    def restore_from_migration(self, data: dict,
+                               space: Space | None = None) -> Entity:
+        """Recreate a migrated-in entity: rebuild attrs, quietly re-assign
+        the client, enter the target space, restore timers, OnMigrateIn."""
+        desc = self.registry.get(data["type"])
+        e: Entity = desc.cls()
+        e._type_desc = desc
+        self._attach(e, data["id"])
+        self.entities[e.id] = e
+        load_into(e.attrs, data["attrs"])
+        if data.get("client"):
+            # direct assignment = the reference's "re-assign client
+            # quietly" (no create_entity resend; the client already has
+            # the entity)
+            e.client = GameClient(
+                data["client"][0], data["client"][1], self
+            )
+        sp = space or self.nil_space
+        if sp is not None:
+            self._enter_space_local(e, sp, tuple(data["pos"]))
+        e._pending_yaw = float(data.get("yaw", 0.0))
+        self.stage_pos_set(e)
+        for tid in self.timers.restore(data.get("timers", [])):
+            e.timer_ids.add(tid)
+        e.OnMigrateIn()
+        if self.on_entity_created is not None:
+            self.on_entity_created(e)
+        return e
+
+    # ==================================================================
     # persistence
     # ==================================================================
     def save_entity(self, e: Entity) -> None:
@@ -706,6 +819,8 @@ class World:
             st = self.state
             msh = np.array([m[0] for m in live], np.int32)
             msl = np.array([m[1] for m in live], np.int32)
+            if live:
+                msh, msl = _pad_scatter(msh, msl, 0)
             rows = jax.device_get({
                 "pos": st.pos[(msh, msl)], "yaw": st.yaw[(msh, msl)],
                 "type_id": st.type_id[(msh, msl)],
@@ -751,30 +866,36 @@ class World:
             self._staged_migrate.clear()
 
         st = self.state
+        cap = cfg.capacity
         if self._staged_spawn:
             sh = np.array([s for s, _, _ in self._staged_spawn], np.int32)
             sl = np.array([s for _, s, _ in self._staged_spawn], np.int32)
             d = [v for _, _, v in self._staged_spawn]
+            sh, sl, p_, y_, mv, hc, cg, ti, ht = _pad_scatter(
+                sh, sl, cap,
+                np.array([x["pos"] for x in d], np.float32),
+                np.array([x["yaw"] for x in d], np.float32),
+                np.array([x["npc_moving"] for x in d]),
+                np.array([x["has_client"] for x in d]),
+                np.array([x["client_gate"] for x in d], np.int32),
+                np.array([x["type_id"] for x in d], np.int32),
+                np.array([x["hot"] for x in d], np.float32),
+            )
+            ix = (sh, sl)
             st = st.replace(
-                pos=st.pos.at[(sh, sl)].set(
-                    np.array([x["pos"] for x in d], np.float32)),
-                yaw=st.yaw.at[(sh, sl)].set(
-                    np.array([x["yaw"] for x in d], np.float32)),
-                vel=st.vel.at[(sh, sl)].set(0.0),
-                alive=st.alive.at[(sh, sl)].set(True),
-                npc_moving=st.npc_moving.at[(sh, sl)].set(
-                    np.array([x["npc_moving"] for x in d])),
-                has_client=st.has_client.at[(sh, sl)].set(
-                    np.array([x["has_client"] for x in d])),
-                client_gate=st.client_gate.at[(sh, sl)].set(
-                    np.array([x["client_gate"] for x in d], np.int32)),
-                type_id=st.type_id.at[(sh, sl)].set(
-                    np.array([x["type_id"] for x in d], np.int32)),
-                gen=st.gen.at[(sh, sl)].add(1),
-                dirty=st.dirty.at[(sh, sl)].set(True),
-                hot_attrs=st.hot_attrs.at[(sh, sl)].set(
-                    np.array([x["hot"] for x in d], np.float32)),
-                attr_dirty=st.attr_dirty.at[(sh, sl)].set(np.uint32(0)),
+                pos=st.pos.at[ix].set(p_, mode="drop"),
+                yaw=st.yaw.at[ix].set(y_, mode="drop"),
+                vel=st.vel.at[ix].set(0.0, mode="drop"),
+                alive=st.alive.at[ix].set(True, mode="drop"),
+                npc_moving=st.npc_moving.at[ix].set(mv, mode="drop"),
+                has_client=st.has_client.at[ix].set(hc, mode="drop"),
+                client_gate=st.client_gate.at[ix].set(cg, mode="drop"),
+                type_id=st.type_id.at[ix].set(ti, mode="drop"),
+                gen=st.gen.at[ix].add(1, mode="drop"),
+                dirty=st.dirty.at[ix].set(True, mode="drop"),
+                hot_attrs=st.hot_attrs.at[ix].set(ht, mode="drop"),
+                attr_dirty=st.attr_dirty.at[ix].set(
+                    np.uint32(0), mode="drop"),
             )
             # the device row now holds the spawn position; clear the host
             # mirror so Entity.position tracks the live row (unless a
@@ -791,12 +912,14 @@ class World:
         if self._staged_despawn:
             sh = np.array([s for s, _ in self._staged_despawn], np.int32)
             sl = np.array([s for _, s in self._staged_despawn], np.int32)
+            sh, sl = _pad_scatter(sh, sl, cap)
+            ix = (sh, sl)
             st = st.replace(
-                alive=st.alive.at[(sh, sl)].set(False),
-                has_client=st.has_client.at[(sh, sl)].set(False),
-                client_gate=st.client_gate.at[(sh, sl)].set(-1),
-                npc_moving=st.npc_moving.at[(sh, sl)].set(False),
-                dirty=st.dirty.at[(sh, sl)].set(False),
+                alive=st.alive.at[ix].set(False, mode="drop"),
+                has_client=st.has_client.at[ix].set(False, mode="drop"),
+                client_gate=st.client_gate.at[ix].set(-1, mode="drop"),
+                npc_moving=st.npc_moving.at[ix].set(False, mode="drop"),
+                dirty=st.dirty.at[ix].set(False, mode="drop"),
             )
             self._release_now.extend(self._staged_despawn)
             self._staged_despawn.clear()
@@ -806,8 +929,10 @@ class World:
             sl = np.array([x[1] for x in self._staged_hot], np.int32)
             co = np.array([x[2] for x in self._staged_hot], np.int32)
             va = np.array([x[3] for x in self._staged_hot], np.float32)
+            sh, sl, co, va = _pad_scatter(sh, sl, cap, co, va)
             st = st.replace(
-                hot_attrs=st.hot_attrs.at[(sh, sl, co)].set(va)
+                hot_attrs=st.hot_attrs.at[(sh, sl, co)].set(
+                    va, mode="drop")
             )
             self._staged_hot.clear()
 
@@ -815,7 +940,10 @@ class World:
             sh = np.array([x[0] for x in self._staged_moving], np.int32)
             sl = np.array([x[1] for x in self._staged_moving], np.int32)
             mv = np.array([x[2] for x in self._staged_moving])
-            st = st.replace(npc_moving=st.npc_moving.at[(sh, sl)].set(mv))
+            sh, sl, mv = _pad_scatter(sh, sl, cap, mv)
+            st = st.replace(
+                npc_moving=st.npc_moving.at[(sh, sl)].set(mv, mode="drop")
+            )
             self._staged_moving.clear()
 
         if self._staged_client:
@@ -823,9 +951,11 @@ class World:
             sl = np.array([x[1] for x in self._staged_client], np.int32)
             hc = np.array([x[2] for x in self._staged_client])
             cg = np.array([x[3] for x in self._staged_client], np.int32)
+            sh, sl, hc, cg = _pad_scatter(sh, sl, cap, hc, cg)
+            ix = (sh, sl)
             st = st.replace(
-                has_client=st.has_client.at[(sh, sl)].set(hc),
-                client_gate=st.client_gate.at[(sh, sl)].set(cg),
+                has_client=st.has_client.at[ix].set(hc, mode="drop"),
+                client_gate=st.client_gate.at[ix].set(cg, mode="drop"),
             )
             self._staged_client.clear()
 
@@ -846,6 +976,7 @@ class World:
         if need_yaw:
             ysh = np.array([s for s, _ in need_yaw], np.int32)
             ysl = np.array([s for _, s in need_yaw], np.int32)
+            ysh, ysl = _pad_scatter(ysh, ysl, 0)  # pad only (gather clips)
             got = jax.device_get(st.yaw[(ysh, ysl)])
             yaw_fb = {k: float(v) for k, v in zip(need_yaw, got)}
         overflow: dict[tuple[int, int], Entity] = {}
@@ -962,16 +1093,36 @@ class World:
                 ws = np.asarray(base.sync_w[shard])[:sn]
                 js = np.asarray(base.sync_j[shard])[:sn]
                 vs = np.asarray(base.sync_vals[shard])[:sn]
-                for w, j, v in zip(ws, js, vs):
-                    we = self._owner_entity(shard, int(w))
-                    je = self._owner_entity(shard, int(j))
-                    if we is None or we.client is None or je is None:
-                        continue
-                    we.client.send({
-                        "type": "sync", "eid": je.id,
-                        "pos": [float(v[0]), float(v[1]), float(v[2])],
-                        "yaw": float(v[3]),
-                    })
+                if self.sync_sink is not None:
+                    # batched path: one (cids, eids, vals) bundle per gate
+                    # per tick — feeds MT_SYNC_POSITION_YAW_ON_CLIENTS
+                    per_gate: dict[int, list] = {}
+                    for i, (w, j) in enumerate(zip(ws, js)):
+                        we = self._owner_entity(shard, int(w))
+                        je = self._owner_entity(shard, int(j))
+                        if we is None or we.client is None or je is None:
+                            continue
+                        per_gate.setdefault(we.client.gate_id, []).append(
+                            (we.client.client_id, je.id, i)
+                        )
+                    for gate_id, rows in per_gate.items():
+                        self.sync_sink(
+                            gate_id,
+                            [r[0] for r in rows],
+                            [r[1] for r in rows],
+                            vs[[r[2] for r in rows]],
+                        )
+                else:
+                    for w, j, v in zip(ws, js, vs):
+                        we = self._owner_entity(shard, int(w))
+                        je = self._owner_entity(shard, int(j))
+                        if we is None or we.client is None or je is None:
+                            continue
+                        we.client.send({
+                            "type": "sync", "eid": je.id,
+                            "pos": [float(v[0]), float(v[1]), float(v[2])],
+                            "yaw": float(v[3]),
+                        })
             # device-side hot-attr deltas (kernel-mutated attrs)
             an = min(int(base.attr_n[shard]), cfg.attr_sync_cap)
             if an:

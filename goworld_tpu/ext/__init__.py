@@ -1,0 +1,2 @@
+"""Extensions built on the core framework (reference ``ext/``): pubsub
+service, async DB wrappers."""

@@ -1,0 +1,144 @@
+"""Global async key-value store (account->avatar maps, mail ids, ...).
+
+Reference being rebuilt: ``engine/kvdb`` (``kvdb.go:42-200``): a cluster-
+global KV store with pluggable backends, all ops running on a dedicated
+async group (``_kvdb``) with callbacks posted to the logic thread:
+``Get/Put/GetOrPut/GetRange/NextLargerKey``. Backends here: ``filesystem``
+(single msgpack file with ordered keys) and ``memory``; the interface
+matches the reference's backend iface (``kvdb/types/kvdb_types.go``) so
+redis/mongo backends can slot in where available.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+from typing import Callable
+
+import msgpack
+
+from goworld_tpu.utils import log
+from goworld_tpu.utils.asyncwork import AsyncWorkers
+
+logger = log.get("kvdb")
+
+_GROUP = "_kvdb"  # dedicated worker group (reference kvdb.go:42)
+
+
+class KVDBBackend:
+    def get(self, key: str) -> str | None:
+        raise NotImplementedError
+
+    def put(self, key: str, val: str) -> None:
+        raise NotImplementedError
+
+    def get_range(self, begin: str, end: str) -> list[tuple[str, str]]:
+        """Items with begin <= key < end, ascending."""
+        raise NotImplementedError
+
+    def close(self) -> None: ...
+
+
+class MemoryKVDB(KVDBBackend):
+    def __init__(self):
+        self._d: dict[str, str] = {}
+
+    def get(self, key):
+        return self._d.get(key)
+
+    def put(self, key, val):
+        self._d[key] = val
+
+    def get_range(self, begin, end):
+        keys = sorted(k for k in self._d if begin <= k < end)
+        return [(k, self._d[k]) for k in keys]
+
+
+class FilesystemKVDB(KVDBBackend):
+    """Append-friendly single-file store; full rewrite on flush (small
+    cluster metadata workloads, not bulk data)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._d: dict[str, str] = {}
+        self._lock = threading.Lock()
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                raw = f.read()
+            if raw:
+                self._d = msgpack.unpackb(raw, raw=False)
+
+    def _flush(self) -> None:
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(self._d, use_bin_type=True))
+        os.replace(tmp, self.path)
+
+    def get(self, key):
+        with self._lock:
+            return self._d.get(key)
+
+    def put(self, key, val):
+        with self._lock:
+            self._d[key] = val
+            self._flush()
+
+    def get_range(self, begin, end):
+        with self._lock:
+            keys = sorted(k for k in self._d if begin <= k < end)
+            return [(k, self._d[k]) for k in keys]
+
+
+def open_kvdb_backend(kind: str, location: str = "") -> KVDBBackend:
+    if kind == "memory":
+        return MemoryKVDB()
+    if kind == "filesystem":
+        return FilesystemKVDB(location or "kvdb_data.mp")
+    raise ValueError(f"unknown kvdb backend {kind!r}")
+
+
+def next_larger_key(key: str) -> str:
+    """The smallest key strictly greater than every key prefixed by
+    ``key`` is not needed — the reference's ``NextLargerKey`` returns
+    ``key + "\\x00"``, the immediate successor (``kvdb.go:196-200``)."""
+    return key + "\x00"
+
+
+class KVDB:
+    """Async facade (``world.kvdb = KVDB(backend, workers)``); callbacks
+    run on the logic thread via the worlds's post queue."""
+
+    def __init__(self, backend: KVDBBackend, workers: AsyncWorkers):
+        self.backend = backend
+        self.workers = workers
+
+    def get(self, key: str,
+            cb: Callable[[str | None, Exception | None], None]) -> None:
+        self.workers.submit(_GROUP, lambda: self.backend.get(key), cb)
+
+    def put(self, key: str, val: str,
+            cb: Callable[[None, Exception | None], None] | None = None,
+            ) -> None:
+        self.workers.submit(_GROUP, lambda: self.backend.put(key, val), cb)
+
+    def get_or_put(self, key: str, val: str,
+                   cb: Callable[[str | None, Exception | None], None],
+                   ) -> None:
+        """Atomic read-else-write (reference ``GetOrPut``): returns the
+        existing value (put skipped) or None (val written). Atomicity holds
+        because all kvdb ops serialize on the single ``_kvdb`` worker."""
+
+        def job():
+            old = self.backend.get(key)
+            if old is None:
+                self.backend.put(key, val)
+            return old
+
+        self.workers.submit(_GROUP, job, cb)
+
+    def get_range(self, begin: str, end: str,
+                  cb: Callable[[list, Exception | None], None]) -> None:
+        self.workers.submit(
+            _GROUP, lambda: self.backend.get_range(begin, end), cb
+        )

@@ -1,0 +1,226 @@
+"""Bot client — headless game client swarm for integration testing.
+
+Reference being rebuilt: ``examples/test_client`` (``ClientBot.go:200-300``,
+``ClientEntity.go``): N bots connect to gates over the real wire protocol,
+mirror server entities/attrs locally, random-walk their player entity with
+position syncs, and in *strict* mode assert that mirrored state stays
+consistent. The bot client is the de-facto fake-client fixture of the whole
+test strategy (``SURVEY.md#4``).
+
+This implementation drives one asyncio task per bot; a swarm runner spins
+up N bots against a gate address.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+
+from goworld_tpu.net import codec, proto
+from goworld_tpu.net.packet import Packet, PacketConnection, new_packet
+from goworld_tpu.utils import log
+
+logger = log.get("bot")
+
+
+class MirrorEntity:
+    """Client-side mirror of a server entity (reference ``clientEntity``)."""
+
+    __slots__ = ("eid", "type_name", "is_player", "attrs", "pos", "yaw")
+
+    def __init__(self, eid: str, type_name: str, is_player: bool,
+                 attrs: dict, pos: tuple, yaw: float):
+        self.eid = eid
+        self.type_name = type_name
+        self.is_player = is_player
+        self.attrs = attrs
+        self.pos = pos
+        self.yaw = yaw
+
+    def apply_deltas(self, deltas: list[dict]) -> None:
+        """Apply server attr deltas to the local mirror (reference
+        ``ClientBot.go:240-300`` applyMapAttrChange et al)."""
+        for d in deltas:
+            path, op, value = d["path"], d["op"], d.get("value")
+            node = self.attrs
+            for key in path[:-1]:
+                if isinstance(node, list):
+                    node = node[int(key)]
+                else:
+                    node = node.setdefault(key, {})
+            last = path[-1] if path else None
+            if op == "set":
+                if isinstance(node, list):
+                    node[int(last)] = value
+                else:
+                    node[last] = value
+            elif op == "del":
+                if isinstance(node, list):
+                    del node[int(last)]
+                else:
+                    node.pop(last, None)
+            elif op == "append":
+                node2 = node[last] if last is not None else node
+                node2.append(value)
+            elif op == "pop":
+                node2 = node[last] if last is not None else node
+                if node2:
+                    node2.pop()
+
+
+class BotClient:
+    """One bot: connects, waits for its player entity, random-walks."""
+
+    def __init__(self, host: str, port: int, *, bot_id: int = 0,
+                 strict: bool = False, move_interval: float = 0.1,
+                 speed: float = 5.0, seed: int | None = None):
+        self.host = host
+        self.port = port
+        self.bot_id = bot_id
+        self.strict = strict
+        self.move_interval = move_interval
+        self.speed = speed
+        self.rng = random.Random(seed if seed is not None else bot_id)
+        self.conn: PacketConnection | None = None
+        self.entities: dict[str, MirrorEntity] = {}
+        self.player: MirrorEntity | None = None
+        self.player_ready = asyncio.Event()
+        self.rpc_log: list[tuple[str, str, list]] = []
+        self.sync_count = 0
+        self.errors: list[str] = []
+        self._stop = False
+
+    # ------------------------------------------------------------------
+    async def connect(self) -> None:
+        reader, writer = await asyncio.open_connection(self.host, self.port)
+        self.conn = PacketConnection(reader, writer)
+
+    async def run(self, duration: float = 5.0) -> None:
+        """Connect and play for ``duration`` seconds."""
+        await self.connect()
+        recv = asyncio.ensure_future(self._recv_loop())
+        move = asyncio.ensure_future(self._move_loop())
+        try:
+            await asyncio.sleep(duration)
+        finally:
+            self._stop = True
+            move.cancel()
+            recv.cancel()
+            await self.conn.close()
+
+    async def _recv_loop(self) -> None:
+        try:
+            while True:
+                msgtype, pkt = await self.conn.recv()
+                self._handle(msgtype, pkt)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                asyncio.CancelledError):
+            pass
+
+    def _handle(self, msgtype: int, pkt: Packet) -> None:
+        if msgtype == proto.MT_CREATE_ENTITY_ON_CLIENT:
+            eid = pkt.read_entity_id()
+            type_name = pkt.read_var_str()
+            is_player = pkt.read_bool()
+            x, y, z, yaw = (pkt.read_f32() for _ in range(4))
+            attrs = pkt.read_data()
+            if self.strict and eid in self.entities:
+                self.errors.append(f"duplicate create_entity {eid}")
+            me = MirrorEntity(eid, type_name, is_player, attrs, (x, y, z),
+                              yaw)
+            self.entities[eid] = me
+            if is_player:
+                self.player = me
+                self.player_ready.set()
+        elif msgtype == proto.MT_DESTROY_ENTITY_ON_CLIENT:
+            eid = pkt.read_entity_id()
+            is_player = pkt.read_bool()
+            gone = self.entities.pop(eid, None)
+            if self.strict and gone is None:
+                self.errors.append(f"destroy of unknown entity {eid}")
+            if is_player and self.player is not None \
+                    and self.player.eid == eid:
+                self.player = None
+                self.player_ready.clear()
+        elif msgtype == proto.MT_NOTIFY_ATTR_CHANGE_ON_CLIENT:
+            eid = pkt.read_entity_id()
+            deltas = pkt.read_data()
+            me = self.entities.get(eid)
+            if me is not None:
+                me.apply_deltas(deltas)
+            elif self.strict:
+                self.errors.append(f"attr change for unknown entity {eid}")
+        elif msgtype == proto.MT_CALL_ENTITY_METHOD_ON_CLIENT:
+            eid = pkt.read_entity_id()
+            method = pkt.read_var_str()
+            args = pkt.read_args()
+            self.rpc_log.append((eid, method, args))
+        elif msgtype == proto.MT_CLIENT_SYNC_POSITION_YAW:
+            eids, vals = codec.decode_sync_batch(
+                memoryview(pkt.buf)[pkt.rpos:]
+            )
+            for eid_b, v in zip(eids, vals):
+                me = self.entities.get(eid_b.decode("ascii", "replace"))
+                if me is not None:
+                    me.pos = (float(v[0]), float(v[1]), float(v[2]))
+                    me.yaw = float(v[3])
+                    self.sync_count += 1
+        elif msgtype == proto.MT_HEARTBEAT:
+            pass
+        else:
+            logger.warning("bot%d: unhandled msgtype %d", self.bot_id,
+                           msgtype)
+
+    # ------------------------------------------------------------------
+    async def _move_loop(self) -> None:
+        """Random-walk + position sync every move interval (reference
+        ``ClientBot.go:214-227``: 50% move probability per 100 ms)."""
+        try:
+            await self.player_ready.wait()
+            while not self._stop:
+                await asyncio.sleep(self.move_interval)
+                if self.player is None or self.rng.random() < 0.5:
+                    continue
+                x, y, z = self.player.pos
+                x += self.rng.uniform(-self.speed, self.speed)
+                z += self.rng.uniform(-self.speed, self.speed)
+                yaw = self.rng.uniform(0, 6.28)
+                self.player.pos = (x, y, z)
+                self.player.yaw = yaw
+                self.send_position(x, y, z, yaw)
+        except asyncio.CancelledError:
+            pass
+
+    def send_position(self, x: float, y: float, z: float,
+                      yaw: float) -> None:
+        if self.player is None or self.conn is None:
+            return
+        p = new_packet(proto.MT_CLIENT_SYNC_POSITION_YAW)
+        p.append_bytes(
+            codec.encode_sync_batch([self.player.eid], [[x, y, z, yaw]])
+        )
+        self.conn.send(p)
+
+    def call_server(self, method: str, *args) -> None:
+        """Client->server RPC on the player entity."""
+        if self.player is None or self.conn is None:
+            return
+        p = new_packet(proto.MT_CALL_ENTITY_METHOD_FROM_CLIENT)
+        p.append_entity_id(self.player.eid)
+        p.append_var_str(method)
+        p.append_args(args)
+        self.conn.send(p)
+
+    def send_heartbeat(self) -> None:
+        if self.conn is not None:
+            self.conn.send(new_packet(proto.MT_HEARTBEAT))
+
+
+async def run_swarm(host: str, port: int, n_bots: int, duration: float,
+                    *, strict: bool = True) -> list[BotClient]:
+    """Run N bots concurrently (reference ``test_client -N``)."""
+    bots = [
+        BotClient(host, port, bot_id=i, strict=strict) for i in range(n_bots)
+    ]
+    await asyncio.gather(*(b.run(duration) for b in bots))
+    return bots

@@ -1,0 +1,528 @@
+"""Dispatcher — the cluster's message router (one process per shard).
+
+Reference being rebuilt: ``components/dispatcher/DispatcherService.go``:
+owns the EntityID->game table, blocks + queues packets for entities that are
+migrating/loading, load-balanced entity placement (min-load choose,
+round-robin boot entities), the deployment-readiness barrier, kvreg
+first-writer-wins registry, freeze orchestration, and disconnect cleanup.
+
+N dispatchers form a sharded star (``engine/dispatchercluster``): every game
+and gate connects to all of them; senders pick the dispatcher by EntityID
+hash (:func:`goworld_tpu.net.cluster.entity_shard`), so each dispatcher's
+entity table only covers its hash shard.
+
+Asyncio single-task message loop = the reference's single-goroutine
+dispatcher loop (``DispatcherService.go:205-278``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from collections import deque
+
+from goworld_tpu.net import proto
+from goworld_tpu.net.packet import Packet, PacketConnection, new_packet
+from goworld_tpu.utils import consts, log
+
+logger = log.get("dispatcher")
+
+
+class _EntityDispatchInfo:
+    """Per-entity routing record (reference ``entityDispatchInfo``,
+    ``DispatcherService.go:28-77``)."""
+
+    __slots__ = ("game_id", "block_until", "pending")
+
+    def __init__(self):
+        self.game_id = 0
+        self.block_until = 0.0
+        self.pending: deque[Packet] = deque()
+
+    @property
+    def blocked(self) -> bool:
+        return time.monotonic() < self.block_until
+
+    def block(self, duration: float) -> None:
+        self.block_until = time.monotonic() + duration
+
+    def unblock(self) -> None:
+        self.block_until = 0.0
+
+
+class _GameInfo:
+    """Per-game connection state (reference ``gameDispatchInfo``)."""
+
+    __slots__ = ("game_id", "conn", "blocked_until", "pending", "load",
+                 "ban_boot")
+
+    def __init__(self, game_id: int):
+        self.game_id = game_id
+        self.conn: PacketConnection | None = None
+        self.blocked_until = 0.0
+        self.pending: deque[bytes] = deque()
+        self.load = 0.0   # CPU% analog reported via MT_GAME_LBC_INFO
+        self.ban_boot = False
+
+    @property
+    def blocked(self) -> bool:
+        return time.monotonic() < self.blocked_until
+
+    def send(self, p: Packet, release: bool = True) -> None:
+        if self.conn is not None and not self.blocked:
+            self.conn.send(p, release=release)
+        else:
+            if len(self.pending) < consts.MAX_PENDING_PACKETS_PER_GAME:
+                self.pending.append(bytes(p.buf))
+            if release:
+                p.release()
+
+    def flush_pending(self) -> None:
+        while self.pending and self.conn is not None:
+            self.conn.send(Packet(self.pending.popleft()), release=False)
+
+
+class DispatcherService:
+    """One dispatcher shard. ``serve()`` runs until cancelled."""
+
+    def __init__(self, dispatcher_id: int, host: str, port: int,
+                 desired_games: int, desired_gates: int):
+        self.id = dispatcher_id
+        self.host = host
+        self.port = port
+        self.desired_games = desired_games
+        self.desired_gates = desired_gates
+
+        self.games: dict[int, _GameInfo] = {}
+        self.gates: dict[int, PacketConnection] = {}
+        self.entities: dict[str, _EntityDispatchInfo] = {}
+        self.kvreg: dict[str, str] = {}
+        self.deployment_ready = False
+        self._boot_rr = 0
+        self._server: asyncio.AbstractServer | None = None
+        # per-game re-batched upstream sync records, flushed on a short
+        # timer like the reference's 5ms tick (DispatcherService.go:797-808)
+        self._sync_pending: dict[int, bytearray] = {}
+        self.started = asyncio.Event()
+
+    # ------------------------------------------------------------------
+    async def serve(self) -> None:
+        self._server = await asyncio.start_server(
+            self._handle_conn, self.host, self.port
+        )
+        self.started.set()
+        logger.info("dispatcher%d listening on %s:%d",
+                    self.id, self.host, self.port)
+        flusher = asyncio.ensure_future(self._flush_loop())
+        try:
+            async with self._server:
+                await self._server.serve_forever()
+        finally:
+            flusher.cancel()
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    async def _flush_loop(self) -> None:
+        while True:
+            await asyncio.sleep(consts.HOST_TICK_INTERVAL)
+            self._flush_sync_pending()
+
+    def _flush_sync_pending(self) -> None:
+        for game_id, buf in self._sync_pending.items():
+            if not buf:
+                continue
+            gi = self.games.get(game_id)
+            if gi is None:
+                buf.clear()
+                continue
+            p = new_packet(proto.MT_SYNC_POSITION_YAW_FROM_CLIENT)
+            p.append_bytes(bytes(buf))
+            gi.send(p)
+            buf.clear()
+
+    # ------------------------------------------------------------------
+    async def _handle_conn(self, reader, writer) -> None:
+        conn = PacketConnection(reader, writer)
+        role: tuple[str, int] | None = None  # ("game"|"gate", id)
+        try:
+            while True:
+                msgtype, pkt = await conn.recv()
+                role = self._handle_packet(conn, role, msgtype, pkt)
+                await conn.drain()
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            await conn.close()
+            if role is not None:
+                self._on_disconnect(role)
+
+    # ------------------------------------------------------------------
+    def _handle_packet(self, conn, role, msgtype: int, pkt: Packet):
+        if msgtype == proto.MT_SET_GAME_ID:
+            return self._handle_set_game_id(conn, pkt)
+        if msgtype == proto.MT_SET_GATE_ID:
+            gate_id = pkt.read_u16()
+            self.gates[gate_id] = conn
+            logger.info("dispatcher%d: gate%d connected", self.id, gate_id)
+            self._check_deployment_ready()
+            return ("gate", gate_id)
+
+        if proto.MT_REDIRECT_TO_GATEPROXY_MSG_TYPE_START <= msgtype <= \
+                proto.MT_REDIRECT_TO_GATEPROXY_MSG_TYPE_STOP:
+            # forward to the gate named in the routing prefix, verbatim
+            gate_id = pkt.read_u16()
+            g = self.gates.get(gate_id)
+            if g is not None:
+                g.send(pkt, release=False)
+            return role
+
+        handler = {
+            proto.MT_CALL_ENTITY_METHOD: self._h_call_entity,
+            proto.MT_CALL_ENTITY_METHOD_FROM_CLIENT: self._h_call_entity,
+            proto.MT_NOTIFY_CREATE_ENTITY: self._h_create_entity,
+            proto.MT_NOTIFY_DESTROY_ENTITY: self._h_destroy_entity,
+            proto.MT_CREATE_ENTITY_ANYWHERE: self._h_create_anywhere,
+            proto.MT_LOAD_ENTITY_ANYWHERE: self._h_load_anywhere,
+            proto.MT_NOTIFY_CLIENT_CONNECTED: self._h_client_connected,
+            proto.MT_NOTIFY_CLIENT_DISCONNECTED: self._h_client_disconnected,
+            proto.MT_SYNC_POSITION_YAW_FROM_CLIENT: self._h_sync_upstream,
+            proto.MT_SYNC_POSITION_YAW_ON_CLIENTS: self._h_sync_downstream,
+            proto.MT_SET_CLIENT_FILTER_PROP: self._h_to_gate,
+            proto.MT_CALL_FILTERED_CLIENTS: self._h_filtered_broadcast,
+            proto.MT_KVREG_REGISTER: self._h_kvreg,
+            proto.MT_GAME_LBC_INFO: self._h_lbc,
+            proto.MT_QUERY_SPACE_GAMEID_FOR_MIGRATE: self._h_query_space,
+            proto.MT_MIGRATE_REQUEST: self._h_migrate_request,
+            proto.MT_REAL_MIGRATE: self._h_real_migrate,
+            proto.MT_CANCEL_MIGRATE: self._h_cancel_migrate,
+            proto.MT_CALL_NIL_SPACES: self._h_broadcast_games,
+            proto.MT_START_FREEZE_GAME: self._h_start_freeze,
+        }.get(msgtype)
+        if handler is None:
+            logger.warning("dispatcher%d: unhandled msgtype %d",
+                           self.id, msgtype)
+            return role
+        handler(conn, role, msgtype, pkt)
+        return role
+
+    # -- handshake ------------------------------------------------------
+    def _handle_set_game_id(self, conn, pkt: Packet):
+        game_id = pkt.read_u16()
+        is_reconnect = pkt.read_bool()
+        is_restore = pkt.read_bool()
+        ban_boot = pkt.read_bool()
+        census = pkt.read_data()  # entity ids this game already hosts
+        gi = self.games.get(game_id)
+        if gi is None:
+            gi = self.games[game_id] = _GameInfo(game_id)
+        gi.conn = conn
+        gi.ban_boot = ban_boot
+        gi.blocked_until = 0.0
+
+        # census reconciliation (reference DispatcherService.go:369-391):
+        # entities the game claims but we route elsewhere get rejected
+        rejects = []
+        for eid in census:
+            info = self.entities.get(eid)
+            if info is None:
+                info = self.entities[eid] = _EntityDispatchInfo()
+                info.game_id = game_id
+            elif info.game_id != game_id:
+                rejects.append(eid)
+        ack = new_packet(proto.MT_SET_GAME_ID_ACK)
+        ack.append_u16(self.id)
+        ack.append_data(self.kvreg)
+        ack.append_data(rejects)
+        conn.send(ack)
+        gi.flush_pending()
+        logger.info(
+            "dispatcher%d: game%d connected (reconnect=%s restore=%s, "
+            "%d entities)", self.id, game_id, is_reconnect, is_restore,
+            len(census),
+        )
+        self._broadcast_to_games(
+            self._mk_game_connected(game_id), exclude=game_id
+        )
+        self._check_deployment_ready()
+        return ("game", game_id)
+
+    @staticmethod
+    def _mk_game_connected(game_id: int) -> Packet:
+        p = new_packet(proto.MT_NOTIFY_GAME_CONNECTED)
+        p.append_u16(game_id)
+        return p
+
+    def _check_deployment_ready(self) -> None:
+        """Reference ``checkDeploymentReady`` (``:439-469``): when desired
+        process counts are met, tell everyone."""
+        if self.deployment_ready:
+            return
+        live_games = sum(1 for g in self.games.values() if g.conn is not None)
+        if live_games >= self.desired_games and \
+                len(self.gates) >= self.desired_gates:
+            self.deployment_ready = True
+            p = new_packet(proto.MT_NOTIFY_DEPLOYMENT_READY)
+            self._broadcast_to_games(p)
+            logger.info("dispatcher%d: deployment ready", self.id)
+
+    def _broadcast_to_games(self, p: Packet, exclude: int = 0) -> None:
+        for gid, gi in self.games.items():
+            if gid != exclude:
+                gi.send(Packet(bytes(p.buf)), release=False)
+        p.release()
+
+    # -- entity table ---------------------------------------------------
+    def _entity_info(self, eid: str) -> _EntityDispatchInfo:
+        info = self.entities.get(eid)
+        if info is None:
+            info = self.entities[eid] = _EntityDispatchInfo()
+        return info
+
+    def _dispatch_to_entity(self, eid: str, pkt: Packet) -> None:
+        """Queue-while-blocked routing (reference ``dispatchPacket``)."""
+        info = self.entities.get(eid)
+        if info is None or info.game_id == 0:
+            logger.warning(
+                "dispatcher%d: no route for entity %s; dropped",
+                self.id, eid,
+            )
+            return
+        if info.blocked:
+            if len(info.pending) < consts.MAX_PENDING_PACKETS_PER_ENTITY:
+                info.pending.append(Packet(bytes(pkt.buf)))
+            return
+        gi = self.games.get(info.game_id)
+        if gi is not None:
+            gi.send(pkt, release=False)
+
+    def _unblock_entity(self, eid: str) -> None:
+        info = self.entities.get(eid)
+        if info is None:
+            return
+        info.unblock()
+        gi = self.games.get(info.game_id)
+        while info.pending:
+            q = info.pending.popleft()
+            if gi is not None:
+                gi.send(q, release=False)
+
+    # -- handlers -------------------------------------------------------
+    def _h_call_entity(self, conn, role, msgtype, pkt: Packet) -> None:
+        eid = pkt.read_entity_id()
+        pkt.rpos = 2  # rewind past msgtype: forward the original packet
+        self._dispatch_to_entity(eid, pkt)
+
+    def _h_create_entity(self, conn, role, msgtype, pkt: Packet) -> None:
+        eid = pkt.read_entity_id()
+        game_id = pkt.read_u16()
+        info = self._entity_info(eid)
+        info.game_id = game_id
+
+    def _h_destroy_entity(self, conn, role, msgtype, pkt: Packet) -> None:
+        eid = pkt.read_entity_id()
+        self.entities.pop(eid, None)
+
+    def _choose_game(self, boot: bool = False) -> _GameInfo | None:
+        """Load-balanced placement (reference ``chooseGame`` min-CPU heap
+        ``:523-536``; boot entities round-robin over non-banned games
+        ``:539-549``)."""
+        live = [
+            g for g in self.games.values()
+            if g.conn is not None and not (boot and g.ban_boot)
+        ]
+        if not live:
+            return None
+        if boot:
+            live.sort(key=lambda g: g.game_id)
+            self._boot_rr = (self._boot_rr + 1) % len(live)
+            return live[self._boot_rr]
+        chosen = min(live, key=lambda g: g.load)
+        chosen.load += 0.1  # reference lbcheap.go:71-77 chosen() penalty
+        return chosen
+
+    def _h_create_anywhere(self, conn, role, msgtype, pkt: Packet) -> None:
+        gi = self._choose_game()
+        if gi is None:
+            logger.error("dispatcher%d: no game for CreateEntityAnywhere",
+                         self.id)
+            return
+        pkt.rpos = 2
+        gi.send(pkt, release=False)
+
+    def _h_load_anywhere(self, conn, role, msgtype, pkt: Packet) -> None:
+        pkt.read_var_str()  # type_name
+        eid = pkt.read_entity_id()
+        info = self._entity_info(eid)
+        if info.game_id != 0 or info.blocked:
+            return  # already loaded/loading: single-load guard (:673-702)
+        gi = self._choose_game()
+        if gi is None:
+            return
+        info.game_id = gi.game_id
+        info.block(consts.LOAD_TIMEOUT)
+        pkt.rpos = 2
+        gi.send(pkt, release=False)
+
+    def _h_client_connected(self, conn, role, msgtype, pkt: Packet) -> None:
+        boot_eid = pkt.read_entity_id()
+        gi = self._choose_game(boot=True)
+        if gi is None:
+            logger.error("dispatcher%d: no game for boot entity", self.id)
+            return
+        self._entity_info(boot_eid).game_id = gi.game_id
+        pkt.rpos = 2
+        gi.send(pkt, release=False)
+
+    def _h_client_disconnected(self, conn, role, msgtype, pkt: Packet) -> None:
+        pkt.read_entity_id()  # client id
+        owner = pkt.read_var_str()
+        pkt.rpos = 2
+        if owner and owner in self.entities:
+            self._dispatch_to_entity(owner, pkt)
+        else:
+            # no known owner: all games check their client bindings
+            for gi in self.games.values():
+                gi.send(Packet(bytes(pkt.buf)), release=False)
+
+    def _h_sync_upstream(self, conn, role, msgtype, pkt: Packet) -> None:
+        """Split a gate's 32B-record batch by eid->game and re-batch per
+        game (reference ``handleSyncPositionYawFromClient`` ``:770-795``)."""
+        buf = memoryview(pkt.buf)[pkt.rpos:]
+        for off in range(0, len(buf), proto.SYNC_RECORD_SIZE):
+            rec = buf[off:off + proto.SYNC_RECORD_SIZE]
+            if len(rec) < proto.SYNC_RECORD_SIZE:
+                break
+            eid = bytes(rec[:16]).decode("ascii", "replace")
+            info = self.entities.get(eid)
+            if info is None or info.game_id == 0 or info.blocked:
+                continue
+            self._sync_pending.setdefault(
+                info.game_id, bytearray()
+            ).extend(rec)
+
+    def _h_sync_downstream(self, conn, role, msgtype, pkt: Packet) -> None:
+        """Game -> gate leg: the packet is [gate_id][48B records...]
+        (reference ``handleSyncPositionYawOnClients`` ``:765-768``)."""
+        gate_id = pkt.read_u16()
+        g = self.gates.get(gate_id)
+        if g is not None:
+            g.send(pkt, release=False)
+
+    def _h_to_gate(self, conn, role, msgtype, pkt: Packet) -> None:
+        gate_id = pkt.read_u16()
+        g = self.gates.get(gate_id)
+        if g is not None:
+            g.send(pkt, release=False)
+
+    def _h_filtered_broadcast(self, conn, role, msgtype, pkt: Packet) -> None:
+        for g in self.gates.values():
+            g.send(Packet(bytes(pkt.buf)), release=False)
+
+    def _h_kvreg(self, conn, role, msgtype, pkt: Packet) -> None:
+        """First-writer-wins registry write + broadcast (reference
+        ``DispatcherService.go:728-742``)."""
+        key = pkt.read_var_str()
+        val = pkt.read_var_str()
+        force = pkt.read_bool()
+        if key in self.kvreg and not force:
+            val = self.kvreg[key]  # lost the race: broadcast the winner
+        else:
+            self.kvreg[key] = val
+        out = proto.pack_kvreg_register(key, val, False)
+        self._broadcast_to_games(out)
+
+    def _h_lbc(self, conn, role, msgtype, pkt: Packet) -> None:
+        if role is not None and role[0] == "game":
+            gi = self.games.get(role[1])
+            if gi is not None:
+                gi.load = pkt.read_f32()
+
+    # -- migration (reference :834-891) ---------------------------------
+    def _h_query_space(self, conn, role, msgtype, pkt: Packet) -> None:
+        space_id = pkt.read_entity_id()
+        eid = pkt.read_entity_id()
+        info = self.entities.get(space_id)
+        ack = new_packet(proto.MT_QUERY_SPACE_GAMEID_FOR_MIGRATE_ACK)
+        ack.append_entity_id(space_id)
+        ack.append_entity_id(eid)
+        ack.append_u16(info.game_id if info is not None else 0)
+        conn.send(ack)
+
+    def _h_migrate_request(self, conn, role, msgtype, pkt: Packet) -> None:
+        eid = pkt.read_entity_id()
+        space_id = pkt.read_entity_id()
+        space_game = pkt.read_u16()
+        self._entity_info(eid).block(consts.MIGRATE_TIMEOUT)
+        ack = new_packet(proto.MT_MIGRATE_REQUEST_ACK)
+        ack.append_entity_id(eid)
+        ack.append_entity_id(space_id)
+        ack.append_u16(space_game)
+        conn.send(ack)
+
+    def _h_real_migrate(self, conn, role, msgtype, pkt: Packet) -> None:
+        eid = pkt.read_entity_id()
+        target_game = pkt.read_u16()
+        info = self._entity_info(eid)
+        info.game_id = target_game
+        gi = self.games.get(target_game)
+        if gi is not None:
+            pkt.rpos = 2
+            gi.send(pkt, release=False)
+        self._unblock_entity(eid)
+
+    def _h_cancel_migrate(self, conn, role, msgtype, pkt: Packet) -> None:
+        self._unblock_entity(pkt.read_entity_id())
+
+    def _h_broadcast_games(self, conn, role, msgtype, pkt: Packet) -> None:
+        pkt.rpos = 2
+        self._broadcast_to_games(Packet(bytes(pkt.buf)))
+
+    def _h_start_freeze(self, conn, role, msgtype, pkt: Packet) -> None:
+        """Block the whole game for the freeze window and ack (reference
+        ``DispatcherService.go:471-488``)."""
+        if role is None or role[0] != "game":
+            return
+        gi = self.games.get(role[1])
+        if gi is None:
+            return
+        gi.blocked_until = time.monotonic() + consts.FREEZE_BLOCK_TIMEOUT
+        ack = new_packet(proto.MT_START_FREEZE_GAME_ACK)
+        ack.append_u16(self.id)
+        conn.send(ack)
+
+    # -- disconnects (reference :551-634) -------------------------------
+    def _on_disconnect(self, role: tuple[str, int]) -> None:
+        kind, rid = role
+        if kind == "game":
+            gi = self.games.get(rid)
+            if gi is not None:
+                gi.conn = None
+            if gi is not None and gi.blocked:
+                # freezing: keep routing entries, queue packets for restore
+                logger.info(
+                    "dispatcher%d: game%d gone while frozen; awaiting "
+                    "restore", self.id, rid,
+                )
+            else:
+                stale = [
+                    eid for eid, info in self.entities.items()
+                    if info.game_id == rid
+                ]
+                for eid in stale:
+                    del self.entities[eid]
+                p = new_packet(proto.MT_NOTIFY_GAME_DISCONNECTED)
+                p.append_u16(rid)
+                self._broadcast_to_games(p, exclude=rid)
+                logger.info(
+                    "dispatcher%d: game%d disconnected (%d entities "
+                    "dropped)", self.id, rid, len(stale),
+                )
+        else:
+            self.gates.pop(rid, None)
+            p = new_packet(proto.MT_NOTIFY_GATE_DISCONNECTED)
+            p.append_u16(rid)
+            self._broadcast_to_games(p)
+            logger.info("dispatcher%d: gate%d disconnected", self.id, rid)

@@ -1,0 +1,498 @@
+"""Game server — hosts a :class:`World` and connects it to the cluster.
+
+Reference being rebuilt: ``components/game/GameService.go`` (the packet
+switch + tick serve loop, ``:77-190``) and ``components/game/game.go``
+(boot sequence ``:65-135``). The reference's single logic goroutine becomes
+a single logic *thread* driving ``World.tick()``; asyncio networking runs on
+a background thread and exchanges packets with the logic thread through a
+queue — the same "logic is single-threaded, I/O is concurrent" shape
+(``SURVEY.md#1``).
+
+Outbound client traffic: per-record messages (create/destroy/attr/rpc) are
+sent as they happen; position sync records batch per gate per tick into one
+``MT_SYNC_POSITION_YAW_ON_CLIENTS`` packet (the reference collects these in
+``CollectEntitySyncInfos`` and ships per-gate packets, ``Entity.go:1208-1267``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import queue
+import threading
+import time
+from typing import Callable
+
+import numpy as np
+
+from goworld_tpu.core.state import WorldConfig
+from goworld_tpu.entity.entity import Entity, GameClient
+from goworld_tpu.entity.manager import World
+from goworld_tpu.net import codec, proto
+from goworld_tpu.net.cluster import DispatcherCluster, DispatcherConn
+from goworld_tpu.net.packet import Packet, new_packet
+from goworld_tpu.utils import consts, log
+
+logger = log.get("game")
+
+
+class GameServer:
+    """One game process: a World + connections to every dispatcher."""
+
+    def __init__(
+        self,
+        game_id: int,
+        world: World,
+        dispatcher_addrs: list[tuple[str, int]],
+        *,
+        boot_entity: str = "Account",
+        ban_boot: bool = False,
+        tick_interval: float = 1.0 / consts.TICK_HZ,
+    ):
+        self.game_id = game_id
+        self.world = world
+        self.boot_entity = boot_entity
+        self.ban_boot = ban_boot
+        self.tick_interval = tick_interval
+
+        self._packet_q: "queue.Queue[tuple[int, int, Packet]]" = \
+            queue.Queue(maxsize=consts.MAX_PENDING_PACKETS_PER_GAME)
+        self.cluster = DispatcherCluster(
+            dispatcher_addrs, self._on_packet_netthread, self._handshake
+        )
+        self._loop: asyncio.AbstractEventLoop | None = None
+        self._net_thread: threading.Thread | None = None
+        self._stop = threading.Event()
+        self.deployment_ready = False
+        self.ready_event = threading.Event()
+        self.kvreg: dict[str, str] = {}
+        self.kvreg_watchers: list[Callable[[str, str], None]] = []
+        # in-flight outbound migrations: eid -> (entity, space_id, pos)
+        self._migrating_out: dict[str, tuple[Entity, str, tuple]] = {}
+        # per-gate downstream sync batches for the current tick
+        self._sync_out: dict[int, list] = {}
+        self.on_deployment_ready: Callable[[], None] | None = None
+
+        # wire the world's pluggable edges to the cluster
+        w = world
+        w.client_sink = self._client_sink
+        w.sync_sink = self._sync_sink
+        w.remote_router = self._remote_call
+        w.remote_space_router = self._remote_enter_space
+        w.filtered_sink = self._filtered_sink
+        w.on_entity_created = self._notify_entity_created
+        w.on_entity_destroyed = self._notify_entity_destroyed
+
+    # ==================================================================
+    # lifecycle
+    # ==================================================================
+    def start_network(self) -> None:
+        """Spawn the asyncio networking thread and connect to dispatchers."""
+        started = threading.Event()
+
+        def run() -> None:
+            self._loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self._loop)
+            self.cluster.start()
+            started.set()
+            self._loop.run_forever()
+
+        self._net_thread = threading.Thread(
+            target=run, name=f"game{self.game_id}-net", daemon=True
+        )
+        self._net_thread.start()
+        started.wait()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._loop is not None:
+            self._loop.call_soon_threadsafe(self.cluster.stop)
+            self._loop.call_soon_threadsafe(self._loop.stop)
+        if self._net_thread is not None:
+            self._net_thread.join(timeout=5)
+
+    def serve_forever(self) -> None:
+        """The logic loop: drain packets, tick the world, repeat."""
+        next_tick = time.monotonic()
+        while not self._stop.is_set():
+            self.pump()
+            self.tick()
+            next_tick += self.tick_interval
+            delay = next_tick - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            else:
+                next_tick = time.monotonic()  # fell behind; don't spiral
+
+    def pump(self) -> int:
+        """Drain and handle every queued dispatcher packet (logic thread)."""
+        n = 0
+        while True:
+            try:
+                didx, msgtype, pkt = self._packet_q.get_nowait()
+            except queue.Empty:
+                return n
+            try:
+                self._handle_packet(didx, msgtype, pkt)
+            except Exception:
+                logger.exception(
+                    "game%d: handler for msgtype %d failed",
+                    self.game_id, msgtype,
+                )
+            n += 1
+
+    def tick(self) -> None:
+        self.world.tick()
+        self._flush_sync_out()
+
+    # ==================================================================
+    # networking thread side
+    # ==================================================================
+    async def _handshake(self, conn: DispatcherConn) -> None:
+        census = list(self.world.entities.keys())
+        p = proto.pack_set_game_id(
+            self.game_id, is_reconnect=self.deployment_ready,
+            is_restore=False, ban_boot=self.ban_boot, entity_ids=census,
+        )
+        conn.conn.send(p)
+        await conn.conn.drain()
+
+    def _on_packet_netthread(self, didx: int, msgtype: int,
+                             pkt: Packet) -> None:
+        try:
+            self._packet_q.put_nowait((didx, msgtype, pkt))
+        except queue.Full:
+            logger.error("game%d: packet queue full; dropping %d",
+                         self.game_id, msgtype)
+
+    def _send(self, conn: DispatcherConn, p: Packet) -> None:
+        """Thread-safe send from the logic thread."""
+        if self._loop is None:
+            conn.send(p)
+            return
+        self._loop.call_soon_threadsafe(conn.send, p)
+
+    # ==================================================================
+    # world -> cluster edges (logic thread)
+    # ==================================================================
+    def _client_sink(self, gate_id: int, client_id: str, msg: dict) -> None:
+        t = msg["type"]
+        if t == "create_entity":
+            p = proto.pack_create_entity_on_client(
+                gate_id, client_id, msg["eid"], msg["etype"],
+                msg["is_player"], msg["attrs"], msg["pos"], msg["yaw"],
+            )
+        elif t == "destroy_entity":
+            p = proto.pack_destroy_entity_on_client(
+                gate_id, client_id, msg["eid"], msg["is_player"]
+            )
+        elif t == "attrs":
+            p = proto.pack_notify_attr_change_on_client(
+                gate_id, client_id, msg["eid"], msg["deltas"]
+            )
+        elif t == "rpc":
+            p = proto.pack_call_entity_method_on_client(
+                gate_id, client_id, msg["eid"], msg["method"],
+                tuple(msg["args"]),
+            )
+        elif t == "sync":
+            self._sync_out.setdefault(gate_id, []).append(
+                (client_id, msg["eid"],
+                 (*msg["pos"], msg["yaw"]))
+            )
+            return
+        else:
+            logger.warning("game%d: unknown client msg type %r",
+                           self.game_id, t)
+            return
+        self._send(self.cluster.select_by_gate_id(gate_id), p)
+
+    def _sync_sink(self, gate_id: int, cids: list, eids: list,
+                   vals: np.ndarray) -> None:
+        self._sync_out.setdefault(gate_id, []).append((cids, eids, vals))
+
+    def _flush_sync_out(self) -> None:
+        for gate_id, chunks in self._sync_out.items():
+            cids: list = []
+            eids: list = []
+            vals: list = []
+            for c in chunks:
+                if isinstance(c[0], list):  # batched (cids, eids, vals)
+                    cids.extend(c[0])
+                    eids.extend(c[1])
+                    vals.extend(np.asarray(c[2]))
+                else:                        # single legacy record
+                    cids.append(c[0])
+                    eids.append(c[1])
+                    vals.append(np.asarray(c[2], np.float32))
+            if not cids:
+                continue
+            p = new_packet(proto.MT_SYNC_POSITION_YAW_ON_CLIENTS)
+            p.append_u16(gate_id)
+            p.append_bytes(
+                codec.encode_client_sync_batch(
+                    cids, eids, np.asarray(vals, np.float32)
+                )
+            )
+            self._send(self.cluster.select_by_gate_id(gate_id), p)
+        self._sync_out.clear()
+
+    def _remote_call(self, eid: str, method: str, args: tuple,
+                     from_client: str | None) -> None:
+        p = proto.pack_call_entity_method(eid, method, args, from_client)
+        self._send(self.cluster.select_by_entity_id(eid), p)
+
+    def _filtered_sink(self, key: str, op: str, val: str, method: str,
+                       args: tuple) -> None:
+        p = proto.pack_call_filtered_clients(key, op, val, "", method, args)
+        self._send(self.cluster.conns[0], p)
+
+    def _notify_entity_created(self, e: Entity) -> None:
+        p = new_packet(proto.MT_NOTIFY_CREATE_ENTITY)
+        p.append_entity_id(e.id)
+        p.append_u16(self.game_id)
+        self._send(self.cluster.select_by_entity_id(e.id), p)
+
+    def _notify_entity_destroyed(self, e: Entity) -> None:
+        p = new_packet(proto.MT_NOTIFY_DESTROY_ENTITY)
+        p.append_entity_id(e.id)
+        self._send(self.cluster.select_by_entity_id(e.id), p)
+
+    # -- public cluster-wide API (the goworld.go facade calls these) ----
+    def create_entity_anywhere(self, type_name: str,
+                               attrs: dict | None = None) -> None:
+        """Reference ``CreateEntityAnywhere`` (``goworld.go``): placement
+        decided by the dispatcher's load heap."""
+        from goworld_tpu.utils import ids as _ids
+
+        eid = _ids.gen_entity_id()
+        p = proto.pack_create_entity_anywhere(type_name, attrs or {}, eid)
+        self._send(self.cluster.select_by_entity_id(eid), p)
+
+    def load_entity_anywhere(self, type_name: str, eid: str) -> None:
+        p = proto.pack_load_entity_anywhere(type_name, eid)
+        self._send(self.cluster.select_by_entity_id(eid), p)
+
+    def kvreg_register(self, key: str, val: str, force: bool = False) -> None:
+        p = proto.pack_kvreg_register(key, val, force)
+        self._send(self.cluster.select_by_srv_id(key), p)
+
+    def setup_services(self) -> "object":
+        """Attach a kvreg-backed ServiceManager (reference ``service.Setup``,
+        started on deployment-ready)."""
+        from goworld_tpu.entity.service import ServiceManager
+
+        return ServiceManager(
+            self.world, game_id=self.game_id,
+            kv_write=lambda k, v: self.kvreg_register(k, v),
+            kv_get=self.kvreg.get,
+        )
+
+    def call_nil_spaces(self, method: str, *args) -> None:
+        p = proto.pack_call_nil_spaces(method, args)
+        self._send(self.cluster.conns[0], p)
+
+    # ==================================================================
+    # migration, outbound (reference Entity.go:1006-1101)
+    # ==================================================================
+    def _remote_enter_space(self, e: Entity, space_id: str,
+                            pos: tuple) -> None:
+        self._migrating_out[e.id] = (e, space_id, pos)
+        p = proto.pack_query_space_gameid(space_id, e.id)
+        self._send(self.cluster.select_by_entity_id(space_id), p)
+
+    # ==================================================================
+    # cluster -> world packet handlers (logic thread)
+    # ==================================================================
+    def _handle_packet(self, didx: int, msgtype: int, pkt: Packet) -> None:
+        w = self.world
+        if msgtype == proto.MT_SET_GAME_ID_ACK:
+            pkt.read_u16()  # dispatcher id
+            kv = pkt.read_data()
+            rejects = pkt.read_data()
+            self.kvreg.update(kv)
+            for eid in rejects:
+                e = w.entities.get(eid)
+                if e is not None:
+                    logger.warning(
+                        "game%d: entity %s rejected by dispatcher; "
+                        "destroying stale copy", self.game_id, eid,
+                    )
+                    e.destroy()
+            return
+        if msgtype == proto.MT_NOTIFY_DEPLOYMENT_READY:
+            if not self.deployment_ready:
+                self.deployment_ready = True
+                self.ready_event.set()
+                for sp in list(w.spaces.values()):
+                    sp.OnGameReady()
+                if w.service_mgr is not None:
+                    # reference service.OnDeploymentReady -> checkServices
+                    w.service_mgr.start()
+                if self.on_deployment_ready is not None:
+                    self.on_deployment_ready()
+            return
+        if msgtype == proto.MT_CALL_ENTITY_METHOD:
+            eid = pkt.read_entity_id()
+            method = pkt.read_var_str()
+            args = pkt.read_args()
+            e = w.entities.get(eid)
+            if e is not None:
+                w._invoke(e, method, tuple(args), None)
+            else:
+                logger.warning("game%d: RPC to unknown entity %s.%s",
+                               self.game_id, eid, method)
+            return
+        if msgtype == proto.MT_CALL_ENTITY_METHOD_FROM_CLIENT:
+            eid = pkt.read_entity_id()
+            client_id = pkt.read_entity_id()
+            method = pkt.read_var_str()
+            args = pkt.read_args()
+            e = w.entities.get(eid)
+            if e is not None:
+                w._invoke(e, method, tuple(args), client_id)
+            return
+        if msgtype == proto.MT_NOTIFY_CLIENT_CONNECTED:
+            boot_eid = pkt.read_entity_id()
+            client_id = pkt.read_entity_id()
+            gate_id = pkt.read_u16()
+            w.create_entity(
+                self.boot_entity, eid=boot_eid,
+                client=GameClient(gate_id, client_id, w),
+            )
+            return
+        if msgtype == proto.MT_NOTIFY_CLIENT_DISCONNECTED:
+            client_id = pkt.read_entity_id()
+            owner = pkt.read_var_str()
+            targets = (
+                [w.entities.get(owner)] if owner else list(w.entities.values())
+            )
+            for e in targets:
+                if e is not None and e.client is not None \
+                        and e.client.client_id == client_id:
+                    e.client = None  # connection already gone: quiet unbind
+                    if e.slot is not None and e.space is not None:
+                        w._staged_client.append(
+                            (e.space.shard, e.slot, False, -1)
+                        )
+                    e.OnClientDisconnected()
+            return
+        if msgtype == proto.MT_SYNC_POSITION_YAW_FROM_CLIENT:
+            eids, vals = codec.decode_sync_batch(
+                memoryview(pkt.buf)[pkt.rpos:]
+            )
+            for eid_b, v in zip(eids, vals):
+                e = w.entities.get(eid_b.decode("ascii", "replace"))
+                if e is None or e.client is None:
+                    continue
+                e._pending_pos = (float(v[0]), float(v[1]), float(v[2]))
+                e._pending_yaw = float(v[3])
+                w.stage_pos_set(e)
+            return
+        if msgtype == proto.MT_CREATE_ENTITY_ANYWHERE:
+            type_name = pkt.read_var_str()
+            eid = pkt.read_var_str()
+            attrs = pkt.read_data()
+            w.create_entity(type_name, eid=eid or None, attrs=attrs)
+            return
+        if msgtype == proto.MT_LOAD_ENTITY_ANYWHERE:
+            type_name = pkt.read_var_str()
+            eid = pkt.read_entity_id()
+            w.load_entity(type_name, eid)
+            return
+        if msgtype == proto.MT_KVREG_REGISTER:
+            key = pkt.read_var_str()
+            val = pkt.read_var_str()
+            pkt.read_bool()
+            self.kvreg[key] = val
+            for cb in self.kvreg_watchers:
+                cb(key, val)
+            return
+        if msgtype == proto.MT_QUERY_SPACE_GAMEID_FOR_MIGRATE_ACK:
+            self._h_query_space_ack(pkt)
+            return
+        if msgtype == proto.MT_MIGRATE_REQUEST_ACK:
+            self._h_migrate_request_ack(pkt)
+            return
+        if msgtype == proto.MT_REAL_MIGRATE:
+            self._h_real_migrate(pkt)
+            return
+        if msgtype == proto.MT_CALL_NIL_SPACES:
+            method = pkt.read_var_str()
+            args = pkt.read_args()
+            if w.nil_space is not None:
+                w._invoke(w.nil_space, method, tuple(args), None)
+            return
+        if msgtype == proto.MT_NOTIFY_GAME_CONNECTED:
+            return
+        if msgtype == proto.MT_NOTIFY_GAME_DISCONNECTED:
+            pkt.read_u16()
+            return
+        if msgtype == proto.MT_NOTIFY_GATE_DISCONNECTED:
+            gate_id = pkt.read_u16()
+            for e in list(w.entities.values()):
+                if e.client is not None and e.client.gate_id == gate_id:
+                    e.client = None
+                    if e.slot is not None and e.space is not None:
+                        w._staged_client.append(
+                            (e.space.shard, e.slot, False, -1)
+                        )
+                    e.OnClientDisconnected()
+            return
+        logger.warning("game%d: unhandled msgtype %d", self.game_id, msgtype)
+
+    # -- migration handlers ---------------------------------------------
+    def _h_query_space_ack(self, pkt: Packet) -> None:
+        space_id = pkt.read_entity_id()
+        eid = pkt.read_entity_id()
+        game_id = pkt.read_u16()
+        pending = self._migrating_out.get(eid)
+        if pending is None:
+            return
+        e, want_space, _pos = pending
+        if want_space != space_id:
+            return
+        if game_id == 0:
+            logger.warning(
+                "game%d: space %s not found for migration of %s",
+                self.game_id, space_id, eid,
+            )
+            del self._migrating_out[eid]
+            return
+        if e.destroyed:
+            del self._migrating_out[eid]
+            return
+        p = proto.pack_migrate_request(eid, space_id, game_id)
+        self._send(self.cluster.select_by_entity_id(eid), p)
+
+    def _h_migrate_request_ack(self, pkt: Packet) -> None:
+        eid = pkt.read_entity_id()
+        space_id = pkt.read_entity_id()
+        game_id = pkt.read_u16()
+        pending = self._migrating_out.pop(eid, None)
+        if pending is None:
+            return
+        e, _space, pos = pending
+        if e.destroyed:
+            self._send(
+                self.cluster.select_by_entity_id(eid),
+                proto.pack_cancel_migrate(eid),
+            )
+            return
+        data = self.world.get_migrate_data(e)
+        data["space_id"] = space_id
+        data["pos"] = list(pos)
+        self.world.remove_for_migration(e)
+        p = proto.pack_real_migrate(eid, game_id, data)
+        self._send(self.cluster.select_by_entity_id(eid), p)
+
+    def _h_real_migrate(self, pkt: Packet) -> None:
+        eid = pkt.read_entity_id()
+        pkt.read_u16()  # target game (us)
+        data = pkt.read_data()
+        space = self.world.spaces.get(data.get("space_id", ""))
+        if space is None:
+            logger.warning(
+                "game%d: migrate-in %s: space %s vanished; entering nil "
+                "space", self.game_id, eid, data.get("space_id"),
+            )
+        self.world.restore_from_migration(data, space=space)

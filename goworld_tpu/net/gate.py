@@ -1,0 +1,366 @@
+"""Gate — terminates client connections and routes client<->dispatcher.
+
+Reference being rebuilt: ``components/gate/GateService.go`` (TCP/WebSocket
+listeners, ClientProxy bookkeeping, boot-entity id generated ON the gate,
+heartbeat timeout, per-dispatcher upstream sync batching, downstream sync
+de-mux) and ``components/gate/FilterTree.go`` (filter-prop indexes driving
+``CallFilteredClients`` broadcasts).
+
+A client's wire protocol is the same framed packet format as the server
+side; the redirect message range (1000-1499) arrives from the dispatcher
+with a ``[gate_id u16][client_id 16B]`` routing prefix which the gate strips
+before forwarding the rest to the client socket verbatim.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from collections import defaultdict
+
+from goworld_tpu.net import codec, proto
+from goworld_tpu.net.cluster import DispatcherCluster, DispatcherConn
+from goworld_tpu.net.packet import (
+    HEADER_SIZE,
+    Packet,
+    PacketConnection,
+    frame,
+    new_packet,
+)
+from goworld_tpu.utils import ids, log
+
+logger = log.get("gate")
+
+
+class ClientProxy:
+    """One connected game client (reference ``ClientProxy.go:29-53``)."""
+
+    __slots__ = ("client_id", "conn", "owner_eid", "filter_props",
+                 "last_heartbeat")
+
+    def __init__(self, conn: PacketConnection):
+        self.client_id = ids.gen_entity_id()
+        self.conn = conn
+        self.owner_eid = ""      # set when the game binds a player entity
+        self.filter_props: dict[str, str] = {}
+        self.last_heartbeat = 0.0
+
+    def send(self, p: Packet, release: bool = True) -> None:
+        self.conn.send(p, release=release)
+
+
+class FilterIndex:
+    """Per-key prop index for filtered broadcasts (reference
+    ``FilterTree.go:13-102``; the LLRB becomes sort-at-query over a val->
+    clients map — updates are the hot side, broadcasts are rare)."""
+
+    def __init__(self):
+        self._by_key: dict[str, dict[str, set[ClientProxy]]] = \
+            defaultdict(lambda: defaultdict(set))
+
+    def set_prop(self, cp: ClientProxy, key: str, val: str) -> None:
+        old = cp.filter_props.get(key)
+        if old is not None:
+            self._by_key[key][old].discard(cp)
+        cp.filter_props[key] = val
+        self._by_key[key][val].add(cp)
+
+    def drop_client(self, cp: ClientProxy) -> None:
+        for key, val in cp.filter_props.items():
+            self._by_key[key][val].discard(cp)
+        cp.filter_props.clear()
+
+    def query(self, key: str, op: int, val: str) -> set[ClientProxy]:
+        vals = self._by_key.get(key)
+        if not vals:
+            return set()
+        out: set[ClientProxy] = set()
+        for v, clients in vals.items():
+            if (
+                (op == proto.FILTER_EQ and v == val)
+                or (op == proto.FILTER_NE and v != val)
+                or (op == proto.FILTER_GT and v > val)
+                or (op == proto.FILTER_LT and v < val)
+                or (op == proto.FILTER_GTE and v >= val)
+                or (op == proto.FILTER_LTE and v <= val)
+            ):
+                out |= clients
+        return out
+
+
+class GateService:
+    """One gate process (``serve()`` runs until cancelled)."""
+
+    def __init__(
+        self,
+        gate_id: int,
+        host: str,
+        port: int,
+        dispatcher_addrs: list[tuple[str, int]],
+        *,
+        ws_port: int = 0,
+        heartbeat_timeout: float = 0.0,
+        position_sync_interval_ms: int = 100,
+    ):
+        self.gate_id = gate_id
+        self.host = host
+        self.port = port
+        self.ws_port = ws_port
+        self.heartbeat_timeout = heartbeat_timeout
+        self.sync_interval = position_sync_interval_ms / 1000.0
+        self.clients: dict[str, ClientProxy] = {}
+        self.filter_index = FilterIndex()
+        self.cluster = DispatcherCluster(
+            dispatcher_addrs, self._on_dispatcher_packet, self._handshake
+        )
+        # per-dispatcher pending upstream sync records
+        # (reference GateService.go:402-429)
+        self._sync_pending: dict[int, bytearray] = defaultdict(bytearray)
+        self._server: asyncio.AbstractServer | None = None
+        self._ws_server = None
+        self.started = asyncio.Event()
+
+    # ------------------------------------------------------------------
+    async def _handshake(self, conn: DispatcherConn) -> None:
+        p = proto.pack_set_gate_id(self.gate_id)
+        conn.conn.send(p)
+        await conn.conn.drain()
+
+    async def serve(self) -> None:
+        self.cluster.start()
+        self._server = await asyncio.start_server(
+            self._handle_client, self.host, self.port
+        )
+        tasks = [asyncio.ensure_future(self._flush_loop())]
+        if self.heartbeat_timeout > 0:
+            tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
+        if self.ws_port:
+            tasks.append(asyncio.ensure_future(self._serve_ws()))
+        self.started.set()
+        logger.info("gate%d listening on %s:%d", self.gate_id, self.host,
+                    self.port)
+        try:
+            async with self._server:
+                await self._server.serve_forever()
+        finally:
+            for t in tasks:
+                t.cancel()
+            self.cluster.stop()
+
+    @property
+    def bound_port(self) -> int:
+        assert self._server is not None
+        return self._server.sockets[0].getsockname()[1]
+
+    # -- client side -----------------------------------------------------
+    async def _handle_client(self, reader, writer) -> None:
+        conn = PacketConnection(reader, writer)
+        cp = ClientProxy(conn)
+        cp.last_heartbeat = asyncio.get_event_loop().time()
+        self.clients[cp.client_id] = cp
+        # boot entity id is generated ON the gate
+        # (reference GateService.go:209-214)
+        boot_eid = ids.gen_entity_id()
+        self.cluster.select_by_entity_id(boot_eid).send(
+            proto.pack_notify_client_connected(
+                boot_eid, cp.client_id, self.gate_id
+            )
+        )
+        try:
+            while True:
+                msgtype, pkt = await conn.recv()
+                self._handle_client_packet(cp, msgtype, pkt)
+        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            pass
+        finally:
+            await conn.close()
+            self._drop_client(cp)
+
+    def _drop_client(self, cp: ClientProxy) -> None:
+        if self.clients.pop(cp.client_id, None) is None:
+            return
+        self.filter_index.drop_client(cp)
+        key = cp.owner_eid or cp.client_id
+        self.cluster.select_by_entity_id(key).send(
+            proto.pack_notify_client_disconnected(
+                cp.client_id, cp.owner_eid
+            )
+        )
+
+    def _handle_client_packet(self, cp: ClientProxy, msgtype: int,
+                              pkt: Packet) -> None:
+        """Reference ``handleClientProxyPacket`` (``:236-256``): stamp the
+        client id onto entity RPCs; batch sync records per dispatcher."""
+        cp.last_heartbeat = asyncio.get_event_loop().time()
+        if msgtype == proto.MT_HEARTBEAT:
+            cp.send(new_packet(proto.MT_HEARTBEAT))
+            return
+        if msgtype == proto.MT_CLIENT_SYNC_POSITION_YAW:
+            rec = pkt.read_bytes(proto.SYNC_RECORD_SIZE)
+            eid = rec[:16].decode("ascii", "replace")
+            didx = self.cluster.select_by_entity_id(eid).index
+            self._sync_pending[didx].extend(rec)
+            return
+        if msgtype == proto.MT_CALL_ENTITY_METHOD_FROM_CLIENT:
+            eid = pkt.read_entity_id()
+            method = pkt.read_var_str()
+            args_raw = memoryview(pkt.buf)[pkt.rpos:]
+            out = new_packet(proto.MT_CALL_ENTITY_METHOD_FROM_CLIENT)
+            out.append_entity_id(eid)
+            out.append_entity_id(cp.client_id)
+            out.append_var_str(method)
+            out.append_bytes(bytes(args_raw))
+            self.cluster.select_by_entity_id(eid).send(out)
+            return
+        logger.warning("gate%d: client sent unhandled msgtype %d",
+                       self.gate_id, msgtype)
+
+    # -- dispatcher side --------------------------------------------------
+    def _on_dispatcher_packet(self, didx: int, msgtype: int,
+                              pkt: Packet) -> None:
+        if proto.MT_REDIRECT_TO_GATEPROXY_MSG_TYPE_START <= msgtype <= \
+                proto.MT_REDIRECT_TO_GATEPROXY_MSG_TYPE_STOP:
+            pkt.read_u16()  # gate_id (ours)
+            client_id = pkt.read_entity_id()
+            cp = self.clients.get(client_id)
+            if cp is None:
+                return
+            if msgtype == proto.MT_CREATE_ENTITY_ON_CLIENT:
+                # peek is_player to learn the owner entity
+                # (reference GateService.go:266-297)
+                save = pkt.rpos
+                eid = pkt.read_entity_id()
+                pkt.read_var_str()
+                if pkt.read_bool():
+                    cp.owner_eid = eid
+                pkt.rpos = save
+            out = new_packet(msgtype)
+            out.append_bytes(bytes(memoryview(pkt.buf)[pkt.rpos:]))
+            cp.send(out)
+            return
+        if msgtype == proto.MT_SYNC_POSITION_YAW_ON_CLIENTS:
+            pkt.read_u16()  # gate_id routing prefix (ours)
+            self._handle_sync_on_clients(pkt)
+            return
+        if msgtype == proto.MT_SET_CLIENT_FILTER_PROP:
+            pkt.read_u16()
+            client_id = pkt.read_entity_id()
+            cp = self.clients.get(client_id)
+            if cp is not None:
+                self.filter_index.set_prop(
+                    cp, pkt.read_var_str(), pkt.read_var_str()
+                )
+            return
+        if msgtype == proto.MT_CALL_FILTERED_CLIENTS:
+            op = pkt.read_u8()
+            key = pkt.read_var_str()
+            val = pkt.read_var_str()
+            eid = pkt.read_var_str()
+            method = pkt.read_var_str()
+            args_raw = bytes(memoryview(pkt.buf)[pkt.rpos:])
+            targets = self.filter_index.query(key, op, val)
+            for cp in targets:
+                out = new_packet(proto.MT_CALL_ENTITY_METHOD_ON_CLIENT)
+                out.append_entity_id(
+                    eid if len(eid) == ids.ENTITYID_LENGTH
+                    else cp.owner_eid or cp.client_id
+                )
+                out.append_var_str(method)
+                out.append_bytes(args_raw)
+                cp.send(out)
+            return
+        logger.warning("gate%d: dispatcher sent unhandled msgtype %d",
+                       self.gate_id, msgtype)
+
+    def _handle_sync_on_clients(self, pkt: Packet) -> None:
+        """Regroup 48B (cid+eid+pos) records per client and send each its
+        own 32B-record bundle (reference ``:350-375``)."""
+        buf = memoryview(pkt.buf)[pkt.rpos:]
+        cids, eids, vals = codec.decode_client_sync_batch(buf)
+        per_client: dict[bytes, list[int]] = defaultdict(list)
+        for i, cid in enumerate(cids):
+            per_client[bytes(cid)].append(i)
+        for cid, idxs in per_client.items():
+            cp = self.clients.get(cid.decode("ascii", "replace"))
+            if cp is None:
+                continue
+            out = new_packet(proto.MT_CLIENT_SYNC_POSITION_YAW)
+            out.append_bytes(
+                codec.encode_sync_batch(eids[idxs], vals[idxs])
+            )
+            cp.send(out)
+
+    # -- periodic work ----------------------------------------------------
+    async def _flush_loop(self) -> None:
+        """Flush pending upstream sync batches every sync interval
+        (reference ``tryFlushPendingSyncPackets`` ``:402-429``)."""
+        while True:
+            await asyncio.sleep(self.sync_interval)
+            for didx, buf in self._sync_pending.items():
+                if not buf:
+                    continue
+                p = new_packet(proto.MT_SYNC_POSITION_YAW_FROM_CLIENT)
+                p.append_bytes(bytes(buf))
+                self.cluster.conns[didx].send(p)
+                buf.clear()
+
+    async def _heartbeat_loop(self) -> None:
+        """Kick clients that stopped heartbeating (reference ``:197-207``)."""
+        while True:
+            await asyncio.sleep(self.heartbeat_timeout / 2)
+            now = asyncio.get_event_loop().time()
+            for cp in list(self.clients.values()):
+                if now - cp.last_heartbeat > self.heartbeat_timeout:
+                    logger.info("gate%d: client %s heartbeat timeout",
+                                self.gate_id, cp.client_id)
+                    await cp.conn.close()
+                    self._drop_client(cp)
+
+    # -- websocket listener ----------------------------------------------
+    async def _serve_ws(self) -> None:
+        """WebSocket edge (reference ``handleWebSocketConn`` ``:121-168``):
+        each binary WS message is one framed packet."""
+        import websockets
+
+        async def handle(ws):
+            loop = asyncio.get_event_loop()
+            # adapt the websocket into the PacketConnection interface via
+            # an in-memory stream pair
+            reader = asyncio.StreamReader()
+
+            class _WSWriter:
+                def write(self, data: bytes) -> None:
+                    # strip our framing: WS messages are already framed
+                    asyncio.ensure_future(ws.send(bytes(data)))
+
+                async def drain(self) -> None: ...
+                def close(self) -> None:
+                    asyncio.ensure_future(ws.close())
+
+                async def wait_closed(self) -> None: ...
+                def get_extra_info(self, _): return None
+
+            conn = PacketConnection(reader, _WSWriter())  # type: ignore
+            cp = ClientProxy(conn)
+            cp.last_heartbeat = loop.time()
+            self.clients[cp.client_id] = cp
+            boot_eid = ids.gen_entity_id()
+            self.cluster.select_by_entity_id(boot_eid).send(
+                proto.pack_notify_client_connected(
+                    boot_eid, cp.client_id, self.gate_id
+                )
+            )
+            try:
+                async for msg in ws:
+                    if not isinstance(msg, (bytes, bytearray)):
+                        continue
+                    p = Packet(msg[HEADER_SIZE:])  # strip size prefix
+                    self._handle_client_packet(cp, p.read_u16(), p)
+            except Exception:
+                pass
+            finally:
+                self._drop_client(cp)
+
+        self._ws_server = await websockets.serve(
+            handle, self.host, self.ws_port
+        )
+        await asyncio.Future()  # run until cancelled

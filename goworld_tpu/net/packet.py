@@ -53,9 +53,12 @@ class Packet:
     # -- lifecycle -------------------------------------------------------
     @staticmethod
     def alloc() -> "Packet":
-        if _pool:
+        try:
+            # list.pop is GIL-atomic; EAFP keeps this safe across the
+            # logic + network threads without a lock
             return _pool.pop()
-        return Packet()
+        except IndexError:
+            return Packet()
 
     def release(self) -> None:
         if len(_pool) < _POOL_MAX:

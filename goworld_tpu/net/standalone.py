@@ -1,0 +1,103 @@
+"""Standalone cluster harness: dispatcher(s) + gate(s) in one process.
+
+The reference always deploys dispatcher/game/gate as separate OS processes
+(``cmd/goworld`` start). For tests, examples and single-machine runs we also
+support hosting the dispatcher and gate services on a background asyncio
+thread inside the game process — real sockets, real wire protocol, one
+process. This is the "single-host multi-process integration driven by a bot
+swarm" fixture of the reference's test strategy (``SURVEY.md#4``) without
+process management.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from concurrent.futures import Future
+from typing import Coroutine
+
+from goworld_tpu.net.dispatcher import DispatcherService
+from goworld_tpu.net.gate import GateService
+from goworld_tpu.utils import log
+
+logger = log.get("standalone")
+
+
+class ClusterHarness:
+    """Runs N dispatchers + M gates on ephemeral ports in a daemon thread."""
+
+    def __init__(self, n_dispatchers: int = 1, n_gates: int = 1,
+                 desired_games: int = 1, host: str = "127.0.0.1",
+                 heartbeat_timeout: float = 0.0,
+                 position_sync_interval_ms: int = 20):
+        self.host = host
+        self.n_dispatchers = n_dispatchers
+        self.n_gates = n_gates
+        self.desired_games = desired_games
+        self.heartbeat_timeout = heartbeat_timeout
+        self.position_sync_interval_ms = position_sync_interval_ms
+        self.dispatchers: list[DispatcherService] = []
+        self.gates: list[GateService] = []
+        self.dispatcher_addrs: list[tuple[str, int]] = []
+        self.gate_addrs: list[tuple[str, int]] = []
+        self.loop: asyncio.AbstractEventLoop | None = None
+        self._thread: threading.Thread | None = None
+        self._tasks: list = []
+
+    # ------------------------------------------------------------------
+    def start(self, timeout: float = 30.0) -> None:
+        ready = threading.Event()
+
+        def run() -> None:
+            self.loop = asyncio.new_event_loop()
+            asyncio.set_event_loop(self.loop)
+            self.loop.run_until_complete(self._boot())
+            ready.set()
+            self.loop.run_forever()
+
+        self._thread = threading.Thread(
+            target=run, name="cluster-harness", daemon=True
+        )
+        self._thread.start()
+        if not ready.wait(timeout):
+            raise TimeoutError("cluster harness failed to start")
+
+    async def _boot(self) -> None:
+        for i in range(self.n_dispatchers):
+            d = DispatcherService(
+                i + 1, self.host, 0,
+                desired_games=self.desired_games,
+                desired_gates=self.n_gates,
+            )
+            self.dispatchers.append(d)
+            self._tasks.append(asyncio.ensure_future(d.serve()))
+            await d.started.wait()
+            self.dispatcher_addrs.append((self.host, d.bound_port))
+        for i in range(self.n_gates):
+            g = GateService(
+                i + 1, self.host, 0, list(self.dispatcher_addrs),
+                heartbeat_timeout=self.heartbeat_timeout,
+                position_sync_interval_ms=self.position_sync_interval_ms,
+            )
+            self.gates.append(g)
+            self._tasks.append(asyncio.ensure_future(g.serve()))
+            await g.started.wait()
+            self.gate_addrs.append((self.host, g.bound_port))
+
+    def submit(self, coro: Coroutine) -> Future:
+        """Run a coroutine (e.g. a bot) on the harness loop."""
+        assert self.loop is not None
+        return asyncio.run_coroutine_threadsafe(coro, self.loop)
+
+    def stop(self) -> None:
+        if self.loop is None:
+            return
+
+        def _shutdown() -> None:
+            for t in self._tasks:
+                t.cancel()
+            self.loop.stop()
+
+        self.loop.call_soon_threadsafe(_shutdown)
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -168,10 +168,14 @@ def grid_neighbors(
         nbr_b = jnp.sort(nbr_b, axis=1)                      # ascending ids
         return nbr_b, ok.sum(axis=1).astype(jnp.int32)
 
-    nblocks = -(-q // spec.row_block)
-    padded = nblocks * spec.row_block
+    # never let the block exceed the query count: a small space with the
+    # default row_block would otherwise pad up to a full block and do
+    # row_block/q times the work
+    rb = min(spec.row_block, q)
+    nblocks = -(-q // rb)
+    padded = nblocks * rb
     all_rows = jnp.minimum(jnp.arange(padded, dtype=jnp.int32), q - 1)
-    blocks = all_rows.reshape(nblocks, spec.row_block)
+    blocks = all_rows.reshape(nblocks, rb)
     if nblocks == 1:
         nbr, cnt = row_block(blocks[0])
     else:

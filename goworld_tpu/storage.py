@@ -1,0 +1,215 @@
+"""Async entity persistence with pluggable backends.
+
+Reference being rebuilt: ``engine/storage`` (``storage.go``): a background
+worker consumes a queue of save/load/exists/list requests against an
+``EntityStorage`` backend (``storage_common.go:5-13``); saves retry forever
+(entity data must not be lost), callbacks are posted back to the logic
+thread, and a queue-length monitor warns on backlog (``:102-110``).
+
+Backends here: ``filesystem`` (one directory per entity type, one msgpack
+file per entity — the structural analog of the reference's one-Mongo-
+collection-per-type, ``backend/mongodb/mongodb.go:27-136``) and ``memory``
+(tests). MongoDB itself is not available in this environment; the backend
+interface matches so one can be added without touching this module.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Callable
+
+import msgpack
+
+from goworld_tpu.utils import log
+
+logger = log.get("storage")
+
+SAVE_RETRY_DELAY = 1.0
+WARN_QUEUE_LEN = 100  # reference storage.go:102-110
+
+
+class EntityStorageBackend:
+    """Backend interface (reference ``EntityStorage``)."""
+
+    def write(self, type_name: str, eid: str, data: dict) -> None:
+        raise NotImplementedError
+
+    def read(self, type_name: str, eid: str) -> dict | None:
+        raise NotImplementedError
+
+    def exists(self, type_name: str, eid: str) -> bool:
+        raise NotImplementedError
+
+    def list_entity_ids(self, type_name: str) -> list[str]:
+        raise NotImplementedError
+
+    def close(self) -> None: ...
+
+
+class MemoryStorage(EntityStorageBackend):
+    def __init__(self):
+        self._data: dict[tuple[str, str], dict] = {}
+
+    def write(self, type_name, eid, data):
+        self._data[(type_name, eid)] = data
+
+    def read(self, type_name, eid):
+        return self._data.get((type_name, eid))
+
+    def exists(self, type_name, eid):
+        return (type_name, eid) in self._data
+
+    def list_entity_ids(self, type_name):
+        return [e for t, e in self._data if t == type_name]
+
+
+class FilesystemStorage(EntityStorageBackend):
+    """``<root>/<type>/<eid>.mp`` — atomic replace via temp file."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, type_name: str, eid: str) -> str:
+        return os.path.join(self.root, type_name, f"{eid}.mp")
+
+    def write(self, type_name, eid, data):
+        d = os.path.join(self.root, type_name)
+        os.makedirs(d, exist_ok=True)
+        path = self._path(type_name, eid)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(msgpack.packb(data, use_bin_type=True))
+        os.replace(tmp, path)
+
+    def read(self, type_name, eid):
+        try:
+            with open(self._path(type_name, eid), "rb") as f:
+                return msgpack.unpackb(f.read(), raw=False)
+        except FileNotFoundError:
+            return None
+
+    def exists(self, type_name, eid):
+        return os.path.exists(self._path(type_name, eid))
+
+    def list_entity_ids(self, type_name):
+        d = os.path.join(self.root, type_name)
+        if not os.path.isdir(d):
+            return []
+        return [f[:-3] for f in os.listdir(d) if f.endswith(".mp")]
+
+
+def open_backend(kind: str, location: str = "") -> EntityStorageBackend:
+    if kind == "memory":
+        return MemoryStorage()
+    if kind == "filesystem":
+        return FilesystemStorage(location or "entity_storage")
+    raise ValueError(f"unknown storage backend {kind!r}")
+
+
+class Storage:
+    """The async storage front-end attached to a World
+    (``world.storage = Storage(backend, world.post_q.post)``)."""
+
+    def __init__(self, backend: EntityStorageBackend,
+                 post: Callable[[Callable], None]):
+        self.backend = backend
+        self._post = post
+        self._q: list[tuple] = []
+        self._cv = threading.Condition()
+        self._closed = False
+        self.op_count = 0
+        self._thread = threading.Thread(
+            target=self._run, name="storage", daemon=True
+        )
+        self._thread.start()
+
+    # -- public API (reference storage.go:60-100) -----------------------
+    def save(self, type_name: str, eid: str, data: dict,
+             cb: Callable[[], None] | None = None) -> None:
+        self._enqueue(("save", type_name, eid, data, cb))
+
+    def load(self, type_name: str, eid: str,
+             cb: Callable[[dict | None], None]) -> None:
+        self._enqueue(("load", type_name, eid, None, cb))
+
+    def exists(self, type_name: str, eid: str,
+               cb: Callable[[bool], None]) -> None:
+        self._enqueue(("exists", type_name, eid, None, cb))
+
+    def list_entity_ids(self, type_name: str,
+                        cb: Callable[[list[str]], None]) -> None:
+        self._enqueue(("list", type_name, "", None, cb))
+
+    def queue_len(self) -> int:
+        with self._cv:
+            return len(self._q)
+
+    def shutdown(self, timeout: float = 30.0) -> None:
+        """Drain then stop (reference ``Shutdown`` waits for queue empty)."""
+        deadline = time.monotonic() + timeout
+        with self._cv:
+            while self._q and time.monotonic() < deadline:
+                self._cv.wait(0.1)
+            self._closed = True
+            self._cv.notify_all()
+        self._thread.join(timeout=5)
+        self.backend.close()
+
+    # -- worker ----------------------------------------------------------
+    def _enqueue(self, op: tuple) -> None:
+        with self._cv:
+            if self._closed:
+                logger.error("storage closed; dropping %s", op[0])
+                return
+            self._q.append(op)
+            if len(self._q) > WARN_QUEUE_LEN:
+                logger.warning("storage queue backlog: %d", len(self._q))
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q and not self._closed:
+                    self._cv.wait()
+                if not self._q and self._closed:
+                    return
+                op = self._q.pop(0)
+                self._cv.notify_all()
+            self._execute(op)
+
+    def _execute(self, op: tuple) -> None:
+        kind, type_name, eid, data, cb = op
+        while True:
+            try:
+                if kind == "save":
+                    self.backend.write(type_name, eid, data)
+                    res: Any = None
+                elif kind == "load":
+                    res = self.backend.read(type_name, eid)
+                elif kind == "exists":
+                    res = self.backend.exists(type_name, eid)
+                else:
+                    res = self.backend.list_entity_ids(type_name)
+                break
+            except Exception:
+                if kind == "save":
+                    # saves retry forever: losing entity data is worse
+                    # than blocking the queue (reference storageRoutine)
+                    logger.exception(
+                        "save %s.%s failed; retrying", type_name, eid
+                    )
+                    time.sleep(SAVE_RETRY_DELAY)
+                    continue
+                logger.exception("storage %s %s.%s failed",
+                                 kind, type_name, eid)
+                res = None
+                break
+        self.op_count += 1
+        if cb is not None:
+            if kind == "save":
+                self._post(cb)
+            else:
+                self._post(lambda cb=cb, res=res: cb(res))

@@ -1,0 +1,217 @@
+"""Service layer, pubsub, storage and kvdb unit tests (reference test
+strategy: kvdb_test.go, service reconcile semantics, storage roundtrips)."""
+
+import time
+
+import pytest
+
+from goworld_tpu.core.state import WorldConfig
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.manager import World
+from goworld_tpu.entity.service import ServiceManager, hash_string
+from goworld_tpu.ext.pubsub import PublishSubscribeService
+from goworld_tpu.kvdb import KVDB, MemoryKVDB, next_larger_key
+from goworld_tpu.ops.aoi import GridSpec
+from goworld_tpu.storage import FilesystemStorage, MemoryStorage, Storage
+from goworld_tpu.utils.asyncwork import AsyncWorkers
+
+
+def make_world():
+    cfg = WorldConfig(
+        capacity=64, grid=GridSpec(radius=10.0, extent_x=100.0,
+                                   extent_z=100.0)
+    )
+    w = World(cfg, n_spaces=1)
+    w.create_nil_space()
+    return w
+
+
+class CounterService(Entity):
+    def OnInit(self):
+        self.counts = {}
+
+    def Bump(self, key):
+        self.counts[key] = self.counts.get(key, 0) + 1
+
+
+class Listener(Entity):
+    def OnInit(self):
+        self.got = []
+
+    def OnPublish(self, subject, *args):
+        self.got.append((subject, args))
+
+
+# ---------------------------------------------------------------------
+# services
+# ---------------------------------------------------------------------
+def test_service_reconcile_creates_shards_and_routes():
+    w = make_world()
+    sm = ServiceManager(w, game_id=1)
+    sm.register("CounterService", CounterService, shard_count=3)
+    sm.start()
+    w.tick()
+    shards = [e for e in w.entities.values()
+              if e.type_name == "CounterService"]
+    assert len(shards) == 3
+
+    # shard-by-key routing is stable
+    sm.call("CounterService", "Bump", ("alpha",), shard_key="alpha")
+    sm.call("CounterService", "Bump", ("alpha",), shard_key="alpha")
+    sm.call("CounterService", "Bump", ("beta",), shard_key="beta")
+    w.tick()
+    idx_a = hash_string("alpha") % 3
+    ea = w.entities[sm.entity_id_of("CounterService", idx_a)]
+    assert ea.counts.get("alpha") == 2
+    total = sum(e.counts.get("beta", 0) for e in shards)
+    assert total == 1
+
+    # call_all reaches every shard
+    sm.call_all("CounterService", "Bump", "everyone")
+    w.tick()
+    assert all(e.counts.get("everyone") == 1 for e in shards)
+
+
+def test_service_second_game_does_not_duplicate():
+    """Two worlds sharing one kvreg map: only the first claims shards."""
+    w1, w2 = make_world(), make_world()
+    shared: dict[str, str] = {}
+
+    def writer(gid):
+        def w(key, val):
+            shared.setdefault(key, val)
+        return w
+
+    sm1 = ServiceManager(w1, game_id=1, kv_write=writer(1),
+                         kv_get=shared.get)
+    sm2 = ServiceManager(w2, game_id=2, kv_write=writer(2),
+                         kv_get=shared.get)
+    sm1.register("CounterService", CounterService, shard_count=2)
+    sm2.register("CounterService", CounterService, shard_count=2)
+    sm1.check_services()
+    sm2.check_services()
+    n1 = sum(1 for e in w1.entities.values()
+             if e.type_name == "CounterService")
+    n2 = sum(1 for e in w2.entities.values()
+             if e.type_name == "CounterService")
+    assert n1 == 2 and n2 == 0  # first writer won everything
+
+
+# ---------------------------------------------------------------------
+# pubsub
+# ---------------------------------------------------------------------
+def test_pubsub_exact_and_wildcard():
+    w = make_world()
+    sm = ServiceManager(w, game_id=1)
+    sm.register("PublishSubscribeService", PublishSubscribeService,
+                shard_count=1)
+    w.register_entity("Listener", Listener)
+    sm.start()
+    w.tick()
+    exact = w.create_entity("Listener")
+    wild = w.create_entity("Listener")
+    other = w.create_entity("Listener")
+
+    sm.call("PublishSubscribeService", "Subscribe",
+            (exact.id, "chat.room1"), shard_key="chat.room1")
+    sm.call("PublishSubscribeService", "Subscribe",
+            (wild.id, "chat.*"), shard_key="chat.room1")
+    sm.call("PublishSubscribeService", "Subscribe",
+            (other.id, "mail.inbox"), shard_key="chat.room1")
+    w.tick()
+    sm.call("PublishSubscribeService", "Publish",
+            ("chat.room1", "hi"), shard_key="chat.room1")
+    w.tick()
+    w.tick()
+    assert exact.got == [("chat.room1", ("hi",))]
+    assert wild.got == [("chat.room1", ("hi",))]
+    assert other.got == []
+
+    # unsubscribe stops delivery
+    sm.call("PublishSubscribeService", "Unsubscribe",
+            (exact.id, "chat.room1"), shard_key="chat.room1")
+    w.tick()
+    sm.call("PublishSubscribeService", "Publish",
+            ("chat.room1", "again"), shard_key="chat.room1")
+    w.tick()
+    w.tick()
+    assert len(exact.got) == 1
+    assert len(wild.got) == 2
+
+
+# ---------------------------------------------------------------------
+# storage
+# ---------------------------------------------------------------------
+def test_storage_roundtrip_and_callbacks(tmp_path):
+    posted = []
+    st = Storage(FilesystemStorage(str(tmp_path / "es")), posted.append)
+    st.save("Avatar", "A" * 16, {"name": "bob", "lv": 3})
+    done = {}
+    st.load("Avatar", "A" * 16, lambda d: done.update(got=d))
+    st.exists("Avatar", "B" * 16, lambda b: done.update(exists=b))
+    st.list_entity_ids("Avatar", lambda xs: done.update(ids=xs))
+    deadline = time.time() + 5
+    while len(posted) < 3 and time.time() < deadline:
+        time.sleep(0.01)
+    for cb in posted:  # drain the "post queue"
+        cb()
+    assert done["got"] == {"name": "bob", "lv": 3}
+    assert done["exists"] is False
+    assert done["ids"] == ["A" * 16]
+    st.shutdown()
+
+
+def test_world_persistence_save_load(tmp_path):
+    class Hero(Entity):
+        ATTRS = {"name": "client persistent", "secret": "persistent",
+                 "transient": "client"}
+
+    w = make_world()
+    w.register_entity("Hero", Hero, persistent=True)
+    posted = w.post_q.post
+    w.storage = Storage(MemoryStorage(), posted)
+    h = w.create_entity("Hero")
+    h.attrs["name"] = "x"
+    h.attrs["secret"] = 42
+    h.attrs["transient"] = "no"
+    hid = h.id
+    h.destroy()  # persistent entities save on destroy
+    deadline = time.time() + 5
+    while w.storage.op_count < 1 and time.time() < deadline:
+        time.sleep(0.01)
+    loaded = {}
+    w.load_entity("Hero", hid, cb=lambda e: loaded.update(e=e))
+    deadline = time.time() + 5
+    while "e" not in loaded and time.time() < deadline:
+        w.tick()
+    e = loaded["e"]
+    assert e is not None and e.id == hid
+    assert e.attrs["name"] == "x" and e.attrs["secret"] == 42
+    # non-persistent attrs do not survive
+    assert e.attrs.get("transient") is None
+    w.storage.shutdown()
+
+
+# ---------------------------------------------------------------------
+# kvdb
+# ---------------------------------------------------------------------
+def test_kvdb_ops():
+    posted = []
+    workers = AsyncWorkers(posted.append)
+    kv = KVDB(MemoryKVDB(), workers)
+    out = {}
+    kv.put("k1", "v1")
+    kv.get("k1", lambda v, err: out.update(get=v))
+    kv.get_or_put("k1", "OTHER", lambda v, err: out.update(gop_old=v))
+    kv.get_or_put("k2", "v2", lambda v, err: out.update(gop_new=v))
+    kv.get_range("k0", "k2", lambda items, err: out.update(rng=items))
+    deadline = time.time() + 5
+    while len(posted) < 4 and time.time() < deadline:
+        time.sleep(0.01)
+    for cb in posted:
+        cb()
+    assert out["get"] == "v1"
+    assert out["gop_old"] == "v1"   # existing value returned, not replaced
+    assert out["gop_new"] is None   # fresh write
+    assert out["rng"] == [("k1", "v1")]
+    assert next_larger_key("abc") == "abc\x00"
