@@ -1,0 +1,16 @@
+"""Minimal game — boot entities only (reference ``examples/nil_game``,
+``nil_game.go:1-13``)."""
+
+import goworld_tpu as gw
+
+
+@gw.register_entity("Account")
+class Account(gw.Entity):
+    ATTRS = {"status": "client"}
+
+    def OnClientConnected(self):
+        self.attrs["status"] = "online"
+
+
+if __name__ == "__main__":
+    gw.run()

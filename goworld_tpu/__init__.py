@@ -24,4 +24,30 @@ The public facade mirrors the reference's root package ``goworld.go:34-256``.
 
 __version__ = "0.1.0"
 
-from goworld_tpu.api import *  # noqa: F401,F403  (populated as subsystems land)
+
+def __getattr__(name: str):
+    """Lazy facade: ``goworld_tpu.api`` pulls in jax (via the entity
+    runtime); dispatcher/gate processes import this package for config and
+    wire code only and must NOT initialize a TPU client (under the axon
+    tunnel, every jax-using process contends for the single chip).
+
+    Submodules resolve first (so ``from goworld_tpu import config`` does
+    not recurse through the api import); everything else proxies to the
+    facade in :mod:`goworld_tpu.api`."""
+    import importlib
+
+    try:
+        return importlib.import_module(f"goworld_tpu.{name}")
+    except ModuleNotFoundError as e:
+        # only swallow "no such submodule"; a submodule's own failing
+        # import (e.g. a missing third-party dep) must surface as-is
+        if e.name != f"goworld_tpu.{name}":
+            raise
+    from goworld_tpu import api
+
+    try:
+        return getattr(api, name)
+    except AttributeError:
+        raise AttributeError(
+            f"module 'goworld_tpu' has no attribute {name!r}"
+        ) from None

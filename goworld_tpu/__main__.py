@@ -1,0 +1,7 @@
+"""``python -m goworld_tpu`` — the ops CLI (reference ``cmd/goworld``)."""
+
+import sys
+
+from goworld_tpu.cli import main
+
+sys.exit(main())

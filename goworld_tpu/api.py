@@ -1,7 +1,392 @@
 """Public facade (mirrors the reference's root package ``goworld.go:34-256``).
 
-Populated incrementally as subsystems land; everything exported here is part
-of the stable user-facing API.
+The reference's user-facing flow::
+
+    goworld.RegisterSpace(...)
+    goworld.RegisterEntity(...)
+    goworld.RegisterService(...)
+    goworld.Run()
+
+is preserved verbatim: a game server script registers its types at import
+time and calls :func:`run`, which performs the boot sequence of
+``components/game/game.go:65-135`` — config, storage, kvdb, world (or
+freeze-file restore), dispatcher connections, signal handlers, serve loop.
+
+Everything exported here is part of the stable user-facing API.
 """
 
-__all__: list = []
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+from typing import Any, Callable
+
+from goworld_tpu import config as config_mod
+from goworld_tpu.entity.entity import Entity, GameClient
+from goworld_tpu.entity.manager import World
+from goworld_tpu.entity.space import Space
+from goworld_tpu.utils import consts, log
+
+logger = log.get("api")
+
+__all__ = [
+    "Entity", "Space", "GameClient",
+    "register_entity", "register_space", "register_service",
+    "on_deployment_ready",
+    "run", "world", "game_server",
+    "create_space", "create_entity", "create_entity_anywhere",
+    "load_entity_anywhere", "call", "call_service", "call_nil_spaces",
+    "call_filtered_clients",
+    "kvdb_get", "kvdb_put", "kvdb_get_or_put", "kvdb_get_range",
+    "add_callback", "add_timer", "cancel_timer", "post",
+    "register_crontab", "kvreg_register", "kvreg_get", "kvreg_watch",
+]
+
+# registrations made before run() builds the World (the reference's
+# RegisterEntity also runs before Run(), goworld.go:42-50)
+_registrations: list[tuple[str, str, type, dict]] = []
+_ready_callbacks: list[Callable[[], None]] = []
+_rt: "_Runtime | None" = None
+
+
+class _Runtime:
+    """Everything one game process owns (world + cluster + IO backends)."""
+
+    def __init__(self, world: World, server, storage, kvdb, workers):
+        self.world = world
+        self.server = server
+        self.storage = storage
+        self.kvdb = kvdb
+        self.workers = workers
+
+
+def _require_rt() -> _Runtime:
+    if _rt is None:
+        raise RuntimeError("goworld_tpu.run() has not been called")
+    return _rt
+
+
+# =======================================================================
+# registration
+# =======================================================================
+def register_entity(name: str, cls: type | None = None, **kw):
+    """Register an entity type (reference ``RegisterEntity``). Usable as a
+    decorator: ``@register_entity("Avatar")``."""
+
+    def _reg(c: type):
+        _registrations.append(("entity", name, c, kw))
+        return c
+
+    return _reg if cls is None else _reg(cls)
+
+
+def register_space(name: str, cls: type | None = None, **kw):
+    """Reference ``RegisterSpace`` (``goworld.go:42``)."""
+
+    def _reg(c: type):
+        _registrations.append(("space", name, c, kw))
+        return c
+
+    return _reg if cls is None else _reg(cls)
+
+
+def on_deployment_ready(cb: Callable[[], None]):
+    """Run ``cb`` once the whole deployment is up (the reference's
+    ``OnGameReady`` on the nil space, ``GameService.go:344-393``). Usable
+    as a decorator."""
+    _ready_callbacks.append(cb)
+    return cb
+
+
+def register_service(name: str, cls: type | None = None,
+                     shard_count: int = 1, **kw):
+    """Reference ``RegisterService`` (``goworld.go:142``,
+    ``service.go:65``): a sharded, auto-placed singleton entity."""
+
+    def _reg(c: type):
+        kw["shard_count"] = shard_count
+        _registrations.append(("service", name, c, kw))
+        return c
+
+    return _reg if cls is None else _reg(cls)
+
+
+# =======================================================================
+# boot (reference goworld.Run -> game.Run, game.go:65-135)
+# =======================================================================
+def _parse_args(argv: list[str]):
+    ap = argparse.ArgumentParser(description="goworld_tpu game process")
+    ap.add_argument("-gid", type=int, default=1)
+    ap.add_argument("-configfile", default=None)
+    ap.add_argument("-restore", action="store_true")
+    ap.add_argument("-logfile", default="")
+    ap.add_argument("-loglevel", default="")
+    return ap.parse_args(argv)
+
+
+def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.ops.aoi import GridSpec
+
+    wc = WorldConfig(
+        capacity=gc.capacity,
+        grid=GridSpec(radius=gc.aoi_radius, extent_x=gc.extent_x,
+                      extent_z=gc.extent_z),
+    )
+    mesh = None
+    if gc.mesh_devices > 1:
+        import jax
+        from goworld_tpu.parallel.mesh import make_mesh
+
+        if len(jax.devices()) >= gc.mesh_devices:
+            mesh = make_mesh(gc.mesh_devices)
+        else:
+            logger.warning(
+                "mesh_devices=%d but only %d devices; single-device path",
+                gc.mesh_devices, len(jax.devices()),
+            )
+    return World(wc, n_spaces=max(gc.n_spaces, 1), mesh=mesh, game_id=gid)
+
+
+def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
+    """Boot this game process (reference ``goworld.Run``)."""
+    global _rt
+    args = _parse_args(sys.argv[1:] if argv is None else argv)
+    if args.logfile or args.loglevel:
+        log.setup(f"game{args.gid}", level=args.loglevel or "info",
+                  logfile=args.logfile or None)
+    # honor JAX_PLATFORMS even when sitecustomize pre-imported jax and
+    # bound a different default platform (e.g. the axon TPU plugin): the
+    # config update works as long as no backend client exists yet
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat and "jax" in sys.modules:
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", plat)
+        except Exception:  # backend already up: too late, keep going
+            pass
+    cfg = config_mod.load(args.configfile)
+    gid = args.gid
+    gc = cfg.games.get(gid) or config_mod.GameConfig()
+
+    # storage + kvdb (reference game.go:99-103)
+    from goworld_tpu.kvdb import KVDB, open_kvdb_backend
+    from goworld_tpu.storage import Storage, open_backend
+    from goworld_tpu.utils.asyncwork import AsyncWorkers
+
+    world = _build_world(gc, gid)
+    workers = AsyncWorkers(world.post_q.post)
+    storage = Storage(
+        open_backend(cfg.storage.kind, cfg.storage.directory),
+        world.post_q.post,
+    )
+    kvdb = KVDB(open_kvdb_backend(cfg.kvdb.kind, cfg.kvdb.path), workers)
+    world.storage = storage
+
+    _apply_registrations(world)
+
+    from goworld_tpu import freeze as freeze_mod
+    from goworld_tpu.net.game import GameServer
+
+    restoring = args.restore and os.path.exists(
+        freeze_mod.freeze_filename(gid)
+    )
+    if not restoring:
+        world.create_nil_space()
+    server = GameServer(
+        gid, world, cfg.dispatcher_addrs(),
+        boot_entity=gc.boot_entity,
+        ban_boot=gc.ban_boot_entity,
+        restore=restoring,
+    )
+    svc = server.setup_services()
+    _apply_registrations(world, svc=svc, services_only=True)
+
+    _rt = _Runtime(world, server, storage, kvdb, workers)
+
+    def _fire_ready() -> None:
+        for cb in _ready_callbacks:
+            try:
+                cb()
+            except Exception:
+                logger.exception("on_deployment_ready callback failed")
+
+    server.on_deployment_ready = _fire_ready
+
+    # signal handling (reference game.go:137-196): TERM = clean stop,
+    # HUP = freeze for hot reload
+    if block:
+        signal.signal(signal.SIGTERM, lambda *_: server.stop())
+        signal.signal(signal.SIGINT, lambda *_: server.stop())
+        signal.signal(signal.SIGHUP, lambda *_: server.request_freeze())
+
+    server.start_network()
+    # registration barrier: pump until every dispatcher acked SET_GAME_ID
+    # so the STARTED tag (consumed by the CLI's readiness wait) means
+    # "routable" — a gate started next can immediately place boot entities
+    import time as _time
+
+    deadline = _time.monotonic() + 60.0
+    n_disp = len(server.cluster.conns)
+    while len(server.handshake_acks) < n_disp \
+            and _time.monotonic() < deadline:
+        server.pump()
+        _time.sleep(0.02)
+    if len(server.handshake_acks) < n_disp:
+        logger.warning(
+            "only %d/%d dispatchers acked within 60s",
+            len(server.handshake_acks), n_disp,
+        )
+    # supervisor tag consumed by the CLI's readiness wait
+    # (reference consts.go:108-112 + start.go:98-114)
+    print(consts.SUPERVISOR_STARTED_TAG, flush=True)
+    logger.info("game%d started (restore=%s)", gid, restoring)
+    if block:
+        try:
+            server.serve_forever()
+        finally:
+            storage.shutdown()
+            workers.wait_clear()
+            server.stop()
+        # hard exit: state is safely on disk by now, and interpreter
+        # teardown can hang in PJRT client finalization (axon tunnel) —
+        # a server process must terminate when told to
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os._exit(
+            consts.FREEZE_EXIT_CODE if server.run_state == "frozen" else 0
+        )
+    return _rt
+
+
+# =======================================================================
+# world accessors
+# =======================================================================
+def world() -> World:
+    return _require_rt().world
+
+
+def game_server():
+    return _require_rt().server
+
+
+# =======================================================================
+# entity / space ops (reference goworld.go:52-140)
+# =======================================================================
+def create_space(type_name: str, **attrs) -> Space:
+    return _require_rt().world.create_space(type_name, **attrs)
+
+
+def create_entity(type_name: str, **kw) -> Entity:
+    return _require_rt().world.create_entity(type_name, **kw)
+
+
+def create_entity_anywhere(type_name: str, attrs: dict | None = None) -> None:
+    _require_rt().server.create_entity_anywhere(type_name, attrs)
+
+
+def load_entity_anywhere(type_name: str, eid: str) -> None:
+    _require_rt().server.load_entity_anywhere(type_name, eid)
+
+
+def call(eid: str, method: str, *args) -> None:
+    _require_rt().world.call(eid, method, *args)
+
+
+def call_service(name: str, method: str, *args,
+                 shard_key: str | None = None) -> None:
+    _require_rt().world.call_service(name, method, *args,
+                                     shard_key=shard_key)
+
+
+def call_nil_spaces(method: str, *args) -> None:
+    _require_rt().server.call_nil_spaces(method, *args)
+
+
+def call_filtered_clients(key: str, op: str, val: str, method: str,
+                          *args) -> None:
+    _require_rt().world.call_filtered_clients(key, op, val, method, args)
+
+
+# =======================================================================
+# kvdb (reference goworld.go:214-256)
+# =======================================================================
+def kvdb_get(key: str, cb: Callable) -> None:
+    _require_rt().kvdb.get(key, cb)
+
+
+def kvdb_put(key: str, val: str, cb: Callable) -> None:
+    _require_rt().kvdb.put(key, val, cb)
+
+
+def kvdb_get_or_put(key: str, val: str, cb: Callable) -> None:
+    _require_rt().kvdb.get_or_put(key, val, cb)
+
+
+def kvdb_get_range(begin: str, end: str, cb: Callable) -> None:
+    _require_rt().kvdb.get_range(begin, end, cb)
+
+
+# =======================================================================
+# kvreg (cluster registry; reference kvreg.go)
+# =======================================================================
+def kvreg_register(key: str, val: str, force: bool = False) -> None:
+    _require_rt().server.kvreg_register(key, val, force)
+
+
+def kvreg_get(key: str) -> str | None:
+    return _require_rt().server.kvreg.get(key)
+
+
+def kvreg_watch(cb: Callable[[str, str], None]) -> None:
+    _require_rt().server.kvreg_watchers.append(cb)
+
+
+# =======================================================================
+# timers / post / crontab (reference goworld.go:190-212)
+# =======================================================================
+def add_callback(delay: float, cb: Callable[[], None]) -> int:
+    return _require_rt().world.timers.add(delay, cb=cb)
+
+
+def add_timer(interval: float, cb: Callable[[], None]) -> int:
+    return _require_rt().world.timers.add(interval, interval=interval, cb=cb)
+
+
+def cancel_timer(tid: int) -> None:
+    _require_rt().world.timers.cancel(tid)
+
+
+def post(cb: Callable[[], None]) -> None:
+    _require_rt().world.post_q.post(cb)
+
+
+def register_crontab(minute: int, hour: int, day: int, month: int,
+                     dow: int, cb: Callable[[], None]) -> None:
+    _require_rt().world.crontab.register(minute, hour, day, month, dow, cb)
+
+
+def _apply_registrations(world: World, svc=None,
+                         services_only: bool = False) -> None:
+    """Install the module-level registrations into a World (used by run()
+    and by tests that host example games in-process)."""
+    for kind, name, c, kw in _registrations:
+        if kind == "entity" and not services_only:
+            world.register_entity(name, c, **kw)
+        elif kind == "space" and not services_only:
+            world.register_space(name, c, **kw)
+        elif kind == "service" and svc is not None:
+            kw = dict(kw)
+            shards = kw.pop("shard_count", 1)
+            svc.register(name, c, shard_count=shards, **kw)
+
+
+def _reset_for_tests() -> None:
+    """Clear module state between tests (not public API)."""
+    global _rt
+    _rt = None
+    _registrations.clear()
+    _ready_callbacks.clear()

@@ -1,0 +1,402 @@
+"""Ops CLI — ``python -m goworld_tpu start|stop|kill|reload|status <dir>``.
+
+Reference being rebuilt: ``cmd/goworld`` (``main.go:22-61``): the operator
+tool that starts a whole cluster from one server directory (dispatchers,
+then games, then gates — ``start.go:17-114``), stops it in reverse order
+(``stop.go:11-90``), hot-reloads games via SIGHUP + ``-restore`` restart
+(``reload.go:10-34``), and reports process status (``status.go:14-116``).
+
+Differences from the reference, by design:
+
+* no ``build`` step — games are Python scripts (the reference compiles Go);
+* liveness is tracked with pid files under ``<dir>/run/`` instead of
+  scanning the process table (same observable behavior, simpler and safer);
+* readiness still uses the supervisor tag printed to each process's log
+  (reference ``consts.go:108-112`` + ``start.go:98-114``).
+
+A server directory contains:
+
+* ``server.py`` — the game script; registers types, calls
+  ``goworld_tpu.run()`` (name override: ``[game_common] entry = ...``);
+* ``goworld_tpu.ini`` or ``goworld.ini`` — the cluster config.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import signal
+import subprocess
+import sys
+import time
+
+from goworld_tpu import config as config_mod
+from goworld_tpu.utils.consts import (
+    FREEZE_EXIT_CODE,
+    SUPERVISOR_STARTED_TAG,
+)
+
+_CONFIG_NAMES = ("goworld_tpu.ini", "goworld.ini")
+
+
+# =======================================================================
+# server-dir helpers
+# =======================================================================
+def _find_config(server_dir: str) -> str | None:
+    for name in _CONFIG_NAMES:
+        p = os.path.join(server_dir, name)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def _run_dir(server_dir: str) -> str:
+    d = os.path.join(server_dir, "run")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _pid_path(server_dir: str, role: str, idx: int) -> str:
+    return os.path.join(_run_dir(server_dir), f"{role}{idx}.pid")
+
+
+def _log_path(server_dir: str, role: str, idx: int) -> str:
+    return os.path.join(_run_dir(server_dir), f"{role}{idx}.log")
+
+
+def _read_pid(server_dir: str, role: str, idx: int) -> int | None:
+    try:
+        with open(_pid_path(server_dir, role, idx)) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def _alive(pid: int | None) -> bool:
+    if pid is None:
+        return False
+    try:
+        # reap if it's an exited child of this process (a long-lived
+        # caller — e.g. a test harness — would otherwise see a zombie
+        # and conclude the process never exited)
+        os.waitpid(pid, os.WNOHANG)
+    except (ChildProcessError, OSError):
+        pass
+    try:
+        os.kill(pid, 0)
+    except OSError:
+        return False
+    try:
+        # kill(0) also succeeds for zombies we cannot reap; check state
+        with open(f"/proc/{pid}/stat") as f:
+            state = f.read().rsplit(") ", 1)[1].split()[0]
+        return state != "Z"
+    except (OSError, IndexError):
+        return True  # no /proc (non-linux): kill(0) verdict stands
+
+
+def _entry_script(cfg: config_mod.ClusterConfig, server_dir: str) -> str:
+    entry = getattr(cfg, "entry", None) or "server.py"
+    return os.path.join(server_dir, entry)
+
+
+def _spawn(server_dir: str, role: str, idx: int, cmd: list[str],
+           extra_env: dict | None = None) -> int:
+    """Start the process; returns the byte offset of its log so readiness
+    waits only match tags THIS process printed (logs append across
+    restarts — reload would otherwise see the previous run's tag)."""
+    log_path = _log_path(server_dir, role, idx)
+    offset = os.path.getsize(log_path) if os.path.exists(log_path) else 0
+    logf = open(log_path, "ab")
+    env = dict(os.environ)
+    # spawned processes run with cwd=server_dir; make sure they can still
+    # import the framework from wherever this CLI loaded it
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = os.pathsep.join(
+        [pkg_root] + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else [])
+    )
+    if extra_env:
+        env.update(extra_env)
+    proc = subprocess.Popen(
+        cmd, stdout=logf, stderr=subprocess.STDOUT, cwd=server_dir,
+        env=env, start_new_session=True,
+    )
+    logf.close()
+    with open(_pid_path(server_dir, role, idx), "w") as f:
+        f.write(str(proc.pid))
+    return offset
+
+
+def _wait_started(server_dir: str, role: str, idx: int,
+                  offset: int = 0, timeout: float = 120.0) -> bool:
+    """Poll the process log for the supervisor tag (reference
+    ``start.go:98-114`` reads the logfile for the STARTED tag)."""
+    path = _log_path(server_dir, role, idx)
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        pid = _read_pid(server_dir, role, idx)
+        try:
+            with open(path, "rb") as f:
+                f.seek(offset)
+                if SUPERVISOR_STARTED_TAG.encode() in f.read():
+                    return True
+        except OSError:
+            pass
+        if not _alive(pid):
+            return False
+        time.sleep(0.2)
+    return False
+
+
+# =======================================================================
+# start (reference start.go:17-114: dispatchers -> games -> gates)
+# =======================================================================
+def cmd_start(server_dir: str) -> int:
+    cfgfile = _find_config(server_dir)
+    cfg = config_mod.load(cfgfile)
+    entry = _entry_script(cfg, server_dir)
+    if not os.path.exists(entry):
+        print(f"error: game script {entry} not found", file=sys.stderr)
+        return 1
+    py = sys.executable
+    rel_cfg = os.path.basename(cfgfile) if cfgfile else ""
+
+    for did in sorted(cfg.dispatchers):
+        if _alive(_read_pid(server_dir, "dispatcher", did)):
+            print(f"dispatcher{did}: already running")
+            continue
+        cmd = [py, "-m", "goworld_tpu.cli", "run-dispatcher",
+               "-dispid", str(did)]
+        if rel_cfg:
+            cmd += ["-configfile", rel_cfg]
+        off = _spawn(server_dir, "dispatcher", did, cmd)
+        ok = _wait_started(server_dir, "dispatcher", did, off)
+        print(f"dispatcher{did}: {'started' if ok else 'FAILED'}")
+        if not ok:
+            return 1
+
+    for gid in sorted(cfg.games):
+        if _alive(_read_pid(server_dir, "game", gid)):
+            print(f"game{gid}: already running")
+            continue
+        cmd = [py, entry, "-gid", str(gid)]
+        if rel_cfg:
+            cmd += ["-configfile", rel_cfg]
+        freeze_file = os.path.join(server_dir, f"game{gid}_freezed.dat")
+        if os.path.exists(freeze_file):
+            cmd.append("-restore")
+        off = _spawn(server_dir, "game", gid, cmd)
+        ok = _wait_started(server_dir, "game", gid, off)
+        print(f"game{gid}: {'started' if ok else 'FAILED'}")
+        if not ok:
+            return 1
+
+    for gid in sorted(cfg.gates):
+        if _alive(_read_pid(server_dir, "gate", gid)):
+            print(f"gate{gid}: already running")
+            continue
+        cmd = [py, "-m", "goworld_tpu.cli", "run-gate",
+               "-gateid", str(gid)]
+        if rel_cfg:
+            cmd += ["-configfile", rel_cfg]
+        off = _spawn(server_dir, "gate", gid, cmd)
+        ok = _wait_started(server_dir, "gate", gid, off)
+        print(f"gate{gid}: {'started' if ok else 'FAILED'}")
+        if not ok:
+            return 1
+    return 0
+
+
+# =======================================================================
+# stop / kill (reference stop.go: gates -> games -> dispatchers)
+# =======================================================================
+def _stop_role(server_dir: str, role: str, indices, sig,
+               timeout: float = 30.0) -> bool:
+    ok = True
+    for idx in indices:
+        pid = _read_pid(server_dir, role, idx)
+        if not _alive(pid):
+            continue
+        try:
+            os.kill(pid, sig)
+        except OSError:
+            continue
+        deadline = time.monotonic() + timeout
+        while _alive(pid) and time.monotonic() < deadline:
+            time.sleep(0.1)
+        if _alive(pid):
+            print(f"{role}{idx}: did not exit", file=sys.stderr)
+            ok = False
+        else:
+            try:
+                os.unlink(_pid_path(server_dir, role, idx))
+            except OSError:
+                pass
+            print(f"{role}{idx}: stopped")
+    return ok
+
+
+def cmd_stop(server_dir: str, sig=signal.SIGTERM) -> int:
+    cfg = config_mod.load(_find_config(server_dir))
+    ok = _stop_role(server_dir, "gate", sorted(cfg.gates), sig)
+    ok &= _stop_role(server_dir, "game", sorted(cfg.games), sig)
+    ok &= _stop_role(server_dir, "dispatcher", sorted(cfg.dispatchers), sig)
+    return 0 if ok else 1
+
+
+# =======================================================================
+# reload (reference reload.go: SIGHUP games, restart with -restore)
+# =======================================================================
+def cmd_reload(server_dir: str) -> int:
+    cfgfile = _find_config(server_dir)
+    cfg = config_mod.load(cfgfile)
+    entry = _entry_script(cfg, server_dir)
+    py = sys.executable
+    rel_cfg = os.path.basename(cfgfile) if cfgfile else ""
+    for gid in sorted(cfg.games):
+        pid = _read_pid(server_dir, "game", gid)
+        if not _alive(pid):
+            print(f"game{gid}: not running; skipping")
+            continue
+        os.kill(pid, signal.SIGHUP)  # freeze (reference FreezeSignal)
+        deadline = time.monotonic() + 60
+        while _alive(pid) and time.monotonic() < deadline:
+            time.sleep(0.1)
+        if _alive(pid):
+            print(f"game{gid}: freeze did not complete", file=sys.stderr)
+            return 1
+        freeze_file = os.path.join(server_dir, f"game{gid}_freezed.dat")
+        if not os.path.exists(freeze_file):
+            print(f"game{gid}: no freeze file after exit", file=sys.stderr)
+            return 1
+        cmd = [py, entry, "-gid", str(gid), "-restore"]
+        if rel_cfg:
+            cmd += ["-configfile", rel_cfg]
+        off = _spawn(server_dir, "game", gid, cmd)
+        ok = _wait_started(server_dir, "game", gid, off)
+        print(f"game{gid}: {'reloaded' if ok else 'RESTORE FAILED'}")
+        if not ok:
+            return 1
+    return 0
+
+
+# =======================================================================
+# status (reference status.go)
+# =======================================================================
+def cmd_status(server_dir: str) -> int:
+    cfg = config_mod.load(_find_config(server_dir))
+    rows = (
+        [("dispatcher", i) for i in sorted(cfg.dispatchers)]
+        + [("game", i) for i in sorted(cfg.games)]
+        + [("gate", i) for i in sorted(cfg.gates)]
+    )
+    all_up = True
+    for role, idx in rows:
+        pid = _read_pid(server_dir, role, idx)
+        up = _alive(pid)
+        all_up &= up
+        state = f"running (pid {pid})" if up else "stopped"
+        print(f"{role}{idx}: {state}")
+    return 0 if all_up else 1
+
+
+# =======================================================================
+# in-process runners (the spawned dispatcher/gate processes)
+# =======================================================================
+def cmd_run_dispatcher(dispid: int, configfile: str | None) -> int:
+    from goworld_tpu.net.dispatcher import DispatcherService
+
+    cfg = config_mod.load(configfile)
+    dc = cfg.dispatchers.get(dispid) or config_mod.DispatcherConfig()
+
+    async def main() -> None:
+        svc = DispatcherService(
+            dispid, dc.host, dc.port,
+            desired_games=cfg.desired_games,
+            desired_gates=cfg.desired_gates,
+        )
+        task = asyncio.ensure_future(svc.serve())
+        await svc.started.wait()
+        print(SUPERVISOR_STARTED_TAG, flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_event_loop()
+        for s in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(s, stop.set)
+        await stop.wait()
+        task.cancel()
+
+    asyncio.run(main())
+    return 0
+
+
+def cmd_run_gate(gateid: int, configfile: str | None) -> int:
+    from goworld_tpu.net.gate import GateService
+
+    cfg = config_mod.load(configfile)
+    gc = cfg.gates.get(gateid) or config_mod.GateConfig()
+
+    async def main() -> None:
+        svc = GateService(
+            gateid, gc.host, gc.port, cfg.dispatcher_addrs(),
+            ws_port=gc.ws_port,
+            heartbeat_timeout=gc.heartbeat_timeout,
+            position_sync_interval_ms=gc.position_sync_interval_ms,
+        )
+        task = asyncio.ensure_future(svc.serve())
+        await svc.started.wait()
+        print(SUPERVISOR_STARTED_TAG, flush=True)
+        stop = asyncio.Event()
+        loop = asyncio.get_event_loop()
+        for s in (signal.SIGTERM, signal.SIGINT):
+            loop.add_signal_handler(s, stop.set)
+        await stop.wait()
+        task.cancel()
+
+    asyncio.run(main())
+    return 0
+
+
+# =======================================================================
+# entry
+# =======================================================================
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="goworld_tpu",
+        description="cluster ops (reference cmd/goworld)",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    for name in ("start", "stop", "kill", "reload", "status"):
+        p = sub.add_parser(name)
+        p.add_argument("server_dir")
+    pd = sub.add_parser("run-dispatcher")
+    pd.add_argument("-dispid", type=int, default=1)
+    pd.add_argument("-configfile", default=None)
+    pg = sub.add_parser("run-gate")
+    pg.add_argument("-gateid", type=int, default=1)
+    pg.add_argument("-configfile", default=None)
+    sub.add_parser("sample-config")
+
+    args = ap.parse_args(argv)
+    if args.cmd == "start":
+        return cmd_start(args.server_dir)
+    if args.cmd == "stop":
+        return cmd_stop(args.server_dir)
+    if args.cmd == "kill":
+        return cmd_stop(args.server_dir, sig=signal.SIGKILL)
+    if args.cmd == "reload":
+        return cmd_reload(args.server_dir)
+    if args.cmd == "status":
+        return cmd_status(args.server_dir)
+    if args.cmd == "run-dispatcher":
+        return cmd_run_dispatcher(args.dispid, args.configfile)
+    if args.cmd == "run-gate":
+        return cmd_run_gate(args.gateid, args.configfile)
+    if args.cmd == "sample-config":
+        print(config_mod.dumps_sample())
+        return 0
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -70,6 +70,7 @@ class KVDBConfig:
 
 @dataclasses.dataclass
 class ClusterConfig:
+    entry: str = "server.py"   # game script ([deployment] entry = ...)
     dispatchers: dict[int, DispatcherConfig] = dataclasses.field(
         default_factory=dict)
     games: dict[int, GameConfig] = dataclasses.field(default_factory=dict)
@@ -143,6 +144,8 @@ def load(path: str | None = None) -> ClusterConfig:
     build("dispatcher", DispatcherConfig, cfg.dispatchers)
     build("game", GameConfig, cfg.games)
     build("gate", GateConfig, cfg.gates)
+    if cp.has_section("deployment"):
+        _fill(cfg, cp["deployment"])
     if cp.has_section("storage"):
         _fill(cfg.storage, cp["storage"])
     if cp.has_section("kvdb"):

@@ -166,6 +166,12 @@ class MapAttr(_Node):
     def get(self, key: str, default: Any = None) -> Any:
         return self._d.get(key, default)
 
+    def setdefault(self, key: str, default: Any) -> Any:
+        """Set-if-absent (journals only when it actually sets)."""
+        if key not in self._d:
+            self.set(key, default)
+        return self._d[key]
+
     def __getitem__(self, key: str) -> Any:
         return self._d[key]
 

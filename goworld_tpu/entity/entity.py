@@ -215,6 +215,15 @@ class Entity:
         ``Entity.go:1150-1170``); resolved by the gateway filter index."""
         self.world.call_filtered_clients(key, op, val, method, args)
 
+    def set_client_filter_prop(self, key: str, val) -> None:
+        """Tag this entity's client in the gate's filter index (reference
+        ``SetClientFilterProp``; used with :meth:`call_filtered_clients`,
+        e.g. chatroom membership)."""
+        if self.client is not None:
+            self.client.send({
+                "type": "filter_prop", "key": key, "val": str(val),
+            })
+
     # ------------------------------------------------------------------
     # space / migration (reference Entity.go:956-1115)
     # ------------------------------------------------------------------

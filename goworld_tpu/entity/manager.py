@@ -38,7 +38,7 @@ from goworld_tpu.entity.registry import (
 from goworld_tpu.entity.space import Space
 from goworld_tpu.entity.timer import Crontab, PostQueue, TimerQueue
 from goworld_tpu.parallel.mesh import create_multi_state
-from goworld_tpu.utils import consts, ids, log
+from goworld_tpu.utils import consts, ids, log, opmon
 
 logger = log.get("world")
 
@@ -788,6 +788,7 @@ class World:
     # the tick
     # ==================================================================
     def tick(self) -> None:
+        t_start = time.perf_counter()
         self.timers.tick(self._fire_timer)
         self.crontab.tick()
         self.post_q.tick()
@@ -801,6 +802,7 @@ class World:
         self._drain_attr_journals()
         self.post_q.tick()
         self.tick_count += 1
+        opmon.monitor.record("world.tick", time.perf_counter() - t_start)
 
     # -- staging flush --------------------------------------------------
     def _flush_staging(self):

@@ -1,0 +1,242 @@
+"""Freeze / restore — whole-game snapshot for hot reload.
+
+Reference being rebuilt: ``engine/entity/EntityManager.go:520-617``
+(``Freeze`` packs every entity's migrate-style data requiring exactly one
+nil space; ``RestoreFreezedEntities`` rebuilds in 3 passes — nil space,
+then spaces, then entities) plus ``components/game/GameService.go:220-269``
+(``doFreeze`` drains pending work and writes ``game%d_freezed.dat``) and
+``components/game/restore.go:16-34`` (read + unpack on ``-restore`` boot).
+
+TPU adaptation: the reference walks heap objects; here the canonical hot
+state (positions, yaw, npc_moving) lives in device SoA arrays, so freezing
+does ONE ``jax.device_get`` of the relevant planes and joins them with the
+host-side attr trees / timers / client bindings. Restore rebuilds the host
+object graph and lets the normal staging path repopulate device rows on the
+first tick — the same "spaces before entities" ordering the reference uses,
+because entities need their target space's AOI shard to exist.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import msgpack
+import numpy as np
+
+from goworld_tpu.entity.entity import Entity, GameClient
+from goworld_tpu.entity.manager import World
+from goworld_tpu.entity.space import Space
+from goworld_tpu.utils import log
+
+logger = log.get("freeze")
+
+FREEZE_FORMAT_VERSION = 1
+
+
+def freeze_filename(game_id: int) -> str:
+    """Reference ``game%d_freezed.dat`` (``GameService.go:252``)."""
+    return f"game{game_id}_freezed.dat"
+
+
+# =======================================================================
+# pack
+# =======================================================================
+def _device_snapshot(world: World) -> dict[str, np.ndarray]:
+    """One batched transfer of every plane freeze needs (per-entity reads
+    would pay the host<->device latency once per entity)."""
+    st = world.state
+    return jax.device_get({
+        "pos": st.pos, "yaw": st.yaw, "npc_moving": st.npc_moving,
+    })
+
+
+def _pack_entity(world: World, e: Entity, snap: dict | None) -> dict:
+    """Migrate-style record (``GetMigrateData``, ``Entity.go:1060-1101``)
+    plus the space binding freeze needs and migrate doesn't."""
+    if snap is not None and e.slot is not None and e.space is not None \
+            and e.space.shard is not None and e._pending_pos is None:
+        shard, slot = e.space.shard, e.slot
+        pos = [float(v) for v in snap["pos"][shard, slot]]
+        yaw = float(snap["yaw"][shard, slot])
+        moving = bool(snap["npc_moving"][shard, slot])
+    else:
+        pos = [float(v) for v in e.position]
+        yaw = float(e._pending_yaw or 0.0)
+        moving = False
+    return {
+        "type": e.type_name,
+        "id": e.id,
+        "attrs": e.attrs.to_dict(),
+        "client": (
+            [e.client.gate_id, e.client.client_id]
+            if e.client is not None else None
+        ),
+        "pos": pos,
+        "yaw": yaw,
+        "moving": moving,
+        "space_id": e.space.id if e.space is not None else None,
+        "timers": world.timers.dump(list(e.timer_ids)),
+    }
+
+
+def freeze_world(world: World) -> dict:
+    """Pack the entire world. Requires exactly one nil space (the same
+    invariant the reference asserts, ``EntityManager.go:536-541``)."""
+    if world.nil_space is None:
+        raise RuntimeError("cannot freeze: no nil space")
+    snap = _device_snapshot(world)
+
+    for e in list(world.entities.values()):
+        if not e.destroyed:
+            try:
+                e.OnFreeze()
+            except Exception:
+                logger.exception("OnFreeze failed for %s", e)
+
+    spaces: list[dict] = []
+    entities: list[dict] = []
+    for e in world.entities.values():
+        if e.destroyed:
+            continue
+        if e is world.nil_space:
+            continue
+        if isinstance(e, Space):
+            spaces.append({
+                "type": e.type_name,
+                "id": e.id,
+                "attrs": e.attrs.to_dict(),
+                "use_aoi": e.shard is not None,
+                "timers": world.timers.dump(list(e.timer_ids)),
+            })
+        else:
+            entities.append(_pack_entity(world, e, snap))
+
+    nil = world.nil_space
+    return {
+        "version": FREEZE_FORMAT_VERSION,
+        "game_id": world.game_id,
+        "nil_space": {
+            "attrs": nil.attrs.to_dict(),
+            "timers": world.timers.dump(list(nil.timer_ids)),
+        },
+        "spaces": spaces,
+        "entities": entities,
+    }
+
+
+# =======================================================================
+# unpack
+# =======================================================================
+def _load_attrs_quiet(e: Entity, attrs: dict) -> None:
+    """Fill the attr tree without journaling deltas: the restore path must
+    not fan out attr-change messages (clients either reconnect fresh or
+    already hold the values — reference 're-assign clients quietly')."""
+    from goworld_tpu.entity.attrs import load_into
+
+    cb = e.attrs._root_cb
+    e.attrs._root_cb = None
+    try:
+        load_into(e.attrs, attrs)
+    finally:
+        e.attrs._root_cb = cb
+
+
+def restore_world(world: World, data: dict) -> None:
+    """3-pass rebuild into a freshly constructed World (reference
+    ``RestoreFreezedEntities``, ``EntityManager.go:556-617``)."""
+    if data.get("version") != FREEZE_FORMAT_VERSION:
+        raise ValueError(f"freeze format {data.get('version')!r} unsupported")
+    if world.entities and not (
+        len(world.entities) == 1 and world.nil_space is not None
+    ):
+        raise RuntimeError("restore requires an empty world")
+
+    # pass 1: nil space (the migration anchor; its id is deterministic
+    # from game_id so routing and CallNilSpaces keep working)
+    nil = world.nil_space or world.create_nil_space()
+    _load_attrs_quiet(nil, data["nil_space"].get("attrs", {}))
+    for tid in world.timers.restore(data["nil_space"].get("timers", [])):
+        nil.timer_ids.add(tid)
+
+    # pass 2: spaces (entities need their shard to exist before entering)
+    for sd in data["spaces"]:
+        desc = world.registry.get(sd["type"])
+        sp: Space = desc.cls()
+        sp._type_desc = desc
+        world._attach(sp, sd["id"])
+        if sd.get("use_aoi", True):
+            try:
+                shard = world._shard_space.index(None)
+            except ValueError:
+                raise RuntimeError(
+                    f"restore: no free shard for space {sd['id']} "
+                    f"({world.n_spaces} configured)"
+                ) from None
+            world._shard_space[shard] = sp.id
+            sp.shard = shard
+        world.entities[sp.id] = sp
+        world.spaces[sp.id] = sp
+        _load_attrs_quiet(sp, sd.get("attrs", {}))
+        for tid in world.timers.restore(sd.get("timers", [])):
+            sp.timer_ids.add(tid)
+        sp.OnRestored()
+
+    # pass 3: entities — client bound BEFORE entering the space so the
+    # spawn staging records has_client/client_gate in the same tick
+    for ed in data["entities"]:
+        desc = world.registry.get(ed["type"])
+        e: Entity = desc.cls()
+        e._type_desc = desc
+        world._attach(e, ed["id"])
+        world.entities[e.id] = e
+        _load_attrs_quiet(e, ed.get("attrs", {}))
+        if ed.get("client"):
+            e.client = GameClient(ed["client"][0], ed["client"][1], world)
+        target = world.spaces.get(ed.get("space_id") or "") or world.nil_space
+        world._enter_space_local(
+            e, target, tuple(ed["pos"]), moving=bool(ed.get("moving"))
+        )
+        e._pending_yaw = float(ed.get("yaw", 0.0))
+        world.stage_pos_set(e)
+        for tid in world.timers.restore(ed.get("timers", [])):
+            e.timer_ids.add(tid)
+        e.OnRestored()
+
+    logger.info(
+        "restored %d spaces + %d entities into game%d",
+        len(data["spaces"]), len(data["entities"]), world.game_id,
+    )
+
+
+# =======================================================================
+# file IO
+# =======================================================================
+def write_freeze_file(path: str, data: dict) -> None:
+    """Atomic write (tmp + rename): a crash mid-freeze must never leave a
+    truncated file that a ``-restore`` boot would half-load."""
+    blob = msgpack.packb(data, use_bin_type=True)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(blob)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    logger.info("froze %d bytes -> %s", len(blob), path)
+
+
+def read_freeze_file(path: str) -> dict:
+    with open(path, "rb") as f:
+        return msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
+
+
+def freeze_to_file(world: World, directory: str = ".") -> str:
+    path = os.path.join(directory, freeze_filename(world.game_id))
+    write_freeze_file(path, freeze_world(world))
+    return path
+
+
+def restore_from_file(world: World, directory: str = ".") -> None:
+    path = os.path.join(directory, freeze_filename(world.game_id))
+    restore_world(world, read_freeze_file(path))

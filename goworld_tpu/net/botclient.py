@@ -17,7 +17,13 @@ import asyncio
 import random
 
 from goworld_tpu.net import codec, proto
-from goworld_tpu.net.packet import Packet, PacketConnection, new_packet
+from goworld_tpu.net.packet import (
+    HEADER_SIZE,
+    Packet,
+    PacketConnection,
+    frame,
+    new_packet,
+)
 from goworld_tpu.utils import log
 
 logger = log.get("bot")
@@ -68,14 +74,54 @@ class MirrorEntity:
                     node2.pop()
 
 
+class WSPacketConnection:
+    """PacketConnection interface over a websocket: one binary WS message
+    per framed packet (matches the gate's ``_serve_ws``, which mirrors the
+    reference's websocket edge, ``GateService.go:121-168``)."""
+
+    def __init__(self, ws):
+        self.ws = ws
+        self._closed = False
+
+    def send(self, p: Packet, release: bool = True) -> None:
+        if not self._closed:
+            data = bytes(frame(p))
+            asyncio.ensure_future(self.ws.send(data))
+        if release:
+            p.release()
+
+    async def drain(self) -> None: ...
+
+    async def recv(self) -> tuple[int, Packet]:
+        msg = await self.ws.recv()
+        if not isinstance(msg, (bytes, bytearray)):
+            raise ConnectionError("non-binary ws message")
+        p = Packet(bytes(msg)[HEADER_SIZE:])
+        return p.read_u16(), p
+
+    async def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        try:
+            await self.ws.close()
+        except Exception:
+            pass
+
+
 class BotClient:
-    """One bot: connects, waits for its player entity, random-walks."""
+    """One bot: connects, waits for its player entity, random-walks.
+
+    ``ws=True`` connects through the gate's websocket listener instead of
+    TCP (the reference test_client's ``-ws`` flag)."""
 
     def __init__(self, host: str, port: int, *, bot_id: int = 0,
                  strict: bool = False, move_interval: float = 0.1,
-                 speed: float = 5.0, seed: int | None = None):
+                 speed: float = 5.0, seed: int | None = None,
+                 ws: bool = False):
         self.host = host
         self.port = port
+        self.ws = ws
         self.bot_id = bot_id
         self.strict = strict
         self.move_interval = move_interval
@@ -92,6 +138,14 @@ class BotClient:
 
     # ------------------------------------------------------------------
     async def connect(self) -> None:
+        if self.ws:
+            import websockets
+
+            sock = await websockets.connect(
+                f"ws://{self.host}:{self.port}"
+            )
+            self.conn = WSPacketConnection(sock)
+            return
         reader, writer = await asyncio.open_connection(self.host, self.port)
         self.conn = PacketConnection(reader, writer)
 

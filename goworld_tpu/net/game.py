@@ -30,7 +30,7 @@ from goworld_tpu.entity.manager import World
 from goworld_tpu.net import codec, proto
 from goworld_tpu.net.cluster import DispatcherCluster, DispatcherConn
 from goworld_tpu.net.packet import Packet, new_packet
-from goworld_tpu.utils import consts, log
+from goworld_tpu.utils import consts, log, opmon
 
 logger = log.get("game")
 
@@ -47,12 +47,24 @@ class GameServer:
         boot_entity: str = "Account",
         ban_boot: bool = False,
         tick_interval: float = 1.0 / consts.TICK_HZ,
+        freeze_dir: str = ".",
+        restore: bool = False,
     ):
         self.game_id = game_id
         self.world = world
         self.boot_entity = boot_entity
         self.ban_boot = ban_boot
         self.tick_interval = tick_interval
+        # freeze/restore (reference GameService.go:220-313 rs* states)
+        self.freeze_dir = freeze_dir
+        self.run_state = "running"  # running | freezing | frozen | stopped
+        self._freeze_acks: set[int] = set()
+        self._is_restore = False
+        if restore:
+            from goworld_tpu import freeze as _freeze
+
+            _freeze.restore_from_file(world, freeze_dir)
+            self._is_restore = True
 
         self._packet_q: "queue.Queue[tuple[int, int, Packet]]" = \
             queue.Queue(maxsize=consts.MAX_PENDING_PACKETS_PER_GAME)
@@ -64,6 +76,8 @@ class GameServer:
         self._stop = threading.Event()
         self.deployment_ready = False
         self.ready_event = threading.Event()
+        # dispatcher ids that acked our SET_GAME_ID (handshake barrier)
+        self.handshake_acks: set[int] = set()
         self.kvreg: dict[str, str] = {}
         self.kvreg_watchers: list[Callable[[str, str], None]] = []
         # in-flight outbound migrations: eid -> (entity, space_id, pos)
@@ -116,12 +130,52 @@ class GameServer:
         while not self._stop.is_set():
             self.pump()
             self.tick()
+            if self.run_state == "freezing":
+                self._do_freeze()
+                return
             next_tick += self.tick_interval
             delay = next_tick - time.monotonic()
             if delay > 0:
                 time.sleep(delay)
             else:
                 next_tick = time.monotonic()  # fell behind; don't spiral
+
+    # ==================================================================
+    # freeze (hot reload; reference GameService.go:220-313, SURVEY.md#3.6)
+    # ==================================================================
+    def request_freeze(self) -> None:
+        """Ask every dispatcher to block this game's traffic; freezing
+        starts once all of them ack (reference ``startFreeze``,
+        ``GameService.go:474-478``)."""
+        if self.run_state != "running":
+            return
+        self._freeze_acks.clear()
+        p = new_packet(proto.MT_START_FREEZE_GAME)
+        for conn in self.cluster.conns:
+            self._send(conn, Packet(bytes(p.buf)))
+        p.release()
+
+    def _do_freeze(self) -> None:
+        """All dispatchers acked: drain deferred work, snapshot, exit.
+        The CLI restarts the process with ``-restore``."""
+        import os
+
+        from goworld_tpu import freeze as _freeze
+
+        w = self.world
+        w.post_q.tick()
+        # snapshot FIRST: OnFreeze hooks may enqueue storage saves, which
+        # the drain below must still execute (reference doFreeze ordering)
+        data = _freeze.freeze_world(w)
+        if w.storage is not None:
+            w.storage.shutdown()
+        path = os.path.join(
+            self.freeze_dir, _freeze.freeze_filename(w.game_id)
+        )
+        _freeze.write_freeze_file(path, data)
+        self.run_state = "frozen"
+        logger.info("game%d: frozen to %s", self.game_id, path)
+        self.stop()
 
     def pump(self) -> int:
         """Drain and handle every queued dispatcher packet (logic thread)."""
@@ -151,7 +205,8 @@ class GameServer:
         census = list(self.world.entities.keys())
         p = proto.pack_set_game_id(
             self.game_id, is_reconnect=self.deployment_ready,
-            is_restore=False, ban_boot=self.ban_boot, entity_ids=census,
+            is_restore=self._is_restore, ban_boot=self.ban_boot,
+            entity_ids=census,
         )
         conn.conn.send(p)
         await conn.conn.drain()
@@ -193,6 +248,10 @@ class GameServer:
             p = proto.pack_call_entity_method_on_client(
                 gate_id, client_id, msg["eid"], msg["method"],
                 tuple(msg["args"]),
+            )
+        elif t == "filter_prop":
+            p = proto.pack_set_client_filter_prop(
+                gate_id, client_id, msg["key"], msg["val"]
             )
         elif t == "sync":
             self._sync_out.setdefault(gate_id, []).append(
@@ -306,7 +365,8 @@ class GameServer:
     def _handle_packet(self, didx: int, msgtype: int, pkt: Packet) -> None:
         w = self.world
         if msgtype == proto.MT_SET_GAME_ID_ACK:
-            pkt.read_u16()  # dispatcher id
+            disp_id = pkt.read_u16()
+            self.handshake_acks.add(disp_id)
             kv = pkt.read_data()
             rejects = pkt.read_data()
             self.kvreg.update(kv)
@@ -322,6 +382,8 @@ class GameServer:
         if msgtype == proto.MT_NOTIFY_DEPLOYMENT_READY:
             if not self.deployment_ready:
                 self.deployment_ready = True
+                # reference exposes this via gwvar/expvar (gwvar.go:1-29)
+                opmon.expose("IsDeploymentReady", True)
                 self.ready_event.set()
                 for sp in list(w.spaces.values()):
                     sp.OnGameReady()
@@ -421,6 +483,14 @@ class GameServer:
             args = pkt.read_args()
             if w.nil_space is not None:
                 w._invoke(w.nil_space, method, tuple(args), None)
+            return
+        if msgtype == proto.MT_START_FREEZE_GAME_ACK:
+            disp_id = pkt.read_u16()
+            self._freeze_acks.add(disp_id)
+            if len(self._freeze_acks) >= len(self.cluster.conns) \
+                    and self.run_state == "running":
+                # every dispatcher is now blocking us: safe to snapshot
+                self.run_state = "freezing"
             return
         if msgtype == proto.MT_NOTIFY_GAME_CONNECTED:
             return

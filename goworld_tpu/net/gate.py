@@ -26,7 +26,7 @@ from goworld_tpu.net.packet import (
     frame,
     new_packet,
 )
-from goworld_tpu.utils import ids, log
+from goworld_tpu.utils import ids, log, opmon
 
 logger = log.get("gate")
 
@@ -118,6 +118,7 @@ class GateService:
         self._server: asyncio.AbstractServer | None = None
         self._ws_server = None
         self.started = asyncio.Event()
+        self.ws_started = asyncio.Event()
 
     # ------------------------------------------------------------------
     async def _handshake(self, conn: DispatcherConn) -> None:
@@ -135,6 +136,7 @@ class GateService:
             tasks.append(asyncio.ensure_future(self._heartbeat_loop()))
         if self.ws_port:
             tasks.append(asyncio.ensure_future(self._serve_ws()))
+            await self.ws_started.wait()  # bind before declaring ready
         self.started.set()
         logger.info("gate%d listening on %s:%d", self.gate_id, self.host,
                     self.port)
@@ -168,7 +170,10 @@ class GateService:
         try:
             while True:
                 msgtype, pkt = await conn.recv()
-                self._handle_client_packet(cp, msgtype, pkt)
+                # reference wraps gate packet handling in opmon
+                # (GateService.go:435-442)
+                with opmon.monitor.op("gate.handleClientPacket"):
+                    self._handle_client_packet(cp, msgtype, pkt)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -360,7 +365,18 @@ class GateService:
             finally:
                 self._drop_client(cp)
 
-        self._ws_server = await websockets.serve(
-            handle, self.host, self.ws_port
-        )
+        try:
+            self._ws_server = await websockets.serve(
+                handle, self.host, self.ws_port
+            )
+        except Exception:
+            logger.exception(
+                "gate%d: websocket listener on port %d failed; "
+                "continuing without ws", self.gate_id, self.ws_port,
+            )
+            return
+        finally:
+            # serve() awaits this before declaring ready; never leave it
+            # hanging on a bind failure
+            self.ws_started.set()
         await asyncio.Future()  # run until cancelled

@@ -29,17 +29,20 @@ class ClusterHarness:
     def __init__(self, n_dispatchers: int = 1, n_gates: int = 1,
                  desired_games: int = 1, host: str = "127.0.0.1",
                  heartbeat_timeout: float = 0.0,
-                 position_sync_interval_ms: int = 20):
+                 position_sync_interval_ms: int = 20,
+                 with_ws: bool = False):
         self.host = host
         self.n_dispatchers = n_dispatchers
         self.n_gates = n_gates
         self.desired_games = desired_games
         self.heartbeat_timeout = heartbeat_timeout
         self.position_sync_interval_ms = position_sync_interval_ms
+        self.with_ws = with_ws
         self.dispatchers: list[DispatcherService] = []
         self.gates: list[GateService] = []
         self.dispatcher_addrs: list[tuple[str, int]] = []
         self.gate_addrs: list[tuple[str, int]] = []
+        self.gate_ws_addrs: list[tuple[str, int]] = []
         self.loop: asyncio.AbstractEventLoop | None = None
         self._thread: threading.Thread | None = None
         self._tasks: list = []
@@ -74,8 +77,16 @@ class ClusterHarness:
             await d.started.wait()
             self.dispatcher_addrs.append((self.host, d.bound_port))
         for i in range(self.n_gates):
+            ws_port = 0
+            if self.with_ws:
+                import socket
+
+                with socket.socket() as s:
+                    s.bind((self.host, 0))
+                    ws_port = s.getsockname()[1]
             g = GateService(
                 i + 1, self.host, 0, list(self.dispatcher_addrs),
+                ws_port=ws_port,
                 heartbeat_timeout=self.heartbeat_timeout,
                 position_sync_interval_ms=self.position_sync_interval_ms,
             )
@@ -83,6 +94,8 @@ class ClusterHarness:
             self._tasks.append(asyncio.ensure_future(g.serve()))
             await g.started.wait()
             self.gate_addrs.append((self.host, g.bound_port))
+            if ws_port:
+                self.gate_ws_addrs.append((self.host, ws_port))
 
     def submit(self, coro: Coroutine) -> Future:
         """Run a coroutine (e.g. a bot) on the harness loop."""

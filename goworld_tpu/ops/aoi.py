@@ -11,10 +11,15 @@ TPU-first redesign: one fixed-shape, jit-compiled **uniform-grid sweep** over
 the whole Space per tick, instead of per-move incremental updates:
 
 1. bin entities into ``radius``-sized cells over a bounded world,
-2. sort slot indices by cell id (one XLA sort),
-3. for every entity, gather up to ``cell_cap`` candidates from its 3x3 cell
-   neighborhood via ``searchsorted`` ranges into the sorted order,
-4. distance-filter and keep the nearest ``k`` as a sorted neighbor list
+2. sort slot indices by cell id (one XLA sort) and compute each entity's
+   rank within its cell with a segment scan,
+3. scatter slot ids and positions into dense per-cell tables
+   ``[cells+1, cell_cap]`` — one row per cell,
+4. for every entity, read its 3x3 neighborhood as NINE CONTIGUOUS ROWS of
+   those tables (TPU gathers are scalar-core-bound: fetching
+   ``cell_cap``-wide rows instead of per-candidate scalars is the
+   difference between ~memory-bandwidth and ~seconds per tick at 1M),
+5. distance-filter and keep the nearest ``k`` as a sorted neighbor list
    ``int32[N, k]`` padded with sentinel ``N``.
 
 Sorted fixed-width neighbor lists make the downstream enter/leave delta a
@@ -116,10 +121,38 @@ def grid_neighbors(
     k = spec.k
     cc = spec.cell_cap
     sentinel = n
+    n_cells = spec.cells_x * spec.cells_z
 
     cid = cell_ids(spec, pos, alive)
     order = jnp.argsort(cid).astype(jnp.int32)
     scid = cid[order]
+
+    # rank of each sorted entity within its cell via a segment scan (no
+    # per-entity binary searches — those are scalar gathers on TPU)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    new_seg = jnp.concatenate(
+        [jnp.ones((1,), bool), scid[1:] != scid[:-1]]
+    )
+    seg_start = lax.cummax(jnp.where(new_seg, idx, 0))
+    rank = idx - seg_start
+
+    # ONE dense per-cell table, px/pz/slot-bits packed side by side so the
+    # 3x3 query below is a single row-gather of 3*cc lanes (gathers are the
+    # scarce resource on TPU — one descriptor per cell visit, not three).
+    # Dead entities and rank overflow scatter OUT OF BOUNDS (dropped) so
+    # row n_cells — read by out-of-world queries — stays all-sentinel.
+    n_rows = n_cells + 1
+    valid_src = (rank < cc) & (scid < n_cells)
+    base = jnp.where(valid_src, scid * (3 * cc) + rank, n_rows * 3 * cc)
+    spos = pos[order]  # single row-gather by sorted order
+    sentinel_bits = jnp.full((), sentinel, jnp.int32).view(jnp.float32)
+    lane = jnp.arange(3 * cc, dtype=jnp.int32)
+    init_row = jnp.where(lane >= 2 * cc, sentinel_bits, jnp.inf)
+    table = jnp.tile(init_row, n_rows) \
+        .at[base].set(spos[:, 0], mode="drop") \
+        .at[base + cc].set(spos[:, 2], mode="drop") \
+        .at[base + 2 * cc].set(order.view(jnp.float32), mode="drop")
+    table = table.reshape(n_rows, 3 * cc)
 
     # 3x3 neighborhood cell offsets.
     dxs = jnp.array([-1, -1, -1, 0, 0, 0, 1, 1, 1], jnp.int32)
@@ -144,20 +177,40 @@ def grid_neighbors(
             & (qcz < spec.cells_z)
             & alive[rows][:, None]
         )
-        qcid = qcx * spec.cells_z + qcz
+        qcid = jnp.where(in_world, qcx * spec.cells_z + qcz, n_cells)
 
-        start = jnp.searchsorted(scid, qcid.ravel(), side="left").reshape(b, 9)
-        slot_in_cell = start[:, :, None] + jnp.arange(cc, dtype=jnp.int32)
-        in_bounds = slot_in_cell < n
-        slot_clamped = jnp.minimum(slot_in_cell, n - 1)
-        cand_cid = scid[slot_clamped]                        # [B, 9, cc]
-        cand = order[slot_clamped]                           # [B, 9, cc]
-        valid = in_bounds & (cand_cid == qcid[:, :, None]) & in_world[:, :, None]
+        packed = table[qcid]                                 # [B, 9, 3cc] rows
+        cand_px = packed[:, :, :cc]
+        cand_pz = packed[:, :, cc:2 * cc]
+        cand = lax.bitcast_convert_type(packed[:, :, 2 * cc:], jnp.int32)
+        valid = cand != sentinel
 
-        ddx = jnp.abs(px[cand] - px[rows][:, None, None])
-        ddz = jnp.abs(pz[cand] - pz[rows][:, None, None])
+        ddx = jnp.abs(cand_px - px[rows][:, None, None])
+        ddz = jnp.abs(cand_pz - pz[rows][:, None, None])
         dist = jnp.maximum(ddx, ddz)                         # Chebyshev XZ
         valid &= (dist <= spec.radius) & (cand != rows[:, None, None])
+
+        if n < (1 << 21):
+            # pack (quantized distance, candidate id) into one int32 so a
+            # single top_k yields the ids — the take_along_axis re-gather
+            # it replaces was the single most expensive op of the sweep
+            # (minor-axis dynamic indexing serializes on TPU). Quantizing
+            # distance to 10 bits only affects WHICH neighbors win when
+            # the true count exceeds k (already best-effort).
+            qd = jnp.minimum(
+                (dist * (1024.0 / spec.radius)).astype(jnp.int32), 1023
+            )
+            # larger than any valid key: max = (1023 << 21) | (n - 1) and
+            # n < 2^21 keeps that strictly below INT32_MAX
+            invalid_key = jnp.int32(2**31 - 1)
+            packed_key = jnp.where(
+                valid, (qd << 21) | cand, invalid_key
+            ).reshape(b, 9 * cc)
+            top = -lax.top_k(-packed_key, k)[0]              # k smallest
+            ok = top < invalid_key
+            nbr_b = jnp.where(ok, top & ((1 << 21) - 1), sentinel)
+            nbr_b = jnp.sort(nbr_b, axis=1)                  # ascending ids
+            return nbr_b, ok.sum(axis=1).astype(jnp.int32)
 
         key = jnp.where(valid, dist, jnp.inf).reshape(b, 9 * cc)
         flat_cand = cand.reshape(b, 9 * cc)

@@ -25,12 +25,13 @@ from goworld_tpu.ops.extract import bounded_extract
 def _not_in(a: jax.Array, b: jax.Array, sentinel) -> jax.Array:
     """Per-row mask over b: True where b's entry is valid and absent from a.
 
-    Both a and b are int32[N, k], ascending, padded with sentinel.
+    Both a and b are int32[N, k], padded with sentinel. Membership is an
+    all-pairs compare with a reduction over a's lane — k² elementwise ops
+    that XLA fuses without materializing [N, k, k]. The "obvious" per-row
+    binary search (vmapped searchsorted + take_along_axis) is ~100x slower
+    on TPU: its k·log k dynamic row indexes serialize on the scalar core.
     """
-    k = a.shape[1]
-    pos = jax.vmap(jnp.searchsorted)(a, b)
-    pos_c = jnp.minimum(pos, k - 1)
-    found = jnp.take_along_axis(a, pos_c, axis=1) == b
+    found = (b[:, :, None] == a[:, None, :]).any(axis=2)
     return (b != sentinel) & ~found
 
 

@@ -22,7 +22,7 @@ from typing import Any, Callable
 
 import msgpack
 
-from goworld_tpu.utils import log
+from goworld_tpu.utils import log, opmon
 
 logger = log.get("storage")
 
@@ -148,7 +148,11 @@ class Storage:
             return len(self._q)
 
     def shutdown(self, timeout: float = 30.0) -> None:
-        """Drain then stop (reference ``Shutdown`` waits for queue empty)."""
+        """Drain then stop (reference ``Shutdown`` waits for queue empty).
+        Idempotent: freeze and process teardown may both call it."""
+        with self._cv:
+            if self._closed:
+                return
         deadline = time.monotonic() + timeout
         with self._cv:
             while self._q and time.monotonic() < deadline:
@@ -182,6 +186,7 @@ class Storage:
 
     def _execute(self, op: tuple) -> None:
         kind, type_name, eid, data, cb = op
+        t0 = time.perf_counter()
         while True:
             try:
                 if kind == "save":
@@ -208,6 +213,7 @@ class Storage:
                 res = None
                 break
         self.op_count += 1
+        opmon.monitor.record(f"storage.{kind}", time.perf_counter() - t0)
         if cb is not None:
             if kind == "save":
                 self._post(cb)

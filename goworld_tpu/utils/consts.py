@@ -43,3 +43,4 @@ OPTIMIZE_LOCAL_ENTITY_CALL = True  # set False in tests to force the full
 
 # --- networking ----------------------------------------------------------
 SUPERVISOR_STARTED_TAG = "GOWORLD_TPU_PROCESS_STARTED"  # consts.go:108-112
+FREEZE_EXIT_CODE = 23  # game exited via freeze; CLI restarts with -restore

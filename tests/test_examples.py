@@ -1,0 +1,158 @@
+"""Host the example games in-process and drive their logic.
+
+The reference exercises examples only via full-cluster CI; here each
+example's entity classes run against a local World (the single-process
+path), which keeps the examples honest as API surface tests."""
+
+import importlib
+import os
+import sys
+
+import pytest
+
+from goworld_tpu import api
+from goworld_tpu.core import WorldConfig
+from goworld_tpu.entity import GameClient, World
+from goworld_tpu.entity.service import ServiceManager
+from goworld_tpu.ops.aoi import GridSpec
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_example(name: str):
+    """Import an example server module fresh, capturing its registrations."""
+    api._reset_for_tests()
+    path = os.path.join(REPO, "examples", name, "server.py")
+    spec = importlib.util.spec_from_file_location(f"example_{name}", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _make_world(n_spaces=2, radius=20.0):
+    return World(
+        WorldConfig(
+            capacity=256,
+            grid=GridSpec(radius=radius, extent_x=100.0, extent_z=100.0),
+            input_cap=128,
+        ),
+        n_spaces=n_spaces,
+    )
+
+
+@pytest.fixture()
+def ex_world():
+    """World + local ServiceManager wired for whichever example loads."""
+
+    def build(name, **kw):
+        mod = _load_example(name)
+        w = _make_world(**kw)
+        svc = ServiceManager(w)
+        api._apply_registrations(w, svc=svc)
+        w.create_nil_space()
+        svc.start()
+        w.tick()
+        return mod, w, svc
+
+    yield build
+    api._reset_for_tests()
+
+
+def test_test_game_flow(ex_world):
+    _, w, svc = ex_world("test_game")
+    # services exist (3+3+1+3 shards, all local)
+    names = {e.type_name for e in w.entities.values()}
+    assert {"OnlineService", "SpaceService", "MailService",
+            "Pubsub"} <= names
+
+    # login: Account -> Avatar -> SpaceService assigns a MySpace
+    acct = w.create_entity("Account",
+                           client=GameClient(1, "c" * 16, w))
+    acct.Login_Client("alice")
+    for _ in range(4):
+        w.tick()
+    avatars = [e for e in w.entities.values()
+               if e.type_name == "Avatar" and not e.destroyed]
+    assert len(avatars) == 1
+    av = avatars[0]
+    assert av.client is not None
+    assert av.attrs.get("name") == "alice"
+    spaces = [s for s in w.spaces.values()
+              if s.type_name == "MySpace"]
+    assert len(spaces) == 1, "SpaceService did not create MySpace"
+    assert av.space is spaces[0]
+    # the space auto-summoned monsters
+    monsters = [e for e in w.entities.values()
+                if e.type_name == "Monster" and not e.destroyed]
+    assert len(monsters) == 4
+
+    # mail + pubsub routing
+    av.SendMail_Client("bob", "hi bob")
+    av.Subscribe_Client("news.*")
+    av.Publish_Client("news.tpu", "v5e")
+    for _ in range(3):
+        w.tick()
+    mail = [e for e in w.entities.values()
+            if e.type_name == "MailService"][0]
+    assert mail.mails.get("bob") == [["alice", "hi bob"]]
+    # pubsub delivered the publish as a client RPC (OnPublish on avatar)
+    rpcs = [m for _, _, m in w.client_messages if m.get("type") == "rpc"]
+    assert any(m["method"] == "OnPublish" for m in rpcs), rpcs
+
+    # second login with same name reuses the avatar id mapping (no kvdb
+    # here -> new avatar, but flow must not crash)
+    acct2 = w.create_entity("Account",
+                            client=GameClient(1, "d" * 16, w))
+    acct2.Login_Client("carol")
+    for _ in range(3):
+        w.tick()
+
+
+def test_unity_demo_combat(ex_world):
+    mod, w, _svc = ex_world("unity_demo", n_spaces=1, radius=40.0)
+    sp = w.create_space("MySpace")
+    w._demo_space = sp
+    w.tick()
+    monsters = [e for e in w.entities.values()
+                if e.type_name == "Monster" and not e.destroyed]
+    assert len(monsters) == 3
+
+    player = w.create_entity("Player",
+                             client=GameClient(1, "p" * 16, w))
+    player.attrs["name"] = "hero"
+    player.OnClientConnected()
+    for _ in range(3):
+        w.tick()
+    assert player.space is sp
+    # player sees monsters via AOI
+    assert any(w.entities[e].type_name == "Monster"
+               for e in player.interested_in)
+
+    target = next(e for e in player.interested_in
+                  if w.entities[e].type_name == "Monster")
+    for _ in range(20):
+        player.Shoot_Client(target)
+        w.tick()
+    m = w.entities.get(target)
+    assert m is None or m.attrs.get("hp", 100) == 0 or m.destroyed
+
+
+def test_chatroom_filter_props(ex_world):
+    _, w, _svc = ex_world("chatroom_demo", n_spaces=1)
+    acct = w.create_entity("Account",
+                           client=GameClient(1, "e" * 16, w))
+    acct.Login_Client("dora")
+    for _ in range(2):
+        w.tick()
+    av = [e for e in w.entities.values()
+          if e.type_name == "ChatAvatar" and not e.destroyed][0]
+    # joining room 1 sent a filter_prop message for the gate index
+    props = [m for _, _, m in w.client_messages
+             if m.get("type") == "filter_prop"]
+    assert props and props[-1]["key"] == "chatroom" \
+        and props[-1]["val"] == "1"
+    av.EnterRoom_Client(7)
+    props = [m for _, _, m in w.client_messages
+             if m.get("type") == "filter_prop"]
+    assert props[-1]["val"] == "7"

@@ -1,0 +1,199 @@
+"""Freeze/restore (hot reload) tests.
+
+Mirrors the reference's live-reload soak (``test_game.yml``: run bots,
+``goworld reload``, run bots again) at unit scale, plus round-trip unit
+tests in the spirit of ``engine/entity/migarte_test.go``."""
+
+import threading
+import time
+
+import pytest
+
+from goworld_tpu import freeze
+from goworld_tpu.core import WorldConfig
+from goworld_tpu.entity import Entity, GameClient, Space, World
+from goworld_tpu.net.game import GameServer
+from goworld_tpu.net.standalone import ClusterHarness
+from goworld_tpu.ops.aoi import GridSpec
+
+
+class Npc(Entity):
+    ATTRS = {"hp": "allclients", "name": "client"}
+
+    def __init__(self):
+        super().__init__()
+        self.heal_count = 0
+
+    def Heal(self, amount):
+        self.heal_count += 1
+        self.attrs["hp"] = self.attrs.get("hp", 0) + amount
+
+
+class Arena(Space):
+    pass
+
+
+def _cfg():
+    return WorldConfig(
+        capacity=64,
+        grid=GridSpec(radius=30.0, extent_x=120.0, extent_z=120.0),
+        input_cap=64,
+    )
+
+
+def _register(world):
+    world.register_entity("Npc", Npc)
+    world.register_space("Arena", Arena)
+
+
+def _make_world():
+    w = World(_cfg(), n_spaces=1)
+    _register(w)
+    w.create_nil_space()
+    return w
+
+
+class TestFreezeRoundtrip:
+    def test_requires_nil_space(self):
+        w = World(_cfg(), n_spaces=1)
+        with pytest.raises(RuntimeError):
+            freeze.freeze_world(w)
+
+    def test_world_roundtrip(self):
+        w = _make_world()
+        arena = w.create_space("Arena", motd="welcome")
+        a = w.create_entity("Npc", space=arena, pos=(10.0, 0.0, 10.0))
+        a.attrs["hp"] = 70
+        a.attrs["name"] = "alice"
+        b = w.create_entity("Npc", space=arena, pos=(12.0, 0.0, 12.0))
+        b.attrs["hp"] = 55
+        b.set_yaw(1.5)
+        # timer by method name: migration/freeze-safe like the reference
+        b.add_timer(0.05, "Heal", 5)
+        # client binding must survive quietly
+        a.client = GameClient(2, "c" * 16, w)
+        parked = w.create_entity("Npc", pos=(0.0, 0.0, 0.0))  # nil space
+        for _ in range(3):
+            w.tick()
+
+        data = freeze.freeze_world(w)
+
+        w2 = _make_world()
+        freeze.restore_world(w2, data)
+        assert set(w2.entities) == set(w.entities)
+        arena2 = w2.spaces[arena.id]
+        assert arena2.attrs.get("motd") == "welcome"
+        a2, b2 = w2.entities[a.id], w2.entities[b.id]
+        assert a2.attrs.get("hp") == 70
+        assert a2.attrs.get("name") == "alice"
+        assert a2.client is not None and a2.client.gate_id == 2
+        assert a2.space is arena2
+        assert w2.entities[parked.id].space is w2.nil_space
+        # positions/yaw carried over (device state was snapshotted)
+        for _ in range(3):
+            w2.tick()
+        assert tuple(w2.read_pos(0, a2.slot)) == pytest.approx(
+            (10.0, 0.0, 10.0))
+        assert w2.read_yaw(0, b2.slot) == pytest.approx(1.5)
+        # AOI re-fires: a and b are within radius -> interest rebuilt
+        assert b2.id in a2.interested_in
+        # restored method-name timer still fires
+        deadline = time.monotonic() + 2.0
+        while b2.heal_count == 0 and time.monotonic() < deadline:
+            w2.tick()
+            time.sleep(0.01)
+        assert b2.heal_count >= 1
+        assert b2.attrs.get("hp") >= 60
+
+    def test_file_roundtrip(self, tmp_path):
+        w = _make_world()
+        arena = w.create_space("Arena")
+        e = w.create_entity("Npc", space=arena, pos=(5.0, 0.0, 5.0))
+        e.attrs["hp"] = 1
+        path = freeze.freeze_to_file(w, str(tmp_path))
+        assert path.endswith("game1_freezed.dat")
+        w2 = _make_world()
+        freeze.restore_from_file(w2, str(tmp_path))
+        assert e.id in w2.entities
+
+    def test_restore_rejects_populated_world(self):
+        w = _make_world()
+        data = freeze.freeze_world(w)
+        w2 = _make_world()
+        w2.create_space("Arena")
+        with pytest.raises(RuntimeError):
+            freeze.restore_world(w2, data)
+
+
+def _drive(gs, stop):
+    while not stop.is_set() and gs.run_state == "running":
+        gs.pump()
+        gs.tick()
+        time.sleep(0.01)
+    # freeze path: serve_forever would do this; emulate its tail
+    if gs.run_state == "freezing":
+        gs._do_freeze()
+
+
+def test_cluster_freeze_then_restore(tmp_path):
+    """Full protocol: game asks dispatchers to block, snapshots, exits;
+    a new game process restores and traffic resumes (SURVEY.md#3.6)."""
+    harness = ClusterHarness(n_dispatchers=2, n_gates=0, desired_games=1)
+    harness.start()
+    try:
+        w = _make_world()
+        arena = w.create_space("Arena")
+        npc = w.create_entity("Npc", space=arena, pos=(1.0, 0.0, 1.0))
+        npc.attrs["hp"] = 9
+
+        gs = GameServer(1, w, list(harness.dispatcher_addrs),
+                        freeze_dir=str(tmp_path))
+        gs.start_network()
+        stop = threading.Event()
+        t = threading.Thread(target=_drive, args=(gs, stop), daemon=True)
+        t.start()
+        assert gs.ready_event.wait(20)
+
+        gs.request_freeze()
+        deadline = time.monotonic() + 15
+        while gs.run_state != "frozen" and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert gs.run_state == "frozen"
+        stop.set()
+        t.join(timeout=5)
+
+        # dispatcher kept the game blocked: queue an RPC while "down"
+        from goworld_tpu.net import proto as P
+        d = harness.dispatchers[0]
+        pkt = P.pack_call_entity_method(npc.id, "Heal", (3,))
+        harness.submit(_inject(d, npc.id, pkt)).result(timeout=5)
+
+        # new process, same game id, -restore
+        w2 = _make_world()
+        gs2 = GameServer(1, w2, list(harness.dispatcher_addrs),
+                         freeze_dir=str(tmp_path), restore=True)
+        assert npc.id in w2.entities
+        gs2.start_network()
+        stop2 = threading.Event()
+        t2 = threading.Thread(target=_drive, args=(gs2, stop2), daemon=True)
+        t2.start()
+        try:
+            npc2 = w2.entities[npc.id]
+            deadline = time.monotonic() + 15
+            while npc2.heal_count == 0 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert npc2.heal_count == 1, \
+                "queued RPC was not delivered after restore"
+            assert npc2.attrs.get("hp") == 12
+        finally:
+            stop2.set()
+            t2.join(timeout=5)
+            gs2.stop()
+    finally:
+        harness.stop()
+
+
+async def _inject(dispatcher, eid, pkt):
+    """Route a packet through the dispatcher's entity table as if it came
+    from another game."""
+    dispatcher._dispatch_to_entity(eid, pkt)

@@ -1,0 +1,46 @@
+"""opmon / gwvar tests (reference ``engine/opmon/opmon_test.go`` spirit)."""
+
+import time
+
+from goworld_tpu.utils import opmon
+
+
+def test_record_and_snapshot():
+    m = opmon.Monitor()
+    m.record("op_a", 0.010)
+    m.record("op_a", 0.030)
+    m.record("op_b", 0.001)
+    snap = m.snapshot()
+    assert snap["op_a"]["count"] == 2
+    assert snap["op_a"]["avg_ms"] == 20.0
+    assert snap["op_a"]["max_ms"] == 30.0
+    assert snap["op_b"]["count"] == 1
+    m.reset()
+    assert m.snapshot() == {}
+
+
+def test_context_manager_times():
+    m = opmon.Monitor()
+    with m.op("sleepy"):
+        time.sleep(0.01)
+    snap = m.snapshot()
+    assert snap["sleepy"]["count"] == 1
+    assert snap["sleepy"]["max_ms"] >= 8.0
+
+
+def test_world_tick_records():
+    opmon.monitor.reset()
+    from goworld_tpu.core import WorldConfig
+    from goworld_tpu.entity import World
+    from goworld_tpu.ops.aoi import GridSpec
+
+    w = World(WorldConfig(capacity=32, grid=GridSpec(
+        radius=10.0, extent_x=40.0, extent_z=40.0)), n_spaces=1)
+    w.create_nil_space()
+    w.tick()
+    assert opmon.monitor.snapshot()["world.tick"]["count"] == 1
+
+
+def test_gwvar_expose():
+    opmon.expose("flag", 7)
+    assert opmon.vars()["flag"] == 7

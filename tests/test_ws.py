@@ -1,0 +1,93 @@
+"""WebSocket client edge (reference gate's websocket listener,
+``GateService.go:121-168``, and test_client's ``-ws`` flag)."""
+
+import threading
+import time
+
+import pytest
+
+from goworld_tpu.core.state import WorldConfig
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.manager import World
+from goworld_tpu.net.botclient import BotClient
+from goworld_tpu.net.game import GameServer
+from goworld_tpu.net.standalone import ClusterHarness
+from goworld_tpu.ops.aoi import GridSpec
+
+
+class Account(Entity):
+    ATTRS = {"status": "client"}
+
+    def OnClientConnected(self):
+        self.attrs["status"] = "online"
+
+    def Echo_Client(self, text):
+        self.call_client("OnEcho", text)
+
+
+@pytest.fixture()
+def ws_cluster():
+    harness = ClusterHarness(n_dispatchers=1, n_gates=1, desired_games=1,
+                             with_ws=True)
+    harness.start()
+    world = World(
+        WorldConfig(capacity=64,
+                    grid=GridSpec(radius=20.0, extent_x=80.0,
+                                  extent_z=80.0)),
+        n_spaces=1,
+    )
+    world.register_entity("Account", Account)
+    world.create_nil_space()
+    gs = GameServer(1, world, list(harness.dispatcher_addrs))
+    gs.start_network()
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            gs.pump()
+            gs.tick()
+            time.sleep(0.01)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    assert gs.ready_event.wait(20)
+    yield harness
+    stop.set()
+    t.join(timeout=5)
+    gs.stop()
+    harness.stop()
+
+
+async def _ws_login(bot: BotClient):
+    import asyncio
+
+    await bot.connect()
+    recv = asyncio.ensure_future(bot._recv_loop())
+    try:
+        await asyncio.wait_for(bot.player_ready.wait(), 15)
+        assert bot.player.type_name == "Account"
+        for _ in range(100):
+            if bot.player.attrs.get("status") == "online":
+                break
+            await asyncio.sleep(0.05)
+        assert bot.player.attrs.get("status") == "online"
+        bot.call_server("Echo_Client", "ping")
+        for _ in range(100):
+            if any(m == "OnEcho" for _, m, _ in bot.rpc_log):
+                break
+            await asyncio.sleep(0.05)
+        assert any(
+            m == "OnEcho" and a == ["ping"] for _, m, a in bot.rpc_log
+        ), bot.rpc_log
+    finally:
+        recv.cancel()
+        await bot.conn.close()
+
+
+def test_ws_login_and_rpc(ws_cluster):
+    harness = ws_cluster
+    host, port = harness.gate_ws_addrs[0]
+    bot = BotClient(host, port, ws=True)
+    fut = harness.submit(_ws_login(bot))
+    fut.result(timeout=40)
+    assert not bot.errors, bot.errors
