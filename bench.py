@@ -6,6 +6,27 @@ behavior, movement integration, grid AOI sweep, interest deltas, sync-record
 soak tops out at 200 bots over 9 processes; it publishes no benchmark
 numbers, see BASELINE.md).
 
+Hardened orchestration (round-1 postmortem: BENCH_r01 died with rc=1 on a
+TPU backend-init failure and recorded nothing):
+
+- the PARENT process (this file, no args) never imports jax itself (the
+  container's sitecustomize still runs at interpreter start — nothing in
+  this file can defend against a hang there). It runs the measurement in
+  CHILD subprocesses (``--child``) with per-attempt timeouts, so a hung
+  backend init is killed and retried instead of zeroing out the round.
+  Because killing a live-but-slow child mid-TPU-RPC can wedge the relay
+  (.claude/skills/verify/SKILL.md), the timeout is extended once when the
+  relay still looks healthy at expiry.
+- each child runs STAGED: an 8K-entity smoke first (fast compile, proves
+  the backend), then the full-N run; each stage prints its own JSON line,
+  so a crash mid-full still leaves the smoke number harvestable.
+- after BENCH_TPU_ATTEMPTS failed TPU attempts the parent falls back to
+  CPU (JAX_PLATFORMS=cpu) at a reduced N so SOME measured number always
+  lands, flagged with "fallback": "cpu".
+- stdout of the parent is exactly ONE JSON line (driver contract); all
+  diagnostics go to stderr, and the JSON carries an "attempts" log even
+  on success.
+
 The timed region is a ``lax.scan`` over BENCH_TICKS ticks entirely on
 device with ONE host readback at the end (the axon tunnel has very high
 per-transfer latency; per-tick readback would measure the tunnel, not the
@@ -16,41 +37,57 @@ vs_baseline: the driver-set north star is 1M entities @ 60 ticks/s on a
 v5e-8 => 7.5M entity-ticks/sec/chip. value/7.5e6 > 1.0 beats it.
 
 Env knobs: BENCH_N (default 1_048_576), BENCH_TICKS (default 20),
-BENCH_CLIENT_FRAC (default 0.01).
+BENCH_CLIENT_FRAC (default 0.01), BENCH_PHASES=1 (add per-phase timing:
+separately-jitted AOI / behavior+integrate / collect variants),
+BENCH_TPU_ATTEMPTS (default 2), BENCH_CHILD_TIMEOUT seconds (default
+1200), BENCH_N_CPU (default 131072) for the CPU fallback.
 """
 
+import argparse
 import json
 import os
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-from jax import lax
-
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-
-from goworld_tpu.core.state import SpaceState, WorldConfig  # noqa: E402
-from goworld_tpu.core.step import TickInputs, tick_body  # noqa: E402
-from goworld_tpu.ops.aoi import GridSpec  # noqa: E402
+REPO = os.path.dirname(os.path.abspath(__file__))
+BASELINE_ENTITY_TICKS_PER_CHIP = 7.5e6
 
 N = int(os.environ.get("BENCH_N", 1_048_576))
 T = int(os.environ.get("BENCH_TICKS", 20))
 CLIENT_FRAC = float(os.environ.get("BENCH_CLIENT_FRAC", 0.01))
-BASELINE_ENTITY_TICKS_PER_CHIP = 7.5e6
+SMOKE_N = int(os.environ.get("BENCH_SMOKE_N", 8192))
+SMOKE_T = int(os.environ.get("BENCH_SMOKE_TICKS", 5))
+TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", 2))
+CHILD_TIMEOUT = float(os.environ.get("BENCH_CHILD_TIMEOUT", 1200))
+N_CPU = int(os.environ.get("BENCH_N_CPU", 131072))
+PHASES = os.environ.get("BENCH_PHASES", "0") == "1"
 
 
-def build():
+def log(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------- child ----
+
+def build(n: int, client_frac: float):
+    import jax
+    import jax.numpy as jnp
+
+    from goworld_tpu.core.state import SpaceState, WorldConfig
+    from goworld_tpu.core.step import TickInputs
+    from goworld_tpu.ops.aoi import GridSpec
+
     # ~12 avg Chebyshev neighbors at radius 50 (north-star AOI density)
-    extent = float(int((N * 10000 / 12) ** 0.5))
+    extent = float(int((n * 10000 / 12) ** 0.5))
     cfg = WorldConfig(
-        capacity=N,
+        capacity=n,
         grid=GridSpec(
             radius=50.0, extent_x=extent, extent_z=extent,
             # ~1.3 entities/cell at this density: cap 12 is ~9x headroom
             # (overflow drops are the documented AOI-cap tradeoff)
             k=32, cell_cap=12,
-            row_block=min(N, 65536),
+            row_block=min(n, int(os.environ.get("BENCH_ROW_BLOCK", 65536))),
         ),
         npc_speed=5.0,
         enter_cap=65536, leave_cap=65536,
@@ -60,33 +97,33 @@ def build():
     k1, k2, k3, k4 = jax.random.split(key, 4)
     pos = jnp.stack(
         [
-            jax.random.uniform(k1, (N,), maxval=extent),
-            jnp.zeros(N),
-            jax.random.uniform(k2, (N,), maxval=extent),
+            jax.random.uniform(k1, (n,), maxval=extent),
+            jnp.zeros(n),
+            jax.random.uniform(k2, (n,), maxval=extent),
         ],
         axis=1,
     )
     st = SpaceState(
         pos=pos,
-        yaw=jnp.zeros(N),
-        vel=jnp.zeros((N, 3)),
-        alive=jnp.ones(N, bool),
-        npc_moving=jnp.ones(N, bool),
-        has_client=jax.random.uniform(k3, (N,)) < CLIENT_FRAC,
-        client_gate=jnp.zeros(N, jnp.int32),
-        type_id=jnp.zeros(N, jnp.int32),
-        gen=jnp.zeros(N, jnp.int32),
-        hot_attrs=jnp.zeros((N, 8)),
-        attr_dirty=jnp.zeros(N, jnp.uint32),
-        nbr=jnp.full((N, cfg.grid.k), N, jnp.int32),
-        nbr_cnt=jnp.zeros(N, jnp.int32),
-        dirty=jnp.zeros(N, bool),
+        yaw=jnp.zeros(n),
+        vel=jnp.zeros((n, 3)),
+        alive=jnp.ones(n, bool),
+        npc_moving=jnp.ones(n, bool),
+        has_client=jax.random.uniform(k3, (n,)) < client_frac,
+        client_gate=jnp.zeros(n, jnp.int32),
+        type_id=jnp.zeros(n, jnp.int32),
+        gen=jnp.zeros(n, jnp.int32),
+        hot_attrs=jnp.zeros((n, 8)),
+        attr_dirty=jnp.zeros(n, jnp.uint32),
+        nbr=jnp.full((n, cfg.grid.k), n, jnp.int32),
+        nbr_cnt=jnp.zeros(n, jnp.int32),
+        dirty=jnp.zeros(n, bool),
         rng=jax.random.PRNGKey(1),
         tick=jnp.zeros((), jnp.int32),
     )
     # steady stream of client position syncs (input-scatter path stays hot)
     inputs = TickInputs(
-        pos_sync_idx=jax.random.randint(k4, (cfg.input_cap,), 0, N),
+        pos_sync_idx=jax.random.randint(k4, (cfg.input_cap,), 0, n),
         pos_sync_vals=jnp.concatenate(
             [
                 jax.random.uniform(k4, (cfg.input_cap, 3), maxval=extent),
@@ -99,8 +136,13 @@ def build():
     return cfg, st, inputs
 
 
-def main():
-    cfg, st, inputs = build()
+def measure(n: int, ticks: int, client_frac: float, phases: bool) -> dict:
+    import jax
+    from jax import lax
+
+    from goworld_tpu.core.step import tick_body
+
+    cfg, st, inputs = build(n, client_frac)
 
     def one_tick(state, _):
         state, out = tick_body(cfg, state, inputs, None)
@@ -113,35 +155,311 @@ def main():
 
     @jax.jit
     def run(state):
-        return lax.scan(one_tick, state, None, length=T)
+        return lax.scan(one_tick, state, None, length=ticks)
 
-    # compile + warm up (first scan execution)
+    t0 = time.perf_counter()
     st_w, _ = run(st)
     jax.block_until_ready(st_w)
+    compile_s = time.perf_counter() - t0
+    log(f"n={n}: compile+warmup {compile_s:.1f}s")
 
     t0 = time.perf_counter()
     st2, checks = run(st)
     jax.block_until_ready(st2)
     elapsed = time.perf_counter() - t0
 
-    ticks_per_sec = T / elapsed
-    value = N * ticks_per_sec
-    print(
-        json.dumps(
-            {
-                "metric": "entity_ticks_per_sec_per_chip",
-                "value": round(value, 1),
-                "unit": "entity-ticks/s/chip",
-                "vs_baseline": round(value / BASELINE_ENTITY_TICKS_PER_CHIP, 3),
-                "entities": N,
-                "ticks_per_sec": round(ticks_per_sec, 2),
-                "tick_ms": round(1000.0 * elapsed / T, 2),
-                "ticks_timed": T,
-                "device": str(jax.devices()[0]),
-            }
+    ticks_per_sec = ticks / elapsed
+    result = {
+        "value": round(n * ticks_per_sec, 1),
+        "entities": n,
+        "ticks_per_sec": round(ticks_per_sec, 2),
+        "tick_ms": round(1000.0 * elapsed / ticks, 3),
+        "ticks_timed": ticks,
+        "compile_s": round(compile_s, 1),
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+    }
+    if phases:
+        result["phase_ms"] = measure_phases(cfg, st, inputs, ticks)
+    return result
+
+
+def measure_phases(cfg, st, inputs, ticks: int) -> dict:
+    """Per-phase timings via separately-jitted partial ticks: aoi (grid
+    sweep only), move (inputs+behavior+integrate), collect (delta + sync +
+    attr extraction, AOI held fixed). Sum != whole tick (XLA fuses across
+    phases in the real program); it localizes where the time goes."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from goworld_tpu.models.random_walk import random_walk_step
+    from goworld_tpu.ops.aoi import grid_neighbors
+    from goworld_tpu.ops.delta import interest_delta, masked_pairs
+    from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
+    from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
+
+    n = cfg.capacity
+
+    @jax.jit
+    def aoi_only(state):
+        def body(carry, _):
+            pos = carry
+            nbr, cnt = grid_neighbors(cfg.grid, pos, state.alive)
+            # feed a nbr-dependent perturbation back so scan iterations
+            # cannot be collapsed by the compiler
+            pos = pos + (cnt[:, None] % 2).astype(pos.dtype) * 1e-6
+            return pos, cnt.sum()
+        return lax.scan(body, state.pos, None, length=ticks)
+
+    @jax.jit
+    def move_only(state):
+        def body(carry, _):
+            pos, yaw, vel, rng = carry
+            pos, yaw, touched = apply_pos_inputs(
+                pos, yaw, inputs.pos_sync_idx, inputs.pos_sync_vals,
+                inputs.pos_sync_n,
+            )
+            rng, k = jax.random.split(rng)
+            vel = random_walk_step(
+                k, vel, state.npc_moving, cfg.npc_speed, cfg.turn_prob
+            )
+            pos, moved = integrate(
+                pos, vel, state.npc_moving, cfg.dt,
+                cfg.bounds_min, cfg.bounds_max,
+            )
+            return (pos, yaw, vel, rng), moved.sum()
+        return lax.scan(
+            body, (state.pos, state.yaw, state.vel, state.rng),
+            None, length=ticks,
         )
+
+    @jax.jit
+    def collect_only(state, nbr, cnt):
+        def body(carry, _):
+            prev_nbr, dirty = carry
+            enter_mask, leave_mask = interest_delta(prev_nbr, nbr, n)
+            ew, ej, en = masked_pairs(enter_mask, nbr, cfg.enter_cap)
+            lw, lj, ln = masked_pairs(leave_mask, prev_nbr, cfg.leave_cap)
+            sw, sj, sv, sn = collect_sync(
+                nbr, dirty, state.has_client, state.pos, state.yaw,
+                cfg.sync_cap,
+            )
+            ae, ai, av, an = collect_attr_deltas(
+                state.hot_attrs, state.attr_dirty, cfg.attr_sync_cap
+            )
+            dirty = jnp.roll(dirty, 1)  # keep iterations data-dependent
+            return (nbr, dirty), en + ln + sn + an + ew.sum() + sv.sum()
+        init_dirty = jnp.ones((n,), bool)
+        return lax.scan(body, (state.nbr, init_dirty), None, length=ticks)
+
+    out = {}
+    nbr, cnt = grid_neighbors(cfg.grid, st.pos, st.alive)
+    nbr, cnt = jax.block_until_ready((nbr, cnt))
+    for name, fn, args in (
+        ("aoi", aoi_only, (st,)),
+        ("move", move_only, (st,)),
+        ("collect", collect_only, (st, nbr, cnt)),
+    ):
+        r = jax.block_until_ready(fn(*args))  # compile
+        t0 = time.perf_counter()
+        r = jax.block_until_ready(fn(*args))
+        dt = time.perf_counter() - t0
+        out[name] = round(1000.0 * dt / ticks, 3)
+        log(f"phase {name}: {out[name]} ms/tick")
+    return out
+
+
+def child_main(args) -> int:
+    """Staged measurement: smoke first, then full. One JSON line per stage
+    on stdout; the parent harvests whatever stages completed."""
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        # the container's sitecustomize imports jax at startup and latches
+        # the axon (TPU) platform; the JAX_PLATFORMS env var alone is too
+        # late. config.update works while no backend client exists yet.
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    stages = [("smoke", min(SMOKE_N, args.n), SMOKE_T, False)]
+    if args.n > SMOKE_N:
+        stages.append(("full", args.n, args.ticks, args.phases))
+    else:
+        stages[0] = ("full", args.n, args.ticks, args.phases)
+    for name, n, ticks, phases in stages:
+        t0 = time.perf_counter()
+        r = measure(n, ticks, args.client_frac, phases)
+        r["stage"] = name
+        r["stage_wall_s"] = round(time.perf_counter() - t0, 1)
+        print(json.dumps(r), flush=True)
+    return 0
+
+
+# --------------------------------------------------------------- parent ----
+
+def run_child(env_extra: dict, n: int, timeout: float) -> tuple[list, str]:
+    """Run one child attempt; returns (parsed stage dicts, failure note)."""
+    env = dict(os.environ)
+    for k, v in env_extra.items():
+        if v is None:
+            env.pop(k, None)  # None = unset (e.g. the axon relay hook)
+        else:
+            env[k] = v
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--child",
+        "--n", str(n), "--ticks", str(T),
+        "--client-frac", str(CLIENT_FRAC),
+    ]
+    if PHASES:
+        cmd.append("--phases")
+    log(f"spawn child: n={n} env+={env_extra} timeout={timeout:.0f}s")
+    proc = subprocess.Popen(
+        cmd, env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
+    extended = False
+    deadline = time.monotonic() + timeout
+    note = ""
+    while True:
+        try:
+            out, err = proc.communicate(
+                timeout=max(0.1, deadline - time.monotonic())
+            )
+            if proc.returncode != 0:
+                note = f"rc={proc.returncode}: {err.strip().splitlines()[-1][:300] if err.strip() else 'no stderr'}"
+            break
+        except subprocess.TimeoutExpired:
+            # killing a live child mid-TPU-RPC can wedge the relay
+            # (verify SKILL.md); if the relay still answers, assume the
+            # child is slow, not stuck, and grant one extension
+            if not extended and relay_up():
+                extended = True
+                deadline = time.monotonic() + timeout
+                log(f"child past {timeout:.0f}s but relay healthy; "
+                    "extending once")
+                continue
+            proc.kill()
+            out, err = proc.communicate()
+            note = f"timeout after {timeout * (2 if extended else 1):.0f}s"
+            break
+    for line in err.strip().splitlines()[-12:]:
+        log(f"  child# {line[:240]}")
+    stages = []
+    for line in out.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                stages.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    return stages, note
+
+
+def relay_up() -> bool:
+    """The axon TPU backend dials a local stdio relay (see
+    .claude/skills/verify/SKILL.md); if nothing is listening, backend init
+    hangs forever. Probe the first relay port so a dead relay costs 2s,
+    not BENCH_CHILD_TIMEOUT * attempts."""
+    if not os.environ.get("PALLAS_AXON_POOL_IPS"):
+        return True  # not an axon env; let jax pick its default backend
+    import socket
+
+    try:
+        with socket.create_connection(("127.0.0.1", 8082), timeout=2.0):
+            return True
+    except OSError:
+        return False
+
+
+def parent_main() -> int:
+    attempts_log = []
+    best = None          # preferred-platform full result
+    partial = None       # any stage result at all (smoke counts)
+
+    for i in range(TPU_ATTEMPTS):
+        # re-probe before EVERY attempt: a kill during attempt i can take
+        # the relay down, and then attempt i+1 would burn a full timeout
+        if not relay_up():
+            log("TPU relay not listening; skipping remaining TPU attempts")
+            attempts_log.append({
+                "attempt": f"relay-probe-{i + 1}", "env": {},
+                "stages": [], "error": "relay port 8082 refused/unreachable",
+            })
+            break
+        stages, note = run_child({}, N, CHILD_TIMEOUT)
+        for s in stages:
+            partial = s
+            if s.get("stage") == "full":
+                best = s
+        attempts_log.append({
+            "attempt": i + 1, "env": {},
+            "stages": [s.get("stage") for s in stages], "error": note or None,
+        })
+        if best is not None:
+            break
+        if note:
+            log(f"attempt {i + 1} failed: {note}")
+            time.sleep(min(30.0, 5.0 * (i + 1)))
+
+    if best is None:
+        log(f"TPU attempts exhausted; CPU fallback at n={N_CPU}")
+        # unset the relay hook so sitecustomize can't dial a dead relay at
+        # interpreter start, and force the cpu platform explicitly
+        cpu_env = {
+            "BENCH_FORCE_CPU": "1",
+            "PALLAS_AXON_POOL_IPS": None,
+            "JAX_PLATFORMS": "cpu",
+        }
+        stages, note = run_child(cpu_env, N_CPU, CHILD_TIMEOUT)
+        attempts_log.append({
+            "attempt": "cpu-fallback", "env": {"BENCH_FORCE_CPU": "1"},
+            "stages": [s.get("stage") for s in stages], "error": note or None,
+        })
+        for s in stages:
+            if s.get("stage") == "full":
+                best = s
+            elif partial is None:
+                partial = s
+
+    chosen = best or partial
+    result = {
+        "metric": "entity_ticks_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "entity-ticks/s/chip",
+        "vs_baseline": 0.0,
+    }
+    if chosen is not None:
+        chosen = dict(chosen)
+        value = chosen.pop("value")
+        result.update(
+            value=value,
+            vs_baseline=round(value / BASELINE_ENTITY_TICKS_PER_CHIP, 3),
+            **chosen,
+        )
+        if chosen.get("platform") == "cpu" and \
+                os.environ.get("PALLAS_AXON_POOL_IPS"):
+            result["fallback"] = "cpu"  # TPU env, but measured on CPU
+        if best is None:
+            result["partial"] = True  # smoke-stage only; full run never landed
+    else:
+        result["error"] = "no stage completed on any backend"
+    result["attempts"] = attempts_log
+    print(json.dumps(result), flush=True)
+    return 0 if chosen is not None else 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--child", action="store_true")
+    ap.add_argument("--n", type=int, default=N)
+    ap.add_argument("--ticks", type=int, default=T)
+    ap.add_argument("--client-frac", type=float, default=CLIENT_FRAC)
+    ap.add_argument("--phases", action="store_true", default=PHASES)
+    args = ap.parse_args()
+    if args.child:
+        sys.path.insert(0, REPO)
+        return child_main(args)
+    return parent_main()
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -247,6 +247,11 @@ class World:
         sp.OnAttrsReady()
         sp.OnCreated()
         sp.OnSpaceCreated()
+        if self.on_entity_created is not None:
+            # spaces are entities: the dispatcher must learn the route so
+            # MT_QUERY_SPACE_GAMEID_FOR_MIGRATE from other games resolves
+            # (reference SpaceService/EnterSpace, DispatcherService.go:834)
+            self.on_entity_created(sp)
         return sp
 
     def create_entity(
