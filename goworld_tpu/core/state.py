@@ -72,6 +72,10 @@ class SpaceState:
     attr_dirty: jax.Array   # u32[N]   bitmask over attr columns
     nbr: jax.Array          # i32[N, k] sorted AOI neighbor list (sentinel N)
     nbr_cnt: jax.Array      # i32[N]
+    aoi_radius: jax.Array   # f32[N] per-entity AOI distance; 0 = excluded
+                            # from AOI entirely, +inf = space default radius
+                            # (reference EntityTypeDesc.aoiDistance,
+                            # EntityManager.go:24-101)
     dirty: jax.Array        # bool[N]  moved this tick (syncInfoFlag analog)
     rng: jax.Array          # PRNG key
     tick: jax.Array         # i32 scalar
@@ -93,6 +97,7 @@ def create_state(cfg: WorldConfig, seed: int = 0) -> SpaceState:
         attr_dirty=jnp.zeros((n,), jnp.uint32),
         nbr=jnp.full((n, k), n, jnp.int32),
         nbr_cnt=jnp.zeros((n,), jnp.int32),
+        aoi_radius=jnp.full((n,), jnp.inf, jnp.float32),
         dirty=jnp.zeros((n,), bool),
         rng=jax.random.PRNGKey(seed),
         tick=jnp.zeros((), jnp.int32),
@@ -110,6 +115,7 @@ def spawn(
     has_client: bool = False,
     client_gate: int = -1,
     hot_attrs=None,
+    aoi_radius: float = float("inf"),
 ) -> SpaceState:
     """Host-side spawn into a free slot (infrequent; not on the hot path).
 
@@ -136,6 +142,7 @@ def spawn(
         has_client=state.has_client.at[slot].set(has_client),
         client_gate=state.client_gate.at[slot].set(client_gate),
         type_id=state.type_id.at[slot].set(type_id),
+        aoi_radius=state.aoi_radius.at[slot].set(aoi_radius),
         gen=state.gen.at[slot].add(1),
         dirty=state.dirty.at[slot].set(True),
         hot_attrs=state.hot_attrs.at[slot].set(

@@ -142,8 +142,11 @@ def tick_body(
     # analog — Entity.go:1189-1205); consumed here, cleared below.
     dirty = (moved | touched | state.dirty) & state.alive
 
-    # 4. AOI sweep (the go-aoi XZList replacement).
-    nbr, nbr_cnt = grid_neighbors(cfg.grid, pos, state.alive)
+    # 4. AOI sweep (the go-aoi XZList replacement). Per-entity aoi_radius
+    # honors EntityTypeDesc.aoiDistance (0 = excluded from AOI).
+    nbr, nbr_cnt = grid_neighbors(
+        cfg.grid, pos, state.alive, watch_radius=state.aoi_radius
+    )
 
     # 5. interest deltas -> bounded enter/leave pair lists.
     enter_mask, leave_mask = interest_delta(state.nbr, nbr, n)

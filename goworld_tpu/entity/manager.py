@@ -72,6 +72,19 @@ def _pad_scatter(sh: np.ndarray, sl: np.ndarray, capacity: int,
     return (sh, sl) + tuple(out)
 
 
+def _type_aoi_radius(desc) -> float:
+    """Device aoi_radius for a type (reference EntityTypeDesc.aoiDistance,
+    ``EntityManager.go:24-101``): use_aoi=False types are excluded from AOI
+    entirely (radius 0 — invisible and blind, the service-entity case); an
+    explicit aoi_distance > 0 bounds the type's view; otherwise +inf means
+    "the space's uniform radius" (GridSpec.radius caps the reach)."""
+    if not desc.use_aoi:
+        return 0.0
+    if desc.aoi_distance > 0:
+        return float(desc.aoi_distance)
+    return float("inf")
+
+
 def _make_local_tick(cfg: WorldConfig):
     """jit(vmap(tick_body)) over stacked spaces on ONE device — the
     single-process analog of the mesh's shard_map step."""
@@ -216,6 +229,13 @@ class World:
         self.entities[sp.id] = sp
         self.spaces[sp.id] = sp
         self.nil_space = sp
+        if self.on_entity_created is not None:
+            # nil-space ids are opaque hashes (ids.nil_space_id): without a
+            # dispatcher route, cross-game enter_space targeting another
+            # game's nil space could never resolve (the handshake census
+            # covers nil spaces created before the cluster connects; this
+            # covers ones created after, e.g. on restore)
+            self.on_entity_created(sp)
         return sp
 
     def create_space(
@@ -458,6 +478,7 @@ class World:
                 has_client=e.client is not None,
                 client_gate=e.client.gate_id if e.client else -1,
                 hot=hot,
+                aoi_radius=_type_aoi_radius(e._type_desc),
             )))
         e._pending_pos = tuple(map(float, pos))
         e.OnEnterSpace()
@@ -850,6 +871,7 @@ class World:
                     has_client=bool(rows["has_client"][i]),
                     client_gate=int(rows["client_gate"][i]),
                     hot=np.asarray(rows["hot"][i]).tolist(),
+                    aoi_radius=_type_aoi_radius(e._type_desc),
                 )))
                 # old slot: despawn now; owner mapping stays for this
                 # step's leave events, slot frees after processing
@@ -878,7 +900,7 @@ class World:
             sh = np.array([s for s, _, _ in self._staged_spawn], np.int32)
             sl = np.array([s for _, s, _ in self._staged_spawn], np.int32)
             d = [v for _, _, v in self._staged_spawn]
-            sh, sl, p_, y_, mv, hc, cg, ti, ht = _pad_scatter(
+            sh, sl, p_, y_, mv, hc, cg, ti, ht, ar = _pad_scatter(
                 sh, sl, cap,
                 np.array([x["pos"] for x in d], np.float32),
                 np.array([x["yaw"] for x in d], np.float32),
@@ -887,6 +909,9 @@ class World:
                 np.array([x["client_gate"] for x in d], np.int32),
                 np.array([x["type_id"] for x in d], np.int32),
                 np.array([x["hot"] for x in d], np.float32),
+                np.array(
+                    [x.get("aoi_radius", np.inf) for x in d], np.float32
+                ),
             )
             ix = (sh, sl)
             st = st.replace(
@@ -898,6 +923,7 @@ class World:
                 has_client=st.has_client.at[ix].set(hc, mode="drop"),
                 client_gate=st.client_gate.at[ix].set(cg, mode="drop"),
                 type_id=st.type_id.at[ix].set(ti, mode="drop"),
+                aoi_radius=st.aoi_radius.at[ix].set(ar, mode="drop"),
                 gen=st.gen.at[ix].add(1, mode="drop"),
                 dirty=st.dirty.at[ix].set(True, mode="drop"),
                 hot_attrs=st.hot_attrs.at[ix].set(ht, mode="drop"),

@@ -99,6 +99,7 @@ def grid_neighbors(
     pos: jax.Array,
     alive: jax.Array,
     query_rows: int | None = None,
+    watch_radius: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Compute AOI neighbor lists for every entity.
 
@@ -111,6 +112,13 @@ def grid_neighbors(
         all N entities remain candidates — megaspaces append ghost rows at
         the end that must be visible but never watch
         (:mod:`goworld_tpu.parallel.megaspace`).
+      watch_radius: optional f32[N] per-entity AOI distance (reference
+        ``EntityTypeDesc.aoiDistance``, ``EntityManager.go:24-101``). An
+        entity with radius <= 0 is excluded from AOI entirely — invisible
+        to every watcher AND blind itself (the reference's aoiDistance=0 /
+        useAOI=false service-entity case); radius > 0 watches within
+        ``min(watch_radius, spec.radius)`` (the grid cell size bounds the
+        reachable range). None = uniform ``spec.radius`` for all.
 
     Returns:
       nbr: int32[Q, k] neighbor slot ids, ascending, padded with sentinel N.
@@ -123,6 +131,10 @@ def grid_neighbors(
     sentinel = n
     n_cells = spec.cells_x * spec.cells_z
 
+    if watch_radius is not None:
+        # radius-0 entities leave the candidate pool here (sorted into the
+        # sentinel cell) so they cost nothing downstream
+        alive = alive & (watch_radius > 0.0)
     cid = cell_ids(spec, pos, alive)
     order = jnp.argsort(cid).astype(jnp.int32)
     scid = cid[order]
@@ -188,7 +200,13 @@ def grid_neighbors(
         ddx = jnp.abs(cand_px - px[rows][:, None, None])
         ddz = jnp.abs(cand_pz - pz[rows][:, None, None])
         dist = jnp.maximum(ddx, ddz)                         # Chebyshev XZ
-        valid &= (dist <= spec.radius) & (cand != rows[:, None, None])
+        if watch_radius is None:
+            reach = spec.radius
+        else:  # per-watcher view distance, bounded by the cell size
+            reach = jnp.minimum(watch_radius[rows], spec.radius)[
+                :, None, None
+            ]
+        valid &= (dist <= reach) & (cand != rows[:, None, None])
 
         if n < (1 << 21):
             # pack (quantized distance, candidate id) into one int32 so a

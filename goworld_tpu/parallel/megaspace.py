@@ -172,9 +172,12 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
         )
         dirty = pre_dirty | state.dirty   # arrivals force-sync
 
-        # 3. halo ghost exchange (ring ppermute).
+        # 3. halo ghost exchange (ring ppermute). AOI-excluded entities
+        #    (aoi_radius <= 0, e.g. service types) never ship as ghosts —
+        #    they are invisible to every watcher, local or remote.
+        visible = state.alive & (state.aoi_radius > 0.0)
         gpos, gyaw, gdirty, gvalid, ggid, halo_demand = exchange_halo(
-            SPACE_AXIS, n_dev, state.pos, state.yaw, dirty, state.alive,
+            SPACE_AXIS, n_dev, state.pos, state.yaw, dirty, visible,
             mc.tile_w, radius, mc.halo_cap,
         )
 
@@ -183,9 +186,16 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
         pos_ext = jnp.concatenate([state.pos, gpos])
         shift = jnp.array([tile_min - radius, 0.0, 0.0], jnp.float32)
         alive_ext = jnp.concatenate([state.alive, gvalid])
+        # ghosts already passed the source-side visibility filter: give
+        # them +inf so only the local per-entity radii gate here
+        wr_ext = jnp.concatenate([
+            state.aoi_radius,
+            jnp.full((2 * mc.halo_cap,), jnp.inf, jnp.float32),
+        ])
         # ghosts are candidates but never watchers: query only local rows
         nbr_ext, nbr_cnt = grid_neighbors(
-            cfg.grid, pos_ext - shift, alive_ext, query_rows=n
+            cfg.grid, pos_ext - shift, alive_ext, query_rows=n,
+            watch_radius=wr_ext,
         )
 
         # 5. translate to stable GLOBAL ids, diff against previous tick.

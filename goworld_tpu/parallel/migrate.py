@@ -45,7 +45,7 @@ def pack_emigrants(
     """Build per-destination send buffers and the departed mask.
 
     Returns:
-      fbuf: f32[n_dev, cap, 7+A] (pos, yaw, vel, hot_attrs)
+      fbuf: f32[n_dev, cap, 8+A] (pos, yaw, vel, aoi_radius, hot_attrs)
       ibuf: i32[n_dev, cap, I_FIELDS]
       departed: bool[N] rows actually packed (despawn them locally)
       demand: i32[n_dev] true per-destination emigrant counts (may exceed cap;
@@ -68,6 +68,7 @@ def pack_emigrants(
             state.pos[slots],                                   # [D, cap, 3]
             state.yaw[slots][..., None],
             state.vel[slots],
+            state.aoi_radius[slots][..., None],
             state.hot_attrs[slots],
         ],
         axis=-1,
@@ -107,7 +108,7 @@ def despawn_departed(state: SpaceState, departed: jax.Array) -> SpaceState:
 
 def insert_arrivals(
     state: SpaceState,
-    fbuf: jax.Array,     # f32[n_dev, cap, 7+A] (post-all_to_all: from each src)
+    fbuf: jax.Array,     # f32[n_dev, cap, 8+A] (post-all_to_all: from each src)
     ibuf: jax.Array,     # i32[n_dev, cap, I_FIELDS]
     nbr_sentinel: int,
     quarantine: jax.Array | None = None,
@@ -130,7 +131,7 @@ def insert_arrivals(
     d, cap, _ = fbuf.shape
     total = d * cap
 
-    f = fbuf.reshape(total, 7 + a)
+    f = fbuf.reshape(total, 8 + a)
     i = ibuf.reshape(total, I_FIELDS)
     arr_valid = i[:, I_VALID] > 0
 
@@ -146,7 +147,8 @@ def insert_arrivals(
         pos=state.pos.at[slot].set(f[:, 0:3], mode="drop"),
         yaw=state.yaw.at[slot].set(f[:, 3], mode="drop"),
         vel=state.vel.at[slot].set(f[:, 4:7], mode="drop"),
-        hot_attrs=state.hot_attrs.at[slot].set(f[:, 7:], mode="drop"),
+        aoi_radius=state.aoi_radius.at[slot].set(f[:, 7], mode="drop"),
+        hot_attrs=state.hot_attrs.at[slot].set(f[:, 8:], mode="drop"),
         type_id=state.type_id.at[slot].set(i[:, I_TYPE], mode="drop"),
         has_client=state.has_client.at[slot].set(
             i[:, I_HAS_CLIENT] > 0, mode="drop"

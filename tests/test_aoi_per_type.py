@@ -1,0 +1,167 @@
+"""Per-type AOI distance semantics (reference EntityTypeDesc.aoiDistance,
+EntityManager.go:24-101 / SetUseAOI: useAOI=false or aoiDistance=0 types are
+excluded from AOI; a positive aoiDistance bounds that type's view)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from goworld_tpu.entity.manager import World, _type_aoi_radius
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.space import Space
+from goworld_tpu.core.state import WorldConfig
+from goworld_tpu.ops.aoi import GridSpec, grid_neighbors, neighbors_oracle
+
+
+def _spec(**kw):
+    base = dict(radius=25.0, extent_x=200.0, extent_z=200.0,
+                k=64, cell_cap=64, row_block=64)
+    base.update(kw)
+    return GridSpec(**base)
+
+
+def test_radius_zero_invisible_and_blind():
+    rng = np.random.default_rng(0)
+    n = 120
+    pos = np.zeros((n, 3), np.float32)
+    pos[:, 0] = rng.uniform(0, 200, n)
+    pos[:, 2] = rng.uniform(0, 200, n)
+    alive = np.ones(n, bool)
+    wr = np.full(n, np.inf, np.float32)
+    excluded = rng.choice(n, 30, replace=False)
+    wr[excluded] = 0.0
+
+    nbr, cnt = grid_neighbors(
+        _spec(), jnp.asarray(pos), jnp.asarray(alive),
+        watch_radius=jnp.asarray(wr),
+    )
+    nbr, cnt = np.asarray(nbr), np.asarray(cnt)
+
+    # oracle over only the participating population
+    oracle = neighbors_oracle(pos, alive & (wr > 0), 25.0)
+    ex = set(excluded.tolist())
+    for i in range(n):
+        got = set(nbr[i][nbr[i] < n].tolist())
+        if i in ex:
+            assert cnt[i] == 0 and not got, f"excluded row {i} watches"
+        else:
+            assert got == oracle[i], f"row {i}"
+        assert not (got & ex), f"row {i} sees an excluded entity"
+
+
+def test_per_type_distance_bounds_view():
+    # watcher A radius 10, watcher B radius inf (-> spec radius 25); a
+    # subject 15 away is visible to B but not A — and A stays visible to
+    # everyone (distance only gates WATCHING, not visibility)
+    pos = np.array(
+        [[50, 0, 50], [50, 0, 50], [65, 0, 50]], np.float32
+    )
+    alive = np.ones(3, bool)
+    wr = np.array([10.0, np.inf, np.inf], np.float32)
+    nbr, cnt = grid_neighbors(
+        _spec(k=8, cell_cap=8, row_block=4),
+        jnp.asarray(pos), jnp.asarray(alive),
+        watch_radius=jnp.asarray(wr),
+    )
+    nbr, cnt = np.asarray(nbr), np.asarray(cnt)
+    sees = lambda i: set(nbr[i][nbr[i] < 3].tolist())
+    assert sees(0) == {1}          # subject 2 is 15 > 10 away
+    assert sees(1) == {0, 2}       # full spec radius
+    assert sees(2) == {0, 1}       # A visible despite its small radius
+
+
+def test_uniform_path_unchanged():
+    rng = np.random.default_rng(1)
+    n = 200
+    pos = np.zeros((n, 3), np.float32)
+    pos[:, 0] = rng.uniform(0, 200, n)
+    pos[:, 2] = rng.uniform(0, 200, n)
+    alive = rng.uniform(size=n) < 0.8
+    spec = _spec()
+    nbr_a, cnt_a = grid_neighbors(spec, jnp.asarray(pos), jnp.asarray(alive))
+    nbr_b, cnt_b = grid_neighbors(
+        spec, jnp.asarray(pos), jnp.asarray(alive),
+        watch_radius=jnp.full((n,), jnp.inf),
+    )
+    assert (np.asarray(nbr_a) == np.asarray(nbr_b)).all()
+    assert (np.asarray(cnt_a) == np.asarray(cnt_b)).all()
+
+
+def test_type_aoi_radius_mapping():
+    class D:  # minimal EntityTypeDesc stand-in
+        def __init__(self, use_aoi, aoi_distance):
+            self.use_aoi = use_aoi
+            self.aoi_distance = aoi_distance
+
+    assert _type_aoi_radius(D(False, 0.0)) == 0.0
+    assert _type_aoi_radius(D(False, 30.0)) == 0.0
+    assert _type_aoi_radius(D(True, 30.0)) == 30.0
+    assert _type_aoi_radius(D(True, 0.0)) == float("inf")
+
+
+class _Plain(Entity):
+    pass
+
+
+class _ServiceLike(Entity):
+    pass
+
+
+class _NearSighted(Entity):
+    pass
+
+
+class _Arena(Space):
+    pass
+
+
+def _world():
+    cfg = WorldConfig(
+        capacity=64,
+        grid=GridSpec(radius=30.0, extent_x=128.0, extent_z=128.0,
+                      k=16, cell_cap=32, row_block=32),
+    )
+    w = World(cfg, n_spaces=1)
+    w.register_space("Arena", _Arena)
+    w.register_entity("Plain", _Plain)
+    w.register_entity("ServiceLike", _ServiceLike, use_aoi=False)
+    w.register_entity("NearSighted", _NearSighted, aoi_distance=5.0)
+    w.create_nil_space()
+    return w
+
+
+def test_world_aoi_less_entity_never_entered():
+    """VERDICT #7 done-condition: an AOI-less service entity placed in the
+    middle of a crowd is never interested in anyone and no one is ever
+    interested in it (reference: useAOI=false types are not in the AOI
+    manager at all, Space.go:200-234)."""
+    w = _world()
+    arena = w.create_space("Arena")
+    svc = w.create_entity("ServiceLike", space=arena, pos=(50, 0, 50))
+    others = [
+        w.create_entity("Plain", space=arena, pos=(50 + i, 0, 50))
+        for i in range(3)
+    ]
+    for _ in range(3):
+        w.tick()
+    assert not svc.interested_in
+    assert not svc.interested_by
+    for o in others:
+        assert svc.id not in o.interested_in
+        assert svc.id not in o.interested_by
+    # the plain entities do see each other (the space AOI still works)
+    assert others[0].interested_in == {others[1].id, others[2].id}
+
+
+def test_world_per_type_distance():
+    w = _world()
+    arena = w.create_space("Arena")
+    near = w.create_entity("NearSighted", space=arena, pos=(50, 0, 50))
+    close = w.create_entity("Plain", space=arena, pos=(53, 0, 50))
+    far = w.create_entity("Plain", space=arena, pos=(70, 0, 50))
+    for _ in range(3):
+        w.tick()
+    # near sees only the entity within its 5-unit view...
+    assert near.interested_in == {close.id}
+    # ...but is visible to both at the space radius (30)
+    assert near.id in close.interested_in
+    assert near.id in far.interested_in
