@@ -413,7 +413,8 @@ def relay_up() -> bool:
 
 def parent_main() -> int:
     attempts_log = []
-    best = None          # preferred-platform full result
+    best = None          # preferred-platform full result, timing-sane
+    suspect_best = None  # full result whose 2x-scale self-check failed
     partial = None       # any stage result at all (smoke counts)
 
     for i in range(TPU_ATTEMPTS):
@@ -427,32 +428,32 @@ def parent_main() -> int:
             })
             break
         stages, note = run_child({}, N, CHILD_TIMEOUT)
-        suspect_full = None
+        had_suspect = False
         for s in stages:
             partial = s
             if s.get("stage") == "full":
                 if s.get("timing_suspect"):
                     # a full stage whose 2x-scale self-check failed is a
                     # FAILED attempt (the r01 failure mode: caching made
-                    # per-tick ~0); keep it only as a last resort
-                    suspect_full = s
+                    # per-tick ~0); retained across attempts as a flagged
+                    # last resort
+                    suspect_best = s
+                    had_suspect = True
                 else:
                     best = s
         attempts_log.append({
             "attempt": i + 1, "env": {},
             "stages": [s.get("stage") for s in stages],
             "error": note or (
-                "timing_suspect full stage" if suspect_full is not None
-                and best is None else None
+                "timing_suspect full stage" if had_suspect and best is None
+                else None
             ),
         })
         if best is not None:
             break
-        if suspect_full is not None and partial is suspect_full:
-            partial = suspect_full  # better than nothing, flagged
-        if note or suspect_full is not None:
+        if note or had_suspect:
             log(f"attempt {i + 1} failed: "
-                f"{note or suspect_full.get('timing_suspect')}")
+                f"{note or 'timing_suspect full stage'}")
             time.sleep(min(30.0, 5.0 * (i + 1)))
 
     if best is None:
@@ -476,7 +477,7 @@ def parent_main() -> int:
             elif partial is None:
                 partial = s
 
-    chosen = best or partial
+    chosen = best or suspect_best or partial
     result = {
         "metric": "entity_ticks_per_sec_per_chip",
         "value": 0.0,

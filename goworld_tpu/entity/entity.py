@@ -64,7 +64,11 @@ class Entity:
         self.id: str = ""
         self.world: "World" = None  # type: ignore
         self.space: "Space | None" = None
-        self.slot: int | None = None  # device row in space's shard
+        # device address = (shard, slot); for normal AOI spaces shard ==
+        # space.shard, for megaspaces it is the entity's current TILE
+        # (which changes as the entity crosses tile borders)
+        self.shard: int | None = None
+        self.slot: int | None = None  # device row in shard
         self.client: GameClient | None = None
         self.attrs: MapAttr = None  # type: ignore
         self.interested_in: set[str] = set()
@@ -93,18 +97,18 @@ class Entity:
         """Last committed device position (one tick behind a staged set)."""
         if self._pending_pos is not None:
             return self._pending_pos
-        if self.slot is None or self.space is None or self.space.shard is None:
+        if self.slot is None or self.shard is None:
             return (0.0, 0.0, 0.0)
-        p = self.world.read_pos(self.space.shard, self.slot)
+        p = self.world.read_pos(self.shard, self.slot)
         return (float(p[0]), float(p[1]), float(p[2]))
 
     @property
     def yaw(self) -> float:
         if self._pending_yaw is not None:
             return self._pending_yaw
-        if self.slot is None or self.space is None or self.space.shard is None:
+        if self.slot is None or self.shard is None:
             return 0.0
-        return self.world.read_yaw(self.space.shard, self.slot)
+        return self.world.read_yaw(self.shard, self.slot)
 
     def set_position(self, pos) -> None:
         """Stage a teleport/position-set; applied inside the next tick via

@@ -121,27 +121,61 @@ class World:
         clock: Callable[[], float] = time.monotonic,
         seed: int = 0,
         migrate_cap: int = 256,
+        megaspace: bool = False,
+        halo_cap: int = 1024,
     ):
         self.cfg = cfg
         self.n_spaces = n_spaces
         self.game_id = game_id
         self.registry = Registry()
         self.mesh = mesh
-        self.state: SpaceState = create_multi_state(cfg, n_spaces, seed=seed)
         self.policy = None  # MLPPolicy when cfg.behavior == 'mlp'
-        if mesh is not None:
-            from goworld_tpu.parallel.mesh import shard_state
-            from goworld_tpu.parallel.step import make_multi_tick
+        self.mega = None    # MegaConfig when megaspace=True
+        if megaspace:
+            # ONE logical space spans the whole mesh as x-interval tiles
+            # (BASELINE config 4; SURVEY.md#5.7). cfg.grid is the TILE
+            # grid in tile-shifted coords: extent_x = tile_w + 2*radius.
+            from goworld_tpu.parallel.megaspace import (
+                MegaConfig, create_mega_state, make_mega_tick,
+            )
 
+            if mesh is None:
+                raise ValueError("megaspace=True requires a mesh")
             if mesh.devices.size != n_spaces:
                 raise ValueError(
                     f"mesh has {mesh.devices.size} devices but "
                     f"n_spaces={n_spaces}"
                 )
-            self.state = shard_state(self.state, mesh)
-            self._step = make_multi_tick(cfg, mesh, migrate_cap=migrate_cap)
+            from goworld_tpu.parallel.mesh import shard_state
+
+            tile_w = cfg.grid.extent_x - 2.0 * cfg.grid.radius
+            self.mega = MegaConfig(
+                cfg=cfg, n_dev=n_spaces, tile_w=tile_w,
+                halo_cap=halo_cap, migrate_cap=migrate_cap,
+            )
+            self.state = shard_state(
+                create_mega_state(self.mega, seed=seed), mesh
+            )
+            self._step = make_mega_tick(self.mega, mesh)
         else:
-            self._step = _make_local_tick(cfg)
+            self.state: SpaceState = create_multi_state(
+                cfg, n_spaces, seed=seed
+            )
+            if mesh is not None:
+                from goworld_tpu.parallel.mesh import shard_state
+                from goworld_tpu.parallel.step import make_multi_tick
+
+                if mesh.devices.size != n_spaces:
+                    raise ValueError(
+                        f"mesh has {mesh.devices.size} devices but "
+                        f"n_spaces={n_spaces}"
+                    )
+                self.state = shard_state(self.state, mesh)
+                self._step = make_multi_tick(
+                    cfg, mesh, migrate_cap=migrate_cap
+                )
+            else:
+                self._step = _make_local_tick(cfg)
 
         # host object model
         self.entities: dict[str, Entity] = {}
@@ -160,6 +194,7 @@ class World:
         self.post_q = PostQueue()
         self.crontab = Crontab()
         self.tick_count = 0
+        self.last_outputs = None  # device outputs of the most recent tick
 
         # staging buffers (flushed as vectorized scatters each tick)
         self._staged_spawn: list[tuple[int, int, dict]] = []
@@ -171,8 +206,11 @@ class World:
         # (src_shard, src_slot, dst_shard, eid) — device-migration requests
         self._staged_migrate: list[tuple[int, int, int, str]] = []
         self._migrate_tags: dict[int, tuple[str, int, int]] = {}
-        self._release_now: list[tuple[int, int]] = []
-        self._release_next: list[tuple[int, int]] = []
+        # (shard, slot, expected_owner_eid): release only applies if the
+        # slot still belongs to that entity — a device arrival may have
+        # re-occupied a host-despawned slot within the same step
+        self._release_now: list[tuple[int, int, str | None]] = []
+        self._release_next: list[tuple[int, int, str | None]] = []
 
         # attr journaling
         self._dirty_attr_entities: dict[str, list[AttrDelta]] = {}
@@ -248,7 +286,27 @@ class World:
         sp._type_desc = desc
         self._attach(sp, ids.gen_entity_id())
         aoi = desc.use_aoi if use_aoi is None else use_aoi
-        if aoi:
+        if desc.megaspace:
+            if self.mega is None:
+                raise RuntimeError(
+                    f"space type {type_name!r} declares megaspace=True but "
+                    "the World was not built with megaspace=True"
+                )
+            if any(s is not None for s in self._shard_space):
+                raise RuntimeError(
+                    "megaspace claims every shard: destroy other AOI "
+                    "spaces (or the previous megaspace) first"
+                )
+            for i in range(self.n_spaces):
+                self._shard_space[i] = sp.id
+            sp.is_mega = True
+        elif aoi:
+            if self.mega is not None:
+                raise RuntimeError(
+                    "a megaspace World hosts exactly one AOI space (the "
+                    "megaspace); register the space type with "
+                    "megaspace=True or use host-only spaces"
+                )
             try:
                 shard = self._shard_space.index(None)
             except ValueError:
@@ -391,14 +449,14 @@ class World:
             return
         src = e.space
         if (
-            src is not None and src.shard is not None
+            src is not None and e.shard is not None
             and target.shard is not None and e.slot is not None
         ):
             e.OnMigrateOut()
             self._staged_migrate.append(
-                (src.shard, e.slot, target.shard, e.id)
+                (e.shard, e.slot, target.shard, e.id)
             )
-            self._drop_staged_for(src.shard, e.slot)
+            self._drop_staged_for(e.shard, e.slot)
             src.members.discard(e.id)
             e.OnLeaveSpace(src)
             src.OnEntityLeaveSpace(e)
@@ -406,8 +464,9 @@ class World:
             # may address: slot ownership of the source row is kept (for
             # its leave events) in _staged_migrate/_migrate_tags, and
             # e.slot is re-pointed from the arrival records
-            e._migrating = (src.shard, e.slot, target.shard)
+            e._migrating = (e.shard, e.slot, target.shard)
             e.slot = None
+            e.shard = None
             e.space = target
             target.members.add(e.id)
             e._pending_pos = tuple(map(float, pos))
@@ -429,9 +488,10 @@ class World:
             return
         src.members.discard(e.id)
         if e.slot is not None:
-            self._drop_staged_for(src.shard, e.slot)
-            self._staged_despawn.append((src.shard, e.slot))
+            self._drop_staged_for(e.shard, e.slot)
+            self._staged_despawn.append((e.shard, e.slot))
             e.slot = None
+            e.shard = None
         self._cancel_migration(e)
         e.space = None
         e.OnLeaveSpace(src)
@@ -457,20 +517,55 @@ class World:
         ]
         self._staged_despawn.append((src_sh, src_sl))
 
+    def _tile_of(self, x: float) -> int:
+        """Owning tile (= shard) of a world x coordinate in megaspace mode
+        (device d owns x in [d*tile_w, (d+1)*tile_w))."""
+        import math
+
+        return max(
+            0, min(self.n_spaces - 1, int(math.floor(x / self.mega.tile_w)))
+        )
+
+    def _enter_space_or_park(
+        self, e: Entity, space: Space, pos, moving: bool = False
+    ) -> bool:
+        """Enter ``space``; if its shard is full, roll back the partial
+        membership and park the entity in the nil space instead of
+        crashing the world loop. Returns True on a real entry."""
+        try:
+            self._enter_space_local(e, space, pos, moving=moving)
+            return True
+        except RuntimeError:
+            # _alloc_slot raised AFTER membership was recorded: undo it
+            space.members.discard(e.id)
+            e.space = None
+            logger.error(
+                "respawn of %s failed (%s full); parked in nil space",
+                e.id, space.id,
+            )
+            if self.nil_space is not None:
+                self._enter_space_local(e, self.nil_space, pos)
+            return False
+
     def _enter_space_local(
         self, e: Entity, space: Space, pos, moving: bool = False
     ) -> None:
         e.space = space
         space.members.add(e.id)
-        if space.shard is not None:
-            slot = self._alloc_slot(space.shard, e.id)
+        if space.is_mega:
+            shard = self._tile_of(float(pos[0]))
+        else:
+            shard = space.shard
+        if shard is not None:
+            slot = self._alloc_slot(shard, e.id)
             e.slot = slot
+            e.shard = shard
             hot = [0.0] * self.cfg.attr_width
             for name, col in e._type_desc.hot_attrs.items():
                 v = e.attrs.get(name)
                 if isinstance(v, (int, float)) and not isinstance(v, bool):
                     hot[col] = float(v)
-            self._staged_spawn.append((space.shard, slot, dict(
+            self._staged_spawn.append((shard, slot, dict(
                 pos=tuple(map(float, pos)),
                 yaw=0.0,
                 type_id=e._type_desc.type_id,
@@ -511,7 +606,11 @@ class World:
                     self._move_space_host(m, self.nil_space, m.position)
                 else:
                     self._leave_space_host(m)
-            if e.shard is not None:
+            if e.is_mega:
+                self._shard_space = [
+                    None if s == e.id else s for s in self._shard_space
+                ]
+            elif e.shard is not None:
                 self._shard_space[e.shard] = None
             e.OnSpaceDestroy()
             self.spaces.pop(e.id, None)
@@ -532,19 +631,16 @@ class World:
     # staging entry points (called by Entity)
     # ==================================================================
     def stage_pos_set(self, e: Entity) -> None:
-        if e.slot is not None and e.space is not None \
-                and e.space.shard is not None:
-            self._staged_pos[(e.space.shard, e.slot)] = e
+        if e.slot is not None and e.shard is not None:
+            self._staged_pos[(e.shard, e.slot)] = e
 
     def set_moving(self, e: Entity, moving: bool) -> None:
-        if e.slot is not None and e.space is not None \
-                and e.space.shard is not None:
-            self._staged_moving.append((e.space.shard, e.slot, moving))
+        if e.slot is not None and e.shard is not None:
+            self._staged_moving.append((e.shard, e.slot, moving))
 
     def stage_hot(self, e: Entity, col: int, val: float) -> None:
-        if e.slot is not None and e.space is not None \
-                and e.space.shard is not None:
-            self._staged_hot.append((e.space.shard, e.slot, col, val))
+        if e.slot is not None and e.shard is not None:
+            self._staged_hot.append((e.shard, e.slot, col, val))
 
     def set_entity_client(self, e: Entity, client: GameClient | None) -> None:
         """Reference ``SetClient`` (``Entity.go:678-720``): bind/unbind and
@@ -553,9 +649,9 @@ class World:
         AllClients attrs)."""
         old = e.client
         e.client = client
-        if e.slot is not None and e.space is not None:
+        if e.slot is not None and e.shard is not None:
             self._staged_client.append((
-                e.space.shard, e.slot,
+                e.shard, e.slot,
                 client is not None,
                 client.gate_id if client is not None else -1,
             ))
@@ -824,6 +920,7 @@ class World:
         self.state, outs = self._step(self.state, inputs, self.policy)
         outs = jax.device_get(outs)
         self.op_stats["device_step_s"] = time.perf_counter() - t0
+        self.last_outputs = outs  # observability (tests, opmon, dryrun)
         self._process_outputs(outs)
         self._drain_attr_journals()
         self.post_q.tick()
@@ -877,6 +974,7 @@ class World:
                 # step's leave events, slot frees after processing
                 self._staged_despawn.append((sh_, sl_))
                 e.slot = new_slot
+                e.shard = dst
                 e._pending_pos = pend
                 # attr writes made during the migration window are only in
                 # the host tree; overwrite the repacked row's hot columns
@@ -954,7 +1052,10 @@ class World:
                 npc_moving=st.npc_moving.at[ix].set(False, mode="drop"),
                 dirty=st.dirty.at[ix].set(False, mode="drop"),
             )
-            self._release_now.extend(self._staged_despawn)
+            self._release_now.extend(
+                (sh_, sl_, self._slot_owner[sh_].get(sl_))
+                for sh_, sl_ in self._staged_despawn
+            )
             self._staged_despawn.clear()
 
         if self._staged_hot:
@@ -1061,12 +1162,64 @@ class World:
         )
 
     # -- output processing ----------------------------------------------
+    def _owner_subject(self, shard: int, j: int) -> Entity | None:
+        """Resolve a subject id from tick outputs: a local slot for normal
+        spaces, a GLOBAL gid (= tile * capacity + slot) in megaspace mode
+        where neighbors may live on adjacent tiles (ghosts)."""
+        if self.mega is not None:
+            tile, slot = divmod(j, self.cfg.capacity)
+            if tile >= self.n_spaces:
+                return None  # gid sentinel
+            return self._owner_entity(tile, slot)
+        return self._owner_entity(shard, j)
+
     def _process_outputs(self, outs) -> None:
         if self.mesh is not None:
             base = outs.base
         else:
             base = outs
         cfg = self.cfg
+        mega_pending = (
+            self._mega_collect_arrivals(outs) if self.mega is not None
+            else None
+        )
+        # Leaves before enters, across all shards: a megaspace border-hop
+        # emits leave(old slot, X) on the source tile and enter(new slot,
+        # X) on the destination tile for a subject X visible from both —
+        # both slots resolve to the same host entity, so enters must be
+        # applied last for the final interest set to be correct.
+        for shard in range(self.n_spaces):
+            ln = int(base.leave_n[shard])
+            if ln > cfg.leave_cap:
+                logger.warning(
+                    "shard %d leave overflow: %d > %d", shard, ln,
+                    cfg.leave_cap,
+                )
+            for w, j in zip(
+                np.asarray(base.leave_w[shard])[: min(ln, cfg.leave_cap)],
+                np.asarray(base.leave_j[shard])[: min(ln, cfg.leave_cap)],
+            ):
+                we = self._owner_entity(shard, int(w))
+                je = self._owner_subject(shard, int(j))
+                if we is None or je is None:
+                    continue
+                we.interested_in.discard(je.id)
+                je.interested_by.discard(we.id)
+                try:
+                    we.OnLeaveAOI(je)
+                except Exception:
+                    logger.exception("OnLeaveAOI failed")
+                if we.client is not None and not we.destroyed:
+                    we.client.send({
+                        "type": "destroy_entity", "eid": je.id,
+                        "is_player": False,
+                    })
+        if mega_pending is not None:
+            # re-point tile-migrated entities AFTER leave decode (their
+            # new slots may be rows host-despawned this tick, whose leave
+            # events reference the previous owner) but BEFORE enter
+            # decode (arrivals' enter events reference their new slots)
+            self._mega_apply_arrivals(mega_pending, outs)
         for shard in range(self.n_spaces):
             en = int(base.enter_n[shard])
             if en > cfg.enter_cap:
@@ -1079,7 +1232,7 @@ class World:
                 np.asarray(base.enter_j[shard])[: min(en, cfg.enter_cap)],
             ):
                 we = self._owner_entity(shard, int(w))
-                je = self._owner_entity(shard, int(j))
+                je = self._owner_subject(shard, int(j))
                 if we is None or je is None:
                     continue
                 we.interested_in.add(je.id)
@@ -1095,31 +1248,7 @@ class World:
                         "attrs": je.get_all_clients_data(),
                         "pos": list(je.position), "yaw": je.yaw,
                     })
-            ln = int(base.leave_n[shard])
-            if ln > cfg.leave_cap:
-                logger.warning(
-                    "shard %d leave overflow: %d > %d", shard, ln,
-                    cfg.leave_cap,
-                )
-            for w, j in zip(
-                np.asarray(base.leave_w[shard])[: min(ln, cfg.leave_cap)],
-                np.asarray(base.leave_j[shard])[: min(ln, cfg.leave_cap)],
-            ):
-                we = self._owner_entity(shard, int(w))
-                je = self._owner_entity(shard, int(j))
-                if we is None or je is None:
-                    continue
-                we.interested_in.discard(je.id)
-                je.interested_by.discard(we.id)
-                try:
-                    we.OnLeaveAOI(je)
-                except Exception:
-                    logger.exception("OnLeaveAOI failed")
-                if we.client is not None and not we.destroyed:
-                    we.client.send({
-                        "type": "destroy_entity", "eid": je.id,
-                        "is_player": False,
-                    })
+        for shard in range(self.n_spaces):
             # position sync records -> watching clients
             sn = min(int(base.sync_n[shard]), cfg.sync_cap)
             if sn:
@@ -1132,7 +1261,7 @@ class World:
                     per_gate: dict[int, list] = {}
                     for i, (w, j) in enumerate(zip(ws, js)):
                         we = self._owner_entity(shard, int(w))
-                        je = self._owner_entity(shard, int(j))
+                        je = self._owner_subject(shard, int(j))
                         if we is None or we.client is None or je is None:
                             continue
                         per_gate.setdefault(we.client.gate_id, []).append(
@@ -1148,7 +1277,7 @@ class World:
                 else:
                     for w, j, v in zip(ws, js, vs):
                         we = self._owner_entity(shard, int(w))
-                        je = self._owner_entity(shard, int(j))
+                        je = self._owner_subject(shard, int(j))
                         if we is None or we.client is None or je is None:
                             continue
                         we.client.send({
@@ -1171,19 +1300,141 @@ class World:
                             self._apply_device_attr(e, name, float(v))
                             break
 
-        if self.mesh is not None:
+        if self.mesh is not None and self.mega is None:
             self._process_arrivals(outs)
 
         # release slots whose leave events have now been processed
-        for shard, slot in self._release_now:
-            eid = self._slot_owner[shard].pop(slot, None)
-            self._free[shard].add(slot)
-            if eid is not None:
-                e = self.entities.get(eid)
-                if e is not None and e.destroyed:
-                    self.entities.pop(eid, None)
+        for shard, slot, expect in self._release_now:
+            cur = self._slot_owner[shard].get(slot)
+            if cur == expect:
+                self._slot_owner[shard].pop(slot, None)
+                self._free[shard].add(slot)
+            # forget destroyed host objects even when the slot was already
+            # re-occupied by an arrival (cur != expect): destroy_entity
+            # kept them alive only for this release point
+            if expect is not None:
+                e = self.entities.get(expect)
+                if e is not None and e.destroyed and e.slot is None:
+                    self.entities.pop(expect, None)
         self._release_now = self._release_next
         self._release_next = []
+
+    def _mega_collect_arrivals(self, outs) -> list[tuple]:
+        """Megaspace: read the device's autonomous tile-migration records
+        (old gid -> new slot). Unlike :meth:`_process_arrivals` there are
+        no host-staged tags — the device migrates from position and the
+        host follows (the dispatcher-table rewrite of
+        ``DispatcherService.go:877-891`` with the device as the source of
+        truth). Returns pending (new_shard, new_slot, old_sh, old_sl, eid)
+        re-pointings; applied by :meth:`_mega_apply_arrivals` BETWEEN the
+        leave and enter passes, because a new slot may be a row another
+        entity was host-despawned from this very tick — its leave events
+        must decode against the OLD owner, the arrival's enter events
+        against the NEW one."""
+        cap = self.cfg.capacity
+        pending: list[tuple] = []
+        for shard in range(self.n_spaces):
+            an = int(outs.arr_n[shard])
+            for t, s in zip(
+                np.asarray(outs.arr_tag[shard])[:an],
+                np.asarray(outs.arr_slot[shard])[:an],
+            ):
+                t, s = int(t), int(s)
+                if t < 0 or s < 0:
+                    continue
+                old_sh, old_sl = divmod(t, cap)
+                eid = self._slot_owner[old_sh].get(old_sl)
+                if eid is not None:
+                    pending.append((shard, s, old_sh, old_sl, eid))
+        mdem = np.asarray(outs.migrate_demand)
+        if (mdem > self.mega.migrate_cap).any():
+            logger.warning(
+                "megaspace migrate demand %d exceeds migrate_cap %d; "
+                "surplus entities linger on the wrong tile this tick",
+                int(mdem.max()), self.mega.migrate_cap,
+            )
+        hdem = np.asarray(outs.halo_demand)
+        if (hdem > self.mega.halo_cap).any():
+            logger.warning(
+                "megaspace halo demand %d exceeds halo_cap %d; some "
+                "cross-border neighbors invisible this tick",
+                int(hdem.max()), self.mega.halo_cap,
+            )
+        return pending
+
+    def _mega_apply_arrivals(self, pending: list[tuple], outs) -> None:
+        for shard, s, old_sh, old_sl, eid in pending:
+            # old slot keeps its owner mapping through THIS step's leave
+            # events; released at the end of _process_outputs
+            self._release_now.append((old_sh, old_sl, eid))
+            self._slot_owner[shard][s] = eid
+            self._free[shard].discard(s)
+            e = self.entities.get(eid)
+            if e is not None:
+                e.shard = shard
+                e.slot = s
+                if e.destroyed:
+                    # destroyed while the row hopped tiles: drop it
+                    self._staged_despawn.append((shard, s))
+                    e.slot = None
+                    e.shard = None
+        total_dropped = int(np.asarray(outs.migrate_dropped).sum())
+        if total_dropped:
+            self._mega_reconcile_dropped(total_dropped)
+
+    def _mega_reconcile_dropped(self, total_dropped: int) -> None:
+        """A border-crosser whose destination tile was full departed its
+        source row but never arrived (no record). Without reconciliation
+        its host object keeps addressing a dead row that a later arrival
+        may re-occupy — staged writes would then corrupt another entity.
+        Find the orphans by comparing host mappings against device
+        liveness (one [n_dev, N] readback, only on this alarmed path) and
+        respawn them from host knowledge."""
+        logger.error(
+            "megaspace dropped %d border-crossing entities (destination "
+            "tiles full); respawning from host state — raise capacity",
+            total_dropped,
+        )
+        snap = jax.device_get({
+            "alive": self.state.alive,
+            "moving": self.state.npc_moving,
+            "yaw": self.state.yaw,
+        })
+        alive = np.asarray(snap["alive"])
+        expected_dead = {
+            (sh_, sl_) for sh_, sl_, _ in self._release_now
+        } | set(self._staged_despawn)
+        orphans: list[tuple[int, int, str]] = []
+        for sh_ in range(self.n_spaces):
+            for sl_, eid in self._slot_owner[sh_].items():
+                if alive[sh_, sl_] or (sh_, sl_) in expected_dead:
+                    continue
+                e = self.entities.get(eid)
+                if e is None or e.shard != sh_ or e.slot != sl_:
+                    continue
+                orphans.append((sh_, sl_, eid))
+        for sh_, sl_, eid in orphans:
+            e = self.entities[eid]
+            last_pos = tuple(self.read_pos(sh_, sl_).tolist())
+            moving = bool(snap["moving"][sh_, sl_])
+            self._slot_owner[sh_].pop(sl_, None)
+            self._free[sh_].add(sl_)
+            e.slot = None
+            e.shard = None
+            if e.destroyed:
+                self.entities.pop(eid, None)
+                continue
+            sp = e.space
+            if sp is not None:
+                sp.members.discard(eid)
+                e.space = None
+                pos = e._pending_pos or last_pos
+                # the dead row's device-only state (heading, mover flag)
+                # travels with the respawn; velocity regenerates from the
+                # behavior on the next tick
+                if self._enter_space_or_park(e, sp, pos, moving=moving):
+                    e._pending_yaw = float(snap["yaw"][sh_, sl_])
+                    self.stage_pos_set(e)
 
     def _process_arrivals(self, outs) -> None:
         """Mesh path: re-point migrated entities from the arrival records
@@ -1205,11 +1456,12 @@ class World:
                 e = self.entities.get(eid)
                 # source slot: owner cleared after its leave events fire
                 # NEXT step (the departure happened inside this step)
-                self._release_next.append((src_sh, src_sl))
+                self._release_next.append((src_sh, src_sl, eid))
                 if e is None:
                     continue
                 e._migrating = None
                 e.slot = int(s)
+                e.shard = shard
                 self._slot_owner[shard][int(s)] = eid
                 self._free[shard].discard(int(s))
                 if e.destroyed:
@@ -1217,6 +1469,7 @@ class World:
                     # drop the arrived row
                     self._staged_despawn.append((shard, int(s)))
                     e.slot = None
+                    e.shard = None
                     continue
                 # the arrived row carries source-tick pos/attrs; stage the
                 # requested destination position and any attr writes made
@@ -1256,6 +1509,7 @@ class World:
                     self._free[src_sh].add(src_sl)
                     self.entities.pop(eid, None)
                 e.slot = None
+                e.shard = None
                 e._migrating = None
                 continue
             still_there = bool(np.asarray(self.state.alive[src_sh, src_sl]))
@@ -1270,6 +1524,7 @@ class World:
                 e.space = src
                 src.members.add(eid)
                 e.slot = src_sl
+                e.shard = src_sh
                 e._migrating = None
                 logger.warning("migration of %s deferred (pack cap)", eid)
                 if intended is not None and intended.id in self.spaces:
@@ -1291,26 +1546,14 @@ class World:
                 self._free[src_sh].add(src_sl)
                 tgt = e.space
                 e.slot = None
+                e.shard = None
                 e._migrating = None
                 if tgt is not None:
                     tgt.members.discard(eid)
                     e.space = None
-                    try:
-                        self._enter_space_local(
-                            e, tgt, e._pending_pos or (0.0, 0.0, 0.0)
-                        )
-                    except RuntimeError:
-                        # destination genuinely full: park in the nil
-                        # space rather than crashing the world loop
-                        logger.error(
-                            "respawn of %s failed (shard full); parked "
-                            "in nil space", eid,
-                        )
-                        if self.nil_space is not None:
-                            self._enter_space_local(
-                                e, self.nil_space,
-                                e._pending_pos or (0.0, 0.0, 0.0),
-                            )
+                    self._enter_space_or_park(
+                        e, tgt, e._pending_pos or (0.0, 0.0, 0.0)
+                    )
         self._migrate_tags = {}
 
     # ==================================================================

@@ -55,6 +55,9 @@ class EntityTypeDesc:
     is_persistent: bool = False
     use_aoi: bool = True
     aoi_distance: float = 0.0
+    # space types only: one instance spans ALL mesh shards as spatial
+    # tiles (parallel.megaspace) instead of pinning to a single shard
+    megaspace: bool = False
     client_attrs: frozenset = frozenset()
     all_client_attrs: frozenset = frozenset()
     persistent_attrs: frozenset = frozenset()
@@ -115,7 +118,10 @@ class Registry:
         persistent: bool = False,
         use_aoi: bool = True,
         aoi_distance: float = 0.0,
+        megaspace: bool = False,
     ) -> EntityTypeDesc:
+        if megaspace and not is_space:
+            raise ValueError(f"{name!r}: megaspace=True requires a space type")
         if name in self._types:
             raise ValueError(f"entity type {name!r} already registered")
         # attr declarations come from class attributes, mirroring the
@@ -145,6 +151,7 @@ class Registry:
             is_persistent=persistent or bool(persist),
             use_aoi=use_aoi,
             aoi_distance=aoi_distance,
+            megaspace=megaspace,
             client_attrs=frozenset(client),
             all_client_attrs=frozenset(all_clients),
             persistent_attrs=frozenset(persist),

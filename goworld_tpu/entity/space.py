@@ -30,11 +30,17 @@ class Space(Entity):
         super().__init__()
         self.members: set[str] = set()
         self.shard: int | None = None  # device shard index; None = host-only
+        # megaspace: this ONE logical space spans every shard of the mesh
+        # as spatial tiles (parallel.megaspace); members' device addresses
+        # are per-entity (Entity.shard = current tile), not per-space.
+        # Removes the reference's one-space-per-process population ceiling
+        # (SpaceService.go:14 caps spaces at 100 avatars in user code).
+        self.is_mega = False
         self.is_nil_space = False
 
     @property
     def use_aoi(self) -> bool:
-        return self.shard is not None
+        return self.shard is not None or self.is_mega
 
     def count_entities(self, type_name: str | None = None) -> int:
         """Reference ``CountEntities`` (``Space.go:273-281``)."""

@@ -55,9 +55,9 @@ def _device_snapshot(world: World) -> dict[str, np.ndarray]:
 def _pack_entity(world: World, e: Entity, snap: dict | None) -> dict:
     """Migrate-style record (``GetMigrateData``, ``Entity.go:1060-1101``)
     plus the space binding freeze needs and migrate doesn't."""
-    if snap is not None and e.slot is not None and e.space is not None \
-            and e.space.shard is not None and e._pending_pos is None:
-        shard, slot = e.space.shard, e.slot
+    if snap is not None and e.slot is not None and e.shard is not None \
+            and e._pending_pos is None:
+        shard, slot = e.shard, e.slot
         pos = [float(v) for v in snap["pos"][shard, slot]]
         yaw = float(snap["yaw"][shard, slot])
         moving = bool(snap["npc_moving"][shard, slot])
@@ -108,6 +108,7 @@ def freeze_world(world: World) -> dict:
                 "id": e.id,
                 "attrs": e.attrs.to_dict(),
                 "use_aoi": e.shard is not None,
+                "mega": e.is_mega,
                 "timers": world.timers.dump(list(e.timer_ids)),
             })
         else:
@@ -166,7 +167,16 @@ def restore_world(world: World, data: dict) -> None:
         sp: Space = desc.cls()
         sp._type_desc = desc
         world._attach(sp, sd["id"])
-        if sd.get("use_aoi", True):
+        if sd.get("mega"):
+            if world.mega is None:
+                raise RuntimeError(
+                    f"restore: space {sd['id']} is a megaspace but the "
+                    "World was not built with megaspace=True"
+                )
+            for i in range(world.n_spaces):
+                world._shard_space[i] = sp.id
+            sp.is_mega = True
+        elif sd.get("use_aoi", True):
             try:
                 shard = world._shard_space.index(None)
             except ValueError:
