@@ -147,7 +147,11 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
                 "mesh_devices=%d but only %d devices; single-device path",
                 gc.mesh_devices, len(jax.devices()),
             )
-    return World(wc, n_spaces=max(gc.n_spaces, 1), mesh=mesh, game_id=gid)
+    w = World(wc, n_spaces=max(gc.n_spaces, 1), mesh=mesh, game_id=gid)
+    # periodic persistence cadence (reference [gameN] save_interval,
+    # goworld.ini.sample:45; Entity.go:164-177)
+    w.save_interval = gc.save_interval
+    return w
 
 
 def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:

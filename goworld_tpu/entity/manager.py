@@ -131,6 +131,11 @@ class World:
         self.mesh = mesh
         self.policy = None  # MLPPolicy when cfg.behavior == 'mlp'
         self.mega = None    # MegaConfig when megaspace=True
+        if mesh is not None and mesh.devices.size != n_spaces:
+            raise ValueError(
+                f"mesh has {mesh.devices.size} devices but "
+                f"n_spaces={n_spaces}"
+            )
         if megaspace:
             # ONE logical space spans the whole mesh as x-interval tiles
             # (BASELINE config 4; SURVEY.md#5.7). cfg.grid is the TILE
@@ -141,11 +146,6 @@ class World:
 
             if mesh is None:
                 raise ValueError("megaspace=True requires a mesh")
-            if mesh.devices.size != n_spaces:
-                raise ValueError(
-                    f"mesh has {mesh.devices.size} devices but "
-                    f"n_spaces={n_spaces}"
-                )
             from goworld_tpu.parallel.mesh import shard_state
 
             tile_w = cfg.grid.extent_x - 2.0 * cfg.grid.radius
@@ -165,11 +165,6 @@ class World:
                 from goworld_tpu.parallel.mesh import shard_state
                 from goworld_tpu.parallel.step import make_multi_tick
 
-                if mesh.devices.size != n_spaces:
-                    raise ValueError(
-                        f"mesh has {mesh.devices.size} devices but "
-                        f"n_spaces={n_spaces}"
-                    )
                 self.state = shard_state(self.state, mesh)
                 self._step = make_multi_tick(
                     cfg, mesh, migrate_cap=migrate_cap
@@ -233,6 +228,13 @@ class World:
         self.remote_space_router: Callable[[Entity, str, tuple], None] | None \
             = None
         self.storage = None        # persistence backend (stage 6)
+        # periodic per-entity persistence (reference Entity.go:164-177
+        # setupSaveTimer + config save_interval, default 5 min): every
+        # persistent entity saves on this cadence, not only on destroy.
+        # Raw timers — never dumped into migrate/freeze data, exactly like
+        # the reference's addRawTimer.
+        self.save_interval: float = 300.0
+        self._save_timers: dict[str, int] = {}
         self.service_mgr = None    # sharded services (stage 5)
         # cluster notifications (the game server wires these)
         self.on_entity_created: Callable[[Entity], None] | None = None
@@ -254,6 +256,22 @@ class World:
         e.id = eid
         e.world = self
         e.attrs = make_root(lambda d, _e=e: self._on_attr_delta(_e, d))
+        self._setup_save_timer(e)
+
+    def _setup_save_timer(self, e: Entity) -> None:
+        """Schedule the periodic save for a persistent entity (reference
+        ``setupSaveTimer``, ``Entity.go:214-217``). Fires regardless of a
+        storage backend being configured yet — save_entity no-ops without
+        one, and picks it up once attached."""
+        if not e._type_desc.is_persistent or self.save_interval <= 0:
+            return
+        if e.id in self._save_timers:
+            return
+        self._save_timers[e.id] = self.timers.add(
+            self.save_interval,
+            lambda _e=e: None if _e.destroyed else self.save_entity(_e),
+            interval=self.save_interval,
+        )
 
     def create_nil_space(self) -> Space:
         """The per-game anchor space (reference ``space_ops.go:33-47``)."""
@@ -529,16 +547,16 @@ class World:
     def _enter_space_or_park(
         self, e: Entity, space: Space, pos, moving: bool = False
     ) -> bool:
-        """Enter ``space``; if its shard is full, roll back the partial
-        membership and park the entity in the nil space instead of
-        crashing the world loop. Returns True on a real entry."""
-        try:
-            self._enter_space_local(e, space, pos, moving=moving)
-            return True
-        except RuntimeError:
-            # _alloc_slot raised AFTER membership was recorded: undo it
-            space.members.discard(e.id)
-            e.space = None
+        """Enter ``space``; if its shard has no free slot, park the
+        entity in the nil space instead of crashing the world loop.
+        Capacity is checked up front — catching _alloc_slot's error
+        after the fact would have to unwind membership and user hooks
+        that already ran. Returns True on a real entry."""
+        if space.is_mega:
+            shard = self._tile_of(float(pos[0]))
+        else:
+            shard = space.shard
+        if shard is not None and not self._free[shard]:
             logger.error(
                 "respawn of %s failed (%s full); parked in nil space",
                 e.id, space.id,
@@ -546,6 +564,8 @@ class World:
             if self.nil_space is not None:
                 self._enter_space_local(e, self.nil_space, pos)
             return False
+        self._enter_space_local(e, space, pos, moving=moving)
+        return True
 
     def _enter_space_local(
         self, e: Entity, space: Space, pos, moving: bool = False
@@ -595,6 +615,9 @@ class World:
         for tid in list(e.timer_ids):
             self.timers.cancel(tid)
         e.timer_ids.clear()
+        save_tid = self._save_timers.pop(e.id, None)
+        if save_tid is not None:
+            self.timers.cancel(save_tid)
         if isinstance(e, Space):
             # evict members into the nil space (despawns their rows) so a
             # new space claiming this shard never sees ghost entities
@@ -863,6 +886,9 @@ class World:
         for tid in list(e.timer_ids):
             self.timers.cancel(tid)
         e.timer_ids.clear()
+        save_tid = self._save_timers.pop(e.id, None)
+        if save_tid is not None:
+            self.timers.cancel(save_tid)  # target game schedules its own
         e.client = None  # quiet detach; the data carries the binding
         e.destroyed = True
         self._leave_space_host(e)

@@ -1,0 +1,172 @@
+"""Async document-store wrapper for user game code.
+
+Reference being rebuilt: ``ext/db/gwmongo.go:31-355`` — an mgo session
+owned by one async group exposing ``InsertOne/FindOne/UpdateId/Count/...``
+per (db, collection), every reply posted back to the logic thread.
+
+DEVIATION NOTE: this environment bakes in neither a MongoDB server nor a
+driver, so the document API is implemented over a pluggable
+:class:`DocStore`. The default store keeps msgpack documents in any
+redis-compatible endpoint (including the in-process miniredis) under
+``doc:<db>:<collection>:<id>`` keys; a MongoDB-driver store can slot in
+behind the same two-method interface where one exists. The ASYNC API —
+what user code actually programs against — matches the reference's shape.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import msgpack
+
+from goworld_tpu.ext.db.resp import RespClient
+from goworld_tpu.utils.asyncwork import AsyncWorkers
+from goworld_tpu.utils import ids
+
+_GROUP = "_gwmongo"  # dedicated worker group (reference gwmongo.go:31)
+
+
+class DocStore:
+    """Minimal KV the document layer needs (swap for a real driver)."""
+
+    def put(self, key: str, blob: bytes) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes | None:
+        raise NotImplementedError
+
+    def delete(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def keys(self, prefix: str) -> list[str]:
+        raise NotImplementedError
+
+    def close(self) -> None: ...
+
+
+class RedisDocStore(DocStore):
+    def __init__(self, addr: str):
+        self._c = RespClient.from_addr(addr)
+
+    def put(self, key, blob):
+        self._c.set(key, blob)
+
+    def get(self, key):
+        return self._c.get(key)
+
+    def delete(self, key):
+        return bool(self._c.delete(key))
+
+    def keys(self, prefix):
+        return sorted(k.decode() for k in self._c.scan_keys(prefix + "*"))
+
+    def close(self):
+        self._c.close()
+
+
+def _matches(doc: dict, query: dict) -> bool:
+    """Flat equality filter (the subset the reference's examples use)."""
+    return all(doc.get(k) == v for k, v in query.items())
+
+
+class GWMongo:
+    """``m = GWMongo(store, workers)``; all callbacks get ``(res, err)``
+    on the logic thread."""
+
+    def __init__(self, store: DocStore, workers: AsyncWorkers):
+        self._store = store
+        self._workers = workers
+
+    @classmethod
+    def connect_redis(cls, addr: str, workers: AsyncWorkers) -> "GWMongo":
+        return cls(RedisDocStore(addr), workers)
+
+    @staticmethod
+    def _key(db: str, col: str, doc_id: str) -> str:
+        return f"doc:{db}:{col}:{doc_id}"
+
+    def _submit(self, job: Callable, cb: Callable | None) -> None:
+        self._workers.submit(_GROUP, job, cb)
+
+    # -- document ops (reference gwmongo.go Insert/Find/Update/Remove) ---
+    def insert_one(self, db: str, col: str, doc: dict,
+                   cb: Callable | None = None) -> str:
+        """Returns the document id immediately; the write lands async."""
+        doc_id = str(doc.get("_id") or ids.gen_entity_id())
+        doc = dict(doc, _id=doc_id)
+
+        def job():
+            self._store.put(
+                self._key(db, col, doc_id),
+                msgpack.packb(doc, use_bin_type=True),
+            )
+            return doc_id
+
+        self._submit(job, cb)
+        return doc_id
+
+    def find_id(self, db: str, col: str, doc_id: str,
+                cb: Callable) -> None:
+        def job():
+            raw = self._store.get(self._key(db, col, doc_id))
+            return None if raw is None else msgpack.unpackb(raw, raw=False)
+
+        self._submit(job, cb)
+
+    def find_one(self, db: str, col: str, query: dict,
+                 cb: Callable) -> None:
+        def job():
+            for key in self._store.keys(f"doc:{db}:{col}:"):
+                raw = self._store.get(key)
+                if raw is None:
+                    continue
+                doc = msgpack.unpackb(raw, raw=False)
+                if _matches(doc, query):
+                    return doc
+            return None
+
+        self._submit(job, cb)
+
+    def find_all(self, db: str, col: str, query: dict,
+                 cb: Callable) -> None:
+        def job():
+            out = []
+            for key in self._store.keys(f"doc:{db}:{col}:"):
+                raw = self._store.get(key)
+                if raw is None:
+                    continue
+                doc = msgpack.unpackb(raw, raw=False)
+                if _matches(doc, query):
+                    out.append(doc)
+            return out
+
+        self._submit(job, cb)
+
+    def update_id(self, db: str, col: str, doc_id: str, fields: dict,
+                  cb: Callable | None = None) -> None:
+        """Merge ``fields`` into the document (reference ``UpdateId`` with
+        a ``$set`` document)."""
+
+        def job():
+            key = self._key(db, col, doc_id)
+            raw = self._store.get(key)
+            doc = {} if raw is None else msgpack.unpackb(raw, raw=False)
+            doc.update(fields)
+            doc["_id"] = doc_id
+            self._store.put(key, msgpack.packb(doc, use_bin_type=True))
+
+        self._submit(job, cb)
+
+    def remove_id(self, db: str, col: str, doc_id: str,
+                  cb: Callable | None = None) -> None:
+        self._submit(
+            lambda: self._store.delete(self._key(db, col, doc_id)), cb
+        )
+
+    def count(self, db: str, col: str, cb: Callable) -> None:
+        self._submit(
+            lambda: len(self._store.keys(f"doc:{db}:{col}:")), cb
+        )
+
+    def close(self) -> None:
+        self._store.close()

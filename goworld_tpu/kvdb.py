@@ -3,10 +3,11 @@
 Reference being rebuilt: ``engine/kvdb`` (``kvdb.go:42-200``): a cluster-
 global KV store with pluggable backends, all ops running on a dedicated
 async group (``_kvdb``) with callbacks posted to the logic thread:
-``Get/Put/GetOrPut/GetRange/NextLargerKey``. Backends here: ``filesystem``
+``Get/Put/GetOrPut/GetRange/NextLargerKey``. Backends here: ``redis``
+(networked RESP, reference ``kvdb/backend/kvdbredis``), ``filesystem``
 (single msgpack file with ordered keys) and ``memory``; the interface
 matches the reference's backend iface (``kvdb/types/kvdb_types.go``) so
-redis/mongo backends can slot in where available.
+a mongo/redis-cluster backend can slot in where a driver exists.
 """
 
 from __future__ import annotations
@@ -90,11 +91,51 @@ class FilesystemKVDB(KVDBBackend):
             return [(k, self._d[k]) for k in keys]
 
 
+class RedisKVDB(KVDBBackend):
+    """Networked backend over RESP (reference ``kvdb/backend/kvdbredis``;
+    keys are namespaced ``kv:<key>`` so one redis db can host both the
+    kvdb and entity storage). Range queries sweep SCAN and filter/sort
+    client-side — the same shape the reference's redis backend uses
+    (redis has no ordered keyspace)."""
+
+    PREFIX = "kv:"
+
+    def __init__(self, addr: str):
+        from goworld_tpu.ext.db.resp import RespClient
+
+        self._c = RespClient.from_addr(addr)
+
+    def get(self, key):
+        raw = self._c.get(self.PREFIX + key)
+        return None if raw is None else raw.decode()
+
+    def put(self, key, val):
+        self._c.set(self.PREFIX + key, val)
+
+    def get_range(self, begin, end):
+        pre = self.PREFIX
+        keys = sorted(
+            k.decode()[len(pre):] for k in self._c.scan_keys(pre + "*")
+        )
+        lo = bisect.bisect_left(keys, begin)
+        hi = bisect.bisect_left(keys, end)
+        sel = keys[lo:hi]
+        vals = self._c.mget([pre + k for k in sel])  # one round-trip
+        return [
+            (k, v.decode()) for k, v in zip(sel, vals) if v is not None
+        ]
+
+    def close(self):
+        self._c.close()
+
+
 def open_kvdb_backend(kind: str, location: str = "") -> KVDBBackend:
     if kind == "memory":
         return MemoryKVDB()
     if kind == "filesystem":
         return FilesystemKVDB(location or "kvdb_data.mp")
+    if kind == "redis":
+        return RedisKVDB(location or "127.0.0.1:6379")
     raise ValueError(f"unknown kvdb backend {kind!r}")
 
 

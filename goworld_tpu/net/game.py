@@ -432,9 +432,9 @@ class GameServer:
                 if e is not None and e.client is not None \
                         and e.client.client_id == client_id:
                     e.client = None  # connection already gone: quiet unbind
-                    if e.slot is not None and e.space is not None:
+                    if e.slot is not None and e.shard is not None:
                         w._staged_client.append(
-                            (e.space.shard, e.slot, False, -1)
+                            (e.shard, e.slot, False, -1)
                         )
                     e.OnClientDisconnected()
             return
@@ -502,9 +502,9 @@ class GameServer:
             for e in list(w.entities.values()):
                 if e.client is not None and e.client.gate_id == gate_id:
                     e.client = None
-                    if e.slot is not None and e.space is not None:
+                    if e.slot is not None and e.shard is not None:
                         w._staged_client.append(
-                            (e.space.shard, e.slot, False, -1)
+                            (e.shard, e.slot, False, -1)
                         )
                     e.OnClientDisconnected()
             return

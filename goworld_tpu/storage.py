@@ -6,11 +6,15 @@ worker consumes a queue of save/load/exists/list requests against an
 (entity data must not be lost), callbacks are posted back to the logic
 thread, and a queue-length monitor warns on backlog (``:102-110``).
 
-Backends here: ``filesystem`` (one directory per entity type, one msgpack
-file per entity — the structural analog of the reference's one-Mongo-
-collection-per-type, ``backend/mongodb/mongodb.go:27-136``) and ``memory``
-(tests). MongoDB itself is not available in this environment; the backend
-interface matches so one can be added without touching this module.
+Backends here: ``redis`` (networked, RESP wire protocol — the key scheme
+mirrors the reference's one-Mongo-collection-per-type layout,
+``backend/mongodb/mongodb.go:27-136``; works against any redis-compatible
+endpoint including the in-process test server
+:mod:`goworld_tpu.ext.db.miniredis`), ``filesystem`` (one directory per
+entity type, one msgpack file per entity), and ``memory`` (tests).
+MongoDB itself is not available in this environment; the backend
+interface matches so a driver-backed one can slot in without touching
+this module.
 """
 
 from __future__ import annotations
@@ -101,11 +105,53 @@ class FilesystemStorage(EntityStorageBackend):
         return [f[:-3] for f in os.listdir(d) if f.endswith(".mp")]
 
 
+class RedisStorage(EntityStorageBackend):
+    """Networked backend over the RESP wire protocol (reference persists
+    to MongoDB, one collection per type with ``_id`` = EntityID,
+    ``backend/mongodb/mongodb.go:27-136``; the key scheme here is the
+    redis equivalent: ``gw:<type>:<eid>`` -> msgpack attr blob). Works
+    against any redis-compatible endpoint, including the in-process
+    :mod:`goworld_tpu.ext.db.miniredis`."""
+
+    PREFIX = "gw"
+
+    def __init__(self, addr: str):
+        from goworld_tpu.ext.db.resp import RespClient
+
+        self._c = RespClient.from_addr(addr)
+
+    def _key(self, type_name: str, eid: str) -> str:
+        return f"{self.PREFIX}:{type_name}:{eid}"
+
+    def write(self, type_name, eid, data):
+        self._c.set(self._key(type_name, eid),
+                    msgpack.packb(data, use_bin_type=True))
+
+    def read(self, type_name, eid):
+        raw = self._c.get(self._key(type_name, eid))
+        return None if raw is None else msgpack.unpackb(raw, raw=False)
+
+    def exists(self, type_name, eid):
+        return self._c.exists(self._key(type_name, eid))
+
+    def list_entity_ids(self, type_name):
+        pre = f"{self.PREFIX}:{type_name}:"
+        return sorted(
+            k.decode()[len(pre):]
+            for k in self._c.scan_keys(pre + "*")
+        )
+
+    def close(self):
+        self._c.close()
+
+
 def open_backend(kind: str, location: str = "") -> EntityStorageBackend:
     if kind == "memory":
         return MemoryStorage()
     if kind == "filesystem":
         return FilesystemStorage(location or "entity_storage")
+    if kind == "redis":
+        return RedisStorage(location or "127.0.0.1:6379")
     raise ValueError(f"unknown storage backend {kind!r}")
 
 
