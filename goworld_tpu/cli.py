@@ -336,12 +336,23 @@ def cmd_run_gate(gateid: int, configfile: str | None) -> int:
     cfg = config_mod.load(configfile)
     gc = cfg.gates.get(gateid) or config_mod.GateConfig()
 
+    ssl_ctx = None
+    if gc.encrypt:
+        from goworld_tpu.net import transport
+
+        cert = gc.tls_cert or f"gate{gateid}_tls.crt"
+        key = gc.tls_key or f"gate{gateid}_tls.key"
+        transport.ensure_self_signed_cert(cert, key)
+        ssl_ctx = transport.server_ssl_context(cert, key)
+
     async def main() -> None:
         svc = GateService(
             gateid, gc.host, gc.port, cfg.dispatcher_addrs(),
             ws_port=gc.ws_port,
             heartbeat_timeout=gc.heartbeat_timeout,
             position_sync_interval_ms=gc.position_sync_interval_ms,
+            compress=gc.compress,
+            ssl_context=ssl_ctx,
         )
         task = asyncio.ensure_future(svc.serve())
         await svc.started.wait()

@@ -49,7 +49,13 @@ class GateConfig:
     host: str = "127.0.0.1"
     port: int = 15000
     ws_port: int = 0          # 0 = no websocket listener
+    # client-edge transport (reference goworld.ini.sample compress/encrypt
+    # flags; ClientProxy.go:38-53). encrypt=TLS on the TCP listener; the
+    # cert/key are generated self-signed on first use when paths are empty.
     compress: bool = False
+    encrypt: bool = False
+    tls_cert: str = ""
+    tls_key: str = ""
     heartbeat_timeout: float = 0.0  # 0 = disabled
     position_sync_interval_ms: int = 100
     log_file: str = ""

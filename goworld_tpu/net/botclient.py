@@ -113,15 +113,20 @@ class BotClient:
     """One bot: connects, waits for its player entity, random-walks.
 
     ``ws=True`` connects through the gate's websocket listener instead of
-    TCP (the reference test_client's ``-ws`` flag)."""
+    TCP (the reference test_client's ``-ws`` flag); ``compress``/``tls``
+    mirror the gate's client-edge transport flags (the reference client
+    reads the same ini the gate does)."""
 
     def __init__(self, host: str, port: int, *, bot_id: int = 0,
                  strict: bool = False, move_interval: float = 0.1,
                  speed: float = 5.0, seed: int | None = None,
-                 ws: bool = False):
+                 ws: bool = False, compress: bool = False,
+                 tls: bool = False):
         self.host = host
         self.port = port
         self.ws = ws
+        self.compress = compress
+        self.tls = tls
         self.bot_id = bot_id
         self.strict = strict
         self.move_interval = move_interval
@@ -146,8 +151,16 @@ class BotClient:
             )
             self.conn = WSPacketConnection(sock)
             return
-        reader, writer = await asyncio.open_connection(self.host, self.port)
-        self.conn = PacketConnection(reader, writer)
+        ssl_ctx = None
+        if self.tls:
+            from goworld_tpu.net.transport import client_ssl_context
+
+            ssl_ctx = client_ssl_context(verify=False)
+        reader, writer = await asyncio.open_connection(
+            self.host, self.port, ssl=ssl_ctx
+        )
+        self.conn = PacketConnection(reader, writer,
+                                     compress=self.compress)
 
     async def run(self, duration: float = 5.0) -> None:
         """Connect and play for ``duration`` seconds."""
@@ -271,10 +284,13 @@ class BotClient:
 
 
 async def run_swarm(host: str, port: int, n_bots: int, duration: float,
-                    *, strict: bool = True) -> list[BotClient]:
+                    *, strict: bool = True, compress: bool = False,
+                    tls: bool = False) -> list[BotClient]:
     """Run N bots concurrently (reference ``test_client -N``)."""
     bots = [
-        BotClient(host, port, bot_id=i, strict=strict) for i in range(n_bots)
+        BotClient(host, port, bot_id=i, strict=strict, compress=compress,
+                  tls=tls)
+        for i in range(n_bots)
     ]
     await asyncio.gather(*(b.run(duration) for b in bots))
     return bots

@@ -100,11 +100,19 @@ class GateService:
         ws_port: int = 0,
         heartbeat_timeout: float = 0.0,
         position_sync_interval_ms: int = 100,
+        compress: bool = False,
+        ssl_context=None,
     ):
         self.gate_id = gate_id
         self.host = host
         self.port = port
         self.ws_port = ws_port
+        # client-edge transport options (reference ClientProxy.go:38-53
+        # snappy + TLS; see net/transport.py for the codec choice and the
+        # KCP deviation note). Compression/TLS apply to the TCP listener;
+        # WebSocket clients get compression from the WS layer itself.
+        self.compress = compress
+        self.ssl_context = ssl_context
         self.heartbeat_timeout = heartbeat_timeout
         self.sync_interval = position_sync_interval_ms / 1000.0
         self.clients: dict[str, ClientProxy] = {}
@@ -129,7 +137,8 @@ class GateService:
     async def serve(self) -> None:
         self.cluster.start()
         self._server = await asyncio.start_server(
-            self._handle_client, self.host, self.port
+            self._handle_client, self.host, self.port,
+            ssl=self.ssl_context,
         )
         tasks = [asyncio.ensure_future(self._flush_loop())]
         if self.heartbeat_timeout > 0:
@@ -155,7 +164,7 @@ class GateService:
 
     # -- client side -----------------------------------------------------
     async def _handle_client(self, reader, writer) -> None:
-        conn = PacketConnection(reader, writer)
+        conn = PacketConnection(reader, writer, compress=self.compress)
         cp = ClientProxy(conn)
         cp.last_heartbeat = asyncio.get_event_loop().time()
         self.clients[cp.client_id] = cp

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import asyncio
 import struct
+import zlib
 from typing import Any
 
 import msgpack
@@ -176,22 +177,42 @@ def frame(p: Packet) -> bytes:
 class PacketConnection:
     """Framed packet IO over an asyncio stream (reference
     ``PacketConnection.go``). Writes are buffered by the transport; reads
-    return (msgtype, Packet-positioned-after-msgtype)."""
+    return (msgtype, Packet-positioned-after-msgtype).
+
+    ``compress=True`` runs one zlib stream per direction over the
+    connection (level 1, ``Z_SYNC_FLUSH`` at packet boundaries) — the
+    cheap-stream-compression role snappy plays in the reference's client
+    edge (``ClientProxy.go:38-53``; python-snappy is not in this
+    environment). A shared per-connection dictionary keeps the dominant
+    small packets (heartbeats, 34-byte sync records) from inflating the
+    way per-packet compression would. Both ends must agree, exactly like
+    the reference's ini flag."""
 
     def __init__(
         self,
         reader: asyncio.StreamReader,
         writer: asyncio.StreamWriter,
+        *,
+        compress: bool = False,
     ):
         self.reader = reader
         self.writer = writer
+        self.compress = compress
+        if compress:
+            self._comp = zlib.compressobj(1)
+            self._decomp = zlib.decompressobj()
         self._closed = False
 
     def send(self, p: Packet, release: bool = True) -> None:
         if self._closed:
             return
         try:
-            self.writer.write(frame(p))
+            if self.compress:
+                payload = self._comp.compress(bytes(p.buf)) \
+                    + self._comp.flush(zlib.Z_SYNC_FLUSH)
+                self.writer.write(_SIZE_FMT.pack(len(payload)) + payload)
+            else:
+                self.writer.write(frame(p))
         except (ConnectionError, RuntimeError):
             self._closed = True
         if release:
@@ -209,7 +230,22 @@ class PacketConnection:
         (size,) = _SIZE_FMT.unpack(hdr)
         if size < 2 or size > MAX_PAYLOAD_LENGTH:
             raise ConnectionError(f"bad packet size {size}")
-        body = await self.reader.readexactly(size)
+        body: bytes | bytearray = await self.reader.readexactly(size)
+        if self.compress:
+            try:
+                # max_length caps output BEFORE allocation: a crafted
+                # high-ratio stream (decompression bomb) hits the limit
+                # and leaves unconsumed input instead of eating RAM
+                body = self._decomp.decompress(
+                    bytes(body), MAX_PAYLOAD_LENGTH + 1
+                )
+            except zlib.error as exc:
+                raise ConnectionError(f"bad compressed packet: {exc}")
+            if self._decomp.unconsumed_tail \
+                    or len(body) > MAX_PAYLOAD_LENGTH:
+                raise ConnectionError("decompressed packet too large")
+            if len(body) < 2:
+                raise ConnectionError("short decompressed packet")
         p = Packet(body)
         msgtype = p.read_u16()
         return msgtype, p

@@ -30,7 +30,8 @@ class ClusterHarness:
                  desired_games: int = 1, host: str = "127.0.0.1",
                  heartbeat_timeout: float = 0.0,
                  position_sync_interval_ms: int = 20,
-                 with_ws: bool = False):
+                 with_ws: bool = False, compress: bool = False,
+                 tls_dir: str | None = None):
         self.host = host
         self.n_dispatchers = n_dispatchers
         self.n_gates = n_gates
@@ -38,6 +39,10 @@ class ClusterHarness:
         self.heartbeat_timeout = heartbeat_timeout
         self.position_sync_interval_ms = position_sync_interval_ms
         self.with_ws = with_ws
+        # client-edge transport (reference goworld_actions.ini runs CI
+        # with compression+encryption ON)
+        self.compress = compress
+        self.tls_dir = tls_dir  # directory for the self-signed pair
         self.dispatchers: list[DispatcherService] = []
         self.gates: list[GateService] = []
         self.dispatcher_addrs: list[tuple[str, int]] = []
@@ -84,11 +89,23 @@ class ClusterHarness:
                 with socket.socket() as s:
                     s.bind((self.host, 0))
                     ws_port = s.getsockname()[1]
+            ssl_ctx = None
+            if self.tls_dir is not None:
+                import os
+
+                from goworld_tpu.net import transport
+
+                cert = os.path.join(self.tls_dir, "gate_tls.crt")
+                key = os.path.join(self.tls_dir, "gate_tls.key")
+                transport.ensure_self_signed_cert(cert, key)
+                ssl_ctx = transport.server_ssl_context(cert, key)
             g = GateService(
                 i + 1, self.host, 0, list(self.dispatcher_addrs),
                 ws_port=ws_port,
                 heartbeat_timeout=self.heartbeat_timeout,
                 position_sync_interval_ms=self.position_sync_interval_ms,
+                compress=self.compress,
+                ssl_context=ssl_ctx,
             )
             self.gates.append(g)
             self._tasks.append(asyncio.ensure_future(g.serve()))
