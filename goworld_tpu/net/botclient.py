@@ -191,8 +191,17 @@ class BotClient:
             is_player = pkt.read_bool()
             x, y, z, yaw = (pkt.read_f32() for _ in range(4))
             attrs = pkt.read_data()
-            if self.strict and eid in self.entities:
-                self.errors.append(f"duplicate create_entity {eid}")
+            prev = self.entities.get(eid)
+            if prev is not None:
+                # re-create is an UPSERT, matching the reference client
+                # (ClientBot.go:240-300 overwrites silently): interest is
+                # re-announced after a hot reload re-enters AOI. A TYPE
+                # change for a live id is still a real inconsistency.
+                if self.strict and prev.type_name != type_name:
+                    self.errors.append(
+                        f"create_entity {eid} changed type "
+                        f"{prev.type_name} -> {type_name}"
+                    )
             me = MirrorEntity(eid, type_name, is_player, attrs, (x, y, z),
                               yaw)
             self.entities[eid] = me

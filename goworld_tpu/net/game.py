@@ -117,12 +117,11 @@ class GameServer:
         started.wait()
 
     def stop(self) -> None:
+        from goworld_tpu.net.loops import drain_and_close
+
         self._stop.set()
-        if self._loop is not None:
-            self._loop.call_soon_threadsafe(self.cluster.stop)
-            self._loop.call_soon_threadsafe(self._loop.stop)
-        if self._net_thread is not None:
-            self._net_thread.join(timeout=5)
+        drain_and_close(self._loop, self._net_thread,
+                        pre_stop=self.cluster.stop)
 
     def serve_forever(self) -> None:
         """The logic loop: drain packets, tick the world, repeat."""

@@ -120,14 +120,6 @@ class ClusterHarness:
         return asyncio.run_coroutine_threadsafe(coro, self.loop)
 
     def stop(self) -> None:
-        if self.loop is None:
-            return
+        from goworld_tpu.net.loops import drain_and_close
 
-        def _shutdown() -> None:
-            for t in self._tasks:
-                t.cancel()
-            self.loop.stop()
-
-        self.loop.call_soon_threadsafe(_shutdown)
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        drain_and_close(self.loop, self._thread)

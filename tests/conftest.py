@@ -23,3 +23,10 @@ if "jax" in sys.modules:
     jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "soak: long-running load tests (the reload-under-load soak)",
+    )

@@ -125,7 +125,11 @@ def test_unity_demo_combat(ex_world):
     for _ in range(3):
         w.tick()
     assert player.space is sp
-    # player sees monsters via AOI
+    # stand next to a monster (spawn positions are random; the corner
+    # cases can exceed the AOI radius) — the player must then see it
+    player.set_position(monsters[0].position)
+    for _ in range(2):
+        w.tick()
     assert any(w.entities[e].type_name == "Monster"
                for e in player.interested_in)
 
