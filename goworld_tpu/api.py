@@ -121,6 +121,8 @@ def _parse_args(argv: list[str]):
     ap.add_argument("-gid", type=int, default=1)
     ap.add_argument("-configfile", default=None)
     ap.add_argument("-restore", action="store_true")
+    ap.add_argument("-d", dest="daemon", action="store_true",
+                    help="daemonize (reference binutil -d, game.go:50-59)")
     ap.add_argument("-logfile", default="")
     ap.add_argument("-loglevel", default="")
     return ap.parse_args(argv)
@@ -158,6 +160,10 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
     """Boot this game process (reference ``goworld.Run``)."""
     global _rt
     args = _parse_args(sys.argv[1:] if argv is None else argv)
+    if args.daemon:
+        from goworld_tpu.utils.daemon import daemonize
+
+        daemonize(args.logfile or f"game{args.gid}.log")
     if args.logfile or args.loglevel:
         log.setup(f"game{args.gid}", level=args.loglevel or "info",
                   logfile=args.logfile or None)

@@ -32,6 +32,7 @@ import sys
 import time
 
 from goworld_tpu import config as config_mod
+from goworld_tpu.utils import log
 from goworld_tpu.utils.consts import (
     FREEZE_EXIT_CODE,
     SUPERVISOR_STARTED_TAG,
@@ -304,9 +305,12 @@ def cmd_status(server_dir: str) -> int:
 # =======================================================================
 # in-process runners (the spawned dispatcher/gate processes)
 # =======================================================================
-def cmd_run_dispatcher(dispid: int, configfile: str | None) -> int:
+def cmd_run_dispatcher(dispid: int, configfile: str | None,
+                       logfile: str = "") -> int:
     from goworld_tpu.net.dispatcher import DispatcherService
 
+    if logfile:
+        log.setup(f"dispatcher{dispid}", logfile=logfile)
     cfg = config_mod.load(configfile)
     dc = cfg.dispatchers.get(dispid) or config_mod.DispatcherConfig()
 
@@ -330,9 +334,12 @@ def cmd_run_dispatcher(dispid: int, configfile: str | None) -> int:
     return 0
 
 
-def cmd_run_gate(gateid: int, configfile: str | None) -> int:
+def cmd_run_gate(gateid: int, configfile: str | None,
+                 logfile: str = "") -> int:
     from goworld_tpu.net.gate import GateService
 
+    if logfile:
+        log.setup(f"gate{gateid}", logfile=logfile)
     cfg = config_mod.load(configfile)
     gc = cfg.gates.get(gateid) or config_mod.GateConfig()
 
@@ -361,11 +368,24 @@ def cmd_run_gate(gateid: int, configfile: str | None) -> int:
         loop = asyncio.get_event_loop()
         for s in (signal.SIGTERM, signal.SIGINT):
             loop.add_signal_handler(s, stop.set)
-        await stop.wait()
+        stop_task = asyncio.ensure_future(stop.wait())
+        # serve() returns early when the gate self-terminates on
+        # dispatcher loss (gate.go:137-143) or crashes; exit nonzero
+        # either way so the supervisor restarts us
+        await asyncio.wait(
+            [stop_task, task], return_when=asyncio.FIRST_COMPLETED
+        )
+        stop_task.cancel()
+        if task.done() and not task.cancelled() \
+                and task.exception() is not None:
+            logger = log.get("gate")
+            logger.error("gate%d serve crashed", gateid,
+                         exc_info=task.exception())
+            return 1
         task.cancel()
+        return 1 if svc.terminated.is_set() else 0
 
-    asyncio.run(main())
-    return 0
+    return asyncio.run(main())
 
 
 # =======================================================================
@@ -383,12 +403,24 @@ def main(argv: list[str] | None = None) -> int:
     pd = sub.add_parser("run-dispatcher")
     pd.add_argument("-dispid", type=int, default=1)
     pd.add_argument("-configfile", default=None)
+    pd.add_argument("-d", dest="daemon", action="store_true",
+                    help="daemonize (reference binutil -d)")
+    pd.add_argument("-logfile", default="")
     pg = sub.add_parser("run-gate")
     pg.add_argument("-gateid", type=int, default=1)
     pg.add_argument("-configfile", default=None)
+    pg.add_argument("-d", dest="daemon", action="store_true",
+                    help="daemonize (reference binutil -d)")
+    pg.add_argument("-logfile", default="")
     sub.add_parser("sample-config")
 
     args = ap.parse_args(argv)
+    if getattr(args, "daemon", False):
+        from goworld_tpu.utils.daemon import daemonize
+
+        role = "dispatcher" if args.cmd == "run-dispatcher" else "gate"
+        rid = args.dispid if role == "dispatcher" else args.gateid
+        daemonize(args.logfile or f"{role}{rid}.log")
     if args.cmd == "start":
         return cmd_start(args.server_dir)
     if args.cmd == "stop":
@@ -400,9 +432,11 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "status":
         return cmd_status(args.server_dir)
     if args.cmd == "run-dispatcher":
-        return cmd_run_dispatcher(args.dispid, args.configfile)
+        return cmd_run_dispatcher(args.dispid, args.configfile,
+                                  "" if args.daemon else args.logfile)
     if args.cmd == "run-gate":
-        return cmd_run_gate(args.gateid, args.configfile)
+        return cmd_run_gate(args.gateid, args.configfile,
+                            "" if args.daemon else args.logfile)
     if args.cmd == "sample-config":
         print(config_mod.dumps_sample())
         return 0

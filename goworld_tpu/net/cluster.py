@@ -63,6 +63,10 @@ class DispatcherConn:
         self._pending: list[bytes] = []
         self.connected = asyncio.Event()
         self._stopped = False
+        # fired on every connection loss (before the reconnect sleep);
+        # the gate uses this to terminate instead of reconnecting
+        # (reference gate.go:137-143)
+        self.on_disconnect = None
 
     async def run(self) -> None:
         """The assureConnected/serve loop; returns only when stopped."""
@@ -93,6 +97,8 @@ class DispatcherConn:
                     "lost dispatcher%d at %s; reconnecting",
                     self.index, self.addr,
                 )
+                if self.on_disconnect is not None:
+                    self.on_disconnect(self.index)
                 await asyncio.sleep(self.reconnect_delay)
 
     def send(self, p: Packet, release: bool = True) -> None:

@@ -103,6 +103,7 @@ class DispatcherService:
         # per-game re-batched upstream sync records, flushed on a short
         # timer like the reference's 5ms tick (DispatcherService.go:797-808)
         self._sync_pending: dict[int, bytearray] = {}
+        self.open_conns: set[PacketConnection] = set()
         self.started = asyncio.Event()
 
     # ------------------------------------------------------------------
@@ -146,6 +147,7 @@ class DispatcherService:
     # ------------------------------------------------------------------
     async def _handle_conn(self, reader, writer) -> None:
         conn = PacketConnection(reader, writer)
+        self.open_conns.add(conn)
         role: tuple[str, int] | None = None  # ("game"|"gate", id)
         try:
             while True:
@@ -155,9 +157,19 @@ class DispatcherService:
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
+            self.open_conns.discard(conn)
             await conn.close()
             if role is not None:
                 self._on_disconnect(role)
+
+    async def kill(self) -> None:
+        """Hard-stop: close the listener and sever every live connection
+        (crash simulation for failure-path tests; also the tail of a
+        graceful shutdown)."""
+        if self._server is not None:
+            self._server.close()
+        for conn in list(self.open_conns):
+            await conn.close()
 
     # ------------------------------------------------------------------
     def _handle_packet(self, conn, role, msgtype: int, pkt: Packet):

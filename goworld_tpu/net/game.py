@@ -223,7 +223,13 @@ class GameServer:
         if self._loop is None:
             conn.send(p)
             return
-        self._loop.call_soon_threadsafe(conn.send, p)
+        try:
+            self._loop.call_soon_threadsafe(conn.send, p)
+        except RuntimeError:
+            # loop closed mid-stop (SIGTERM lands between ticks): the
+            # interrupted serve iteration must still unwind to the
+            # hard-exit path, not die on a send
+            pass
 
     # ==================================================================
     # world -> cluster edges (logic thread)

@@ -102,6 +102,7 @@ class GateService:
         position_sync_interval_ms: int = 100,
         compress: bool = False,
         ssl_context=None,
+        exit_on_dispatcher_loss: bool = True,
     ):
         self.gate_id = gate_id
         self.host = host
@@ -120,6 +121,15 @@ class GateService:
         self.cluster = DispatcherCluster(
             dispatcher_addrs, self._on_dispatcher_packet, self._handshake
         )
+        # a gate that lost a dispatcher is routing into a black hole:
+        # the reference kills itself and lets the supervisor restart it
+        # (gate.go:137-143). Harness/tests may opt out to exercise
+        # reconnect paths.
+        self.exit_on_dispatcher_loss = exit_on_dispatcher_loss
+        self.terminated = asyncio.Event()
+        if exit_on_dispatcher_loss:
+            for c in self.cluster.conns:
+                c.on_disconnect = self._on_dispatcher_lost
         # per-dispatcher pending upstream sync records
         # (reference GateService.go:402-429)
         self._sync_pending: dict[int, bytearray] = defaultdict(bytearray)
@@ -149,13 +159,30 @@ class GateService:
         self.started.set()
         logger.info("gate%d listening on %s:%d", self.gate_id, self.host,
                     self.port)
+        serve_task = asyncio.ensure_future(self._server.serve_forever())
+        term_task = asyncio.ensure_future(self.terminated.wait())
         try:
-            async with self._server:
-                await self._server.serve_forever()
+            await asyncio.wait(
+                [serve_task, term_task],
+                return_when=asyncio.FIRST_COMPLETED,
+            )
         finally:
+            serve_task.cancel()
+            term_task.cancel()
             for t in tasks:
                 t.cancel()
+            for cp in list(self.clients.values()):
+                await cp.conn.close()
+            self._server.close()
             self.cluster.stop()
+
+    def _on_dispatcher_lost(self, didx: int) -> None:
+        logger.error(
+            "gate%d: dispatcher%d connection lost; terminating "
+            "(reference gate.go:137-143 — a gate without its dispatchers "
+            "is a black hole for clients)", self.gate_id, didx,
+        )
+        self.terminated.set()
 
     @property
     def bound_port(self) -> int:

@@ -52,12 +52,29 @@ def drain_and_close(
         finally:
             loop.stop()
 
-    coro = _drain()
-    try:
-        asyncio.run_coroutine_threadsafe(coro, loop)
-    except RuntimeError:
-        coro.close()  # loop already stopped/closing
-    if thread is not None:
-        thread.join(timeout=timeout)
+    if loop.is_running():
+        coro = _drain()
+        try:
+            asyncio.run_coroutine_threadsafe(coro, loop)
+        except RuntimeError:
+            coro.close()  # loop stopped in the race window
+        if thread is not None:
+            thread.join(timeout=timeout)
+    else:
+        # loop stopped but open (e.g. its thread died during boot):
+        # nothing can schedule there — finalize the orphan tasks inline
+        # so close() doesn't discard half-cancelled coroutines
+        try:
+            pending = asyncio.all_tasks(loop)
+            for t in pending:
+                t.cancel()
+            if pending:
+                loop.run_until_complete(
+                    asyncio.gather(*pending, return_exceptions=True)
+                )
+        except RuntimeError:
+            pass
+        if thread is not None:
+            thread.join(timeout=timeout)
     if not loop.is_running() and not loop.is_closed():
         loop.close()

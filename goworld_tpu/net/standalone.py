@@ -31,7 +31,8 @@ class ClusterHarness:
                  heartbeat_timeout: float = 0.0,
                  position_sync_interval_ms: int = 20,
                  with_ws: bool = False, compress: bool = False,
-                 tls_dir: str | None = None):
+                 tls_dir: str | None = None,
+                 gate_exit_on_dispatcher_loss: bool = False):
         self.host = host
         self.n_dispatchers = n_dispatchers
         self.n_gates = n_gates
@@ -43,6 +44,9 @@ class ClusterHarness:
         # with compression+encryption ON)
         self.compress = compress
         self.tls_dir = tls_dir  # directory for the self-signed pair
+        # default False: the harness tears processes down in arbitrary
+        # order; real deployments keep the gate default (True)
+        self.gate_exit_on_dispatcher_loss = gate_exit_on_dispatcher_loss
         self.dispatchers: list[DispatcherService] = []
         self.gates: list[GateService] = []
         self.dispatcher_addrs: list[tuple[str, int]] = []
@@ -106,6 +110,7 @@ class ClusterHarness:
                 position_sync_interval_ms=self.position_sync_interval_ms,
                 compress=self.compress,
                 ssl_context=ssl_ctx,
+                exit_on_dispatcher_loss=self.gate_exit_on_dispatcher_loss,
             )
             self.gates.append(g)
             self._tasks.append(asyncio.ensure_future(g.serve()))

@@ -1,0 +1,203 @@
+"""Failure-path parity (VERDICT #10): dispatcher cleanup when a game
+dies (DispatcherService.go:586-634), gate self-termination on dispatcher
+loss (gate.go:137-143), and the bot's view of both."""
+
+import asyncio
+import threading
+import time
+
+import pytest
+
+from goworld_tpu.core.state import WorldConfig
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.manager import World
+from goworld_tpu.entity.space import Space
+from goworld_tpu.net.botclient import BotClient
+from goworld_tpu.net.game import GameServer
+from goworld_tpu.net.standalone import ClusterHarness
+from goworld_tpu.ops.aoi import GridSpec
+
+
+class Account(Entity):
+    def OnClientConnected(self):
+        avatar = self.world.create_entity(
+            "Avatar", space=self.world._arena, pos=(50.0, 0.0, 50.0)
+        )
+        avatar.attrs["name"] = "n"
+        self.give_client_to(avatar)
+        self.destroy()
+
+
+class Avatar(Entity):
+    ATTRS = {"name": "allclients"}
+
+
+class Arena(Space):
+    pass
+
+
+def _make_world():
+    cfg = WorldConfig(
+        capacity=64,
+        grid=GridSpec(radius=30.0, extent_x=200.0, extent_z=200.0,
+                      k=16, cell_cap=32, row_block=64),
+    )
+    w = World(cfg, n_spaces=1)
+    w.register_entity("Account", Account)
+    w.register_entity("Avatar", Avatar)
+    w.register_space("Arena", Arena)
+    w.create_nil_space()
+    w._arena = w.create_space("Arena")
+    return w
+
+
+def _start_game(harness, game_id=1):
+    w = _make_world()
+    gs = GameServer(game_id, w, list(harness.dispatcher_addrs),
+                    boot_entity="Account")
+    gs.start_network()
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            gs.pump()
+            gs.tick()
+            time.sleep(0.01)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    return w, gs, stop, t
+
+
+def test_game_death_cleans_dispatcher_and_detaches_bot():
+    """Kill a game (hard stop, no freeze): every dispatcher must drop the
+    game's entity routes and broadcast NOTIFY_GAME_DISCONNECTED; a
+    connected bot keeps its gate connection but its entities go silent."""
+    harness = ClusterHarness(n_dispatchers=2, n_gates=1, desired_games=1)
+    harness.start()
+    stop = t = gs = None
+    try:
+        w, gs, stop, t = _start_game(harness)
+        assert gs.ready_event.wait(20)
+        host, port = harness.gate_addrs[0]
+        bot = BotClient(host, port, strict=True, move_interval=0.1)
+        bot_fut = harness.submit(bot.run(30.0))
+        deadline = time.monotonic() + 10
+        while bot.player is None and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert bot.player is not None and bot.player.type_name == "Avatar"
+
+        routed = sum(
+            1 for d in harness.dispatchers
+            for info in d.entities.values() if info.game_id == 1
+        )
+        assert routed > 0, "dispatchers never learned the game's entities"
+
+        # hard-kill the game (crash: no freeze handshake)
+        stop.set()
+        t.join(timeout=5)
+        gs.stop()
+        stop = t = gs = None
+
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            leftover = sum(
+                1 for d in harness.dispatchers
+                for info in d.entities.values() if info.game_id == 1
+            )
+            if leftover == 0:
+                break
+            time.sleep(0.1)
+        assert leftover == 0, (
+            f"{leftover} stale entity routes survived the game's death"
+        )
+
+        # bot is detached from the dead game: no further syncs arrive
+        time.sleep(0.5)
+        syncs = bot.sync_count
+        time.sleep(1.0)
+        assert bot.sync_count == syncs, "syncs from a dead game"
+        bot._stop = True
+        bot_fut.cancel()
+    finally:
+        if stop is not None:
+            stop.set()
+        if t is not None:
+            t.join(timeout=5)
+        if gs is not None:
+            gs.stop()
+        harness.stop()
+
+
+def test_game_death_while_frozen_keeps_routes():
+    """A game that died FREEZING keeps its routes and queues packets for
+    the restore (reference :602-607) — the opposite of the crash path."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as tmp:
+        harness = ClusterHarness(n_dispatchers=1, n_gates=0,
+                                 desired_games=1)
+        harness.start()
+        try:
+            w = _make_world()
+            gs = GameServer(1, w, list(harness.dispatcher_addrs),
+                            freeze_dir=tmp)
+            gs.start_network()
+            stop = threading.Event()
+
+            def drive():
+                while not stop.is_set() and gs.run_state == "running":
+                    gs.pump()
+                    gs.tick()
+                    time.sleep(0.01)
+                if gs.run_state == "freezing":
+                    gs._do_freeze()
+
+            t = threading.Thread(target=drive, daemon=True)
+            t.start()
+            assert gs.ready_event.wait(20)
+            npc = w.create_entity("Avatar", space=w._arena,
+                                  pos=(1.0, 0.0, 1.0))
+            time.sleep(0.3)
+            gs.request_freeze()
+            deadline = time.monotonic() + 15
+            while gs.run_state != "frozen" and time.monotonic() < deadline:
+                time.sleep(0.05)
+            assert gs.run_state == "frozen"
+            stop.set()
+            t.join(timeout=5)
+            gs.stop()
+            time.sleep(0.5)
+            d = harness.dispatchers[0]
+            gi = d.games.get(1)
+            assert gi is not None and gi.blocked, \
+                "frozen game lost its blocked state on disconnect"
+            assert any(
+                info.game_id == 1 for info in d.entities.values()
+            ), "frozen game's entity routes were dropped"
+        finally:
+            harness.stop()
+
+
+def test_gate_exits_on_dispatcher_loss():
+    """Reference gate.go:137-143: a gate that loses a dispatcher kills
+    itself (clients would be routing into a black hole)."""
+    harness = ClusterHarness(
+        n_dispatchers=1, n_gates=1, desired_games=0,
+        gate_exit_on_dispatcher_loss=True,
+    )
+    harness.start()
+    try:
+        gate = harness.gates[0]
+        assert not gate.terminated.is_set()
+
+        harness.submit(harness.dispatchers[0].kill()).result(timeout=10)
+
+        async def wait_term():
+            await asyncio.wait_for(gate.terminated.wait(), 15)
+            return True
+
+        assert harness.submit(wait_term()).result(timeout=20), \
+            "gate did not self-terminate after dispatcher loss"
+    finally:
+        harness.stop()
