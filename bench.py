@@ -54,6 +54,11 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINE_ENTITY_TICKS_PER_CHIP = 7.5e6
 
 N = int(os.environ.get("BENCH_N", 1_048_576))
+BEHAVIOR = os.environ.get("BENCH_BEHAVIOR", "random_walk")  # or "mlp"
+                                                            # (config 5)
+if BEHAVIOR not in ("random_walk", "mlp"):
+    raise SystemExit(f"BENCH_BEHAVIOR must be random_walk|mlp, "
+                     f"got {BEHAVIOR!r}")
 T = int(os.environ.get("BENCH_TICKS", 20))
 CLIENT_FRAC = float(os.environ.get("BENCH_CLIENT_FRAC", 0.01))
 SMOKE_N = int(os.environ.get("BENCH_SMOKE_N", 8192))
@@ -90,6 +95,7 @@ def build(n: int, client_frac: float):
             row_block=min(n, int(os.environ.get("BENCH_ROW_BLOCK", 65536))),
         ),
         npc_speed=5.0,
+        behavior=BEHAVIOR,  # "mlp" = config 5 (fused NPC behavior kernel)
         enter_cap=65536, leave_cap=65536,
         sync_cap=65536, attr_sync_cap=4096, input_cap=4096,
     )
@@ -117,6 +123,7 @@ def build(n: int, client_frac: float):
         attr_dirty=jnp.zeros(n, jnp.uint32),
         nbr=jnp.full((n, cfg.grid.k), n, jnp.int32),
         nbr_cnt=jnp.zeros(n, jnp.int32),
+        nbr_mean_off=jnp.zeros((n, 3), jnp.float32),
         aoi_radius=jnp.full(n, jnp.inf, jnp.float32),
         dirty=jnp.zeros(n, bool),
         rng=jax.random.PRNGKey(1),
@@ -146,8 +153,14 @@ def measure(n: int, ticks: int, client_frac: float, phases: bool) -> dict:
 
     cfg, st, inputs = build(n, client_frac)
 
+    policy = None
+    if cfg.behavior == "mlp":
+        from goworld_tpu.models.npc_policy import init_policy
+
+        policy = init_policy(jax.random.PRNGKey(5))
+
     def one_tick(state, _):
-        state, out = tick_body(cfg, state, inputs, None)
+        state, out = tick_body(cfg, state, inputs, policy)
         checks = (
             out.enter_n + out.leave_n + out.sync_n + out.attr_n,
             out.sync_vals.sum(),
@@ -209,6 +222,7 @@ def measure(n: int, ticks: int, client_frac: float, phases: bool) -> dict:
         "scale_2x": round(scale, 2),
         "compile_s": round(compile_s, 1),
         "compile2_s": round(compile2_s, 1),
+        "behavior": cfg.behavior,
         "device": str(jax.devices()[0]),
         "platform": jax.devices()[0].platform,
     }

@@ -72,6 +72,11 @@ class SpaceState:
     attr_dirty: jax.Array   # u32[N]   bitmask over attr columns
     nbr: jax.Array          # i32[N, k] sorted AOI neighbor list (sentinel N)
     nbr_cnt: jax.Array      # i32[N]
+    nbr_mean_off: jax.Array  # f32[N, 3] mean neighbor offset, computed at
+                             # AOI time (megaspace MLP observations read
+                             # this — its gid neighbor lists can't gather
+                             # positions locally; one tick stale, like the
+                             # single-space path's prev-tick nbr lists)
     aoi_radius: jax.Array   # f32[N] per-entity AOI distance; 0 = excluded
                             # from AOI entirely, +inf = space default radius
                             # (reference EntityTypeDesc.aoiDistance,
@@ -97,6 +102,7 @@ def create_state(cfg: WorldConfig, seed: int = 0) -> SpaceState:
         attr_dirty=jnp.zeros((n,), jnp.uint32),
         nbr=jnp.full((n, k), n, jnp.int32),
         nbr_cnt=jnp.zeros((n,), jnp.int32),
+        nbr_mean_off=jnp.zeros((n, 3), jnp.float32),
         aoi_radius=jnp.full((n,), jnp.inf, jnp.float32),
         dirty=jnp.zeros((n,), bool),
         rng=jax.random.PRNGKey(seed),

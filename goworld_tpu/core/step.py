@@ -23,7 +23,12 @@ import jax.numpy as jnp
 from flax import struct
 
 from goworld_tpu.core.state import SpaceState, WorldConfig
-from goworld_tpu.models.npc_policy import MLPPolicy, build_obs, policy_accel
+from goworld_tpu.models.npc_policy import (
+    MLPPolicy,
+    build_obs,
+    build_obs_from_features,
+    policy_accel,
+)
 from goworld_tpu.models.random_walk import random_walk_step
 from goworld_tpu.ops.aoi import grid_neighbors
 from goworld_tpu.ops.delta import interest_delta, masked_pairs
@@ -89,13 +94,18 @@ def compute_velocity(
     """Per-entity velocity update for cfg.behavior (shared by the single-
     space tick and the megaspace shard step). ``nbr``/``nbr_cnt`` are the
     LOCAL-slot neighbor lists for the MLP observation; pass None when they
-    are unavailable (e.g. megaspace state holds global ids)."""
+    are unavailable (megaspace state holds global ids — its observation
+    then comes from the precomputed ``state.nbr_mean_off`` features the
+    previous tick's AOI sweep left behind)."""
     if cfg.behavior == "mlp":
-        n = pos.shape[0]
         if nbr is None:
-            nbr = jnp.full((n, cfg.grid.k), n, jnp.int32)
-            nbr_cnt = jnp.zeros((n,), jnp.int32)
-        obs = build_obs(pos, state.vel, yaw, nbr, nbr_cnt, world_extent)
+            obs = build_obs_from_features(
+                pos, state.vel, yaw, state.nbr_cnt, state.nbr_mean_off,
+                cfg.grid.k, world_extent,
+            )
+        else:
+            obs = build_obs(pos, state.vel, yaw, nbr, nbr_cnt,
+                            world_extent)
         accel = policy_accel(policy, obs)
         vel = state.vel + accel * cfg.dt
         # cap speed by XZ magnitude (not per-axis) so diagonal movers

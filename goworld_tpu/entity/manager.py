@@ -123,6 +123,7 @@ class World:
         migrate_cap: int = 256,
         megaspace: bool = False,
         halo_cap: int = 1024,
+        mega_shape: tuple[int, int] | None = None,
     ):
         self.cfg = cfg
         self.n_spaces = n_spaces
@@ -137,9 +138,11 @@ class World:
                 f"n_spaces={n_spaces}"
             )
         if megaspace:
-            # ONE logical space spans the whole mesh as x-interval tiles
-            # (BASELINE config 4; SURVEY.md#5.7). cfg.grid is the TILE
-            # grid in tile-shifted coords: extent_x = tile_w + 2*radius.
+            # ONE logical space spans the whole mesh as tiles — x strips,
+            # or XZ tiles when mega_shape=(tx, tz) is given (BASELINE
+            # config 4; SURVEY.md#5.7). cfg.grid is the TILE grid in
+            # tile-shifted coords: extent_x = tile_w + 2*radius (and
+            # extent_z = tile_d + 2*radius for 2D tiles).
             from goworld_tpu.parallel.megaspace import (
                 MegaConfig, create_mega_state, make_mega_tick,
             )
@@ -149,9 +152,13 @@ class World:
             from goworld_tpu.parallel.mesh import shard_state
 
             tile_w = cfg.grid.extent_x - 2.0 * cfg.grid.radius
+            tile_d = 0.0
+            if mega_shape is not None and mega_shape[1] > 1:
+                tile_d = cfg.grid.extent_z - 2.0 * cfg.grid.radius
             self.mega = MegaConfig(
                 cfg=cfg, n_dev=n_spaces, tile_w=tile_w,
                 halo_cap=halo_cap, migrate_cap=migrate_cap,
+                mesh_shape=mega_shape, tile_d=tile_d,
             )
             self.state = shard_state(
                 create_mega_state(self.mega, seed=seed), mesh
@@ -535,14 +542,10 @@ class World:
         ]
         self._staged_despawn.append((src_sh, src_sl))
 
-    def _tile_of(self, x: float) -> int:
-        """Owning tile (= shard) of a world x coordinate in megaspace mode
-        (device d owns x in [d*tile_w, (d+1)*tile_w))."""
-        import math
-
-        return max(
-            0, min(self.n_spaces - 1, int(math.floor(x / self.mega.tile_w)))
-        )
+    def _tile_of(self, pos) -> int:
+        """Owning tile (= shard) of a world position in megaspace mode
+        (1D x-strips or 2D XZ tiles; MegaConfig.tile_of)."""
+        return self.mega.tile_of(float(pos[0]), float(pos[2]))
 
     def _enter_space_or_park(
         self, e: Entity, space: Space, pos, moving: bool = False
@@ -553,7 +556,7 @@ class World:
         after the fact would have to unwind membership and user hooks
         that already ran. Returns True on a real entry."""
         if space.is_mega:
-            shard = self._tile_of(float(pos[0]))
+            shard = self._tile_of(pos)
         else:
             shard = space.shard
         if shard is not None and not self._free[shard]:
@@ -573,7 +576,7 @@ class World:
         e.space = space
         space.members.add(e.id)
         if space.is_mega:
-            shard = self._tile_of(float(pos[0]))
+            shard = self._tile_of(pos)
         else:
             shard = space.shard
         if shard is not None:

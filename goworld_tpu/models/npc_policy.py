@@ -45,22 +45,36 @@ def init_policy(key: jax.Array, hidden: int = 128) -> MLPPolicy:
     )
 
 
-def build_obs(
+def neighbor_mean_offset(
+    pos_src: jax.Array,
+    self_pos: jax.Array,
+    nbr: jax.Array,
+    nbr_cnt: jax.Array,
+    sentinel: int,
+) -> jax.Array:
+    """f32[N, 3] mean offset to valid neighbors. ``pos_src`` is the
+    candidate position table ``nbr`` indexes into (the full population for
+    a single space; local+ghost rows for a megaspace tile)."""
+    valid = nbr != sentinel
+    nbr_c = jnp.minimum(nbr, pos_src.shape[0] - 1)
+    npos = pos_src[nbr_c]                               # [N, k, 3]
+    offs = jnp.where(valid[:, :, None], npos - self_pos[:, None, :], 0.0)
+    cnt = jnp.maximum(nbr_cnt, 1).astype(jnp.float32)
+    return offs.sum(axis=1) / cnt[:, None]
+
+
+def build_obs_from_features(
     pos: jax.Array,
     vel: jax.Array,
     yaw: jax.Array,
-    nbr: jax.Array,
     nbr_cnt: jax.Array,
+    mean_off: jax.Array,
+    k: int,
     world_extent: tuple[float, float],
 ) -> jax.Array:
-    """f32[N, OBS_DIM]: normalized pos, vel, yaw sin/cos, neighbor summary."""
-    n, k = nbr.shape
-    valid = nbr != n
-    nbr_c = jnp.minimum(nbr, n - 1)
-    npos = pos[nbr_c]                                   # [N, k, 3]
-    offs = jnp.where(valid[:, :, None], npos - pos[:, None, :], 0.0)
-    cnt = jnp.maximum(nbr_cnt, 1).astype(jnp.float32)
-    mean_off = offs.sum(axis=1) / cnt[:, None]
+    """f32[N, OBS_DIM] from precomputed neighbor features — the megaspace
+    path, whose gid neighbor lists cannot gather positions locally
+    (features come from the previous tick's AOI sweep)."""
     ex, ez = world_extent
     return jnp.concatenate(
         [
@@ -73,6 +87,22 @@ def build_obs(
             mean_off[:, ::2] / 100.0,                    # x, z mean offset
         ],
         axis=1,
+    )
+
+
+def build_obs(
+    pos: jax.Array,
+    vel: jax.Array,
+    yaw: jax.Array,
+    nbr: jax.Array,
+    nbr_cnt: jax.Array,
+    world_extent: tuple[float, float],
+) -> jax.Array:
+    """f32[N, OBS_DIM]: normalized pos, vel, yaw sin/cos, neighbor summary."""
+    n, k = nbr.shape
+    mean_off = neighbor_mean_offset(pos, pos, nbr, nbr_cnt, n)
+    return build_obs_from_features(
+        pos, vel, yaw, nbr_cnt, mean_off, k, world_extent
     )
 
 

@@ -86,3 +86,122 @@ def exchange_halo(
     gvalid = jnp.concatenate([from_left[3], from_right[3]])
     ggid = jnp.concatenate([from_left[4], from_right[4]])
     return gpos, gyaw, gdirty, gvalid, ggid, strip_demand
+
+
+def exchange_halo_2d(
+    axis: str,
+    shape: tuple[int, int],   # (tx, tz) device grid over the flat axis
+    n_per_dev: int,
+    pos: jax.Array,           # f32[N, 3] (global coords)
+    yaw: jax.Array,
+    dirty: jax.Array,
+    alive: jax.Array,
+    tile_w: float,            # x tile width
+    tile_d: float,            # z tile depth
+    radius: float,
+    halo_cap: int,
+):
+    """Two-phase 8-neighbor halo for 2D (XZ) tiling.
+
+    Device ``d`` owns tile ``(ix, iz) = (d // tz, d % tz)``. Phase 1
+    ships the west/east boundary strips laterally; phase 2 ships the
+    north/south strips of the COMBINED region (local + phase-1 ghosts),
+    so corner neighbors arrive transitively — the classic 2-phase halo
+    that avoids 4 extra diagonal transfers. Ghost block = 4 * halo_cap
+    rows (west, east, north, south — the z-phase buffers carry the
+    corners). Per-strip capacity overflow drops entities beyond the cap
+    in slot order (not by distance) from the neighbor's view that tick —
+    same contract as the 1D exchange; size halo_cap for the worst
+    expected strip density.
+
+    Returns (gpos[4H,3], gyaw[4H], gdirty[4H], gvalid[4H], ggid[4H],
+    strip_demand) — strip_demand is the max true occupancy over this
+    shard's inward-facing strips (alarm when > halo_cap).
+    """
+    tx, tz = shape
+    n = pos.shape[0]
+    d = lax.axis_index(axis)
+    ix = d // tz
+    iz = d % tz
+    tmin_x = ix.astype(jnp.float32) * tile_w
+    tmin_z = iz.astype(jnp.float32) * tile_d
+    x = pos[:, 0]
+    z = pos[:, 2]
+    local_gid = d * n_per_dev + jnp.arange(n, dtype=jnp.int32)
+
+    def pack(mask, src_pos, src_yaw, src_dirty, src_gid):
+        m = src_pos.shape[0]
+        flat, valid, demand = bounded_extract(mask, halo_cap)
+        slots = jnp.where(valid, flat, m - 1)
+        return (
+            jnp.where(valid[:, None], src_pos[slots], 0.0),
+            jnp.where(valid, src_yaw[slots], 0.0),
+            src_dirty[slots] & valid,
+            valid,
+            jnp.where(valid, src_gid[slots], -1),
+        ), demand
+
+    # ---- phase 1: x strips over the flat axis (stride tz) -------------
+    west_pack, west_dem = pack(
+        alive & (x < tmin_x + radius), pos, yaw, dirty, local_gid
+    )
+    east_pack, east_dem = pack(
+        alive & (x >= tmin_x + tile_w - radius), pos, yaw, dirty,
+        local_gid,
+    )
+    n_dev = tx * tz
+    to_west = [(i, i - tz) for i in range(n_dev) if i // tz > 0]
+    to_east = [(i, i + tz) for i in range(n_dev) if i // tz < tx - 1]
+    from_east = jax.tree.map(
+        lambda t: lax.ppermute(t, axis, to_west), west_pack
+    )
+    from_west = jax.tree.map(
+        lambda t: lax.ppermute(t, axis, to_east), east_pack
+    )
+
+    # ---- phase 2: z strips of local + phase-1 ghosts ------------------
+    cpos = jnp.concatenate([pos, from_west[0], from_east[0]])
+    cyaw = jnp.concatenate([yaw, from_west[1], from_east[1]])
+    cdirty = jnp.concatenate([dirty, from_west[2], from_east[2]])
+    cvalid = jnp.concatenate([alive, from_west[3], from_east[3]])
+    cgid = jnp.concatenate([local_gid, from_west[4], from_east[4]])
+    cz = cpos[:, 2]
+    north_pack, north_dem = pack(
+        cvalid & (cz < tmin_z + radius), cpos, cyaw, cdirty, cgid
+    )
+    south_pack, south_dem = pack(
+        cvalid & (cz >= tmin_z + tile_d - radius), cpos, cyaw, cdirty,
+        cgid,
+    )
+    to_north = [(i, i - 1) for i in range(n_dev) if i % tz > 0]
+    to_south = [(i, i + 1) for i in range(n_dev) if i % tz < tz - 1]
+    from_south = jax.tree.map(
+        lambda t: lax.ppermute(t, axis, to_north), north_pack
+    )
+    from_north = jax.tree.map(
+        lambda t: lax.ppermute(t, axis, to_south), south_pack
+    )
+
+    gpos = jnp.concatenate(
+        [from_west[0], from_east[0], from_north[0], from_south[0]]
+    )
+    gyaw = jnp.concatenate(
+        [from_west[1], from_east[1], from_north[1], from_south[1]]
+    )
+    gdirty = jnp.concatenate(
+        [from_west[2], from_east[2], from_north[2], from_south[2]]
+    )
+    gvalid = jnp.concatenate(
+        [from_west[3], from_east[3], from_north[3], from_south[3]]
+    )
+    ggid = jnp.concatenate(
+        [from_west[4], from_east[4], from_north[4], from_south[4]]
+    )
+    # inward-facing strips only: world-edge outward strips never ship
+    strip_demand = jnp.max(jnp.stack([
+        jnp.where(ix > 0, west_dem, 0),
+        jnp.where(ix < tx - 1, east_dem, 0),
+        jnp.where(iz > 0, north_dem, 0),
+        jnp.where(iz < tz - 1, south_dem, 0),
+    ]))
+    return gpos, gyaw, gdirty, gvalid, ggid, strip_demand

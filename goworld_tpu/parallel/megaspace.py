@@ -28,12 +28,13 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from goworld_tpu.core.state import SpaceState, WorldConfig
 from goworld_tpu.core.step import TickOutputs, compute_velocity
+from goworld_tpu.models.npc_policy import neighbor_mean_offset
 from goworld_tpu.ops.aoi import grid_neighbors
 from goworld_tpu.ops.delta import interest_delta, masked_pairs
 from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
 from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
 from goworld_tpu.parallel import migrate as mig
-from goworld_tpu.parallel.halo import exchange_halo
+from goworld_tpu.parallel.halo import exchange_halo, exchange_halo_2d
 from goworld_tpu.parallel.mesh import SPACE_AXIS
 from goworld_tpu.parallel.step import MultiTickInputs
 
@@ -44,7 +45,13 @@ class MegaConfig:
 
     ``cfg.grid`` describes the TILE-LOCAL grid in shifted coordinates:
     origin 0, ``extent_x = tile_w + 2 * radius`` (one halo margin on each
-    side), ``extent_z`` = the world's z extent.
+    side). 1D mode (``mesh_shape=None``): devices tile the x axis as
+    strips and ``extent_z`` is the world's z extent. 2D mode
+    (``mesh_shape=(tx, tz)``): devices tile the XZ plane, device ``d``
+    owns tile ``(d // tz, d % tz)`` of size ``tile_w x tile_d``, and
+    ``extent_z = tile_d + 2 * radius`` — the realistic layout for square
+    worlds at high device counts, where 1D strips become thinner than
+    the AOI radius (BASELINE config 4 at 64 devices).
     """
 
     cfg: WorldConfig
@@ -52,6 +59,8 @@ class MegaConfig:
     tile_w: float
     halo_cap: int = 1024
     migrate_cap: int = 256
+    mesh_shape: tuple[int, int] | None = None  # (tx, tz); None = (n_dev, 1)
+    tile_d: float = 0.0                        # z tile depth (2D only)
 
     def __post_init__(self):
         g = self.cfg.grid
@@ -67,21 +76,69 @@ class MegaConfig:
                 "grid.origin_x/origin_z must be 0"
             )
         if g.radius > self.tile_w:
-            # The halo exchange is one ring hop each way: an AOI radius
-            # wider than a tile would need neighbors-of-neighbors, which
-            # never arrive — interest events silently missing.
+            # The halo exchange is one hop each way: an AOI radius wider
+            # than a tile would need neighbors-of-neighbors, which never
+            # arrive — interest events silently missing.
             raise ValueError(
                 f"grid.radius ({g.radius}) must be <= tile_w "
                 f"({self.tile_w}) for adjacent-tile halo exchange"
             )
+        if self.mesh_shape is not None:
+            tx, tz = self.mesh_shape
+            if tx * tz != self.n_dev:
+                raise ValueError(
+                    f"mesh_shape {self.mesh_shape} != n_dev {self.n_dev}"
+                )
+            if tz > 1:
+                if self.tile_d <= 0:
+                    raise ValueError("2D megaspace requires tile_d > 0")
+                if g.radius > self.tile_d:
+                    raise ValueError(
+                        f"grid.radius ({g.radius}) must be <= tile_d "
+                        f"({self.tile_d})"
+                    )
+                expected_z = self.tile_d + 2.0 * g.radius
+                if abs(g.extent_z - expected_z) > 1e-6:
+                    raise ValueError(
+                        "2D megaspace: grid.extent_z must be "
+                        f"tile_d + 2*radius = {expected_z}, got "
+                        f"{g.extent_z}"
+                    )
+
+    @property
+    def shape(self) -> tuple[int, int]:
+        return self.mesh_shape or (self.n_dev, 1)
+
+    @property
+    def is_2d(self) -> bool:
+        return self.shape[1] > 1
 
     @property
     def world_x(self) -> float:
-        return self.tile_w * self.n_dev
+        return self.tile_w * self.shape[0]
+
+    @property
+    def world_z(self) -> float:
+        if self.is_2d:
+            return self.tile_d * self.shape[1]
+        return self.cfg.grid.extent_z
+
+    @property
+    def ghost_rows(self) -> int:
+        return (4 if self.is_2d else 2) * self.halo_cap
 
     @property
     def gid_sentinel(self) -> int:
         return self.n_dev * self.cfg.capacity
+
+    def tile_of(self, x: float, z: float) -> int:
+        """Owning device of a world coordinate (host-side placement)."""
+        tx, tz = self.shape
+        ix = max(0, min(tx - 1, int(x // self.tile_w)))
+        if not self.is_2d:
+            return ix
+        iz = max(0, min(tz - 1, int(z // self.tile_d)))
+        return ix * tz + iz
 
 
 @struct.dataclass
@@ -125,12 +182,17 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
         )
     radius = cfg.grid.radius
     gsent = mc.gid_sentinel
+    tx, tz = mc.shape
+    ghost_rows = mc.ghost_rows
 
     def shard_fn(state, inputs: MultiTickInputs, policy):
         state = jax.tree.map(lambda x: x[0], state)
         inputs = jax.tree.map(lambda x: x[0], inputs)
         d = jax.lax.axis_index(SPACE_AXIS)
-        tile_min = d.astype(jnp.float32) * mc.tile_w
+        d_ix = d // tz
+        d_iz = d % tz
+        tile_min = d_ix.astype(jnp.float32) * mc.tile_w
+        tile_min_z = d_iz.astype(jnp.float32) * mc.tile_d
 
         # 1. client inputs (global coords), behaviors, integrate over the
         #    WHOLE world extent (not the tile: movers cross borders freely).
@@ -140,24 +202,34 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
             inputs.base.pos_sync_n,
         )
         rng, k_behave = jax.random.split(state.rng)
-        # state.nbr holds GLOBAL gids here, not valid local gather indices —
-        # nbr=None gives the MLP a neighbor-free observation (neighbor-aware
-        # mega policies need the ghost block; TODO).
+        # state.nbr holds GLOBAL gids (not local gather indices); the MLP
+        # observation instead reads state.nbr_cnt/nbr_mean_off — neighbor
+        # features computed over local+ghost positions by the PREVIOUS
+        # tick's AOI sweep (step 5 below)
         vel = compute_velocity(
             cfg, k_behave, pos, yaw, state, policy,
-            (mc.world_x, cfg.grid.extent_z), nbr=None, nbr_cnt=None,
+            (mc.world_x, mc.world_z), nbr=None, nbr_cnt=None,
         )
         pos, moved = integrate(
             pos, vel, state.npc_moving, cfg.dt,
-            (0.0, -1e9, 0.0), (mc.world_x, 1e9, cfg.grid.extent_z),
+            (0.0, -1e9, 0.0), (mc.world_x, 1e9, mc.world_z),
         )
         state = state.replace(pos=pos, yaw=yaw, vel=vel, rng=rng)
         pre_dirty = (moved | touched | state.dirty) & state.alive
 
-        # 2. automatic tile migration from position.
-        tgt = jnp.clip(
-            jnp.floor(pos[:, 0] / mc.tile_w).astype(jnp.int32), 0, n_dev - 1
+        # 2. automatic tile migration from position (x strip in 1D;
+        #    (ix, iz) tile in 2D).
+        tgt_ix = jnp.clip(
+            jnp.floor(pos[:, 0] / mc.tile_w).astype(jnp.int32), 0, tx - 1
         )
+        if mc.is_2d:
+            tgt_iz = jnp.clip(
+                jnp.floor(pos[:, 2] / mc.tile_d).astype(jnp.int32),
+                0, tz - 1,
+            )
+            tgt = tgt_ix * tz + tgt_iz
+        else:
+            tgt = tgt_ix
         tgt = jnp.where(state.alive & (tgt != d), tgt, -1)
         tag = d * n + jnp.arange(n, dtype=jnp.int32)   # old gid as tag
         fbuf, ibuf, departed, mig_demand = mig.pack_emigrants(
@@ -176,21 +248,32 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
         #    (aoi_radius <= 0, e.g. service types) never ship as ghosts —
         #    they are invisible to every watcher, local or remote.
         visible = state.alive & (state.aoi_radius > 0.0)
-        gpos, gyaw, gdirty, gvalid, ggid, halo_demand = exchange_halo(
-            SPACE_AXIS, n_dev, state.pos, state.yaw, dirty, visible,
-            mc.tile_w, radius, mc.halo_cap,
-        )
+        if mc.is_2d:
+            gpos, gyaw, gdirty, gvalid, ggid, halo_demand = \
+                exchange_halo_2d(
+                    SPACE_AXIS, (tx, tz), n, state.pos, state.yaw, dirty,
+                    visible, mc.tile_w, mc.tile_d, radius, mc.halo_cap,
+                )
+        else:
+            gpos, gyaw, gdirty, gvalid, ggid, halo_demand = exchange_halo(
+                SPACE_AXIS, n_dev, state.pos, state.yaw, dirty, visible,
+                mc.tile_w, radius, mc.halo_cap,
+            )
 
         # 4. AOI over the extended local+ghost population, in tile-shifted
-        #    coordinates so the static grid covers [0, tile_w + 2R).
+        #    coordinates so the static grid covers [0, tile_w + 2R)
+        #    (x [0, tile_d + 2R) in z for 2D tiles).
         pos_ext = jnp.concatenate([state.pos, gpos])
-        shift = jnp.array([tile_min - radius, 0.0, 0.0], jnp.float32)
+        shift = jnp.array([0.0, 0.0, 0.0], jnp.float32) \
+            .at[0].set(tile_min - radius)
+        if mc.is_2d:
+            shift = shift.at[2].set(tile_min_z - radius)
         alive_ext = jnp.concatenate([state.alive, gvalid])
         # ghosts already passed the source-side visibility filter: give
         # them +inf so only the local per-entity radii gate here
         wr_ext = jnp.concatenate([
             state.aoi_radius,
-            jnp.full((2 * mc.halo_cap,), jnp.inf, jnp.float32),
+            jnp.full((ghost_rows,), jnp.inf, jnp.float32),
         ])
         # ghosts are candidates but never watchers: query only local rows
         nbr_ext, nbr_cnt = grid_neighbors(
@@ -198,11 +281,22 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
             watch_radius=wr_ext,
         )
 
-        # 5. translate to stable GLOBAL ids, diff against previous tick.
+        # 5. neighbor features for next tick's MLP observation (computed
+        #    HERE because nbr_ext still indexes pos_ext; after the gid
+        #    translation below the positions are no longer addressable),
+        #    then translate to stable GLOBAL ids and diff.
+        p_ext = n + ghost_rows
+        if cfg.behavior == "mlp":  # static at trace time
+            mean_off = neighbor_mean_offset(
+                pos_ext, state.pos, nbr_ext, nbr_cnt, p_ext
+            )
+        else:
+            # nothing reads the features: skip the [N, k, 3] gather
+            # (gathers are the scarce resource on TPU)
+            mean_off = state.nbr_mean_off
         gid_ext = jnp.concatenate(
             [d * n + jnp.arange(n, dtype=jnp.int32), ggid]
         )
-        p_ext = n + 2 * mc.halo_cap
         nbr_gid = jnp.where(
             nbr_ext == p_ext, gsent,
             gid_ext[jnp.minimum(nbr_ext, p_ext - 1)],
@@ -238,6 +332,7 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
         state = state.replace(
             nbr=nbr_gid,
             nbr_cnt=nbr_cnt,
+            nbr_mean_off=mean_off,
             dirty=jnp.zeros_like(state.dirty),
             attr_dirty=jnp.zeros_like(state.attr_dirty),
             tick=state.tick + 1,
