@@ -113,20 +113,76 @@ class RedisKVDB(KVDBBackend):
         self._c.set(self.PREFIX + key, val)
 
     def get_range(self, begin, end):
-        pre = self.PREFIX
-        keys = sorted(
-            k.decode()[len(pre):] for k in self._c.scan_keys(pre + "*")
-        )
-        lo = bisect.bisect_left(keys, begin)
-        hi = bisect.bisect_left(keys, end)
-        sel = keys[lo:hi]
-        vals = self._c.mget([pre + k for k in sel])  # one round-trip
-        return [
-            (k, v.decode()) for k, v in zip(sel, vals) if v is not None
-        ]
+        return _range_on(self._c, begin, end)
 
     def close(self):
         self._c.close()
+
+
+def _range_on(client, begin: str, end: str) -> list[tuple[str, str]]:
+    """SCAN-sweep one redis endpoint and return the [begin, end) window,
+    values fetched in a single MGET round-trip."""
+    pre = RedisKVDB.PREFIX
+    keys = sorted(
+        k.decode()[len(pre):] for k in client.scan_keys(pre + "*")
+    )
+    lo = bisect.bisect_left(keys, begin)
+    hi = bisect.bisect_left(keys, end)
+    sel = keys[lo:hi]
+    vals = client.mget([pre + k for k in sel])
+    return [(k, v.decode()) for k, v in zip(sel, vals) if v is not None]
+
+
+def _crc16(data: bytes) -> int:
+    """CRC16-CCITT (XModem) — redis cluster's key-slot hash function."""
+    crc = 0
+    for b in data:
+        crc ^= b << 8
+        for _ in range(8):
+            crc = ((crc << 1) ^ 0x1021) if crc & 0x8000 else (crc << 1)
+            crc &= 0xFFFF
+    return crc
+
+
+class RedisClusterKVDB(KVDBBackend):
+    """Client-side sharding over N INDEPENDENT redis endpoints (the
+    architecture of the reference's ``kvdb/backend/kvdbrediscluster``
+    role: horizontal kvdb capacity). Keys route by CRC16 (redis
+    cluster's slot hash function) modulo the node count; range queries
+    fan out to every node and merge.
+
+    DEVIATION: this is NOT the redis cluster-mode protocol — there is
+    no 16384-slot map, hashtag parsing, or MOVED-redirect handling, so
+    point it at plain redis instances (or miniredis), not at the nodes
+    of an actual cluster-mode deployment."""
+
+    def __init__(self, addrs: list[str]):
+        from goworld_tpu.ext.db.resp import RespClient
+
+        if not addrs:
+            raise ValueError("redis-cluster needs at least one node")
+        self._nodes = [RespClient.from_addr(a) for a in addrs]
+
+    def _node(self, key: str):
+        return self._nodes[_crc16(key.encode()) % len(self._nodes)]
+
+    def get(self, key):
+        raw = self._node(key).get(RedisKVDB.PREFIX + key)
+        return None if raw is None else raw.decode()
+
+    def put(self, key, val):
+        self._node(key).set(RedisKVDB.PREFIX + key, val)
+
+    def get_range(self, begin, end):
+        out: list[tuple[str, str]] = []
+        for node in self._nodes:
+            out.extend(_range_on(node, begin, end))
+        out.sort()
+        return out
+
+    def close(self):
+        for n in self._nodes:
+            n.close()
 
 
 def open_kvdb_backend(kind: str, location: str = "") -> KVDBBackend:
@@ -136,6 +192,10 @@ def open_kvdb_backend(kind: str, location: str = "") -> KVDBBackend:
         return FilesystemKVDB(location or "kvdb_data.mp")
     if kind == "redis":
         return RedisKVDB(location or "127.0.0.1:6379")
+    if kind in ("redis_cluster", "redis-cluster"):
+        return RedisClusterKVDB(
+            [a.strip() for a in location.split(",") if a.strip()]
+        )
     raise ValueError(f"unknown kvdb backend {kind!r}")
 
 
