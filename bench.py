@@ -486,9 +486,11 @@ def parent_main() -> int:
             break
         stages, note = run_child({}, N, CHILD_TIMEOUT)
         had_suspect = False
+        child_p99 = None
+        got_best = False
         for s in stages:
             if s.get("stage") == "p99":
-                p99 = s  # latency side-channel, never a headline result
+                child_p99 = s  # latency side-channel, never a headline
                 continue
             partial = s
             if s.get("stage") == "full":
@@ -501,6 +503,12 @@ def parent_main() -> int:
                     had_suspect = True
                 else:
                     best = s
+                    got_best = True
+        if got_best:
+            # latency only attaches to the SAME child's headline: a p99
+            # from a failed TPU attempt must not graft onto a CPU
+            # fallback (or smoke-only) result
+            p99 = child_p99
         attempts_log.append({
             "attempt": i + 1, "env": {},
             "stages": [s.get("stage") for s in stages],
@@ -531,15 +539,21 @@ def parent_main() -> int:
             "attempt": "cpu-fallback", "env": {"BENCH_FORCE_CPU": "1"},
             "stages": [s.get("stage") for s in stages], "error": note or None,
         })
+        child_p99 = None
+        got_best = False
         for s in stages:
             if s.get("stage") == "p99":
-                p99 = s
+                child_p99 = s
             elif s.get("stage") == "full":
                 best = s
+                got_best = True
             elif partial is None:
                 partial = s
+        p99 = child_p99 if got_best else None
 
     chosen = best or suspect_best or partial
+    if best is None:
+        p99 = None  # no same-child headline to attach latency to
     if chosen is not None and p99 is not None:
         chosen = dict(chosen)
         for k in ("tick_p50_ms", "tick_p99_ms",

@@ -119,6 +119,15 @@ class Avatar(gw.Entity):
         self.save()
 
 
+@gw.register_entity("AOITester", aoi_distance=100.0)
+class AOITester(gw.Entity):
+    """Reference ``examples/test_game/AOITester.go``: an entity type with
+    its OWN AOI distance (SetUseAOI(true, 100)) — exercises the per-type
+    ``aoi_distance`` honored by the grid sweep's watch_radius path."""
+
+    ATTRS = {"name": "allclients"}
+
+
 @gw.register_entity("Monster")
 class Monster(gw.Entity):
     ATTRS = {"hp": "allclients hot:0"}

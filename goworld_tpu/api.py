@@ -37,11 +37,13 @@ __all__ = [
     "on_deployment_ready",
     "run", "world", "game_server",
     "create_space", "create_entity", "create_entity_anywhere",
+    "create_space_anywhere",
     "load_entity_anywhere", "call", "call_service", "call_nil_spaces",
     "call_filtered_clients",
     "kvdb_get", "kvdb_put", "kvdb_get_or_put", "kvdb_get_range",
     "add_callback", "add_timer", "cancel_timer", "post",
     "register_crontab", "kvreg_register", "kvreg_get", "kvreg_watch",
+    "kvreg_traverse",
 ]
 
 # registrations made before run() builds the World (the reference's
@@ -298,6 +300,15 @@ def create_entity_anywhere(type_name: str, attrs: dict | None = None) -> None:
     _require_rt().server.create_entity_anywhere(type_name, attrs)
 
 
+def create_space_anywhere(type_name: str, attrs: dict | None = None) -> None:
+    """Reference ``CreateSpaceAnywhere`` (``goworld.go``): the dispatcher's
+    load heap picks the hosting game."""
+    rt = _require_rt()
+    if not rt.world.registry.get(type_name).is_space:
+        raise TypeError(f"{type_name} is not a space type")
+    rt.server.create_entity_anywhere(type_name, attrs)
+
+
 def load_entity_anywhere(type_name: str, eid: str) -> None:
     _require_rt().server.load_entity_anywhere(type_name, eid)
 
@@ -349,6 +360,13 @@ def kvreg_register(key: str, val: str, force: bool = False) -> None:
 
 def kvreg_get(key: str) -> str | None:
     return _require_rt().server.kvreg.get(key)
+
+
+def kvreg_traverse(prefix: str,
+                   cb: Callable[[str, str], None]) -> None:
+    """Walk the local kvreg mirror by key prefix (reference
+    ``kvreg.TraverseByPrefix``, ``kvreg.go:23``)."""
+    _require_rt().server.kvreg_traverse(prefix, cb)
 
 
 def kvreg_watch(cb: Callable[[str, str], None]) -> None:

@@ -302,7 +302,8 @@ class World:
         return sp
 
     def create_space(
-        self, type_name: str, *, use_aoi: bool | None = None, **attrs
+        self, type_name: str, *, use_aoi: bool | None = None,
+        attrs: dict | None = None, **kw_attrs,
     ) -> Space:
         desc = self.registry.get(type_name)
         if not desc.is_space:
@@ -343,7 +344,9 @@ class World:
             sp.shard = shard
         self.entities[sp.id] = sp
         self.spaces[sp.id] = sp
-        for k, v in attrs.items():
+        # explicit attrs dict first (wire path — attr names there may
+        # collide with parameter names), then kwarg sugar
+        for k, v in {**(attrs or {}), **kw_attrs}.items():
             sp.attrs[k] = v
         sp.OnInit()
         sp.OnSpaceInit()

@@ -340,6 +340,13 @@ class GameServer:
         p = proto.pack_kvreg_register(key, val, force)
         self._send(self.cluster.select_by_srv_id(key), p)
 
+    def kvreg_traverse(self, prefix: str, cb) -> None:
+        """Walk the local kvreg mirror by key prefix (reference
+        ``kvreg.TraverseByPrefix``, ``kvreg.go:23``)."""
+        for k, v in sorted(self.kvreg.items()):
+            if k.startswith(prefix):
+                cb(k, v)
+
     def setup_services(self) -> "object":
         """Attach a kvreg-backed ServiceManager (reference ``service.Setup``,
         started on deployment-ready)."""
@@ -459,7 +466,16 @@ class GameServer:
             type_name = pkt.read_var_str()
             eid = pkt.read_var_str()
             attrs = pkt.read_data()
-            w.create_entity(type_name, eid=eid or None, attrs=attrs)
+            desc = (w.registry.get(type_name)
+                    if type_name in w.registry else None)
+            if desc is not None and desc.is_space:
+                # CreateSpaceAnywhere rides the same placement path
+                # (reference goworld.go CreateSpaceAnywhere); attrs go
+                # as a dict, never as kwargs (wire attr names may
+                # collide with parameter names)
+                w.create_space(type_name, attrs=attrs)
+            else:
+                w.create_entity(type_name, eid=eid or None, attrs=attrs)
             return
         if msgtype == proto.MT_LOAD_ENTITY_ANYWHERE:
             type_name = pkt.read_var_str()

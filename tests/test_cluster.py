@@ -221,3 +221,33 @@ def test_client_disconnect_detaches_entity(cluster):
     avatars = [e for e in world.entities.values()
                if e.type_name == "Avatar" and not e.destroyed]
     assert avatars and avatars[0].client is None
+
+
+def test_create_space_anywhere_and_kvreg_traverse(cluster):
+    """CreateSpaceAnywhere rides the anywhere placement path (reference
+    goworld.go) and kvreg.TraverseByPrefix walks the local mirror."""
+    harness, world, gs = cluster
+    world.register_space("Lobby", Space, use_aoi=False)
+    n_before = sum(1 for s in world.spaces.values()
+                   if s.type_name == "Lobby")
+    gs.create_entity_anywhere("Lobby", None)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        lobbies = [s for s in world.spaces.values()
+                   if s.type_name == "Lobby"]
+        if len(lobbies) > n_before:
+            break
+        time.sleep(0.05)
+    assert len(lobbies) == n_before + 1, "space never placed anywhere"
+
+    gs.kvreg_register("Zone/alpha", "1")
+    gs.kvreg_register("Zone/beta", "2")
+    gs.kvreg_register("Other/x", "9")
+    deadline = time.time() + 10
+    while time.time() < deadline and len(
+        [k for k in gs.kvreg if k.startswith("Zone/")]
+    ) < 2:
+        time.sleep(0.05)
+    seen = []
+    gs.kvreg_traverse("Zone/", lambda k, v: seen.append((k, v)))
+    assert seen == [("Zone/alpha", "1"), ("Zone/beta", "2")]
