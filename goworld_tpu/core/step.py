@@ -30,7 +30,7 @@ from goworld_tpu.models.npc_policy import (
     policy_accel,
 )
 from goworld_tpu.models.random_walk import random_walk_step
-from goworld_tpu.ops.aoi import grid_neighbors
+from goworld_tpu.ops.aoi import grid_neighbors_flags
 from goworld_tpu.ops.delta import interest_delta, masked_pairs
 from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
 from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
@@ -153,9 +153,13 @@ def tick_body(
     dirty = (moved | touched | state.dirty) & state.alive
 
     # 4. AOI sweep (the go-aoi XZList replacement). Per-entity aoi_radius
-    # honors EntityTypeDesc.aoiDistance (0 = excluded from AOI).
-    nbr, nbr_cnt = grid_neighbors(
-        cfg.grid, pos, state.alive, watch_radius=state.aoi_radius
+    # honors EntityTypeDesc.aoiDistance (0 = excluded from AOI). The dirty
+    # bit rides the sweep's packed candidate words so sync collection
+    # never re-gathers it over [N, k] (r02 TPU profile: that gather cost
+    # as much as the sweep itself).
+    nbr, nbr_cnt, nbr_fl = grid_neighbors_flags(
+        cfg.grid, pos, state.alive, watch_radius=state.aoi_radius,
+        flag_bits=dirty.astype(jnp.int32),
     )
 
     # 5. interest deltas -> bounded enter/leave pair lists.
@@ -167,7 +171,8 @@ def tick_body(
 
     # 6. position sync records (CollectEntitySyncInfos analog).
     sync_w, sync_j, sync_vals, sync_n = collect_sync(
-        nbr, dirty, state.has_client, pos, yaw, cfg.sync_cap
+        nbr, dirty, state.has_client, pos, yaw, cfg.sync_cap,
+        nbr_dirty=(nbr_fl & 1).astype(bool),
     )
 
     # 7. hot-attr deltas.

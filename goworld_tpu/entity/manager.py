@@ -303,14 +303,17 @@ class World:
 
     def create_space(
         self, type_name: str, *, use_aoi: bool | None = None,
-        attrs: dict | None = None, **kw_attrs,
+        attrs: dict | None = None, eid: str | None = None, **kw_attrs,
     ) -> Space:
         desc = self.registry.get(type_name)
         if not desc.is_space:
             raise TypeError(f"{type_name} is not a space type")
         sp: Space = desc.cls()
         sp._type_desc = desc
-        self._attach(sp, ids.gen_entity_id())
+        # honor a caller-supplied id (CreateSpaceAnywhere pre-generates one
+        # and routes by it — the space must be findable under that id,
+        # goworld.go CreateSpaceAnywhere / space_ops.go)
+        self._attach(sp, eid or ids.gen_entity_id())
         aoi = desc.use_aoi if use_aoi is None else use_aoi
         if desc.megaspace:
             if self.mega is None:

@@ -473,7 +473,7 @@ class GameServer:
                 # (reference goworld.go CreateSpaceAnywhere); attrs go
                 # as a dict, never as kwargs (wire attr names may
                 # collide with parameter names)
-                w.create_space(type_name, attrs=attrs)
+                w.create_space(type_name, attrs=attrs, eid=eid or None)
             else:
                 w.create_entity(type_name, eid=eid or None, attrs=attrs)
             return

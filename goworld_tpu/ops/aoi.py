@@ -10,17 +10,29 @@ A's AOI iff ``|dx| <= dist`` and ``|dz| <= dist``.
 TPU-first redesign: one fixed-shape, jit-compiled **uniform-grid sweep** over
 the whole Space per tick, instead of per-move incremental updates:
 
-1. bin entities into ``radius``-sized cells over a bounded world,
+1. bin entities into ``radius``-sized cells over a bounded world, with one
+   BORDER ring of always-empty cells around the grid (border cells stay at
+   their sentinel init value, so edge queries need no bounds masking),
 2. sort slot indices by cell id (one XLA sort) and compute each entity's
    rank within its cell with a segment scan,
-3. scatter slot ids and positions into dense per-cell tables
-   ``[cells+1, cell_cap]`` — one row per cell,
-4. for every entity, read its 3x3 neighborhood as NINE CONTIGUOUS ROWS of
-   those tables (TPU gathers are scalar-core-bound: fetching
-   ``cell_cap``-wide rows instead of per-candidate scalars is the
-   difference between ~memory-bandwidth and ~seconds per tick at 1M),
+3. scatter per-entity records into a dense per-cell table
+   ``[(cells_x+2) * (cells_z+2), 3 * cell_cap]`` — px / pz / packed
+   slot+flag words side by side, one row per cell,
+4. for every entity, read its 3x3 neighborhood as THREE CONTIGUOUS
+   3-ROW WINDOWS of that table (cells are z-minor, so the z-triple
+   ``(cz-1, cz, cz+1)`` of each x-row is contiguous: one dynamic-slice of
+   ``(3, 3*cell_cap)`` per x-offset). TPU gathers are descriptor-bound on
+   the scalar core — 3 descriptors of 3 rows beat the 9 single-row
+   descriptors of the naive layout, and both beat per-candidate scalar
+   gathers by orders of magnitude at 1M entities,
 5. distance-filter and keep the nearest ``k`` as a sorted neighbor list
    ``int32[N, k]`` padded with sentinel ``N``.
+
+Per-entity **flag bits** (dirty / has_client) ride the packed slot words:
+the sweep can return each neighbor's flags alongside its id, so downstream
+consumers (sync collection) never re-gather per-neighbor state over the
+``[N, k]`` index space — at 1M x 32 that gather alone costs more than the
+whole sweep (r02 TPU profile).
 
 Sorted fixed-width neighbor lists make the downstream enter/leave delta a
 vectorized sorted-set difference (:mod:`goworld_tpu.ops.delta`) and the sync
@@ -46,6 +58,19 @@ import jax.numpy as jnp
 from jax import lax
 
 from goworld_tpu.utils import consts
+
+# Packed candidate word layouts (n < 2^21 fast path). The top_k ranking key
+# stacks a quantized distance above the word; flag bits sit BELOW the id so
+# ranking is exactly (distance, id) — flags can never bias which neighbors
+# survive a k-overflow (same id never appears twice, so the flag bits are
+# unreachable as a tie-break):
+#   with flags:    key = (qd8 << 23) | (id << 2) | flags,   qd8  in [0, 254]
+#   without flags: key = (qd10 << 21) | id,                 qd10 in [0, 1023]
+# Every valid key stays strictly below INT32_MAX (the invalid key).
+_ID_BITS = 21
+_ID_MASK = (1 << _ID_BITS) - 1
+_WORD_MASK = (1 << 23) - 1
+_QD_MAX = 254
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,9 +101,30 @@ class GridSpec:
         return max(1, int(-(-self.extent_z // self.radius)))
 
 
-def cell_ids(spec: GridSpec, pos: jax.Array, alive: jax.Array) -> jax.Array:
-    """Cell id per entity; dead entities get an out-of-range sentinel id so
-    they sort to the end and never appear in any searchsorted range."""
+def _sweep(
+    spec: GridSpec,
+    pos: jax.Array,
+    alive: jax.Array,
+    query_rows: int | None,
+    watch_radius: jax.Array | None,
+    flag_bits: jax.Array | None,
+) -> tuple[jax.Array, jax.Array, jax.Array | None]:
+    n = pos.shape[0]
+    q = n if query_rows is None else query_rows
+    k = spec.k
+    cc = spec.cell_cap
+    sentinel = n
+    packed_path = n < (1 << _ID_BITS)
+    want_flags = flag_bits is not None
+    czp = spec.cells_z + 2          # padded (border) cell columns
+    cxp = spec.cells_x + 2
+    n_rows = cxp * czp
+
+    if watch_radius is not None:
+        # radius-0 entities leave the candidate pool here (sorted out of
+        # every cell row) so they cost nothing downstream
+        alive = alive & (watch_radius > 0.0)
+
     cx = jnp.clip(
         jnp.floor((pos[:, 0] - spec.origin_x) / spec.radius).astype(jnp.int32),
         0,
@@ -89,8 +135,171 @@ def cell_ids(spec: GridSpec, pos: jax.Array, alive: jax.Array) -> jax.Array:
         0,
         spec.cells_z - 1,
     )
-    cid = cx * spec.cells_z + cz
-    return jnp.where(alive, cid, spec.cells_x * spec.cells_z)
+    # padded row id; dead entities scatter out of bounds (dropped)
+    row = (cx + 1) * czp + (cz + 1)
+    srow = jnp.where(alive, row, n_rows)
+
+    order = jnp.argsort(srow).astype(jnp.int32)
+    sorted_row = srow[order]
+
+    # rank of each sorted entity within its cell via a segment scan (no
+    # per-entity binary searches — those are scalar gathers on TPU)
+    idx = jnp.arange(n, dtype=jnp.int32)
+    new_seg = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_row[1:] != sorted_row[:-1]]
+    )
+    seg_start = lax.cummax(jnp.where(new_seg, idx, 0))
+    rank = idx - seg_start
+
+    # ONE dense per-cell table, px/pz/word packed side by side, gathered by
+    # the sorted order in a single [N, 3]-row gather. The word carries the
+    # slot id plus caller flag bits (dirty/has_client) on the fast path so
+    # consumers never re-gather them per neighbor.
+    if packed_path and want_flags:
+        word = (idx << 2) | (flag_bits.astype(jnp.int32) & 3)
+        table_sentinel = sentinel << 2
+    else:
+        word = idx
+        table_sentinel = sentinel
+    src = jnp.stack(
+        [pos[:, 0], pos[:, 2], word.view(jnp.float32)], axis=1
+    )[order]
+
+    valid_src = (rank < cc) & (sorted_row < n_rows)
+    base = jnp.where(valid_src, sorted_row * (3 * cc) + rank, n_rows * 3 * cc)
+    sentinel_bits = jnp.full((), table_sentinel, jnp.int32).view(jnp.float32)
+    lane = jnp.arange(3 * cc, dtype=jnp.int32)
+    init_row = jnp.where(lane >= 2 * cc, sentinel_bits, jnp.inf)
+    table = jnp.tile(init_row, n_rows) \
+        .at[base].set(src[:, 0], mode="drop") \
+        .at[base + cc].set(src[:, 1], mode="drop") \
+        .at[base + 2 * cc].set(src[:, 2], mode="drop")
+    table = table.reshape(n_rows, 3 * cc)
+
+    dxs = jnp.array([-1, 0, 1], jnp.int32)
+    px = pos[:, 0]
+    pz = pos[:, 2]
+
+    def row_block(rows: jax.Array):
+        # rows: int32[B] entity slot indices (may include padding = n-1
+        # dupes; harmless, outputs for them are overwritten consistently).
+        b = rows.shape[0]
+        # z-triple windows: for each x-offset, rows ((cx+dx+1)*czp + cz)
+        # .. +2 are the contiguous (cz-1, cz, cz+1) padded cells. Dead
+        # query rows read window 0 — border rows, all sentinel.
+        starts = (cx[rows][:, None] + dxs[None, :] + 1) * czp \
+            + cz[rows][:, None]
+        starts = jnp.where(alive[rows][:, None], starts, 0)
+
+        win = jax.vmap(
+            jax.vmap(
+                lambda s: lax.dynamic_slice(table, (s, 0), (3, 3 * cc)),
+            )
+        )(starts)                                    # [B, 3, 3, 3cc]
+        win = win.reshape(b, 9, 3 * cc)
+        cand_px = win[:, :, :cc].reshape(b, 9 * cc)
+        cand_pz = win[:, :, cc:2 * cc].reshape(b, 9 * cc)
+        cand_w = lax.bitcast_convert_type(
+            win[:, :, 2 * cc:], jnp.int32
+        ).reshape(b, 9 * cc)
+
+        ddx = jnp.abs(cand_px - px[rows][:, None])
+        ddz = jnp.abs(cand_pz - pz[rows][:, None])
+        dist = jnp.maximum(ddx, ddz)                 # Chebyshev XZ
+        if watch_radius is None:
+            reach = spec.radius
+        else:  # per-watcher view distance, bounded by the cell size
+            reach = jnp.minimum(watch_radius[rows], spec.radius)[:, None]
+
+        if packed_path:
+            cand_id = cand_w >> 2 if want_flags else cand_w
+            valid = (
+                (cand_id != sentinel)
+                & (dist <= reach)
+                & (cand_id != rows[:, None])
+            )
+            # pack (quantized distance, word) into one int32 so a single
+            # top_k yields ids AND flags — the take_along_axis re-gather
+            # it replaces was the single most expensive op of the sweep
+            # (minor-axis dynamic indexing serializes on TPU). Distance
+            # quantization (10 bits plain / 8 bits with flags) only
+            # affects WHICH neighbors win when the true count exceeds k
+            # (already best-effort); flags sit below the id so they never
+            # influence the ranking.
+            invalid_key = jnp.int32(2**31 - 1)
+            if want_flags:
+                qd = jnp.minimum(
+                    (dist * (255.0 / spec.radius)).astype(jnp.int32),
+                    _QD_MAX,
+                )
+                packed_key = jnp.where(
+                    valid, (qd << 23) | cand_w, invalid_key
+                )
+            else:
+                qd = jnp.minimum(
+                    (dist * (1024.0 / spec.radius)).astype(jnp.int32), 1023
+                )
+                packed_key = jnp.where(
+                    valid, (qd << _ID_BITS) | cand_w, invalid_key
+                )
+            top = -lax.top_k(-packed_key, k)[0]      # k smallest
+            ok = top < invalid_key
+            if want_flags:
+                # the (id << 2) | flags words are already id-ordered:
+                # one sort restores ascending ids with flags aligned
+                combo = jnp.sort(
+                    jnp.where(ok, top & _WORD_MASK, sentinel << 2), axis=1
+                )
+                nbr_b = combo >> 2
+                fl_b = jnp.where(nbr_b == sentinel, 0, combo & 3)
+            else:
+                nbr_b = jnp.sort(
+                    jnp.where(ok, top & _ID_MASK, sentinel), axis=1
+                )
+                fl_b = None
+            return nbr_b, ok.sum(axis=1).astype(jnp.int32), fl_b
+
+        valid = (
+            (cand_w != sentinel)
+            & (dist <= reach)
+            & (cand_w != rows[:, None])
+        )
+        key = jnp.where(valid, dist, jnp.inf)
+        top_val, top_idx = lax.top_k(-key, k)        # k nearest
+        nbr_b = jnp.take_along_axis(cand_w, top_idx, axis=1)
+        ok = jnp.isfinite(top_val)
+        nbr_b = jnp.where(ok, nbr_b, sentinel).astype(jnp.int32)
+        nbr_b = jnp.sort(nbr_b, axis=1)              # ascending ids
+        fl_b = None
+        if want_flags:
+            # wide-id fallback: flags can't ride the word; one bounded
+            # gather over [B, k] recovers them (megaspace-scale only)
+            nbr_c = jnp.minimum(nbr_b, n - 1)
+            fl_b = jnp.where(
+                nbr_b == sentinel, 0,
+                flag_bits[nbr_c].astype(jnp.int32) & 3,
+            )
+        return nbr_b, ok.sum(axis=1).astype(jnp.int32), fl_b
+
+    # never let the block exceed the query count: a small space with the
+    # default row_block would otherwise pad up to a full block and do
+    # row_block/q times the work
+    rb = min(spec.row_block, q)
+    nblocks = -(-q // rb)
+    padded = nblocks * rb
+    all_rows = jnp.minimum(jnp.arange(padded, dtype=jnp.int32), q - 1)
+    blocks = all_rows.reshape(nblocks, rb)
+    if nblocks == 1:
+        nbr, cnt, fl = row_block(blocks[0])
+    else:
+        nbr, cnt, fl = lax.map(row_block, blocks)
+        nbr = nbr.reshape(padded, k)
+        cnt = cnt.reshape(padded)
+        if fl is not None:
+            fl = fl.reshape(padded, k)
+    if fl is not None:
+        fl = fl[:q]
+    return nbr[:q], cnt[:q], fl
 
 
 @partial(jax.jit, static_argnums=(0, 3))
@@ -124,136 +333,35 @@ def grid_neighbors(
       nbr: int32[Q, k] neighbor slot ids, ascending, padded with sentinel N.
       cnt: int32[Q] number of valid neighbors per row. (Q = query_rows or N)
     """
-    n = pos.shape[0]
-    q = n if query_rows is None else query_rows
-    k = spec.k
-    cc = spec.cell_cap
-    sentinel = n
-    n_cells = spec.cells_x * spec.cells_z
+    nbr, cnt, _ = _sweep(spec, pos, alive, query_rows, watch_radius, None)
+    return nbr, cnt
 
-    if watch_radius is not None:
-        # radius-0 entities leave the candidate pool here (sorted into the
-        # sentinel cell) so they cost nothing downstream
-        alive = alive & (watch_radius > 0.0)
-    cid = cell_ids(spec, pos, alive)
-    order = jnp.argsort(cid).astype(jnp.int32)
-    scid = cid[order]
 
-    # rank of each sorted entity within its cell via a segment scan (no
-    # per-entity binary searches — those are scalar gathers on TPU)
-    idx = jnp.arange(n, dtype=jnp.int32)
-    new_seg = jnp.concatenate(
-        [jnp.ones((1,), bool), scid[1:] != scid[:-1]]
+@partial(jax.jit, static_argnums=(0, 3))
+def grid_neighbors_flags(
+    spec: GridSpec,
+    pos: jax.Array,
+    alive: jax.Array,
+    query_rows: int | None = None,
+    watch_radius: jax.Array | None = None,
+    flag_bits: jax.Array | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """:func:`grid_neighbors` plus per-neighbor flag propagation.
+
+    ``flag_bits`` is int32/uint32[N] with 2 meaningful low bits per entity
+    (bit 0 = dirty, bit 1 = has_client by convention of the callers). The
+    extra return value ``flags`` is int32[Q, k], aligned with ``nbr``: each
+    neighbor's flag bits as of sweep time (0 on sentinel lanes). This costs
+    nothing on the packed fast path (n < 2^21) — the bits ride the packed
+    candidate words through top_k — and one bounded [Q, k] gather on the
+    wide-id fallback.
+    """
+    if flag_bits is None:
+        raise ValueError("grid_neighbors_flags requires flag_bits")
+    nbr, cnt, fl = _sweep(
+        spec, pos, alive, query_rows, watch_radius, flag_bits
     )
-    seg_start = lax.cummax(jnp.where(new_seg, idx, 0))
-    rank = idx - seg_start
-
-    # ONE dense per-cell table, px/pz/slot-bits packed side by side so the
-    # 3x3 query below is a single row-gather of 3*cc lanes (gathers are the
-    # scarce resource on TPU — one descriptor per cell visit, not three).
-    # Dead entities and rank overflow scatter OUT OF BOUNDS (dropped) so
-    # row n_cells — read by out-of-world queries — stays all-sentinel.
-    n_rows = n_cells + 1
-    valid_src = (rank < cc) & (scid < n_cells)
-    base = jnp.where(valid_src, scid * (3 * cc) + rank, n_rows * 3 * cc)
-    spos = pos[order]  # single row-gather by sorted order
-    sentinel_bits = jnp.full((), sentinel, jnp.int32).view(jnp.float32)
-    lane = jnp.arange(3 * cc, dtype=jnp.int32)
-    init_row = jnp.where(lane >= 2 * cc, sentinel_bits, jnp.inf)
-    table = jnp.tile(init_row, n_rows) \
-        .at[base].set(spos[:, 0], mode="drop") \
-        .at[base + cc].set(spos[:, 2], mode="drop") \
-        .at[base + 2 * cc].set(order.view(jnp.float32), mode="drop")
-    table = table.reshape(n_rows, 3 * cc)
-
-    # 3x3 neighborhood cell offsets.
-    dxs = jnp.array([-1, -1, -1, 0, 0, 0, 1, 1, 1], jnp.int32)
-    dzs = jnp.array([-1, 0, 1, -1, 0, 1, -1, 0, 1], jnp.int32)
-
-    cx_all = cid // spec.cells_z
-    cz_all = cid % spec.cells_z
-
-    px = pos[:, 0]
-    pz = pos[:, 2]
-
-    def row_block(rows: jax.Array) -> tuple[jax.Array, jax.Array]:
-        # rows: int32[B] entity slot indices (may include padding = n-1 dupes;
-        # harmless, outputs for them are overwritten consistently).
-        b = rows.shape[0]
-        qcx = cx_all[rows][:, None] + dxs[None, :]          # [B, 9]
-        qcz = cz_all[rows][:, None] + dzs[None, :]
-        in_world = (
-            (qcx >= 0)
-            & (qcx < spec.cells_x)
-            & (qcz >= 0)
-            & (qcz < spec.cells_z)
-            & alive[rows][:, None]
-        )
-        qcid = jnp.where(in_world, qcx * spec.cells_z + qcz, n_cells)
-
-        packed = table[qcid]                                 # [B, 9, 3cc] rows
-        cand_px = packed[:, :, :cc]
-        cand_pz = packed[:, :, cc:2 * cc]
-        cand = lax.bitcast_convert_type(packed[:, :, 2 * cc:], jnp.int32)
-        valid = cand != sentinel
-
-        ddx = jnp.abs(cand_px - px[rows][:, None, None])
-        ddz = jnp.abs(cand_pz - pz[rows][:, None, None])
-        dist = jnp.maximum(ddx, ddz)                         # Chebyshev XZ
-        if watch_radius is None:
-            reach = spec.radius
-        else:  # per-watcher view distance, bounded by the cell size
-            reach = jnp.minimum(watch_radius[rows], spec.radius)[
-                :, None, None
-            ]
-        valid &= (dist <= reach) & (cand != rows[:, None, None])
-
-        if n < (1 << 21):
-            # pack (quantized distance, candidate id) into one int32 so a
-            # single top_k yields the ids — the take_along_axis re-gather
-            # it replaces was the single most expensive op of the sweep
-            # (minor-axis dynamic indexing serializes on TPU). Quantizing
-            # distance to 10 bits only affects WHICH neighbors win when
-            # the true count exceeds k (already best-effort).
-            qd = jnp.minimum(
-                (dist * (1024.0 / spec.radius)).astype(jnp.int32), 1023
-            )
-            # larger than any valid key: max = (1023 << 21) | (n - 1) and
-            # n < 2^21 keeps that strictly below INT32_MAX
-            invalid_key = jnp.int32(2**31 - 1)
-            packed_key = jnp.where(
-                valid, (qd << 21) | cand, invalid_key
-            ).reshape(b, 9 * cc)
-            top = -lax.top_k(-packed_key, k)[0]              # k smallest
-            ok = top < invalid_key
-            nbr_b = jnp.where(ok, top & ((1 << 21) - 1), sentinel)
-            nbr_b = jnp.sort(nbr_b, axis=1)                  # ascending ids
-            return nbr_b, ok.sum(axis=1).astype(jnp.int32)
-
-        key = jnp.where(valid, dist, jnp.inf).reshape(b, 9 * cc)
-        flat_cand = cand.reshape(b, 9 * cc)
-        top_val, top_idx = lax.top_k(-key, k)                # k nearest
-        nbr_b = jnp.take_along_axis(flat_cand, top_idx, axis=1)
-        ok = jnp.isfinite(top_val)
-        nbr_b = jnp.where(ok, nbr_b, sentinel).astype(jnp.int32)
-        nbr_b = jnp.sort(nbr_b, axis=1)                      # ascending ids
-        return nbr_b, ok.sum(axis=1).astype(jnp.int32)
-
-    # never let the block exceed the query count: a small space with the
-    # default row_block would otherwise pad up to a full block and do
-    # row_block/q times the work
-    rb = min(spec.row_block, q)
-    nblocks = -(-q // rb)
-    padded = nblocks * rb
-    all_rows = jnp.minimum(jnp.arange(padded, dtype=jnp.int32), q - 1)
-    blocks = all_rows.reshape(nblocks, rb)
-    if nblocks == 1:
-        nbr, cnt = row_block(blocks[0])
-    else:
-        nbr, cnt = lax.map(row_block, blocks)
-        nbr = nbr.reshape(padded, k)
-        cnt = cnt.reshape(padded)
-    return nbr[:q], cnt[:q]
+    return nbr, cnt, fl
 
 
 def neighbors_oracle(pos, alive, radius):

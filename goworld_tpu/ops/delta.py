@@ -19,7 +19,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from goworld_tpu.ops.extract import bounded_extract
+from goworld_tpu.ops.extract import bounded_extract_rows
 
 
 def _not_in(a: jax.Array, b: jax.Array, sentinel) -> jax.Array:
@@ -63,7 +63,7 @@ def masked_pairs(
       ``consts.go:26-28``).
     """
     k = mask.shape[1]
-    flat, valid, count = bounded_extract(mask, cap)
+    flat, valid, count = bounded_extract_rows(mask, cap)
     watcher = jnp.where(valid, flat // k, -1)
     subject = jnp.where(valid, values.ravel()[flat], -1)
     return watcher, subject, count

@@ -27,7 +27,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from goworld_tpu.ops.extract import bounded_extract
+from goworld_tpu.ops.extract import bounded_extract_rows
 
 
 @partial(jax.jit, static_argnums=5)
@@ -38,6 +38,7 @@ def collect_sync(
     pos: jax.Array,
     yaw: jax.Array,
     cap: int,
+    nbr_dirty: jax.Array | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Collect position/yaw sync records for client-owning watchers.
 
@@ -53,6 +54,11 @@ def collect_sync(
       has_client: bool[N] watcher owns a connected client.
       pos: f32[P, 3]; yaw: f32[P].
       cap: static max records.
+      nbr_dirty: optional bool[N, k] — each neighbor's dirty bit as
+        delivered by the AOI sweep (:func:`goworld_tpu.ops.aoi.
+        grid_neighbors_flags`), aligned with ``nbr``. When given, the
+        [N, k] ``dirty[nbr]`` gather is skipped entirely (it rivals the
+        whole sweep's cost at 1M x 32 on TPU; r02 profile).
 
     Returns:
       watcher int32[cap], subject int32[cap], vals f32[cap, 4] (x,y,z,yaw),
@@ -63,9 +69,11 @@ def collect_sync(
     sentinel = p
     valid_nbr = nbr != sentinel
     nbr_c = jnp.minimum(nbr, p - 1)
-    watch = has_client[:, None] & valid_nbr & dirty[nbr_c]
+    if nbr_dirty is None:
+        nbr_dirty = dirty[nbr_c]
+    watch = has_client[:, None] & valid_nbr & nbr_dirty
 
-    flat, valid, count = bounded_extract(watch, cap)
+    flat, valid, count = bounded_extract_rows(watch, cap)
     watcher = jnp.where(valid, flat // k, -1)
     subject_raw = nbr_c.ravel()[flat]
     subject = jnp.where(valid, subject_raw, -1)
@@ -91,7 +99,7 @@ def collect_attr_deltas(
     n, a = hot_attrs.shape
     bits = (attr_dirty[:, None] >> jnp.arange(a, dtype=jnp.uint32)) & 1
     mask = bits.astype(bool)
-    flat, valid, count = bounded_extract(mask, cap)
+    flat, valid, count = bounded_extract_rows(mask, cap)
     ent = jnp.where(valid, flat // a, -1)
     attr_idx = jnp.where(valid, flat % a, -1)
     value = jnp.where(valid, hot_attrs.ravel()[flat], 0.0)

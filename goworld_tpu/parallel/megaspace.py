@@ -29,7 +29,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from goworld_tpu.core.state import SpaceState, WorldConfig
 from goworld_tpu.core.step import TickOutputs, compute_velocity
 from goworld_tpu.models.npc_policy import neighbor_mean_offset
-from goworld_tpu.ops.aoi import grid_neighbors
+from goworld_tpu.ops.aoi import grid_neighbors_flags
 from goworld_tpu.ops.delta import interest_delta, masked_pairs
 from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
 from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
@@ -275,10 +275,13 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
             state.aoi_radius,
             jnp.full((ghost_rows,), jnp.inf, jnp.float32),
         ])
-        # ghosts are candidates but never watchers: query only local rows
-        nbr_ext, nbr_cnt = grid_neighbors(
+        # ghosts are candidates but never watchers: query only local rows.
+        # Dirty bits (local + ghost) ride the sweep so sync collection
+        # needs no [N, k] dirty gather.
+        dirty_ext = jnp.concatenate([dirty, gdirty])
+        nbr_ext, nbr_cnt, nbr_fl = grid_neighbors_flags(
             cfg.grid, pos_ext - shift, alive_ext, query_rows=n,
-            watch_radius=wr_ext,
+            watch_radius=wr_ext, flag_bits=dirty_ext.astype(jnp.int32),
         )
 
         # 5. neighbor features for next tick's MLP observation (computed
@@ -311,11 +314,10 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
         )
 
         # 6. sync records over the extended population; subjects -> gids.
-        dirty_ext = jnp.concatenate([dirty, gdirty])
         yaw_ext = jnp.concatenate([state.yaw, gyaw])
         sync_w, sync_j, sync_vals, sync_n = collect_sync(
             nbr_ext, dirty_ext, state.has_client, pos_ext, yaw_ext,
-            cfg.sync_cap,
+            cfg.sync_cap, nbr_dirty=(nbr_fl & 1).astype(bool),
         )
         sync_j = jnp.where(
             sync_j >= 0, gid_ext[jnp.clip(sync_j, 0, p_ext - 1)], -1

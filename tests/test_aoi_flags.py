@@ -1,0 +1,96 @@
+"""Flag propagation through the AOI sweep + two-level bounded extraction.
+
+grid_neighbors_flags rides per-entity dirty/has_client bits through the
+packed candidate words (fast path) or a bounded [Q, k] gather (wide-id
+fallback), so downstream sync collection never gathers over [N, k]
+(reference hot loop being rebuilt: CollectEntitySyncInfos,
+engine/entity/Entity.go:1208-1267)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from goworld_tpu.ops.aoi import GridSpec, grid_neighbors, \
+    grid_neighbors_flags, neighbors_oracle
+from goworld_tpu.ops.extract import bounded_extract, bounded_extract_rows
+from goworld_tpu.ops.sync import collect_sync
+
+
+def random_world(n, seed, extent=200.0):
+    rng = np.random.default_rng(seed)
+    pos = np.zeros((n, 3), np.float32)
+    pos[:, 0] = rng.uniform(0, extent, n)
+    pos[:, 2] = rng.uniform(0, extent, n)
+    return pos, rng
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+def test_flags_align_with_neighbors(seed):
+    n = 256
+    pos, rng = random_world(n, seed)
+    alive = jnp.ones(n, bool)
+    dirty = rng.uniform(size=n) < 0.3
+    hc = rng.uniform(size=n) < 0.2
+    flag_bits = jnp.asarray(
+        dirty.astype(np.int32) | (hc.astype(np.int32) << 1)
+    )
+    spec = GridSpec(radius=25.0, extent_x=200.0, extent_z=200.0,
+                    k=128, cell_cap=128, row_block=64)
+    nbr, cnt, fl = grid_neighbors_flags(
+        spec, jnp.asarray(pos), alive, flag_bits=flag_bits
+    )
+    nbr, cnt, fl = np.asarray(nbr), np.asarray(cnt), np.asarray(fl)
+    oracle = neighbors_oracle(pos, np.ones(n, bool), 25.0)
+    for i in range(n):
+        got = set(nbr[i][nbr[i] < n].tolist())
+        assert got == oracle[i]
+        for lane in range(nbr.shape[1]):
+            j = nbr[i, lane]
+            if j == n:
+                assert fl[i, lane] == 0
+            else:
+                assert fl[i, lane] & 1 == int(dirty[j])
+                assert (fl[i, lane] >> 1) & 1 == int(hc[j])
+    # flags variant must agree with the plain sweep
+    nbr2, cnt2 = grid_neighbors(spec, jnp.asarray(pos), alive)
+    np.testing.assert_array_equal(nbr, np.asarray(nbr2))
+    np.testing.assert_array_equal(cnt, np.asarray(cnt2))
+
+
+def test_collect_sync_flag_path_matches_gather_path():
+    n = 300
+    pos, rng = random_world(n, 7)
+    alive = jnp.ones(n, bool)
+    dirty = jnp.asarray(rng.uniform(size=n) < 0.4)
+    hc = jnp.asarray(rng.uniform(size=n) < 0.3)
+    spec = GridSpec(radius=25.0, extent_x=200.0, extent_z=200.0,
+                    k=64, cell_cap=64, row_block=64)
+    nbr, cnt, fl = grid_neighbors_flags(
+        spec, jnp.asarray(pos), alive,
+        flag_bits=dirty.astype(jnp.int32),
+    )
+    yaw = jnp.zeros(n)
+    ref = collect_sync(nbr, dirty, hc, jnp.asarray(pos), yaw, 512)
+    got = collect_sync(nbr, dirty, hc, jnp.asarray(pos), yaw, 512,
+                       nbr_dirty=(fl & 1).astype(bool))
+    for a, b in zip(ref, got):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("shape,density,cap", [
+    ((64, 8), 0.2, 32),    # overflow: count > cap
+    ((64, 8), 0.02, 64),   # sparse
+    ((33, 5), 0.0, 16),    # empty
+    ((128, 32), 1.0, 256),  # dense overflow
+])
+def test_two_level_extract_matches_flat(shape, density, cap):
+    rng = np.random.default_rng(int(shape[0] * density * cap))
+    mask = jnp.asarray(rng.uniform(size=shape) < density)
+    f1, v1, c1 = bounded_extract(mask, cap)
+    f2, v2, c2 = bounded_extract_rows(mask, cap)
+    np.testing.assert_array_equal(np.asarray(v1), np.asarray(v2))
+    assert int(c1) == int(c2)
+    # identical extraction INCLUDING which bits drop on overflow
+    np.testing.assert_array_equal(
+        np.asarray(f1)[np.asarray(v1)], np.asarray(f2)[np.asarray(v2)]
+    )

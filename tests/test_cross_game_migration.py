@@ -128,7 +128,7 @@ def two_game_cluster():
         threads.append(t)
 
     for gs in servers:
-        assert gs.ready_event.wait(20), "deployment never became ready"
+        assert gs.ready_event.wait(60), "deployment never became ready"
     # spaces are created on the logic threads after deployment-ready
     deadline = time.time() + 10
     while time.time() < deadline and not all(
