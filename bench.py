@@ -171,7 +171,17 @@ def measure(n: int, ticks: int, client_frac: float, phases: bool) -> dict:
     def make_run(length):
         @jax.jit
         def run(state):
-            return lax.scan(one_tick, state, None, length=length)
+            st2, checks = lax.scan(one_tick, state, None, length=length)
+            # ONE scalar depending on every tick's outputs AND the final
+            # state: fetching it (np.asarray below) forces the whole scan
+            # even where block_until_ready returns early (tunneled axon
+            # backend, see measure_p99)
+            return (
+                checks[0].sum().astype(jnp.float32)
+                + checks[1].sum()
+                + checks[2].sum().astype(jnp.float32)
+                + st2.pos.sum()
+            )
         return run
 
     run = make_run(ticks)
@@ -188,23 +198,29 @@ def measure(n: int, ticks: int, client_frac: float, phases: bool) -> dict:
             pos=st.pos + jnp.float32(0.001 * (i + 1)),
         )
 
+    import numpy as _np
+
+    def force(x):
+        return float(_np.asarray(x))
+
     t0 = time.perf_counter()
-    st_w, _ = run(variant(0))
-    jax.block_until_ready(st_w)
+    force(run(variant(0)))
     compile_s = time.perf_counter() - t0
     log(f"n={n}: compile+warmup {compile_s:.1f}s")
     t0 = time.perf_counter()
-    jax.block_until_ready(run2(variant(1)))
+    force(run2(variant(1)))
     compile2_s = time.perf_counter() - t0
 
     t0 = time.perf_counter()
-    jax.block_until_ready(run(variant(2)))
+    force(run(variant(2)))
     elapsed_t = time.perf_counter() - t0
 
     # a 2x-length scan on fresh input must take ~2x: if it doesn't, the
     # harness is NOT measuring execution and the number can't be trusted
+    # (the marginal per-tick figure below also cancels the constant
+    # scalar-readback roundtrip these force() calls add)
     t0 = time.perf_counter()
-    jax.block_until_ready(run2(variant(3)))
+    force(run2(variant(3)))
     elapsed_2t = time.perf_counter() - t0
     scale = elapsed_2t / max(elapsed_t, 1e-9)
     # marginal per-tick cost cancels constant dispatch/transfer overhead
@@ -270,16 +286,22 @@ def measure_p99(cfg, st, inputs, policy, samples: int = 64) -> dict:
 
 def measure_phases(cfg, st, inputs, ticks: int) -> dict:
     """Per-phase timings via separately-jitted partial ticks: aoi (grid
-    sweep only), move (inputs+behavior+integrate), collect (delta + sync +
-    attr extraction, AOI held fixed). Sum != whole tick (XLA fuses across
-    phases in the real program); it localizes where the time goes."""
+    sweep only), move (inputs+behavior+integrate), collect (changed-row
+    interest pairs + sync + attr extraction, AOI held fixed). Sum != whole
+    tick (XLA fuses across phases in the real program); it localizes where
+    the time goes. Each phase reduces to ONE scalar which is fetched with
+    np.asarray — block_until_ready returns early on the tunneled backend
+    (see measure_p99) and a lazily-left-on-device result would time as
+    ~0 ms."""
+    import numpy as np
+
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from goworld_tpu.models.random_walk import random_walk_step
-    from goworld_tpu.ops.aoi import grid_neighbors
-    from goworld_tpu.ops.delta import interest_delta, masked_pairs
+    from goworld_tpu.ops.aoi import grid_neighbors, grid_neighbors_flags
+    from goworld_tpu.ops.delta import interest_pairs
     from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
     from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
 
@@ -294,7 +316,8 @@ def measure_phases(cfg, st, inputs, ticks: int) -> dict:
             # cannot be collapsed by the compiler
             pos = pos + (cnt[:, None] % 2).astype(pos.dtype) * 1e-6
             return pos, cnt.sum()
-        return lax.scan(body, state.pos, None, length=ticks)
+        pos, s = lax.scan(body, state.pos, None, length=ticks)
+        return s.sum() + pos.sum()
 
     @jax.jit
     def move_only(state):
@@ -313,41 +336,60 @@ def measure_phases(cfg, st, inputs, ticks: int) -> dict:
                 cfg.bounds_min, cfg.bounds_max,
             )
             return (pos, yaw, vel, rng), moved.sum()
-        return lax.scan(
+        carry, s = lax.scan(
             body, (state.pos, state.yaw, state.vel, state.rng),
             None, length=ticks,
         )
+        return s.sum() + carry[0].sum()
 
     @jax.jit
-    def collect_only(state, nbr, cnt):
+    def collect_only(state, nbr, fl):
         def body(carry, _):
-            prev_nbr, dirty = carry
-            enter_mask, leave_mask = interest_delta(prev_nbr, nbr, n)
-            ew, ej, en = masked_pairs(enter_mask, nbr, cfg.enter_cap)
-            lw, lj, ln = masked_pairs(leave_mask, prev_nbr, cfg.leave_cap)
+            prev_dirty, dirty = carry
+            # prev list derived from the loop-carried dirty vector so
+            # NOTHING here is loop-invariant (XLA LICM would otherwise
+            # hoist a whole phase out of the scan and under-report it —
+            # the r01/r02 mismeasurement failure mode). ~6% of rows
+            # differ from nbr: realistic steady-state churn.
+            prev_nbr = jnp.where(
+                prev_dirty[:, None], jnp.roll(nbr, 1, axis=0), nbr
+            )
+            ew, ej, en, lw, lj, ln, drn = interest_pairs(
+                prev_nbr, nbr, n, cfg.enter_cap, cfg.leave_cap,
+                min(cfg.delta_rows_cap, n),
+            )
             sw, sj, sv, sn = collect_sync(
                 nbr, dirty, state.has_client, state.pos, state.yaw,
                 cfg.sync_cap,
+                nbr_dirty=(fl & 1).astype(bool) & dirty[: nbr.shape[0],
+                                                        None],
             )
             ae, ai, av, an = collect_attr_deltas(
                 state.hot_attrs, state.attr_dirty, cfg.attr_sync_cap
             )
-            dirty = jnp.roll(dirty, 1)  # keep iterations data-dependent
-            return (nbr, dirty), en + ln + sn + an + ew.sum() + sv.sum()
+            return (
+                (jnp.roll(prev_dirty, 1), jnp.roll(dirty, 3)),
+                en + ln + sn + an + drn + ew.sum() + sv.sum(),
+            )
+        init_prev = (jnp.arange(n) % 16) == 0      # ~6% churn rows
         init_dirty = jnp.ones((n,), bool)
-        return lax.scan(body, (state.nbr, init_dirty), None, length=ticks)
+        carry, s = lax.scan(
+            body, (init_prev, init_dirty), None, length=ticks
+        )
+        return s.sum()
 
     out = {}
-    nbr, cnt = grid_neighbors(cfg.grid, st.pos, st.alive)
-    nbr, cnt = jax.block_until_ready((nbr, cnt))
+    nbr, cnt, fl = grid_neighbors_flags(
+        cfg.grid, st.pos, st.alive, flag_bits=st.dirty.astype(jnp.int32)
+    )
     for name, fn, args in (
         ("aoi", aoi_only, (st,)),
         ("move", move_only, (st,)),
-        ("collect", collect_only, (st, nbr, cnt)),
+        ("collect", collect_only, (st, nbr, fl)),
     ):
-        r = jax.block_until_ready(fn(*args))  # compile
+        float(np.asarray(fn(*args)))  # compile + force
         t0 = time.perf_counter()
-        r = jax.block_until_ready(fn(*args))
+        r = float(np.asarray(fn(*args)))
         dt = time.perf_counter() - t0
         out[name] = round(1000.0 * dt / ticks, 3)
         log(f"phase {name}: {out[name]} ms/tick")

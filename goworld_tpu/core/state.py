@@ -43,6 +43,9 @@ class WorldConfig:
     sync_cap: int = consts.DEFAULT_SYNC_CAP
     attr_sync_cap: int = consts.DEFAULT_EVENT_CAP
     input_cap: int = consts.DEFAULT_INPUT_CAP
+    delta_rows_cap: int = consts.DEFAULT_EVENT_CAP  # max rows whose AOI
+    # list may change per tick before enter/leave events overflow
+    # (ops.delta.interest_pairs)
 
     @property
     def bounds_min(self) -> tuple[float, float, float]:

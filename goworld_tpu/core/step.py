@@ -31,7 +31,7 @@ from goworld_tpu.models.npc_policy import (
 )
 from goworld_tpu.models.random_walk import random_walk_step
 from goworld_tpu.ops.aoi import grid_neighbors_flags
-from goworld_tpu.ops.delta import interest_delta, masked_pairs
+from goworld_tpu.ops.delta import interest_pairs
 from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
 from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
 
@@ -69,6 +69,9 @@ class TickOutputs:
     leave_w: jax.Array
     leave_j: jax.Array
     leave_n: jax.Array
+    delta_rows_n: jax.Array  # i32 TRUE count of rows whose AOI list
+    # changed; > cfg.delta_rows_cap means surplus rows' enter/leave
+    # events were dropped (widen delta_rows_cap, not enter/leave caps)
     sync_w: jax.Array    # i32[SC] watcher slots (has_client only)
     sync_j: jax.Array    # i32[SC] subject slots
     sync_vals: jax.Array  # f32[SC, 4]
@@ -162,11 +165,12 @@ def tick_body(
         flag_bits=dirty.astype(jnp.int32),
     )
 
-    # 5. interest deltas -> bounded enter/leave pair lists.
-    enter_mask, leave_mask = interest_delta(state.nbr, nbr, n)
-    enter_w, enter_j, enter_n = masked_pairs(enter_mask, nbr, cfg.enter_cap)
-    leave_w, leave_j, leave_n = masked_pairs(
-        leave_mask, state.nbr, cfg.leave_cap
+    # 5. interest deltas -> bounded enter/leave pair lists (changed rows
+    # only; the k^2 membership compare never touches stable rows).
+    (enter_w, enter_j, enter_n, leave_w, leave_j, leave_n,
+     delta_rows_n) = interest_pairs(
+        state.nbr, nbr, n, cfg.enter_cap, cfg.leave_cap,
+        min(cfg.delta_rows_cap, n),
     )
 
     # 6. position sync records (CollectEntitySyncInfos analog).
@@ -194,6 +198,7 @@ def tick_body(
     outputs = TickOutputs(
         enter_w=enter_w, enter_j=enter_j, enter_n=enter_n,
         leave_w=leave_w, leave_j=leave_j, leave_n=leave_n,
+        delta_rows_n=delta_rows_n,
         sync_w=sync_w, sync_j=sync_j, sync_vals=sync_vals, sync_n=sync_n,
         attr_e=attr_e, attr_i=attr_i, attr_v=attr_v, attr_n=attr_n,
         alive_count=state.alive.sum().astype(jnp.int32),

@@ -308,6 +308,10 @@ class World:
         desc = self.registry.get(type_name)
         if not desc.is_space:
             raise TypeError(f"{type_name} is not a space type")
+        if eid is not None and eid in self.entities:
+            # same guard as create_entity: a replayed CreateSpaceAnywhere
+            # must not silently replace a live space under its id
+            raise ValueError(f"entity id collision: {eid}")
         sp: Space = desc.cls()
         sp._type_desc = desc
         # honor a caller-supplied id (CreateSpaceAnywhere pre-generates one
@@ -1256,6 +1260,15 @@ class World:
             # decode (arrivals' enter events reference their new slots)
             self._mega_apply_arrivals(mega_pending, outs)
         for shard in range(self.n_spaces):
+            drn = int(base.delta_rows_n[shard])
+            drc = min(cfg.delta_rows_cap, cfg.capacity)
+            if drn > drc:
+                # the ROW cap overflowed: surplus rows' enter/leave events
+                # are gone and widening enter/leave caps won't help
+                logger.warning(
+                    "shard %d AOI delta rows overflow: %d > %d — widen "
+                    "WorldConfig.delta_rows_cap", shard, drn, drc,
+                )
             en = int(base.enter_n[shard])
             if en > cfg.enter_cap:
                 logger.warning(

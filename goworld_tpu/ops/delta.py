@@ -19,7 +19,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from goworld_tpu.ops.extract import bounded_extract_rows
+from goworld_tpu.ops.extract import bounded_extract, bounded_extract_rows
 
 
 def _not_in(a: jax.Array, b: jax.Array, sentinel) -> jax.Array:
@@ -67,3 +67,59 @@ def masked_pairs(
     watcher = jnp.where(valid, flat // k, -1)
     subject = jnp.where(valid, values.ravel()[flat], -1)
     return watcher, subject, count
+
+
+@partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def interest_pairs(
+    old_nbr: jax.Array,
+    new_nbr: jax.Array,
+    sentinel,
+    enter_cap: int,
+    leave_cap: int,
+    row_cap: int,
+) -> tuple[jax.Array, ...]:
+    """Fused changed-rows-only interest diff + pair extraction.
+
+    Equivalent to ``interest_delta`` + two ``masked_pairs`` calls — same
+    pairs, same order, same drop policy — but the k^2 membership compare
+    runs only on rows whose neighbor list CHANGED this tick. Lists are
+    canonical (ascending ids, sentinel-padded, no duplicates), so row
+    equality is set equality and equal rows can emit no events; at 60 Hz
+    neighbor churn touches a small fraction of rows, cutting the compare
+    from N*k^2 to row_cap*k^2 (the r02 1M-entity tick spends ~2G compares
+    here otherwise).
+
+    Returns (enter_w, enter_j, enter_n, leave_w, leave_j, leave_n,
+    changed_n). Counts are true demand within the selected rows;
+    ``changed_n`` is the TRUE number of changed rows — when it exceeds
+    ``row_cap``, surplus rows' events were dropped and the pair counts
+    additionally saturate past their caps, so a host watching only the
+    event counts still alarms, while a host watching ``changed_n`` can
+    name the right knob (``delta_rows_cap``, not enter/leave cap).
+    """
+    n, k = old_nbr.shape
+    changed = (old_nbr != new_nbr).any(axis=1)
+    changed_total = changed.sum().astype(jnp.int32)
+    rows = jnp.flatnonzero(changed, size=row_cap, fill_value=n).astype(
+        jnp.int32
+    )
+    rows_c = jnp.minimum(rows, n - 1)
+    row_ok = (rows < n)[:, None]
+    old_s = old_nbr[rows_c]                       # [R, k]
+    new_s = new_nbr[rows_c]
+    eq = new_s[:, :, None] == old_s[:, None, :]   # [R, k, k] — R << N
+    enter_m = row_ok & (new_s != sentinel) & ~eq.any(axis=2)
+    leave_m = row_ok & (old_s != sentinel) & ~eq.any(axis=1)
+
+    def pairs(mask, values, cap):
+        flat, valid, count = bounded_extract(mask, cap)
+        watcher = jnp.where(valid, rows_c[flat // k], -1)
+        subject = jnp.where(valid, values.ravel()[flat], -1)
+        return watcher, subject, count
+
+    ew, ej, en = pairs(enter_m, new_s, enter_cap)
+    lw, lj, ln = pairs(leave_m, old_s, leave_cap)
+    overflow = changed_total > row_cap
+    en = jnp.where(overflow, jnp.maximum(en, enter_cap + 1), en)
+    ln = jnp.where(overflow, jnp.maximum(ln, leave_cap + 1), ln)
+    return ew, ej, en, lw, lj, ln, changed_total

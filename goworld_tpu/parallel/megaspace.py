@@ -30,7 +30,7 @@ from goworld_tpu.core.state import SpaceState, WorldConfig
 from goworld_tpu.core.step import TickOutputs, compute_velocity
 from goworld_tpu.models.npc_policy import neighbor_mean_offset
 from goworld_tpu.ops.aoi import grid_neighbors_flags
-from goworld_tpu.ops.delta import interest_delta, masked_pairs
+from goworld_tpu.ops.delta import interest_pairs
 from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
 from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
 from goworld_tpu.parallel import migrate as mig
@@ -305,12 +305,10 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
             gid_ext[jnp.minimum(nbr_ext, p_ext - 1)],
         )
         nbr_gid = jnp.sort(nbr_gid, axis=1)
-        enter_mask, leave_mask = interest_delta(state.nbr, nbr_gid, gsent)
-        enter_w, enter_j, enter_n = masked_pairs(
-            enter_mask, nbr_gid, cfg.enter_cap
-        )
-        leave_w, leave_j, leave_n = masked_pairs(
-            leave_mask, state.nbr, cfg.leave_cap
+        (enter_w, enter_j, enter_n, leave_w, leave_j, leave_n,
+         delta_rows_n) = interest_pairs(
+            state.nbr, nbr_gid, gsent, cfg.enter_cap, cfg.leave_cap,
+            min(cfg.delta_rows_cap, n),
         )
 
         # 6. sync records over the extended population; subjects -> gids.
@@ -343,6 +341,7 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
             base=TickOutputs(
                 enter_w=enter_w, enter_j=enter_j, enter_n=enter_n,
                 leave_w=leave_w, leave_j=leave_j, leave_n=leave_n,
+                delta_rows_n=delta_rows_n,
                 sync_w=sync_w, sync_j=sync_j, sync_vals=sync_vals,
                 sync_n=sync_n,
                 attr_e=attr_e, attr_i=attr_i, attr_v=attr_v, attr_n=attr_n,

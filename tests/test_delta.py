@@ -61,3 +61,49 @@ def test_masked_pairs_overflow_reports_true_count():
     w, j, cnt = masked_pairs(jnp.asarray(mask), jnp.asarray(vals), 5)
     assert int(cnt) == 16      # true demand
     assert (np.asarray(w) >= 0).sum() == 5  # only cap extracted
+
+
+def test_interest_pairs_matches_masked_pairs():
+    from goworld_tpu.ops.delta import interest_pairs
+
+    rng = np.random.default_rng(11)
+    n, k, sentinel = 120, 6, 120
+    def rand_lists():
+        out = np.full((n, k), sentinel, np.int32)
+        for i in range(n):
+            cnt = rng.integers(0, k + 1)
+            ids = rng.choice(n, size=cnt, replace=False)
+            out[i, :cnt] = np.sort(ids)
+        return out
+    old = rand_lists()
+    new = old.copy()
+    touched = rng.uniform(size=n) < 0.3          # most rows unchanged
+    new[touched] = rand_lists()[touched]
+    em, lm = interest_delta(jnp.asarray(old), jnp.asarray(new), sentinel)
+    ew0, ej0, en0 = masked_pairs(em, jnp.asarray(new), 64)
+    lw0, lj0, ln0 = masked_pairs(lm, jnp.asarray(old), 64)
+    ew, ej, en, lw, lj, ln, drn = interest_pairs(
+        jnp.asarray(old), jnp.asarray(new), sentinel, 64, 64, n
+    )
+    assert int(drn) == int((old != new).any(axis=1).sum())
+    np.testing.assert_array_equal(np.asarray(ew0), np.asarray(ew))
+    np.testing.assert_array_equal(np.asarray(ej0), np.asarray(ej))
+    np.testing.assert_array_equal(np.asarray(lw0), np.asarray(lw))
+    np.testing.assert_array_equal(np.asarray(lj0), np.asarray(lj))
+    assert int(en0) == int(en) and int(ln0) == int(ln)
+
+
+def test_interest_pairs_row_overflow_saturates_counts():
+    from goworld_tpu.ops.delta import interest_pairs
+
+    n, k, sentinel = 16, 2, 16
+    old = np.full((n, k), sentinel, np.int32)
+    new = old.copy()
+    new[:, 0] = (np.arange(n) + 1) % n           # every row changes
+    ew, ej, en, lw, lj, ln, drn = interest_pairs(
+        jnp.asarray(old), jnp.asarray(new), sentinel, 4, 4, 8
+    )
+    assert int(drn) == n  # true changed-row demand surfaces
+    # only 8 rows selected, but counts must exceed the caps so the host
+    # overflow alarm fires
+    assert int(en) > 4
