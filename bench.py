@@ -56,8 +56,8 @@ BASELINE_ENTITY_TICKS_PER_CHIP = 7.5e6
 N = int(os.environ.get("BENCH_N", 1_048_576))
 BEHAVIOR = os.environ.get("BENCH_BEHAVIOR", "random_walk")  # or "mlp"
                                                             # (config 5)
-if BEHAVIOR not in ("random_walk", "mlp"):
-    raise SystemExit(f"BENCH_BEHAVIOR must be random_walk|mlp, "
+if BEHAVIOR not in ("random_walk", "mlp", "btree"):
+    raise SystemExit(f"BENCH_BEHAVIOR must be random_walk|mlp|btree, "
                      f"got {BEHAVIOR!r}")
 T = int(os.environ.get("BENCH_TICKS", 20))
 CLIENT_FRAC = float(os.environ.get("BENCH_CLIENT_FRAC", 0.01))
@@ -98,6 +98,8 @@ def build(n: int, client_frac: float):
         behavior=BEHAVIOR,  # "mlp" = config 5 (fused NPC behavior kernel)
         enter_cap=65536, leave_cap=65536,
         sync_cap=65536, attr_sync_cap=4096, input_cap=4096,
+        delta_rows_cap=65536,  # sized with enter/leave caps: 1M movers at
+                               # 60 Hz churn tens of thousands of rows/tick
     )
     key = jax.random.PRNGKey(0)
     k1, k2, k3, k4 = jax.random.split(key, 4)
@@ -123,6 +125,7 @@ def build(n: int, client_frac: float):
         attr_dirty=jnp.zeros(n, jnp.uint32),
         nbr=jnp.full((n, cfg.grid.k), n, jnp.int32),
         nbr_cnt=jnp.zeros(n, jnp.int32),
+        nbr_client_cnt=jnp.zeros(n, jnp.int32),
         nbr_mean_off=jnp.zeros((n, 3), jnp.float32),
         aoi_radius=jnp.full(n, jnp.inf, jnp.float32),
         dirty=jnp.zeros(n, bool),

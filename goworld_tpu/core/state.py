@@ -37,7 +37,8 @@ class WorldConfig:
     dt: float = 1.0 / consts.TICK_HZ
     npc_speed: float = 5.0
     turn_prob: float = 0.05                   # random-walk heading change/tick
-    behavior: str = "random_walk"             # or "mlp" (models.npc_policy)
+    behavior: str = "random_walk"             # "mlp" (models.npc_policy) or
+                                              # "btree" (models.behavior_tree)
     enter_cap: int = consts.DEFAULT_EVENT_CAP
     leave_cap: int = consts.DEFAULT_EVENT_CAP
     sync_cap: int = consts.DEFAULT_SYNC_CAP
@@ -46,6 +47,15 @@ class WorldConfig:
     delta_rows_cap: int = consts.DEFAULT_EVENT_CAP  # max rows whose AOI
     # list may change per tick before enter/leave events overflow
     # (ops.delta.interest_pairs)
+
+    def __post_init__(self):
+        if self.behavior not in ("random_walk", "mlp", "btree"):
+            # a typo would otherwise silently fall through to random_walk
+            # in compute_velocity
+            raise ValueError(
+                f"behavior must be random_walk|mlp|btree, "
+                f"got {self.behavior!r}"
+            )
 
     @property
     def bounds_min(self) -> tuple[float, float, float]:
@@ -75,6 +85,9 @@ class SpaceState:
     attr_dirty: jax.Array   # u32[N]   bitmask over attr columns
     nbr: jax.Array          # i32[N, k] sorted AOI neighbor list (sentinel N)
     nbr_cnt: jax.Array      # i32[N]
+    nbr_client_cnt: jax.Array  # i32[N] client-owning neighbors as of the
+                               # last AOI sweep (behavior-tree feature;
+                               # rides the sweep's flag bits for free)
     nbr_mean_off: jax.Array  # f32[N, 3] mean neighbor offset, computed at
                              # AOI time (megaspace MLP observations read
                              # this — its gid neighbor lists can't gather
@@ -105,6 +118,7 @@ def create_state(cfg: WorldConfig, seed: int = 0) -> SpaceState:
         attr_dirty=jnp.zeros((n,), jnp.uint32),
         nbr=jnp.full((n, k), n, jnp.int32),
         nbr_cnt=jnp.zeros((n,), jnp.int32),
+        nbr_client_cnt=jnp.zeros((n,), jnp.int32),
         nbr_mean_off=jnp.zeros((n, 3), jnp.float32),
         aoi_radius=jnp.full((n,), jnp.inf, jnp.float32),
         dirty=jnp.zeros((n,), bool),

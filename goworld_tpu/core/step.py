@@ -23,6 +23,11 @@ import jax.numpy as jnp
 from flax import struct
 
 from goworld_tpu.core.state import SpaceState, WorldConfig
+from goworld_tpu.models.behavior_tree import (
+    btree_velocity,
+    features_from_neighbors,
+    features_from_summary,
+)
 from goworld_tpu.models.npc_policy import (
     MLPPolicy,
     build_obs,
@@ -96,10 +101,26 @@ def compute_velocity(
 ) -> jax.Array:
     """Per-entity velocity update for cfg.behavior (shared by the single-
     space tick and the megaspace shard step). ``nbr``/``nbr_cnt`` are the
-    LOCAL-slot neighbor lists for the MLP observation; pass None when they
-    are unavailable (megaspace state holds global ids — its observation
-    then comes from the precomputed ``state.nbr_mean_off`` features the
-    previous tick's AOI sweep left behind)."""
+    LOCAL-slot neighbor lists for the MLP/behavior-tree observation; pass
+    None when they are unavailable (megaspace state holds global ids — its
+    observation then comes from the precomputed ``state.nbr_mean_off`` /
+    ``state.nbr_client_cnt`` features the previous tick's AOI sweep left
+    behind)."""
+    if cfg.behavior == "btree":
+        # fused Monster-AI behavior tree (BASELINE config 5;
+        # models.behavior_tree cites Monster.go:32-100)
+        if nbr is None:
+            feats = features_from_summary(
+                state.nbr_cnt, state.nbr_client_cnt, state.nbr_mean_off
+            )
+        else:
+            feats = features_from_neighbors(
+                pos, state.has_client, nbr, nbr_cnt
+            )
+        return btree_velocity(
+            key, feats, state.vel, state.npc_moving,
+            cfg.npc_speed, cfg.turn_prob,
+        )
     if cfg.behavior == "mlp":
         if nbr is None:
             obs = build_obs_from_features(
@@ -162,7 +183,8 @@ def tick_body(
     # as much as the sweep itself).
     nbr, nbr_cnt, nbr_fl = grid_neighbors_flags(
         cfg.grid, pos, state.alive, watch_radius=state.aoi_radius,
-        flag_bits=dirty.astype(jnp.int32),
+        flag_bits=dirty.astype(jnp.int32)
+        | (state.has_client.astype(jnp.int32) << 1),
     )
 
     # 5. interest deltas -> bounded enter/leave pair lists (changed rows
@@ -190,6 +212,7 @@ def tick_body(
         vel=vel,
         nbr=nbr,
         nbr_cnt=nbr_cnt,
+        nbr_client_cnt=((nbr_fl >> 1) & 1).sum(axis=1).astype(jnp.int32),
         dirty=jnp.zeros_like(state.dirty),
         attr_dirty=jnp.zeros_like(state.attr_dirty),
         rng=rng,

@@ -90,12 +90,12 @@ def interest_pairs(
     here otherwise).
 
     Returns (enter_w, enter_j, enter_n, leave_w, leave_j, leave_n,
-    changed_n). Counts are true demand within the selected rows;
-    ``changed_n`` is the TRUE number of changed rows — when it exceeds
-    ``row_cap``, surplus rows' events were dropped and the pair counts
-    additionally saturate past their caps, so a host watching only the
-    event counts still alarms, while a host watching ``changed_n`` can
-    name the right knob (``delta_rows_cap``, not enter/leave cap).
+    changed_n). Pair counts are true demand WITHIN the selected rows
+    (never fabricated — hosts slice ``[:min(n, cap)]`` and must not walk
+    padding); ``changed_n`` is the TRUE number of changed rows and is the
+    row-cap overflow signal: when it exceeds ``row_cap``, surplus rows'
+    events were dropped and the fix is widening ``delta_rows_cap`` —
+    enter/leave caps only bound the pairs within selected rows.
     """
     n, k = old_nbr.shape
     changed = (old_nbr != new_nbr).any(axis=1)
@@ -119,7 +119,4 @@ def interest_pairs(
 
     ew, ej, en = pairs(enter_m, new_s, enter_cap)
     lw, lj, ln = pairs(leave_m, old_s, leave_cap)
-    overflow = changed_total > row_cap
-    en = jnp.where(overflow, jnp.maximum(en, enter_cap + 1), en)
-    ln = jnp.where(overflow, jnp.maximum(ln, leave_cap + 1), ln)
     return ew, ej, en, lw, lj, ln, changed_total

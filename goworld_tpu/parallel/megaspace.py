@@ -276,12 +276,22 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
             jnp.full((ghost_rows,), jnp.inf, jnp.float32),
         ])
         # ghosts are candidates but never watchers: query only local rows.
-        # Dirty bits (local + ghost) ride the sweep so sync collection
-        # needs no [N, k] dirty gather.
+        # Dirty and has_client bits (local + ghost) ride the sweep so sync
+        # collection needs no [N, k] dirty gather and the behavior tree
+        # gets its players-in-AOI count for free. Halo records don't carry
+        # has_client, so remote-tile clients read as NPCs to the
+        # behavior tree (boundary approximation; transport.py-level parity
+        # is unaffected — sync/interest never consult bit 1 of ghosts).
         dirty_ext = jnp.concatenate([dirty, gdirty])
+        hc_ext = jnp.concatenate([
+            state.has_client,
+            jnp.zeros((ghost_rows,), bool),
+        ])
         nbr_ext, nbr_cnt, nbr_fl = grid_neighbors_flags(
             cfg.grid, pos_ext - shift, alive_ext, query_rows=n,
-            watch_radius=wr_ext, flag_bits=dirty_ext.astype(jnp.int32),
+            watch_radius=wr_ext,
+            flag_bits=dirty_ext.astype(jnp.int32)
+            | (hc_ext.astype(jnp.int32) << 1),
         )
 
         # 5. neighbor features for next tick's MLP observation (computed
@@ -289,7 +299,7 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
         #    translation below the positions are no longer addressable),
         #    then translate to stable GLOBAL ids and diff.
         p_ext = n + ghost_rows
-        if cfg.behavior == "mlp":  # static at trace time
+        if cfg.behavior in ("mlp", "btree"):  # static at trace time
             mean_off = neighbor_mean_offset(
                 pos_ext, state.pos, nbr_ext, nbr_cnt, p_ext
             )
@@ -332,6 +342,9 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
         state = state.replace(
             nbr=nbr_gid,
             nbr_cnt=nbr_cnt,
+            nbr_client_cnt=(
+                (nbr_fl >> 1) & 1
+            ).sum(axis=1).astype(jnp.int32),
             nbr_mean_off=mean_off,
             dirty=jnp.zeros_like(state.dirty),
             attr_dirty=jnp.zeros_like(state.attr_dirty),
