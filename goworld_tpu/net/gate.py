@@ -17,6 +17,8 @@ from __future__ import annotations
 import asyncio
 from collections import defaultdict
 
+import numpy as np
+
 from goworld_tpu.net import codec, proto
 from goworld_tpu.net.cluster import DispatcherCluster, DispatcherConn
 from goworld_tpu.net.packet import (
@@ -314,14 +316,23 @@ class GateService:
 
     def _handle_sync_on_clients(self, pkt: Packet) -> None:
         """Regroup 48B (cid+eid+pos) records per client and send each its
-        own 32B-record bundle (reference ``:350-375``)."""
+        own 32B-record bundle (reference ``:350-375``). Grouping is a
+        vectorized unique+argsort over the 16B client ids — Python work
+        scales with CLIENTS, not records."""
         buf = memoryview(pkt.buf)[pkt.rpos:]
         cids, eids, vals = codec.decode_client_sync_batch(buf)
-        per_client: dict[bytes, list[int]] = defaultdict(list)
-        for i, cid in enumerate(cids):
-            per_client[bytes(cid)].append(i)
-        for cid, idxs in per_client.items():
-            cp = self.clients.get(cid.decode("ascii", "replace"))
+        n = len(cids)
+        if n == 0:
+            return
+        keys = np.ascontiguousarray(cids).view("V16").ravel()
+        uniq, inv = np.unique(keys, return_inverse=True)
+        order = np.argsort(inv, kind="stable")
+        bounds = np.cumsum(np.bincount(inv, minlength=len(uniq)))
+        start = 0
+        for u, stop in zip(uniq, bounds):
+            idxs = order[start:start + (stop - start)]
+            start = stop
+            cp = self.clients.get(bytes(u).decode("ascii", "replace"))
             if cp is None:
                 continue
             out = new_packet(proto.MT_CLIENT_SYNC_POSITION_YAW)

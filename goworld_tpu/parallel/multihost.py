@@ -1,0 +1,114 @@
+"""Multi-host (multi-controller) deployment of the device mesh over DCN.
+
+Reference behavior being rebuilt: GoWorld scales past one machine by
+running more game processes connected through the dispatcher star over TCP
+(``engine/dispatchercluster/dispatchercluster.go:18-37``; SURVEY.md §5.8).
+The TPU-native equivalent keeps that host-side wire protocol for gates and
+cross-cluster RPC, but the ENTITY data plane — AOI halos, tile migration,
+global counters — rides XLA collectives. Within one host those collectives
+use ICI; across hosts, ``jax.distributed`` forms one global device mesh
+and the very same ``shard_map`` programs (:mod:`goworld_tpu.parallel.step`,
+:mod:`goworld_tpu.parallel.megaspace`) run unchanged, with XLA routing the
+``all_to_all`` / ``ppermute`` / ``psum`` legs that cross process
+boundaries over DCN (gRPC/Gloo on CPU test rigs, ICI+DCN on real pods).
+
+One process per host, SPMD: every process traces the same tick over the
+global mesh and owns the shards on its local devices. Host-side output
+decoding must therefore read only addressable shards —
+:func:`local_shard_outputs` — because a non-addressable shard's data never
+exists in this process.
+
+Tested end-to-end in ``tests/test_multihost.py``: two OS processes, four
+virtual CPU devices each, one 8-tile megaspace; an NPC walks across the
+process boundary and arrives on the other host's shard via the collective
+migration path, and ghost-zone interest enters fire across the boundary.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from goworld_tpu.parallel.mesh import SPACE_AXIS
+
+
+def init_distributed(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+) -> None:
+    """Join (or form) the multi-controller cluster.
+
+    Call BEFORE any other jax API touches a backend. Equivalent of the
+    reference game's dispatcher handshake (``DispatcherConnMgr.go:63-85``)
+    at the data-plane level: process 0 is the coordinator, everyone blocks
+    until all ``num_processes`` have joined. Device-count env knobs
+    (``xla_force_host_platform_device_count`` for CPU rigs) must already
+    be set in the environment — XLA reads them at backend init.
+    """
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(axis_name: str = SPACE_AXIS) -> Mesh:
+    """One mesh axis over EVERY device of EVERY process, in process order
+    (jax.devices() is globally consistent, so all processes build the
+    identical mesh and the shard_map programs agree)."""
+    return Mesh(np.array(jax.devices()), (axis_name,))
+
+
+def local_shard_indices(mesh: Mesh) -> list[int]:
+    """Mesh positions owned by THIS process (= the World shard indices
+    whose outputs this host may decode)."""
+    me = jax.process_index()
+    return [
+        i for i, d in enumerate(mesh.devices.ravel())
+        if d.process_index == me
+    ]
+
+
+def local_shard_outputs(out_tree, mesh: Mesh):
+    """Per-local-shard host copies of a sharded output pytree.
+
+    Returns ``(indices, [tree_of_np_arrays per local shard])`` where each
+    tree leaf has the leading [n_dev] axis stripped. Only addressable
+    shards are touched — never the cross-host ones.
+    """
+    idxs = local_shard_indices(mesh)
+    pos_of = {i: k for k, i in enumerate(idxs)}
+
+    def per_leaf(x):
+        rows = [None] * len(idxs)
+        for s in x.addressable_shards:
+            row = s.index[0] if s.index else slice(None)
+            if isinstance(row, slice):
+                if row.start is None and row.stop is None:
+                    # replicated on the mesh axis: every device holds the
+                    # full array — slice out this process's rows once
+                    data = np.asarray(s.data)
+                    for i in idxs:
+                        rows[pos_of[i]] = data[i]
+                    break
+                start = row.start or 0
+                stop = row.stop if row.stop is not None else start + 1
+                for off in range(stop - start):
+                    if start + off in pos_of:
+                        rows[pos_of[start + off]] = np.asarray(s.data)[off]
+                continue
+            if row in pos_of:
+                rows[pos_of[row]] = np.asarray(s.data)[0]
+        return rows
+
+    leaves, treedef = jax.tree_util.tree_flatten(out_tree)
+    per_shard_leaves = [per_leaf(x) for x in leaves]
+    trees = [
+        jax.tree_util.tree_unflatten(
+            treedef, [pl[k] for pl in per_shard_leaves]
+        )
+        for k in range(len(idxs))
+    ]
+    return idxs, trees

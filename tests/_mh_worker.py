@@ -1,0 +1,101 @@
+"""Worker process for tests/test_multihost.py — runs one controller of a
+2-process megaspace over a global 8-device mesh and prints JSON results.
+
+Invoked as: python -m tests._mh_worker <process_id> <coordinator_port>
+(env must already carry JAX_PLATFORMS=cpu and
+ XLA_FLAGS=--xla_force_host_platform_device_count=4).
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+
+    from goworld_tpu.parallel.multihost import (
+        global_mesh, init_distributed, local_shard_indices,
+        local_shard_outputs,
+    )
+    init_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+
+    import jax
+    import numpy as np
+    from goworld_tpu.core.state import WorldConfig, spawn
+    from goworld_tpu.ops.aoi import GridSpec
+    from goworld_tpu.parallel import MegaConfig, MultiTickInputs
+    from goworld_tpu.parallel.megaspace import (
+        create_mega_state, make_mega_tick,
+    )
+    from goworld_tpu.parallel.mesh import shard_state
+
+    n_dev, tile_w, radius = 8, 100.0, 10.0
+    cfg = WorldConfig(
+        capacity=16,
+        grid=GridSpec(radius=radius, extent_x=tile_w + 2 * radius,
+                      extent_z=100.0, k=8, cell_cap=16, row_block=16),
+        npc_speed=5.0,
+        enter_cap=256, leave_cap=256, sync_cap=256,
+    )
+    mc = MegaConfig(cfg=cfg, n_dev=n_dev, tile_w=tile_w,
+                    halo_cap=8, migrate_cap=4)
+    mesh = global_mesh()
+    assert mesh.devices.size == n_dev, "expected 8 global devices"
+    step = make_mega_tick(mc, mesh)
+    st = create_mega_state(mc)
+
+    from tests.conftest import spawn_on
+
+    # IDENTICAL program on both controllers (SPMD): a walker just west of
+    # the tile-3/tile-4 border (the process boundary: devices 0-3 are
+    # process 0, 4-7 process 1) heading east, plus a stationary watcher
+    # on tile 4 that must see the walker as a ghost before it migrates.
+    st = spawn_on(st, 3, 0, pos=(398.5, 0.0, 50.0))
+    st = spawn_on(st, 4, 0, pos=(401.0, 0.0, 50.0))
+    st = shard_state(st, mesh)
+
+    inputs = MultiTickInputs.empty(cfg, n_dev)
+    # drive the walker east by client position syncs: 1 unit/tick for a
+    # FIXED 2 ticks (398.5 -> 400.5 crosses the border), then stop — the
+    # drive schedule must be identical on both controllers (SPMD: the
+    # input arrays must never depend on process-local observations)
+    enters_seen = []
+    migrated_tick = -1
+    x = 398.5
+    for t in range(6):
+        x += 1.0
+        base = inputs.base
+        base = base.replace(
+            pos_sync_idx=base.pos_sync_idx.at[:, 0].set(0),
+            pos_sync_vals=base.pos_sync_vals.at[:, 0, :].set(
+                jax.numpy.asarray([x, 0.0, 50.0, 0.0])
+            ),
+            pos_sync_n=base.pos_sync_n.at[3].set(1 if t < 2 else 0),
+        )
+        st, out = step(st, inputs.replace(base=base), None)
+        idxs, outs = local_shard_outputs(out, mesh)
+        for i, o in zip(idxs, outs):
+            if int(o.arr_n) > 0 and migrated_tick < 0 and i == 4:
+                migrated_tick = t
+            n_ent = int(o.base.enter_n)
+            for w, j in zip(
+                np.asarray(o.base.enter_w)[:n_ent],
+                np.asarray(o.base.enter_j)[:n_ent],
+            ):
+                enters_seen.append((i, int(w), int(j)))
+    ga = int(np.asarray(
+        out.global_alive.addressable_shards[0].data
+    ).ravel()[0])
+    print(json.dumps({
+        "process": pid,
+        "local_shards": local_shard_indices(mesh),
+        "migrated_tick": migrated_tick,
+        "enters": enters_seen[:16],
+        "global_alive": ga,
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,3 +30,21 @@ def pytest_configure(config):
         "markers",
         "soak: long-running load tests (the reload-under-load soak)",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-process / long tests (multihost mesh, soak)",
+    )
+
+
+def spawn_on(states, dev, slot, **kw):
+    """Spawn into one device's shard of a stacked [n_dev, ...] state
+    (shared by the parallel/megaspace/multihost tests)."""
+    import jax
+
+    from goworld_tpu.core.state import spawn
+
+    one = jax.tree.map(lambda x: x[dev], states)
+    one = spawn(one, slot, **kw)
+    return jax.tree.map(
+        lambda full, new: full.at[dev].set(new), states, one
+    )

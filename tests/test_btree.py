@@ -166,13 +166,7 @@ def test_mega_btree_chases_cross_border_player():
     step = make_mega_tick(mc, mesh)
     st = create_mega_state(mc)
 
-    def spawn_on(st, dev, slot, **kw):
-        import jax as _jax
-        one = _jax.tree.map(lambda x: x[dev], st)
-        one = spawn(one, slot, **kw)
-        return _jax.tree.map(
-            lambda full, new: full.at[dev].set(new), st, one
-        )
+    from tests.conftest import spawn_on
 
     # monster on tile 2 at x=250; player 6 units east, same tile
     st = spawn_on(st, 2, 0, pos=(250.0, 0.0, 50.0), npc_moving=True)

@@ -1,0 +1,66 @@
+"""Multi-host (multi-controller) megaspace: two OS processes, one global
+8-device mesh, entity migration and AOI ghost interest across the PROCESS
+boundary (SURVEY.md §5.8 — the reference scales across machines via its
+dispatcher TCP star; here the data plane rides XLA collectives whose
+cross-process legs run over the distributed runtime: Gloo/gRPC on this
+CPU rig, ICI+DCN on real hardware)."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.slow
+def test_two_process_megaspace_migration_and_ghosts():
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # never dial the TPU relay
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tests._mh_worker", str(pid), str(port)],
+            cwd=REPO, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        for pid in (0, 1)
+    ]
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        r = json.loads(line)
+        results[r["process"]] = r
+
+    # each controller owns its half of the mesh
+    assert results[0]["local_shards"] == [0, 1, 2, 3]
+    assert results[1]["local_shards"] == [4, 5, 6, 7]
+    # both controllers agree on the global population (psum over DCN)
+    assert results[0]["global_alive"] == 2
+    assert results[1]["global_alive"] == 2
+    # the walker crossed the process boundary: process 1 saw the arrival
+    # on its shard 4 (process 0 can never see it — not addressable there)
+    assert results[1]["migrated_tick"] >= 0, (
+        f"no cross-process migration: {results[1]}"
+    )
+    # the tile-4 watcher (process 1) saw an AOI enter BEFORE the walker
+    # migrated — ghost-zone interest across the process boundary
+    shard4_enters = [
+        e for e in results[1]["enters"] if e[0] == 4 and e[1] == 0
+    ]
+    assert shard4_enters, (
+        f"tile-4 watcher never saw the cross-border ghost: {results[1]}"
+    )

@@ -36,12 +36,7 @@ def small_cfg(**kw):
     return WorldConfig(**base)
 
 
-def spawn_on(states, dev, slot, **kw):
-    one = jax.tree.map(lambda x: x[dev], states)
-    one = spawn(one, slot, **kw)
-    return jax.tree.map(
-        lambda full, new: full.at[dev].set(new), states, one
-    )
+from tests.conftest import spawn_on  # noqa: E402
 
 
 class TestMultiSpace:
