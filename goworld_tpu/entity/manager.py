@@ -221,6 +221,23 @@ class World:
         self._pos_cache: np.ndarray | None = None
         self._yaw_cache: np.ndarray | None = None
 
+        # multi-controller (multi-host) mode: every process runs this
+        # World as the SAME program (identical registrations, spawns and
+        # staged mutations each tick — the SPMD contract,
+        # goworld_tpu/parallel/multihost.py); device fetches then go
+        # through process_allgather, and CLIENT-FACING event decode
+        # (enter/leave/sync/attr fan-out) covers only the shards on this
+        # process's devices, so each host fans out exactly its own tiles'
+        # events. Bookkeeping (slot ownership, arrivals) stays global so
+        # every controller stages identical follow-up mutations.
+        self._multihost = mesh is not None and jax.process_count() > 1
+        if self._multihost:
+            from goworld_tpu.parallel.multihost import local_shard_indices
+
+            self.local_shards = local_shard_indices(mesh)
+        else:
+            self.local_shards = list(range(n_spaces))
+
         # pluggable sinks (the gateway overrides these; defaults capture)
         self.client_messages: list[tuple[int, str, dict]] = []
         self.client_sink: Callable[[int, str, dict], None] | None = None
@@ -957,7 +974,16 @@ class World:
         self._pos_cache = self._yaw_cache = None
         t0 = time.perf_counter()
         self.state, outs = self._step(self.state, inputs, self.policy)
-        outs = jax.device_get(outs)
+        outs = self._dget(outs)
+        if self._multihost:
+            # EAGER pos/yaw refresh: every controller executes these two
+            # collectives at the same point every tick. Lazy fetching
+            # would deadlock — read_pos is a process_allgather under
+            # multihost, and the owner-local decode below reaches it on
+            # ONE controller only (e.g. je.position while building a
+            # client enter message, or a user OnEnterAOI hook)
+            self._pos_cache = self._dget(self.state.pos)
+            self._yaw_cache = self._dget(self.state.yaw)
         self.op_stats["device_step_s"] = time.perf_counter() - t0
         self.last_outputs = outs  # observability (tests, opmon, dryrun)
         self._process_outputs(outs)
@@ -967,8 +993,46 @@ class World:
         opmon.monitor.record("world.tick", time.perf_counter() - t_start)
 
     # -- staging flush --------------------------------------------------
+    def _spmd_guard(self) -> None:
+        """Multi-controller divergence tripwire: every controller must
+        stage IDENTICAL mutations each tick (the SPMD contract — e.g. a
+        user AOI hook that spawns only on the event-owning controller
+        violates it and silently forks device state). Compare a cheap
+        signature of this tick's staging across processes and log loudly
+        on mismatch."""
+        import zlib
+
+        from jax.experimental import multihost_utils
+
+        sig = repr((
+            sorted(
+                (s, sl, sorted((k, str(v)) for k, v in d.items()))
+                for s, sl, d in self._staged_spawn
+            ),
+            sorted(self._staged_despawn),
+            sorted(self._staged_hot),
+            sorted(self._staged_moving),
+            sorted(self._staged_client),
+            sorted(
+                (k, e._pending_pos, e._pending_yaw)
+                for k, e in self._staged_pos.items()
+            ),
+            sorted(self._staged_migrate),
+        )).encode()
+        h = np.uint32(zlib.crc32(sig))
+        hs = multihost_utils.process_allgather(h)
+        if (np.asarray(hs) != np.asarray(hs).ravel()[0]).any():
+            logger.error(
+                "SPMD staging divergence across controllers (hashes %s): "
+                "device state is forking — all controllers must perform "
+                "identical World mutations each tick "
+                "(parallel/multihost.py contract)", np.asarray(hs),
+            )
+
     def _flush_staging(self):
         cfg = self.cfg
+        if self._multihost:
+            self._spmd_guard()
 
         # local-path migrations become a host repack (read row -> respawn
         # at destination) BEFORE the scatter flush below applies them
@@ -1150,7 +1214,7 @@ class World:
             ysh = np.array([s for s, _ in need_yaw], np.int32)
             ysl = np.array([s for _, s in need_yaw], np.int32)
             ysh, ysl = _pad_scatter(ysh, ysl, 0)  # pad only (gather clips)
-            got = jax.device_get(st.yaw[(ysh, ysl)])
+            got = self._dget(st.yaw[(ysh, ysl)])
             yaw_fb = {k: float(v) for k, v in zip(need_yaw, got)}
         overflow: dict[tuple[int, int], Entity] = {}
         for (shard, slot), e in entries:
@@ -1227,7 +1291,7 @@ class World:
         # X) on the destination tile for a subject X visible from both —
         # both slots resolve to the same host entity, so enters must be
         # applied last for the final interest set to be correct.
-        for shard in range(self.n_spaces):
+        for shard in self.local_shards:
             ln = int(base.leave_n[shard])
             if ln > cfg.leave_cap:
                 logger.warning(
@@ -1259,7 +1323,7 @@ class World:
             # events reference the previous owner) but BEFORE enter
             # decode (arrivals' enter events reference their new slots)
             self._mega_apply_arrivals(mega_pending, outs)
-        for shard in range(self.n_spaces):
+        for shard in self.local_shards:
             drn = int(base.delta_rows_n[shard])
             drc = min(cfg.delta_rows_cap, cfg.capacity)
             if drn > drc:
@@ -1296,7 +1360,7 @@ class World:
                         "attrs": je.get_all_clients_data(),
                         "pos": list(je.position), "yaw": je.yaw,
                     })
-        for shard in range(self.n_spaces):
+        for shard in self.local_shards:
             # position sync records -> watching clients
             sn = min(int(base.sync_n[shard]), cfg.sync_cap)
             if sn:
@@ -1443,7 +1507,7 @@ class World:
             "tiles full); respawning from host state — raise capacity",
             total_dropped,
         )
-        snap = jax.device_get({
+        snap = self._dget({
             "alive": self.state.alive,
             "moving": self.state.npc_moving,
             "yaw": self.state.yaw,
@@ -1540,7 +1604,13 @@ class World:
                 logger.warning("shard %d dropped %d migrants", shard, dropped)
 
         # unresolved requests: either the emigrant stayed behind
-        # (pack capacity) or it was dropped at a full destination
+        # (pack capacity) or it was dropped at a full destination.
+        # ONE batched alive fetch for the whole loop — per-entity reads
+        # would pay the transfer (or, under multihost, a DCN allgather)
+        # once per migrant
+        alive_np = None
+        if any(t not in resolved for t in self._migrate_tags):
+            alive_np = self._dget(self.state.alive)
         for t, (eid, src_sh, src_sl) in self._migrate_tags.items():
             if t in resolved:
                 continue
@@ -1550,7 +1620,7 @@ class World:
             if e.destroyed:
                 # destroyed while unresolved: drop whichever row survived
                 # and forget the entity
-                if bool(np.asarray(self.state.alive[src_sh, src_sl])):
+                if bool(alive_np[src_sh, src_sl]):
                     self._staged_despawn.append((src_sh, src_sl))
                 else:
                     self._slot_owner[src_sh].pop(src_sl, None)
@@ -1560,7 +1630,7 @@ class World:
                 e.shard = None
                 e._migrating = None
                 continue
-            still_there = bool(np.asarray(self.state.alive[src_sh, src_sl]))
+            still_there = bool(alive_np[src_sh, src_sl])
             src_id = self._shard_space[src_sh]
             src = self.spaces.get(src_id) if src_id else None
             if still_there and src is not None:
@@ -1607,12 +1677,26 @@ class World:
     # ==================================================================
     # device reads
     # ==================================================================
+    def _dget(self, x):
+        """Device fetch that works in BOTH controller modes: plain
+        device_get on a single controller; process_allgather under
+        multi-controller (a non-addressable shard's value can only cross
+        hosts through a collective, and the SPMD contract guarantees
+        every controller reaches this call at the same point)."""
+        if self._multihost:
+            from jax.experimental import multihost_utils
+
+            # tiled=True: global sharded arrays come back as their
+            # assembled global value (no stacked process axis)
+            return multihost_utils.process_allgather(x, tiled=True)
+        return jax.device_get(x)
+
     def read_pos(self, shard: int, slot: int) -> np.ndarray:
         if self._pos_cache is None:
-            self._pos_cache = np.asarray(self.state.pos)
+            self._pos_cache = self._dget(self.state.pos)
         return self._pos_cache[shard, slot]
 
     def read_yaw(self, shard: int, slot: int) -> float:
         if self._yaw_cache is None:
-            self._yaw_cache = np.asarray(self.state.yaw)
+            self._yaw_cache = self._dget(self.state.yaw)
         return float(self._yaw_cache[shard, slot])

@@ -47,7 +47,7 @@ def _device_snapshot(world: World) -> dict[str, np.ndarray]:
     """One batched transfer of every plane freeze needs (per-entity reads
     would pay the host<->device latency once per entity)."""
     st = world.state
-    return jax.device_get({
+    return world._dget({
         "pos": st.pos, "yaw": st.yaw, "npc_moving": st.npc_moving,
     })
 

@@ -1,0 +1,93 @@
+"""Worker for tests/test_multihost.py::test_world_api_multihost — a full
+World (entity API, megaspace space type, host bookkeeping) running SPMD on
+two controllers over one global mesh.
+
+Invoked as: python -m tests._mh_world_worker <process_id> <port>
+(env: JAX_PLATFORMS=cpu, XLA_FLAGS=--xla_force_host_platform_device_count=4).
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+
+    from goworld_tpu.parallel.multihost import global_mesh, init_distributed
+    init_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+
+    import numpy as np
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.entity.entity import Entity
+    from goworld_tpu.entity.manager import World
+    from goworld_tpu.entity.space import Space
+    from goworld_tpu.ops.aoi import GridSpec
+
+    n_dev, tile_w, radius = 8, 100.0, 10.0
+    cfg = WorldConfig(
+        capacity=16,
+        grid=GridSpec(radius=radius, extent_x=tile_w + 2 * radius,
+                      extent_z=100.0, k=8, cell_cap=16, row_block=16),
+        npc_speed=0.0,   # nothing wanders: motion comes from pos staging
+        enter_cap=256, leave_cap=256, sync_cap=256,
+    )
+    mesh = global_mesh()
+    w = World(cfg, n_spaces=n_dev, mesh=mesh, megaspace=True,
+              halo_cap=8, migrate_cap=4)
+
+    class Mega(Space):
+        pass
+
+    class Npc(Entity):
+        pass
+
+    w.registry.register("Mega", Mega, is_space=True, megaspace=True)
+    w.registry.register("Npc", Npc)
+
+    # IDENTICAL program on both controllers (the SPMD contract): the
+    # walker starts on tile 3 (process 0) and is driven east across the
+    # process boundary; a watcher sits on tile 4 (process 1).
+    sp = w.create_space("Mega")
+    walker = w.create_entity("Npc", space=sp, pos=(398.5, 0.0, 50.0),
+                             eid="walker_walker_00")
+    watcher = w.create_entity("Npc", space=sp, pos=(403.0, 0.0, 50.0),
+                              eid="watcher_watcher0")
+
+    events = []
+    orig = walker.OnEnterAOI
+
+    def on_enter(other):
+        events.append(("walker_sees", other.id))
+        return orig(other)
+    walker.OnEnterAOI = on_enter
+    worig = watcher.OnEnterAOI
+
+    def won_enter(other):
+        events.append(("watcher_sees", other.id))
+        return worig(other)
+    watcher.OnEnterAOI = won_enter
+
+    x = 398.5
+    for t in range(6):
+        if t < 3:
+            x += 1.0
+            walker.set_position((x, 0.0, 50.0))  # staged scatter, SPMD
+        w.tick()
+
+    out = {
+        "process": pid,
+        "local_shards": w.local_shards,
+        "walker_shard": walker.shard,
+        "watcher_shard": watcher.shard,
+        "walker_alive": not walker.destroyed and walker.slot is not None,
+        "events": events,
+        "watcher_interested_in": sorted(watcher.interested_in),
+        "walker_pos_x": float(walker.position[0]),
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

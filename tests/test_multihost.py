@@ -64,3 +64,48 @@ def test_two_process_megaspace_migration_and_ghosts():
     assert shard4_enters, (
         f"tile-4 watcher never saw the cross-border ghost: {results[1]}"
     )
+
+
+@pytest.mark.slow
+def test_world_api_multihost():
+    """The full World (entity API + megaspace + host bookkeeping) running
+    SPMD on two controllers: slot bookkeeping stays identical everywhere,
+    while AOI event fan-out is owner-local — the watcher's interest set
+    updates on the controller owning its tile."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tests._mh_world_worker",
+             str(pid), str(port)],
+            cwd=REPO, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        for pid in (0, 1)
+    ]
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=300)
+        assert p.returncode == 0, f"worker failed:\n{err[-2500:]}"
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        r = json.loads(line)
+        results[r["process"]] = r
+
+    r0, r1 = results[0], results[1]
+    # slot/shard bookkeeping is SPMD-identical on both controllers
+    assert r0["walker_shard"] == r1["walker_shard"] == 4, (r0, r1)
+    assert r0["watcher_shard"] == r1["watcher_shard"] == 4
+    assert r0["walker_alive"] and r1["walker_alive"]
+    # both controllers read the same committed device position
+    assert abs(r0["walker_pos_x"] - r1["walker_pos_x"]) < 1e-4
+    assert r0["walker_pos_x"] > 400.0
+    # event fan-out is owner-local: tile 4 belongs to process 1, so ONLY
+    # process 1 fired the watcher's OnEnterAOI / updated its interest set
+    assert "walker_walker_00" in r1["watcher_interested_in"]
+    assert ("watcher_sees", "walker_walker_00") in [
+        tuple(e) for e in r1["events"]
+    ]
+    assert "walker_walker_00" not in r0["watcher_interested_in"]
