@@ -34,7 +34,6 @@ import time
 from goworld_tpu import config as config_mod
 from goworld_tpu.utils import log
 from goworld_tpu.utils.consts import (
-    FREEZE_EXIT_CODE,
     SUPERVISOR_STARTED_TAG,
 )
 
@@ -356,6 +355,7 @@ def cmd_run_gate(gateid: int, configfile: str | None,
         svc = GateService(
             gateid, gc.host, gc.port, cfg.dispatcher_addrs(),
             ws_port=gc.ws_port,
+            kcp_port=gc.kcp_port,
             heartbeat_timeout=gc.heartbeat_timeout,
             position_sync_interval_ms=gc.position_sync_interval_ms,
             compress=gc.compress,

@@ -49,6 +49,8 @@ class GateConfig:
     host: str = "127.0.0.1"
     port: int = 15000
     ws_port: int = 0          # 0 = no websocket listener
+    kcp_port: int = 0         # 0 = no KCP (reliable-UDP) listener
+                              # (reference GateService.go:129-161)
     # client-edge transport (reference goworld.ini.sample compress/encrypt
     # flags; ClientProxy.go:38-53). encrypt=TLS on the TCP listener; the
     # cert/key are generated self-signed on first use when paths are empty.

@@ -16,7 +16,6 @@ bounded arrays the host/gateway fans out to clients
 
 from __future__ import annotations
 
-from functools import partial
 
 import jax
 import jax.numpy as jnp

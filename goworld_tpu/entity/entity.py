@@ -17,7 +17,7 @@ from __future__ import annotations
 
 from typing import TYPE_CHECKING, Any, Callable
 
-from goworld_tpu.entity.attrs import MapAttr, make_root
+from goworld_tpu.entity.attrs import MapAttr
 from goworld_tpu.entity.registry import EntityTypeDesc
 from goworld_tpu.utils import log
 

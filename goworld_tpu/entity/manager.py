@@ -1031,7 +1031,10 @@ class World:
 
     def _flush_staging(self):
         cfg = self.cfg
-        if self._multihost:
+        # tick_count is SPMD-consistent, so sampling keeps the collective
+        # uniform across controllers while keeping the tripwire off the
+        # steady-state hot path (it still catches a fork within 16 ticks)
+        if self._multihost and self.tick_count % 16 == 0:
             self._spmd_guard()
 
         # local-path migrations become a host repack (read row -> respawn

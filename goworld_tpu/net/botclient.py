@@ -113,18 +113,21 @@ class BotClient:
     """One bot: connects, waits for its player entity, random-walks.
 
     ``ws=True`` connects through the gate's websocket listener instead of
-    TCP (the reference test_client's ``-ws`` flag); ``compress``/``tls``
-    mirror the gate's client-edge transport flags (the reference client
-    reads the same ini the gate does)."""
+    TCP (the reference test_client's ``-ws`` flag); ``kcp=True`` dials the
+    gate's reliable-UDP listener (the ``-kcp`` flag, GateService.go:
+    129-161); ``compress``/``tls`` mirror the gate's client-edge
+    transport flags (the reference client reads the same ini the gate
+    does)."""
 
     def __init__(self, host: str, port: int, *, bot_id: int = 0,
                  strict: bool = False, move_interval: float = 0.1,
                  speed: float = 5.0, seed: int | None = None,
-                 ws: bool = False, compress: bool = False,
-                 tls: bool = False):
+                 ws: bool = False, kcp: bool = False,
+                 compress: bool = False, tls: bool = False):
         self.host = host
         self.port = port
         self.ws = ws
+        self.kcp = kcp
         self.compress = compress
         self.tls = tls
         self.bot_id = bot_id
@@ -150,6 +153,15 @@ class BotClient:
                 f"ws://{self.host}:{self.port}"
             )
             self.conn = WSPacketConnection(sock)
+            return
+        if self.kcp:
+            from goworld_tpu.net.kcp import open_kcp_connection
+
+            reader, writer = await open_kcp_connection(
+                self.host, self.port
+            )
+            self.conn = PacketConnection(reader, writer,
+                                         compress=self.compress)
             return
         ssl_ctx = None
         if self.tls:

@@ -24,7 +24,6 @@ from typing import Callable
 
 import numpy as np
 
-from goworld_tpu.core.state import WorldConfig
 from goworld_tpu.entity.entity import Entity, GameClient
 from goworld_tpu.entity.manager import World
 from goworld_tpu.net import codec, proto

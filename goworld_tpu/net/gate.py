@@ -100,6 +100,7 @@ class GateService:
         dispatcher_addrs: list[tuple[str, int]],
         *,
         ws_port: int = 0,
+        kcp_port: int = 0,
         heartbeat_timeout: float = 0.0,
         position_sync_interval_ms: int = 100,
         compress: bool = False,
@@ -110,6 +111,10 @@ class GateService:
         self.host = host
         self.port = port
         self.ws_port = ws_port
+        # reliable-UDP client edge (reference GateService.go:129-161
+        # serveKCP with turbo tuning): same framed protocol over
+        # net/kcp.py sessions; 0 = no KCP listener
+        self.kcp_port = kcp_port
         # client-edge transport options (reference ClientProxy.go:38-53
         # snappy + TLS; see net/transport.py for the codec choice and the
         # KCP deviation note). Compression/TLS apply to the TCP listener;
@@ -137,6 +142,7 @@ class GateService:
         self._sync_pending: dict[int, bytearray] = defaultdict(bytearray)
         self._server: asyncio.AbstractServer | None = None
         self._ws_server = None
+        self._kcp_server = None
         self.started = asyncio.Event()
         self.ws_started = asyncio.Event()
 
@@ -158,6 +164,18 @@ class GateService:
         if self.ws_port:
             tasks.append(asyncio.ensure_future(self._serve_ws()))
             await self.ws_started.wait()  # bind before declaring ready
+        if self.kcp_port:
+            from goworld_tpu.net.kcp import start_kcp_server
+
+            # KCP sessions reuse the SAME handler as TCP: the adapters
+            # present (reader, writer) so ClientProxy/PacketConnection
+            # run unchanged (no TLS over KCP — parity with kcp-go, whose
+            # crypto is a kcp-layer option the reference leaves off).
+            # kcp_port=-1 binds an ephemeral UDP port (tests).
+            self._kcp_server = await start_kcp_server(
+                self._handle_client, self.host,
+                max(self.kcp_port, 0),
+            )
         self.started.set()
         logger.info("gate%d listening on %s:%d", self.gate_id, self.host,
                     self.port)
@@ -176,6 +194,8 @@ class GateService:
             for cp in list(self.clients.values()):
                 await cp.conn.close()
             self._server.close()
+            if self._kcp_server is not None:
+                self._kcp_server.close()
             self.cluster.stop()
 
     def _on_dispatcher_lost(self, didx: int) -> None:
@@ -190,6 +210,11 @@ class GateService:
     def bound_port(self) -> int:
         assert self._server is not None
         return self._server.sockets[0].getsockname()[1]
+
+    @property
+    def bound_kcp_port(self) -> int:
+        assert self._kcp_server is not None
+        return self._kcp_server.bound_port
 
     # -- client side -----------------------------------------------------
     async def _handle_client(self, reader, writer) -> None:

@@ -30,7 +30,8 @@ class ClusterHarness:
                  desired_games: int = 1, host: str = "127.0.0.1",
                  heartbeat_timeout: float = 0.0,
                  position_sync_interval_ms: int = 20,
-                 with_ws: bool = False, compress: bool = False,
+                 with_ws: bool = False, with_kcp: bool = False,
+                 compress: bool = False,
                  tls_dir: str | None = None,
                  gate_exit_on_dispatcher_loss: bool = False):
         self.host = host
@@ -40,6 +41,8 @@ class ClusterHarness:
         self.heartbeat_timeout = heartbeat_timeout
         self.position_sync_interval_ms = position_sync_interval_ms
         self.with_ws = with_ws
+        self.with_kcp = with_kcp
+        self.gate_kcp_addrs: list[tuple[str, int]] = []
         # client-edge transport (reference goworld_actions.ini runs CI
         # with compression+encryption ON)
         self.compress = compress
@@ -106,6 +109,7 @@ class ClusterHarness:
             g = GateService(
                 i + 1, self.host, 0, list(self.dispatcher_addrs),
                 ws_port=ws_port,
+                kcp_port=-1 if self.with_kcp else 0,
                 heartbeat_timeout=self.heartbeat_timeout,
                 position_sync_interval_ms=self.position_sync_interval_ms,
                 compress=self.compress,
@@ -116,6 +120,8 @@ class ClusterHarness:
             self._tasks.append(asyncio.ensure_future(g.serve()))
             await g.started.wait()
             self.gate_addrs.append((self.host, g.bound_port))
+            if self.with_kcp:
+                self.gate_kcp_addrs.append((self.host, g.bound_kcp_port))
             if ws_port:
                 self.gate_ws_addrs.append((self.host, ws_port))
 

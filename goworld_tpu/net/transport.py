@@ -8,12 +8,11 @@ compression codec is zlib level 1 per packet (:mod:`goworld_tpu.net.packet`
 — python-snappy is not available in this environment; zlib-1 fills the
 same cheap-stream-compression role).
 
-KCP DEVIATION: the reference's third client transport is KCP, a
-reliable-UDP protocol tuned for latency (``GateService.go:129-161``).
-No KCP implementation exists in this environment's package set and a
-from-scratch ARQ stack is out of scope; TCP(+TLS) and WebSocket cover the
-client edge. The transport seam (PacketConnection over any asyncio
-stream pair) is where a KCP listener would slot in.
+The third client transport, KCP (reliable-UDP tuned for latency,
+``GateService.go:129-161``), is implemented from scratch in
+:mod:`goworld_tpu.net.kcp` — same wire protocol as the reference's
+kcp-go dependency, adapted to the (reader, writer) seam so
+PacketConnection runs unchanged over it.
 """
 
 from __future__ import annotations

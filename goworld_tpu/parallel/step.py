@@ -26,7 +26,6 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from goworld_tpu.core.state import SpaceState, WorldConfig
 from goworld_tpu.core.step import TickInputs, TickOutputs, tick_body
-from goworld_tpu.models.npc_policy import MLPPolicy
 from goworld_tpu.parallel import migrate as mig
 from goworld_tpu.parallel.mesh import SPACE_AXIS
 

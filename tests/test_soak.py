@@ -20,9 +20,14 @@ from goworld_tpu.net.game import GameServer
 from goworld_tpu.net.standalone import ClusterHarness
 from goworld_tpu.ops.aoi import GridSpec
 
-N_BOTS = 100
-SOAK_BEFORE_RELOAD = 20.0
-SOAK_AFTER_RELOAD = 25.0
+# reference CI scale is 200 bots / 300 s + 60 s after reload
+# (.github/workflows/test_game.yml:34-46); CI-sized defaults here, full
+# scale via env: SOAK_BOTS=200 SOAK_BEFORE=300 SOAK_AFTER=60
+import os as _os
+
+N_BOTS = int(_os.environ.get("SOAK_BOTS", 100))
+SOAK_BEFORE_RELOAD = float(_os.environ.get("SOAK_BEFORE", 20.0))
+SOAK_AFTER_RELOAD = float(_os.environ.get("SOAK_AFTER", 25.0))
 
 
 class Account(Entity):

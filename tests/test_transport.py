@@ -177,12 +177,12 @@ class Arena(Space):
     pass
 
 
-@pytest.fixture()
-def secure_cluster(tmp_path):
+def _cluster(**harness_kwargs):
+    """Shared 1-dispatcher/1-gate/1-game bring-up for the transport
+    variants; yields (harness, world, game_server) and tears down."""
     harness = ClusterHarness(
         n_dispatchers=1, n_gates=1, desired_games=1,
-        position_sync_interval_ms=20,
-        compress=True, tls_dir=str(tmp_path),
+        position_sync_interval_ms=20, **harness_kwargs,
     )
     harness.start()
     cfg = WorldConfig(
@@ -209,12 +209,17 @@ def secure_cluster(tmp_path):
 
     t = threading.Thread(target=loop, daemon=True)
     t.start()
-    assert gs.ready_event.wait(20), "deployment never became ready"
+    assert gs.ready_event.wait(60), "deployment never became ready"
     yield harness, world, gs
     stop.set()
     t.join(timeout=5)
     gs.stop()
     harness.stop()
+
+
+@pytest.fixture()
+def secure_cluster(tmp_path):
+    yield from _cluster(compress=True, tls_dir=str(tmp_path))
 
 
 async def _login_and_walk(bot: BotClient):
@@ -269,3 +274,24 @@ def test_plaintext_bot_rejected_by_tls_gate(secure_cluster):
 
     ok = harness.submit(attempt()).result(timeout=20)
     assert not ok, "plaintext client slipped through a TLS gate"
+
+
+# =======================================================================
+# KCP (reliable-UDP) client edge — reference GateService.go:129-161
+# =======================================================================
+@pytest.fixture()
+def kcp_cluster():
+    yield from _cluster(with_kcp=True)
+
+
+def test_bot_over_kcp(kcp_cluster):
+    """Full client flow (boot entity, RPC login, avatar handoff, strict
+    attr mirror, position sync) over the reliable-UDP listener."""
+    harness, world, gs = kcp_cluster
+    host, port = harness.gate_kcp_addrs[0]
+    bot = BotClient(host, port, strict=True, kcp=True)
+    harness.submit(_login_and_walk(bot)).result(timeout=40)
+    assert not bot.errors, bot.errors
+    avatars = [e for e in world.entities.values()
+               if e.type_name == "Avatar" and not e.destroyed]
+    assert len(avatars) == 1 and avatars[0].client is not None
