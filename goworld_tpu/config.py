@@ -199,6 +199,10 @@ heartbeat_timeout = 60
 
 [gate1]
 port = 15000
+# ws_port = 15100    # websocket listener
+# kcp_port = 15200   # KCP (reliable-UDP) listener
+# compress = true    # zlib stream compression (both ends must agree)
+# encrypt = true     # TLS on the TCP listener (self-signed on first use)
 
 [storage]
 kind = filesystem
