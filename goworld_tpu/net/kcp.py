@@ -33,8 +33,12 @@ anyway).
 from __future__ import annotations
 
 import asyncio
+import ctypes
+import os
 import secrets
 import struct
+import subprocess
+import threading
 import time
 from collections import deque
 from typing import Callable
@@ -279,6 +283,166 @@ class KcpCore:
             self.output(bytes(out))
 
 
+# ===================================================== native C++ core ==
+# Same state machine in C++ (native/kcp_core.cpp) — the reference links
+# kcp-go for exactly this role. The Python KcpCore above stays canonical
+# (and the fallback); sessions pick the native core when the .so builds.
+# GOWORLD_TPU_PURE_KCP=1 forces the Python core.
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
+_KCP_SO = os.path.abspath(os.path.join(_NATIVE_DIR, "_kcp_core.so"))
+_kcp_lib: ctypes.CDLL | None = None
+_kcp_lib_tried = False
+_kcp_build_lock = threading.Lock()
+
+
+def _load_native() -> ctypes.CDLL | None:
+    global _kcp_lib, _kcp_lib_tried
+    if _kcp_lib is not None or _kcp_lib_tried:
+        return _kcp_lib
+    with _kcp_build_lock:
+        if _kcp_lib is not None or _kcp_lib_tried:
+            return _kcp_lib
+        _kcp_lib_tried = True
+        if os.environ.get("GOWORLD_TPU_PURE_KCP") == "1":
+            return None
+        src = os.path.join(_NATIVE_DIR, "kcp_core.cpp")
+        if not os.path.exists(_KCP_SO):
+            if not os.path.exists(src):
+                return None
+            # build to a temp path and rename into place: a concurrent
+            # or interrupted build must never leave a corrupt .so that
+            # pins every future process to the fallback
+            tmp = f"{_KCP_SO}.{os.getpid()}.tmp"
+            cxx = os.environ.get("CXX", "g++")  # match the Makefile
+            try:
+                subprocess.run(
+                    [cxx, "-O3", "-Wall", "-Wextra", "-std=c++17",
+                     "-fPIC", "-shared", "-o", tmp, src],
+                    check=True, capture_output=True, timeout=120,
+                )
+                os.replace(tmp, _KCP_SO)
+            except (subprocess.SubprocessError, FileNotFoundError,
+                    OSError) as e:
+                logger.warning(
+                    "native kcp build failed (%s); using python core", e
+                )
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                return None
+        try:
+            lib = ctypes.CDLL(_KCP_SO)
+        except OSError as e:
+            logger.warning("native kcp load failed (%s)", e)
+            try:
+                os.unlink(_KCP_SO)  # let the next process rebuild
+            except OSError:
+                pass
+            return None
+        lib.kcp_create.restype = ctypes.c_void_p
+        lib.kcp_create.argtypes = [ctypes.c_uint32] + [ctypes.c_int] * 6
+        lib.kcp_free.argtypes = [ctypes.c_void_p]
+        lib.kcp_send.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.kcp_input.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+            ctypes.c_int64]
+        lib.kcp_recv.restype = ctypes.c_int
+        lib.kcp_recv.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.kcp_flush.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.kcp_drain_out.restype = ctypes.c_int
+        lib.kcp_drain_out.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int]
+        lib.kcp_unsent.restype = ctypes.c_int
+        lib.kcp_unsent.argtypes = [ctypes.c_void_p]
+        lib.kcp_dead.restype = ctypes.c_int
+        lib.kcp_dead.argtypes = [ctypes.c_void_p]
+        lib.kcp_announce.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        _kcp_lib = lib
+        return lib
+
+
+class NativeKcpCore:
+    """ctypes facade over the C++ core; same interface as KcpCore."""
+
+    def __init__(
+        self,
+        conv: int,
+        output: Callable[[bytes], None],
+        *,
+        mtu: int = 1400,
+        snd_wnd: int = 1024,
+        rcv_wnd: int = 1024,
+        interval: int = 10,
+        resend: int = 2,
+        rx_minrto: int = 10,
+    ):
+        self._lib = _load_native()
+        assert self._lib is not None
+        self.conv = conv
+        self.output = output
+        self.interval = interval
+        self._h = self._lib.kcp_create(
+            conv, mtu, snd_wnd, rcv_wnd, interval, resend, rx_minrto
+        )
+        self._buf = ctypes.create_string_buffer(max(mtu, 65536))
+
+    @property
+    def dead(self) -> bool:
+        return bool(self._lib.kcp_dead(self._h))
+
+    def send(self, data: bytes) -> None:
+        self._lib.kcp_send(self._h, bytes(data), len(data))
+
+    def unsent(self) -> int:
+        return self._lib.kcp_unsent(self._h)
+
+    def input(self, datagram: bytes) -> None:
+        self._lib.kcp_input(
+            self._h, bytes(datagram), len(datagram), _now_ms()
+        )
+
+    def recv(self) -> bytes | None:
+        n = self._lib.kcp_recv(self._h, self._buf, len(self._buf))
+        if n == 0:
+            return None
+        if n < 0:  # chunk larger than buffer (can't happen at our MTUs)
+            raise ConnectionError("kcp recv buffer overflow")
+        return self._buf.raw[:n]
+
+    def _drain(self) -> None:
+        while True:
+            n = self._lib.kcp_drain_out(self._h, self._buf, len(self._buf))
+            if n == 0:
+                return
+            if n < 0:
+                raise ConnectionError("kcp datagram buffer overflow")
+            self.output(self._buf.raw[:n])
+
+    def flush(self) -> None:
+        self._lib.kcp_flush(self._h, _now_ms())
+        self._drain()
+
+    def announce(self) -> None:
+        self._lib.kcp_announce(self._h, _now_ms())
+        self._drain()
+
+    def __del__(self):
+        h, lib = getattr(self, "_h", None), getattr(self, "_lib", None)
+        if h and lib is not None:
+            lib.kcp_free(h)
+
+
+def make_core(conv: int, output: Callable[[bytes], None]):
+    """Native core when available, Python otherwise (same protocol)."""
+    if _load_native() is not None:
+        return NativeKcpCore(conv, output)
+    return KcpCore(conv, output)
+
+
 # ======================================================== asyncio layer ==
 
 class KcpWriter:
@@ -333,7 +497,7 @@ class _Session:
             except OSError:
                 pass
 
-        self.core = KcpCore(conv, output)
+        self.core = make_core(conv, output)
         self.reader = asyncio.StreamReader()
         self.writer = KcpWriter(self.core, addr, self.close)
         self.await_peer = False   # client side: re-announce until heard

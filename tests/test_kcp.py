@@ -144,3 +144,124 @@ def test_dead_link_detected():
     finally:
         kcpmod._now_ms = real_now
     assert a.dead
+
+
+# =======================================================================
+# native C++ core (native/kcp_core.cpp) — must interoperate with the
+# Python core bit-for-bit (same wire protocol; kcp-go parity role)
+# =======================================================================
+def _native_available():
+    from goworld_tpu.net.kcp import _load_native
+    return _load_native() is not None
+
+
+@pytest.mark.skipif(not _native_available(), reason="no native kcp core")
+@pytest.mark.parametrize("a_native,b_native", [
+    (True, True), (True, False), (False, True),
+])
+def test_native_core_interop_under_loss(a_native, b_native):
+    from goworld_tpu.net.kcp import NativeKcpCore
+
+    rng = random.Random(23)
+    a_out, b_out = [], []
+
+    def mk(native, sink, lossp):
+        out = (lambda d: sink.append(d) if rng.random() > lossp else None)
+        return NativeKcpCore(5, out) if native else KcpCore(5, out)
+
+    a = mk(a_native, a_out, 0.25)
+    b = mk(b_native, b_out, 0.25)
+    payload = bytes(rng.getrandbits(8) for _ in range(30000))
+    a.send(payload)
+    b.send(payload[::-1])    # full-duplex
+    got_b, got_a = bytearray(), bytearray()
+    import goworld_tpu.net.kcp as kcpmod
+    t = kcpmod._now_ms()
+    real_now = kcpmod._now_ms
+    step = 0
+    try:
+        while (len(got_b) < len(payload) or len(got_a) < len(payload)) \
+                and step < 4000:
+            step += 1
+            kcpmod._now_ms = lambda: t + step * 10
+            a.flush()
+            for d in a_out:
+                b.input(d)
+            a_out.clear()
+            b.flush()
+            for d in b_out:
+                a.input(d)
+            b_out.clear()
+            while (chunk := b.recv()) is not None:
+                got_b += chunk
+            while (chunk := a.recv()) is not None:
+                got_a += chunk
+    finally:
+        kcpmod._now_ms = real_now
+    assert bytes(got_b) == payload
+    assert bytes(got_a) == payload[::-1]
+
+
+@pytest.mark.skipif(not _native_available(), reason="no native kcp core")
+def test_native_core_drives_the_gate_stack():
+    """The asyncio adapters pick the native core automatically; the full
+    PacketConnection flow must still work."""
+    from goworld_tpu.net.kcp import _Session, make_core, NativeKcpCore
+    assert isinstance(make_core(1, lambda d: None), NativeKcpCore)
+
+    async def main():
+        got = []
+
+        async def on_client(reader, writer):
+            conn = PacketConnection(reader, writer)
+            mt, p = await conn.recv()
+            got.append((mt, p.read_var_str()))
+            reply = new_packet(31)
+            reply.append_var_str("native-pong")
+            conn.send(reply)
+            await conn.drain()
+
+        server = await start_kcp_server(on_client, "127.0.0.1", 0)
+        reader, writer = await open_kcp_connection(
+            "127.0.0.1", server.bound_port
+        )
+        conn = PacketConnection(reader, writer)
+        p = new_packet(30)
+        p.append_var_str("native-ping" * 400)
+        conn.send(p)
+        await conn.drain()
+        mt, reply = await conn.recv()
+        assert mt == 31 and reply.read_var_str() == "native-pong"
+        await conn.close()
+        server.close()
+        return got
+
+    got = run(main())
+    assert got == [(30, "native-ping" * 400)]
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_crafted_len_field_rejected(use_native):
+    """A datagram whose len field is near 2^31 must be rejected, not
+    drive a negative offset into an out-of-bounds read (native core) or
+    a bogus slice (python core)."""
+    if use_native and not _native_available():
+        pytest.skip("no native kcp core")
+    from goworld_tpu.net.kcp import NativeKcpCore
+    import struct as _s
+
+    cls = NativeKcpCore if use_native else KcpCore
+    core = cls(5, lambda d: None)
+    evil = _s.pack("<IBBHIII", 5, 81, 0, 64, 0, 0, 0) \
+        + _s.pack("<I", 0x80000000) + b"xx"
+    core.input(evil)                      # must not crash
+    assert core.recv() is None
+    # and a 0-len PUSH never wedges the recv drain behind it
+    z = _s.pack("<IBBHIII", 5, 81, 0, 64, 0, 0, 0) + _s.pack("<I", 0)
+    d = _s.pack("<IBBHIII", 5, 81, 0, 64, 0, 1, 0) \
+        + _s.pack("<I", 4) + b"data"
+    core.input(z + d)
+    chunks = []
+    while (c := core.recv()) is not None:
+        chunks.append(c)
+    assert b"".join(chunks) == b"data"
