@@ -138,6 +138,8 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
         capacity=gc.capacity,
         grid=GridSpec(radius=gc.aoi_radius, extent_x=gc.extent_x,
                       extent_z=gc.extent_z),
+        npc_speed=gc.npc_speed,
+        behavior=gc.behavior,
     )
     mesh = None
     if gc.mesh_devices > 1:

@@ -42,6 +42,9 @@ class GameConfig:
     extent_x: float = 1000.0
     extent_z: float = 1000.0
     mesh_devices: int = 0  # 0 = single-device vmap path
+    npc_speed: float = 5.0
+    behavior: str = "random_walk"  # random_walk | mlp | btree (the fused
+                                   # NPC kernels, BASELINE config 5)
 
 
 @dataclasses.dataclass
@@ -138,9 +141,12 @@ def load(path: str | None = None) -> ClusterConfig:
 
     cfg = ClusterConfig()
 
+    def common_of(prefix: str):
+        name = f"{prefix}_common"
+        return cp[name] if cp.has_section(name) else {}
+
     def build(prefix: str, cls, store: dict) -> None:
-        common = cp[f"{prefix}_common"] if cp.has_section(
-            f"{prefix}_common") else {}
+        common = common_of(prefix)
         for name in cp.sections():
             if name.startswith(prefix) and name[len(prefix):].isdigit():
                 idx = int(name[len(prefix):])
@@ -153,7 +159,39 @@ def load(path: str | None = None) -> ClusterConfig:
     build("game", GameConfig, cfg.games)
     build("gate", GateConfig, cfg.gates)
     if cp.has_section("deployment"):
-        _fill(cfg, cp["deployment"])
+        dep = cp["deployment"]
+        if "entry" in dep:
+            cfg.entry = dep["entry"]
+        # reference semantics: [deployment] declares DESIRED COUNTS
+        # (read_config.go:40-118): counts beyond the explicit numbered
+        # sections auto-create defaults from the *_common section, and
+        # sections beyond the count are dropped (the count IS the
+        # deployment). Auto-created listeners get a per-index port
+        # offset — inheriting one host:port N times would EADDRINUSE at
+        # start. (These keys share names with ClusterConfig's dicts —
+        # never _fill them, or `games = 3` would clobber the dict.)
+        for key, cls, store, prefix in (
+            ("dispatchers", DispatcherConfig, cfg.dispatchers,
+             "dispatcher"),
+            ("games", GameConfig, cfg.games, "game"),
+            ("gates", GateConfig, cfg.gates, "gate"),
+        ):
+            if key not in dep:
+                continue
+            want = int(dep[key])
+            common = common_of(prefix)
+            for idx in range(1, want + 1):
+                if idx not in store:
+                    dc = cls()
+                    _fill(dc, common)
+                    for pf in ("port", "ws_port", "kcp_port",
+                               "http_port"):
+                        base = getattr(dc, pf, 0)
+                        if base:
+                            setattr(dc, pf, base + idx - 1)
+                    store[idx] = dc
+            for idx in [i for i in store if i > want]:
+                del store[idx]
     if cp.has_section("storage"):
         _fill(cfg.storage, cp["storage"])
     if cp.has_section("kvdb"):
@@ -189,6 +227,7 @@ n_spaces = 1
 aoi_radius = 50.0
 extent_x = 1000.0
 extent_z = 1000.0
+# behavior = btree   # fused NPC kernel: random_walk | mlp | btree
 
 [game1]
 

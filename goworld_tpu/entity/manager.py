@@ -131,6 +131,12 @@ class World:
         self.registry = Registry()
         self.mesh = mesh
         self.policy = None  # MLPPolicy when cfg.behavior == 'mlp'
+        if cfg.behavior == "mlp":
+            # config-built worlds need a live policy; callers may replace
+            # it (e.g. with trained weights) before the first tick
+            from goworld_tpu.models.npc_policy import init_policy
+
+            self.policy = init_policy(jax.random.PRNGKey(seed))
         self.mega = None    # MegaConfig when megaspace=True
         if mesh is not None and mesh.devices.size != n_spaces:
             raise ValueError(

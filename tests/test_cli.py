@@ -94,3 +94,66 @@ def _logs(server_dir: str) -> str:
 def test_sample_config_prints(capsys):
     assert cli.main(["sample-config"]) == 0
     assert "[dispatcher1]" in capsys.readouterr().out
+
+
+def test_deployment_counts_autocreate_sections(tmp_path):
+    """[deployment] declares desired counts (reference read_config.go:
+    40-118): counts beyond the numbered sections create defaults from
+    *_common, and the count keys never clobber the parsed dicts."""
+    from goworld_tpu import config as config_mod
+
+    ini = tmp_path / "goworld.ini"
+    ini.write_text(
+        "[deployment]\n"
+        "dispatchers = 2\n"
+        "games = 3\n"
+        "gates = 1\n"
+        "[dispatcher1]\n"
+        "port = 14100\n"
+        "[game_common]\n"
+        "capacity = 512\n"
+        "behavior = btree\n"
+        "[game1]\n"
+        "capacity = 1024\n"
+        "[gate1]\n"
+        "port = 15100\n"
+    )
+    cfg = config_mod.load(str(ini))
+    assert sorted(cfg.dispatchers) == [1, 2]
+    assert sorted(cfg.games) == [1, 2, 3]
+    assert cfg.desired_games == 3
+    # explicit section keeps its override; auto-created ones get _common
+    assert cfg.games[1].capacity == 1024
+    assert cfg.games[2].capacity == 512
+    assert cfg.games[2].behavior == "btree"
+    assert cfg.gates[1].port == 15100
+
+
+def test_deployment_counts_offset_ports_and_truncate(tmp_path):
+    """Auto-created listeners get per-index port offsets (no EADDRINUSE
+    at start) and sections beyond the declared count are dropped."""
+    from goworld_tpu import config as config_mod
+
+    ini = tmp_path / "goworld.ini"
+    ini.write_text(
+        "[deployment]\n"
+        "dispatchers = 3\n"
+        "games = 1\n"
+        "gates = 2\n"
+        "[dispatcher_common]\n"
+        "port = 14100\n"
+        "[dispatcher1]\n"
+        "port = 14000\n"
+        "[game1]\n"
+        "[game2]\n"          # beyond the declared count: dropped
+        "[gate_common]\n"
+        "port = 15100\n"
+        "kcp_port = 15200\n"
+    )
+    cfg = config_mod.load(str(ini))
+    assert cfg.dispatchers[1].port == 14000          # explicit wins
+    assert cfg.dispatchers[2].port == 14101          # common + offset
+    assert cfg.dispatchers[3].port == 14102
+    assert sorted(cfg.games) == [1]                  # truncated to count
+    assert cfg.gates[1].port == 15100 and cfg.gates[1].kcp_port == 15200
+    assert cfg.gates[2].port == 15101 and cfg.gates[2].kcp_port == 15201
