@@ -287,6 +287,16 @@ def game_server():
     return _require_rt().server
 
 
+def checkpoint_async(directory: str = "."):
+    """Crash-recovery snapshot of the running world without stalling the
+    tick loop (beyond reference parity — the reference only has
+    stop-the-world freeze; see freeze.checkpoint_async). Returns a
+    handle; call ``.join()`` to wait."""
+    from goworld_tpu import freeze as freeze_mod
+
+    return freeze_mod.checkpoint_async(_require_rt().world, directory)
+
+
 # =======================================================================
 # entity / space ops (reference goworld.go:52-140)
 # =======================================================================

@@ -189,9 +189,34 @@ def load(path: str | None = None) -> ClusterConfig:
                         base = getattr(dc, pf, 0)
                         if base:
                             setattr(dc, pf, base + idx - 1)
+                    lf = getattr(dc, "log_file", "")
+                    if lf:  # shared log files interleave unattributably
+                        stem, dot, ext = lf.rpartition(".")
+                        setattr(dc, "log_file",
+                                f"{stem}{idx}{dot}{ext}" if dot
+                                else f"{lf}{idx}")
                     store[idx] = dc
             for idx in [i for i in store if i > want]:
                 del store[idx]
+        # explicit sections inheriting a *_common port can still collide
+        # with an auto-created sibling's offset scheme: detect instead of
+        # guessing intent
+        for role, store in (("dispatcher", cfg.dispatchers),
+                            ("gate", cfg.gates)):
+            seen: dict[tuple, int] = {}
+            for idx, dc in sorted(store.items()):
+                for pf in ("port", "ws_port", "kcp_port", "http_port"):
+                    p = getattr(dc, pf, 0)
+                    if not p or p < 0:
+                        continue
+                    key = (getattr(dc, "host", ""), p)
+                    if key in seen:
+                        raise ValueError(
+                            f"{role}{idx} {pf} {p} collides with "
+                            f"{role}{seen[key]} — give each listener a "
+                            "distinct port"
+                        )
+                    seen[key] = idx
     if cp.has_section("storage"):
         _fill(cfg.storage, cp["storage"])
     if cp.has_section("kvdb"):

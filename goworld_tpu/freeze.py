@@ -52,11 +52,25 @@ def _device_snapshot(world: World) -> dict[str, np.ndarray]:
     })
 
 
-def _pack_entity(world: World, e: Entity, snap: dict | None) -> dict:
+# sentinel: pack device-resident pos/yaw/moving LATER from a state
+# reference (async checkpoints patch the records off-thread)
+_DEFER = object()
+
+
+def _pack_entity(world: World, e: Entity, snap) -> dict:
     """Migrate-style record (``GetMigrateData``, ``Entity.go:1060-1101``)
     plus the space binding freeze needs and migrate doesn't."""
-    if snap is not None and e.slot is not None and e.shard is not None \
-            and e._pending_pos is None:
+    live_slot = (
+        e.slot is not None and e.shard is not None
+        and e._pending_pos is None
+    )
+    extra: dict = {}
+    if live_slot and snap is _DEFER:
+        # placeholders; the checkpoint worker patches pos/yaw/moving
+        # from the captured state off-thread (no device read here)
+        pos, yaw, moving = [0.0, 0.0, 0.0], 0.0, False
+        extra["_slot"] = [e.shard, e.slot]
+    elif live_slot and snap is not None:
         shard, slot = e.shard, e.slot
         pos = [float(v) for v in snap["pos"][shard, slot]]
         yaw = float(snap["yaw"][shard, slot])
@@ -65,7 +79,7 @@ def _pack_entity(world: World, e: Entity, snap: dict | None) -> dict:
         pos = [float(v) for v in e.position]
         yaw = float(e._pending_yaw or 0.0)
         moving = False
-    return {
+    return extra | {
         "type": e.type_name,
         "id": e.id,
         "attrs": e.attrs.to_dict(),
@@ -81,19 +95,26 @@ def _pack_entity(world: World, e: Entity, snap: dict | None) -> dict:
     }
 
 
-def freeze_world(world: World) -> dict:
+def freeze_world(world: World, *, _snap=None, run_hooks: bool = True
+                 ) -> dict:
     """Pack the entire world. Requires exactly one nil space (the same
-    invariant the reference asserts, ``EntityManager.go:536-541``)."""
+    invariant the reference asserts, ``EntityManager.go:536-541``).
+
+    ``_snap=_DEFER`` packs host state only, embedding (shard, slot) refs
+    for the checkpoint worker to patch later; ``run_hooks=False`` skips
+    OnFreeze (async checkpoints snapshot a RUNNING world — the reload
+    hook contract doesn't apply)."""
     if world.nil_space is None:
         raise RuntimeError("cannot freeze: no nil space")
-    snap = _device_snapshot(world)
+    snap = _snap if _snap is not None else _device_snapshot(world)
 
-    for e in list(world.entities.values()):
-        if not e.destroyed:
-            try:
-                e.OnFreeze()
-            except Exception:
-                logger.exception("OnFreeze failed for %s", e)
+    if run_hooks:
+        for e in list(world.entities.values()):
+            if not e.destroyed:
+                try:
+                    e.OnFreeze()
+                except Exception:
+                    logger.exception("OnFreeze failed for %s", e)
 
     spaces: list[dict] = []
     entities: list[dict] = []
@@ -248,5 +269,109 @@ def freeze_to_file(world: World, directory: str = ".") -> str:
 
 
 def restore_from_file(world: World, directory: str = ".") -> None:
+    """Restore for a ``-restore`` boot: the freeze file (intentional
+    reload) wins when present; otherwise a crash-recovery checkpoint
+    written by :func:`checkpoint_async` is used — the capability the
+    reference lacks (a crashed, unfrozen game there loses everything
+    since the last persistence save; SURVEY.md §5.3)."""
     path = os.path.join(directory, freeze_filename(world.game_id))
+    if not os.path.exists(path):
+        ckpt = os.path.join(
+            directory, checkpoint_filename(world.game_id)
+        )
+        if os.path.exists(ckpt):
+            logger.info(
+                "no freeze file; restoring from async checkpoint %s",
+                ckpt,
+            )
+            path = ckpt
     restore_world(world, read_freeze_file(path))
+
+
+# =======================================================================
+# async checkpoint (crash recovery while the world keeps running)
+# =======================================================================
+def checkpoint_filename(game_id: int) -> str:
+    return f"game{game_id}_checkpoint.dat"
+
+
+class CheckpointHandle:
+    """Handle to an in-flight async checkpoint: ``join()`` waits, then
+    ``path``/``error`` report the outcome."""
+
+    def __init__(self):
+        self.path: str | None = None
+        self.error: BaseException | None = None
+        self._thread: "threading.Thread | None" = None
+
+    def join(self, timeout: float | None = None) -> "CheckpointHandle":
+        assert self._thread is not None
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError("checkpoint still in flight")
+        if self.error is not None:
+            raise self.error
+        return self
+
+
+def checkpoint_async(world: World, directory: str = ".") -> CheckpointHandle:
+    """Snapshot a RUNNING world without stalling its tick loop.
+
+    The reference has only stop-the-world freeze (SIGHUP reload, SURVEY.md
+    §3.6) plus per-entity attr persistence; a TPU world can do better
+    because device state is immutable — capturing ``world.state`` costs
+    nothing, and the host part (attrs, timers, client bindings) packs
+    synchronously at the same tick boundary. The slow work — the
+    device->host transfer of the captured planes and the file write —
+    runs on a background thread while ticks continue. The file is the
+    standard freeze format (written atomically), restorable with
+    :func:`restore_world` / :func:`restore_from_file`.
+
+    Call from the logic thread, between ticks.
+    """
+    import threading
+
+    if getattr(world, "_multihost", False):
+        # a background device fetch would be a one-sided collective
+        # under multi-controller (manager._dget contract); checkpoint
+        # synchronously there instead
+        raise RuntimeError(
+            "checkpoint_async is single-controller only; multihost "
+            "worlds must checkpoint synchronously (freeze_to_file)"
+        )
+    if getattr(world, "_ckpt_inflight", False):
+        # overlapping checkpoints would race on the same output path;
+        # calls come from the logic thread, so a plain flag suffices
+        raise RuntimeError("a checkpoint is already in flight")
+    world._ckpt_inflight = True
+    state_ref = world.state            # immutable pytree: the snapshot
+    data = freeze_world(world, _snap=_DEFER, run_hooks=False)
+    path = os.path.join(directory, checkpoint_filename(world.game_id))
+    handle = CheckpointHandle()
+
+    def work() -> None:
+        try:
+            snap = jax.device_get({
+                "pos": state_ref.pos,
+                "yaw": state_ref.yaw,
+                "npc_moving": state_ref.npc_moving,
+            })
+            for rec in data["entities"]:
+                ref = rec.pop("_slot", None)
+                if ref is not None:
+                    sh, sl = ref
+                    rec["pos"] = [float(v) for v in snap["pos"][sh, sl]]
+                    rec["yaw"] = float(snap["yaw"][sh, sl])
+                    rec["moving"] = bool(snap["npc_moving"][sh, sl])
+            write_freeze_file(path, data)   # already atomic (tmp+replace)
+            handle.path = path
+        except BaseException as exc:  # surfaced via join()
+            handle.error = exc
+            logger.exception("async checkpoint failed")
+        finally:
+            world._ckpt_inflight = False
+
+    t = threading.Thread(target=work, name="ckpt", daemon=True)
+    handle._thread = t
+    t.start()
+    return handle

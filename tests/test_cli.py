@@ -157,3 +157,25 @@ def test_deployment_counts_offset_ports_and_truncate(tmp_path):
     assert sorted(cfg.games) == [1]                  # truncated to count
     assert cfg.gates[1].port == 15100 and cfg.gates[1].kcp_port == 15200
     assert cfg.gates[2].port == 15101 and cfg.gates[2].kcp_port == 15201
+
+
+def test_port_collisions_detected(tmp_path):
+    """An explicit section inheriting a _common port must not silently
+    collide with an auto-created sibling (EADDRINUSE at start)."""
+    import pytest
+
+    from goworld_tpu import config as config_mod
+
+    ini = tmp_path / "goworld.ini"
+    ini.write_text(
+        "[deployment]\n"
+        "dispatchers = 2\n"
+        "[dispatcher_common]\n"
+        "port = 14100\n"
+        "[dispatcher2]\n"   # explicit but empty: inherits 14100 verbatim
+        "[game1]\n"
+        "[gate1]\n"
+        "port = 15000\n"
+    )
+    with pytest.raises(ValueError, match="collides"):
+        config_mod.load(str(ini))
