@@ -314,3 +314,50 @@ def test_early_cancel_before_query_ack(two_game_cluster):
     assert _avatar_in(w1) is None
     assert _avatar_in(w2) is None
     assert not gs1._migrating_out, "pending migration leaked"
+
+
+def test_enter_space_survives_target_game_death(two_game_cluster):
+    """EnterSpace to a space whose hosting game DIED: the dispatcher's
+    cleanup dropped the space route (DispatcherService.go:586-634), the
+    query ack returns game 0, and the migrating entity must recover —
+    alive, in its source space, timers firing, RPCs still served
+    (reference semantics: nothing was packed yet, so nothing is lost)."""
+    import asyncio
+
+    harness, worlds, servers = two_game_cluster
+    host, port = harness.gate_addrs[0]
+    bot = BotClient(host, port, strict=True)
+
+    target_space_id = worlds[1]._test_space.id
+
+    async def script():
+        recv = await _login(bot, "carol")
+        try:
+            # kill game2 and wait for the dispatchers to drop its routes
+            servers[1].stop()
+            await asyncio.sleep(1.0)
+            bot.call_server("JumpTo_Client", target_space_id)
+            await asyncio.sleep(1.5)
+            # the avatar must still answer RPCs on game1
+            before = bot.player.attrs.get("pings") or 0
+            bot.call_server("Ping_Client")
+            for _ in range(100):
+                if (bot.player.attrs.get("pings") or 0) > before:
+                    break
+                await asyncio.sleep(0.05)
+            assert (bot.player.attrs.get("pings") or 0) > before
+        finally:
+            recv.cancel()
+            await bot.conn.close()
+
+    harness.submit(script()).result(timeout=60)
+    av = _avatar_in(worlds[0])
+    assert av is not None and not av.destroyed
+    assert av.space is worlds[0]._test_space        # stayed home
+    assert av.slot is not None and av._migrating is None
+    # timers kept firing through the failed attempt
+    hb = av.attrs.get("heartbeats") or 0
+    time.sleep(0.3)
+    assert (av.attrs.get("heartbeats") or 0) > hb
+    # and the failed migration left no leaked bookkeeping
+    assert not servers[0]._migrating_out
