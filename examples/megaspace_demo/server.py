@@ -1,0 +1,70 @@
+"""Megaspace demo — ONE logical space spanning the device mesh.
+
+The flagship capability beyond the reference: where GoWorld caps a
+space's population by pinning it to one process (the example policy is
+100 avatars/space, ``SpaceService.go:14``), a megaspace tiles the XZ
+plane over TPU cores — AOI sees across tile borders through halo
+exchange, and entities that walk over a border migrate between cores
+inside the step (no EnterSpace, no dispatcher hop). The ini sets
+``megaspace = true`` with a ``4x2`` tile layout over 8 devices and the
+fused behavior-tree NPC kernel (monsters chase players, avoid crowds,
+wander — ``models/behavior_tree.py``).
+
+Run on a CPU rig:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python -m goworld_tpu start examples/megaspace_demo
+"""
+
+import goworld_tpu as gw
+
+
+@gw.register_space("World", megaspace=True)
+class World(gw.Space):
+    def OnGameReady(self):
+        pass
+
+
+@gw.register_entity("Monster")
+class Monster(gw.Entity):
+    ATTRS = {"hp": "allclients"}
+
+
+@gw.register_entity("Avatar")
+class Avatar(gw.Entity):
+    ATTRS = {"name": "allclients"}
+
+    def OnClientConnected(self):
+        self.attrs["name"] = "hero"
+
+
+@gw.register_entity("Account")
+class Account(gw.Entity):
+    ATTRS = {"status": "client"}
+
+    def Login_Client(self, name):
+        avatar = gw.create_entity(
+            "Avatar", space=gw.world()._mega_space, pos=(400.0, 0.0, 200.0)
+        )
+        avatar.attrs["name"] = name
+        self.give_client_to(avatar)
+        self.destroy()
+
+
+@gw.on_deployment_ready
+def setup():
+    import numpy as np
+
+    w = gw.world()
+    sp = gw.create_space("World")
+    w._mega_space = sp
+    rng = np.random.default_rng(7)
+    for _ in range(200):
+        gw.create_entity(
+            "Monster", space=sp, moving=True,
+            pos=(rng.uniform(0, 800), 0.0, rng.uniform(0, 400)),
+            attrs={"hp": 100},
+        )
+
+
+if __name__ == "__main__":
+    gw.run()

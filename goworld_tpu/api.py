@@ -134,10 +134,53 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
     from goworld_tpu.core.state import WorldConfig
     from goworld_tpu.ops.aoi import GridSpec
 
+    mega_shape = None
+    if gc.megaspace:
+        # user config speaks WORLD extents; the megaspace grid is the
+        # TILE grid in tile-shifted coordinates (extent = tile + 2R on
+        # each tiled axis — parallel/megaspace.py MegaConfig contract)
+        if gc.mesh_devices < 2:
+            raise ValueError(
+                "megaspace = true requires mesh_devices > 1 "
+                f"(got {gc.mesh_devices})"
+            )
+        n_dev = gc.mesh_devices
+        if gc.mega_shape:
+            try:
+                parts = [int(v) for v in
+                         gc.mega_shape.lower().split("x") if v != ""]
+                if len(parts) == 1:      # "8" = 1D x-strips
+                    tx, tz = parts[0], 1
+                elif len(parts) == 2:
+                    tx, tz = parts
+                else:
+                    raise ValueError
+            except ValueError:
+                raise ValueError(
+                    f"mega_shape {gc.mega_shape!r} must be \"N\" (1D "
+                    "x-strips) or \"TXxTZ\" (2D tiles), e.g. 8 or 4x2"
+                ) from None
+        else:
+            tx, tz = n_dev, 1
+        if tx * tz != n_dev:
+            raise ValueError(
+                f"mega_shape {gc.mega_shape!r} needs {tx * tz} devices "
+                f"but mesh_devices = {n_dev}"
+            )
+        tile_w = gc.extent_x / tx
+        grid = GridSpec(
+            radius=gc.aoi_radius,
+            extent_x=tile_w + 2 * gc.aoi_radius,
+            extent_z=(gc.extent_z / tz + 2 * gc.aoi_radius) if tz > 1
+            else gc.extent_z,
+        )
+        mega_shape = (tx, tz)
+    else:
+        grid = GridSpec(radius=gc.aoi_radius, extent_x=gc.extent_x,
+                        extent_z=gc.extent_z)
     wc = WorldConfig(
         capacity=gc.capacity,
-        grid=GridSpec(radius=gc.aoi_radius, extent_x=gc.extent_x,
-                      extent_z=gc.extent_z),
+        grid=grid,
         npc_speed=gc.npc_speed,
         behavior=gc.behavior,
     )
@@ -148,12 +191,26 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
 
         if len(jax.devices()) >= gc.mesh_devices:
             mesh = make_mesh(gc.mesh_devices)
+        elif gc.megaspace:
+            # no single-device fallback exists for a megaspace: fail
+            # with the fix, not a misleading fallback log
+            raise ValueError(
+                f"megaspace = true needs {gc.mesh_devices} devices but "
+                f"only {len(jax.devices())} are visible (set XLA_FLAGS="
+                "--xla_force_host_platform_device_count=N on CPU rigs)"
+            )
         else:
             logger.warning(
                 "mesh_devices=%d but only %d devices; single-device path",
                 gc.mesh_devices, len(jax.devices()),
             )
-    w = World(wc, n_spaces=max(gc.n_spaces, 1), mesh=mesh, game_id=gid)
+    w = World(
+        wc, n_spaces=max(gc.n_spaces, 1)
+        if not gc.megaspace else gc.mesh_devices,
+        mesh=mesh, game_id=gid,
+        megaspace=gc.megaspace, mega_shape=mega_shape,
+        halo_cap=gc.halo_cap, migrate_cap=gc.migrate_cap,
+    )
     # periodic persistence cadence (reference [gameN] save_interval,
     # goworld.ini.sample:45; Entity.go:164-177)
     w.save_interval = gc.save_interval

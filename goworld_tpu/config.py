@@ -45,6 +45,15 @@ class GameConfig:
     npc_speed: float = 5.0
     behavior: str = "random_walk"  # random_walk | mlp | btree (the fused
                                    # NPC kernels, BASELINE config 5)
+    # ONE logical space spanning the whole mesh as spatial tiles
+    # (parallel/megaspace.py; BASELINE config 4). extent_x/extent_z are
+    # the WORLD extents; tiles are derived from mega_shape ("8" = 1D
+    # x-strips, "4x2" = 2D XZ tiles; device count must match
+    # mesh_devices). capacity is PER TILE.
+    megaspace: bool = False
+    mega_shape: str = ""           # "" = 1D strips over mesh_devices
+    halo_cap: int = 1024
+    migrate_cap: int = 256
 
 
 @dataclasses.dataclass

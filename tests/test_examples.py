@@ -160,3 +160,38 @@ def test_chatroom_filter_props(ex_world):
     props = [m for _, _, m in w.client_messages
              if m.get("type") == "filter_prop"]
     assert props[-1]["val"] == "7"
+
+
+def test_megaspace_demo_from_its_own_ini():
+    """The megaspace demo boots through the CONFIG path (megaspace=true,
+    4x2 tiles, btree NPCs) and runs its deployment-ready setup: 200
+    monsters spread over the mesh, an avatar joins via the boot flow."""
+    from goworld_tpu import config as config_mod
+    from goworld_tpu.api import _apply_registrations, _build_world
+
+    api._reset_for_tests()
+    try:
+        mod = _load_example("megaspace_demo")
+        cfg = config_mod.load(os.path.join(
+            REPO, "examples", "megaspace_demo", "goworld_tpu.ini"
+        ))
+        gc = cfg.games[1]
+        assert gc.megaspace and gc.mega_shape == "4x2"
+        w = _build_world(gc, 1)
+        _apply_registrations(w)
+        w.create_nil_space()
+        # stand in for run()'s runtime so gw.world()/gw.create_entity
+        # work inside the example's deployment-ready hook
+        api._rt = api._Runtime(w, None, None, None, None)
+        for cb in api._ready_callbacks:
+            cb()
+        for _ in range(3):
+            w.tick()
+        monsters = [e for e in w.entities.values()
+                    if e.type_name == "Monster" and not e.destroyed]
+        assert len(monsters) == 200
+        assert w.mega is not None and w.mega.shape == (4, 2)
+        # the tick ran the behavior tree + halo + migration machinery
+        assert int(w.last_outputs.global_alive[0]) >= 200
+    finally:
+        api._reset_for_tests()
