@@ -24,8 +24,19 @@ Two implementations with the same contract:
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
+
+def _use_pallas() -> bool:
+    """Opt-in Pallas compaction kernel (ops/pallas_extract.py):
+    compaction as an MXU permutation matmul on a sequential grid,
+    replacing the cumsum+scatter XLA lowers flatnonzero to. Off by
+    default until profiled on hardware (round 3; the dev TPU tunnel died
+    this round). Read at CALL time so tests/drivers can flip it after
+    import (jit caches traces per call site — flip before first use)."""
+    return os.environ.get("GOWORLD_TPU_PALLAS_EXTRACT") == "1"
 
 
 def bounded_extract(
@@ -33,6 +44,10 @@ def bounded_extract(
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Returns (flat int32[cap] indices into mask.ravel(), valid bool[cap],
     count int32). Entries past ``count`` point at 0 and are invalid."""
+    if _use_pallas():
+        from goworld_tpu.ops.pallas_extract import bounded_extract_pallas
+
+        return bounded_extract_pallas(mask, cap)
     flat = jnp.flatnonzero(mask.ravel(), size=cap, fill_value=0)
     count = mask.sum().astype(jnp.int32)
     valid = jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(count, cap)
@@ -48,14 +63,13 @@ def bounded_extract_rows(
     count = mask.sum().astype(jnp.int32)
     row_any = mask.any(axis=1)
     cap_rows = min(cap, n)
-    rows = jnp.flatnonzero(row_any, size=cap_rows, fill_value=n).astype(
-        jnp.int32
-    )
+    # both nonzero levels route through bounded_extract so the Pallas
+    # opt-in covers the hot [N, k] event paths, not just the flat callers
+    rflat, rvalid, _ = bounded_extract(row_any, cap_rows)
+    rows = jnp.where(rvalid, rflat, n)
     rows_c = jnp.minimum(rows, n - 1)
     sub = mask[rows_c] & (rows[:, None] < n)          # [cap_rows, k]
-    flat2 = jnp.flatnonzero(sub.ravel(), size=cap, fill_value=0).astype(
-        jnp.int32
-    )
+    flat2, _, _ = bounded_extract(sub, cap)
     flat = rows_c[flat2 // k] * k + flat2 % k
     valid = jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(count, cap)
     flat = jnp.where(valid, flat, 0)

@@ -1,0 +1,129 @@
+"""Pallas TPU kernel: bounded stream compaction (flatnonzero with a cap).
+
+The bounded-extraction primitive (:mod:`goworld_tpu.ops.extract`) is the
+backbone of every event stream the tick emits. XLA lowers the
+``flatnonzero(size=cap)`` form to a cumsum plus an element scatter whose
+destinations are data-dependent — scatters serialize on the TPU's scalar
+core. This kernel re-states compaction in TPU-native terms, per the
+playbook in ``/opt/skills/guides/pallas_guide.md``:
+
+- walk the mask in blocks on a SEQUENTIAL grid, carrying the running
+  set-bit count in SMEM scratch (grid steps run in order on one core, so
+  scratch persists across them);
+- inside a block, compaction is a PERMUTATION MATMUL on the MXU: the
+  within-block destination of each set bit is its prefix sum, so a
+  one-hot matrix ``onehot[i, j] = mask[i] & (prefix[i] == j+1)``
+  contracted with the local indices compacts them into the first
+  ``count`` lanes — no scatter anywhere;
+- each block writes its compacted window at the carried offset with one
+  dynamic-slice store; the next block's window starts exactly where this
+  block's real data ends, so inter-block garbage is overwritten and the
+  tail past the global count is masked by the caller.
+
+Numerical safety: the matmul contracts int32 one-hots with LOCAL indices
+(< block size, exactly representable in f32); the per-block base offset
+is added after compaction, keeping flat indices exact for masks of any
+length.
+
+Semantics are identical to :func:`goworld_tpu.ops.extract.bounded_extract`
+(first ``cap`` set bits in flat order win; ``count`` is the TRUE total).
+Opt-in: set ``GOWORLD_TPU_PALLAS_EXTRACT=1`` (the kernel runs in
+interpreter mode off-TPU, so correctness tests run on CPU; real-hardware
+profiling is round-3 work — the development TPU tunnel died this round,
+see docs/ROUND2.md).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _compact_kernel(mask_ref, base_ref, out_ref, cnt_ref, *, block: int):
+    # first-block detection via the DATA (base == 0), not program_id:
+    # under jax.vmap the batching rule prepends the batch axis to the
+    # grid, so program_id(0) would become the batch index and the carry
+    # init would silently corrupt every batch element after the first
+    # (migrate.py vmaps bounded_extract over destinations)
+    @pl.when(base_ref[0] == 0)
+    def _init():
+        cnt_ref[0] = 0
+
+    m = mask_ref[:].reshape(block).astype(jnp.int32)          # [B]
+    prefix = jnp.cumsum(m)                                    # [B]
+    local = jax.lax.broadcasted_iota(jnp.float32, (block, 1), 0)
+    # onehot[i, j] = 1 where set bit i lands in compacted lane j
+    lanes = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+    onehot = ((prefix[:, None] - 1 == lanes) & (m[:, None] == 1))
+    compacted = jax.lax.dot_general(
+        onehot.astype(jnp.float32), local,
+        dimension_numbers=(((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )                                                          # [B, 1]
+    nset = prefix[block - 1]
+    carry = cnt_ref[0]
+    base = base_ref[0]
+    vals = compacted.reshape(block).astype(jnp.int32) + base
+    # lanes beyond nset hold matmul zeros (-> index "base"): harmless,
+    # the next block's window overwrites them and the global tail is
+    # masked by the caller's valid computation. Clamp the write offset:
+    # once the cap is exhausted every later window lands in the padding
+    # past it (out buffer is cap + block long).
+    cap = out_ref.shape[0] - block
+    out_ref[pl.ds(jnp.minimum(carry, cap), block)] = vals
+    cnt_ref[0] = carry + nset
+
+
+@partial(jax.jit, static_argnums=(1, 2, 3))
+def compact_indices(
+    mask_flat: jax.Array,
+    cap: int,
+    block: int = 1024,
+    interpret: bool | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Flat indices of the first ``cap`` set bits + TRUE total count.
+
+    ``mask_flat`` is bool[M]; M is padded up to a block multiple. Returns
+    (idx int32[cap], count int32) — entries past min(count, cap) are
+    unspecified (callers mask with their own ``valid``).
+    """
+    m = mask_flat.size
+    nblocks = -(-m // block)
+    padded = nblocks * block
+    mask_p = jnp.zeros((padded,), bool).at[:m].set(mask_flat)
+    bases = jnp.arange(nblocks, dtype=jnp.int32) * block
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    out, cnt = pl.pallas_call(
+        partial(_compact_kernel, block=block),
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((block,), lambda i: (i,)),
+            pl.BlockSpec((1,), lambda i: (i,)),
+        ],
+        out_specs=[
+            # whole output buffer, revisited every sequential step
+            pl.BlockSpec((cap + block,), lambda i: (0,)),
+            pl.BlockSpec((1,), lambda i: (0,)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((cap + block,), jnp.int32),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ],
+        interpret=interpret,
+    )(mask_p, bases)
+    return out[:cap], cnt[0]
+
+
+def bounded_extract_pallas(
+    mask: jax.Array, cap: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Drop-in for :func:`goworld_tpu.ops.extract.bounded_extract`."""
+    flat, count = compact_indices(mask.ravel(), cap)
+    valid = jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(count, cap)
+    flat = jnp.where(valid, flat, 0)
+    return flat, valid, count
