@@ -35,10 +35,14 @@ __all__ = [
     "Entity", "Space", "GameClient",
     "register_entity", "register_space", "register_service",
     "on_deployment_ready",
-    "run", "world", "game_server",
+    "run", "world", "game_server", "checkpoint_async",
     "create_space", "create_entity", "create_entity_anywhere",
-    "create_space_anywhere",
-    "load_entity_anywhere", "call", "call_service", "call_nil_spaces",
+    "create_space_anywhere", "create_entity_on_game",
+    "create_space_on_game",
+    "load_entity_anywhere", "load_entity_on_game",
+    "get_entity", "get_space", "entities", "get_game_id",
+    "get_nil_space", "get_online_games", "exists",
+    "call", "call_service", "call_nil_spaces",
     "call_filtered_clients",
     "kvdb_get", "kvdb_put", "kvdb_get_or_put", "kvdb_get_range",
     "add_callback", "add_timer", "cancel_timer", "post",
@@ -365,6 +369,52 @@ def create_entity(type_name: str, **kw) -> Entity:
     return _require_rt().world.create_entity(type_name, **kw)
 
 
+def get_entity(eid: str) -> Entity | None:
+    """Reference ``GetEntity`` (``goworld.go:112``)."""
+    e = _require_rt().world.entities.get(eid)
+    return None if e is None or e.destroyed or e.is_space else e
+
+
+def get_space(eid: str) -> Space | None:
+    """Reference ``GetSpace`` (``goworld.go:117``)."""
+    return _require_rt().world.spaces.get(eid)
+
+
+def entities() -> dict:
+    """Reference ``Entities`` (``goworld.go:147``) — the live entity map
+    of this game (read-only by convention)."""
+    return _require_rt().world.entities
+
+
+def get_game_id() -> int:
+    """Reference ``GetGameID`` (``goworld.go:125``)."""
+    return _require_rt().world.game_id
+
+
+def get_nil_space() -> Space | None:
+    """Reference ``GetNilSpace`` (``goworld.go:206``)."""
+    return _require_rt().world.nil_space
+
+
+def get_online_games() -> set[int]:
+    """Reference ``GetOnlineGames`` (``goworld.go:226``): game ids
+    currently connected to the cluster (seeded by the handshake ack,
+    maintained by NOTIFY_GAME_CONNECTED/DISCONNECTED)."""
+    rt = _require_rt()
+    if rt.server is not None:
+        return set(rt.server.online_games)
+    return {rt.world.game_id}
+
+
+def exists(type_name: str, eid: str, cb: Callable) -> None:
+    """Reference ``Exists`` (``goworld.go:107``): async existence check
+    against entity storage."""
+    rt = _require_rt()
+    if rt.storage is None:
+        raise RuntimeError("storage is not initialized")
+    rt.storage.exists(type_name, eid, cb)
+
+
 def create_entity_anywhere(type_name: str, attrs: dict | None = None) -> None:
     _require_rt().server.create_entity_anywhere(type_name, attrs)
 
@@ -378,6 +428,30 @@ def create_space_anywhere(type_name: str, attrs: dict | None = None) -> None:
     rt.server.create_entity_anywhere(type_name, attrs)
 
 
+def create_entity_on_game(gameid: int, type_name: str,
+                          attrs: dict | None = None) -> None:
+    """Reference ``CreateEntityOnGame`` (``goworld.go:83``)."""
+    _require_rt().server.create_entity_anywhere(type_name, attrs,
+                                                gameid=gameid)
+
+
+def create_space_on_game(gameid: int, type_name: str,
+                         attrs: dict | None = None) -> None:
+    """Reference ``CreateSpaceOnGame`` (``goworld.go:67``) — space types
+    ride the same placement message (net/game.py routes them to
+    ``create_space``)."""
+    rt = _require_rt()
+    if not rt.world.registry.get(type_name).is_space:
+        raise TypeError(f"{type_name} is not a space type")
+    rt.server.create_entity_anywhere(type_name, attrs, gameid=gameid)
+
+
+def load_entity_on_game(type_name: str, eid: str, gameid: int) -> None:
+    """Reference ``LoadEntityOnGame`` (``goworld.go:94``)."""
+    _require_rt().server.load_entity_anywhere(type_name, eid,
+                                              gameid=gameid)
+
+
 def load_entity_anywhere(type_name: str, eid: str) -> None:
     _require_rt().server.load_entity_anywhere(type_name, eid)
 
@@ -387,9 +461,15 @@ def call(eid: str, method: str, *args) -> None:
 
 
 def call_service(name: str, method: str, *args,
-                 shard_key: str | None = None) -> None:
-    _require_rt().world.call_service(name, method, *args,
-                                     shard_key=shard_key)
+                 shard_key: str | None = None,
+                 shard_index: int | None = None,
+                 all_shards: bool = False) -> None:
+    """Reference ``CallServiceAny/All/ShardIndex/ShardKey``
+    (``goworld.go:157-172``) — default Any; pick one keyword."""
+    _require_rt().world.call_service(
+        name, method, *args, shard_key=shard_key,
+        shard_index=shard_index, all_shards=all_shards,
+    )
 
 
 def call_nil_spaces(method: str, *args) -> None:

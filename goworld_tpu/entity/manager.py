@@ -833,10 +833,17 @@ class World:
             logger.exception("RPC %s.%s failed", e, method)
 
     def call_service(self, name: str, method: str, *args,
-                     shard_key: str | None = None) -> None:
+                     shard_key: str | None = None,
+                     shard_index: int | None = None,
+                     all_shards: bool = False) -> None:
+        """CallServiceAny/ShardKey/ShardIndex/All (goworld.go:157-172)."""
         if self.service_mgr is None:
             raise RuntimeError("service manager not configured")
-        self.service_mgr.call(name, method, args, shard_key=shard_key)
+        if all_shards:
+            self.service_mgr.call_all(name, method, *args)
+            return
+        self.service_mgr.call(name, method, args, shard_key=shard_key,
+                              shard_index=shard_index)
 
     def call_filtered_clients(self, key, op, val, method, args) -> None:
         if self.filtered_sink is None:

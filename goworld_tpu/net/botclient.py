@@ -306,11 +306,13 @@ class BotClient:
 
 async def run_swarm(host: str, port: int, n_bots: int, duration: float,
                     *, strict: bool = True, compress: bool = False,
-                    tls: bool = False) -> list[BotClient]:
-    """Run N bots concurrently (reference ``test_client -N``)."""
+                    tls: bool = False, kcp: bool = False
+                    ) -> list[BotClient]:
+    """Run N bots concurrently (reference ``test_client -N``; ``kcp``
+    mirrors its ``-kcp`` flag — dial the gate's reliable-UDP port)."""
     bots = [
         BotClient(host, port, bot_id=i, strict=strict, compress=compress,
-                  tls=tls)
+                  tls=tls, kcp=kcp)
         for i in range(n_bots)
     ]
     await asyncio.gather(*(b.run(duration) for b in bots))

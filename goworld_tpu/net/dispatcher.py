@@ -248,6 +248,11 @@ class DispatcherService:
         ack.append_u16(self.id)
         ack.append_data(self.kvreg)
         ack.append_data(rejects)
+        # seed the joiner's online-games view (reference GetOnlineGames,
+        # goworld.go:226; games that joined earlier never re-broadcast)
+        ack.append_data(sorted(
+            g.game_id for g in self.games.values() if g.conn is not None
+        ))
         conn.send(ack)
         gi.flush_pending()
         logger.info(
@@ -356,22 +361,33 @@ class DispatcherService:
         return chosen
 
     def _h_create_anywhere(self, conn, role, msgtype, pkt: Packet) -> None:
-        gi = self._choose_game()
+        want = pkt.read_u16()          # 0 = min-load choice
+        gi = self.games.get(want) if want else self._choose_game()
         if gi is None:
-            logger.error("dispatcher%d: no game for CreateEntityAnywhere",
-                         self.id)
+            logger.error(
+                "dispatcher%d: no game (want=%d) for CreateEntityAnywhere",
+                self.id, want,
+            )
             return
+        # a known-but-reconnecting pinned target queues (gi.send pends
+        # while conn is None, flushed on reconnect) — same survival the
+        # min-load path gets
         pkt.rpos = 2
         gi.send(pkt, release=False)
 
     def _h_load_anywhere(self, conn, role, msgtype, pkt: Packet) -> None:
+        want = pkt.read_u16()          # 0 = min-load choice
         pkt.read_var_str()  # type_name
         eid = pkt.read_entity_id()
         info = self._entity_info(eid)
         if info.game_id != 0 or info.blocked:
             return  # already loaded/loading: single-load guard (:673-702)
-        gi = self._choose_game()
+        gi = self.games.get(want) if want else self._choose_game()
         if gi is None:
+            logger.error(
+                "dispatcher%d: no game (want=%d) for LoadEntityAnywhere",
+                self.id, want,
+            )
             return
         info.game_id = gi.game_id
         info.block(consts.LOAD_TIMEOUT)

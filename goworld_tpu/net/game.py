@@ -78,6 +78,8 @@ class GameServer:
         # dispatcher ids that acked our SET_GAME_ID (handshake barrier)
         self.handshake_acks: set[int] = set()
         self.kvreg: dict[str, str] = {}
+        # cluster view (reference gameService.onlineGames / GetOnlineGames)
+        self.online_games: set[int] = {game_id}
         self.kvreg_watchers: list[Callable[[str, str], None]] = []
         # in-flight outbound migrations: eid -> (entity, space_id, pos)
         self._migrating_out: dict[str, tuple[Entity, str, tuple]] = {}
@@ -322,17 +324,21 @@ class GameServer:
 
     # -- public cluster-wide API (the goworld.go facade calls these) ----
     def create_entity_anywhere(self, type_name: str,
-                               attrs: dict | None = None) -> None:
+                               attrs: dict | None = None,
+                               gameid: int = 0) -> None:
         """Reference ``CreateEntityAnywhere`` (``goworld.go``): placement
-        decided by the dispatcher's load heap."""
+        decided by the dispatcher's load heap; nonzero ``gameid`` pins
+        the target (``CreateEntityOnGame`` / ``CreateSpaceOnGame``)."""
         from goworld_tpu.utils import ids as _ids
 
         eid = _ids.gen_entity_id()
-        p = proto.pack_create_entity_anywhere(type_name, attrs or {}, eid)
+        p = proto.pack_create_entity_anywhere(type_name, attrs or {}, eid,
+                                              gameid)
         self._send(self.cluster.select_by_entity_id(eid), p)
 
-    def load_entity_anywhere(self, type_name: str, eid: str) -> None:
-        p = proto.pack_load_entity_anywhere(type_name, eid)
+    def load_entity_anywhere(self, type_name: str, eid: str,
+                             gameid: int = 0) -> None:
+        p = proto.pack_load_entity_anywhere(type_name, eid, gameid)
         self._send(self.cluster.select_by_entity_id(eid), p)
 
     def kvreg_register(self, key: str, val: str, force: bool = False) -> None:
@@ -380,6 +386,7 @@ class GameServer:
             self.handshake_acks.add(disp_id)
             kv = pkt.read_data()
             rejects = pkt.read_data()
+            self.online_games.update(pkt.read_data())
             self.kvreg.update(kv)
             for eid in rejects:
                 e = w.entities.get(eid)
@@ -462,6 +469,7 @@ class GameServer:
                 w.stage_pos_set(e)
             return
         if msgtype == proto.MT_CREATE_ENTITY_ANYWHERE:
+            pkt.read_u16()  # routing gameid (consumed by the dispatcher)
             type_name = pkt.read_var_str()
             eid = pkt.read_var_str()
             attrs = pkt.read_data()
@@ -477,6 +485,7 @@ class GameServer:
                 w.create_entity(type_name, eid=eid or None, attrs=attrs)
             return
         if msgtype == proto.MT_LOAD_ENTITY_ANYWHERE:
+            pkt.read_u16()  # routing gameid
             type_name = pkt.read_var_str()
             eid = pkt.read_entity_id()
             w.load_entity(type_name, eid)
@@ -513,9 +522,10 @@ class GameServer:
                 self.run_state = "freezing"
             return
         if msgtype == proto.MT_NOTIFY_GAME_CONNECTED:
+            self.online_games.add(pkt.read_u16())
             return
         if msgtype == proto.MT_NOTIFY_GAME_DISCONNECTED:
-            pkt.read_u16()
+            self.online_games.discard(pkt.read_u16())
             return
         if msgtype == proto.MT_NOTIFY_GATE_DISCONNECTED:
             gate_id = pkt.read_u16()

@@ -125,16 +125,24 @@ def pack_call_entity_method(eid: str, method: str, args: tuple,
 
 
 def pack_create_entity_anywhere(type_name: str, attrs: dict,
-                                eid: str = "") -> Packet:
+                                eid: str = "", gameid: int = 0) -> Packet:
+    """gameid 0 = dispatcher chooses (min-load heap); nonzero pins the
+    target game (reference CreateEntityOnGame / CreateSpaceOnGame,
+    goworld.go:67,83)."""
     p = new_packet(MT_CREATE_ENTITY_ANYWHERE)
+    p.append_u16(gameid)
     p.append_var_str(type_name)
     p.append_var_str(eid)
     p.append_data(attrs)
     return p
 
 
-def pack_load_entity_anywhere(type_name: str, eid: str) -> Packet:
+def pack_load_entity_anywhere(type_name: str, eid: str,
+                              gameid: int = 0) -> Packet:
+    """gameid 0 = dispatcher chooses (reference LoadEntityOnGame when
+    nonzero, goworld.go:94)."""
     p = new_packet(MT_LOAD_ENTITY_ANYWHERE)
+    p.append_u16(gameid)
     p.append_var_str(type_name)
     p.append_entity_id(eid)
     return p

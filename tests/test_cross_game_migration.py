@@ -361,3 +361,40 @@ def test_enter_space_survives_target_game_death(two_game_cluster):
     assert (av.attrs.get("heartbeats") or 0) > hb
     # and the failed migration left no leaked bookkeeping
     assert not servers[0]._migrating_out
+
+
+def test_create_on_game_and_online_games(two_game_cluster):
+    """CreateEntityOnGame pins placement to a specific game (reference
+    goworld.go:83) and GetOnlineGames-style views are seeded by the
+    handshake and maintained by connect/disconnect notifies."""
+    harness, worlds, servers = two_game_cluster
+    # both games see the full cluster (game1 joined first, learns of
+    # game2 via NOTIFY_GAME_CONNECTED; game2 is seeded by its ack)
+    deadline = time.time() + 10
+    while time.time() < deadline and not all(
+        gs.online_games == {1, 2} for gs in servers
+    ):
+        time.sleep(0.05)
+    assert servers[0].online_games == {1, 2}
+    assert servers[1].online_games == {1, 2}
+
+    # pin an entity onto game2 explicitly (the load heap would otherwise
+    # prefer either)
+    servers[0].create_entity_anywhere("Avatar", {"name": "pinned"},
+                                      gameid=2)
+    deadline = time.time() + 10
+    placed = None
+    while time.time() < deadline:
+        for e in worlds[1].entities.values():
+            if e.type_name == "Avatar" and \
+                    e.attrs.get("name") == "pinned":
+                placed = e
+                break
+        if placed is not None:
+            break
+        time.sleep(0.05)
+    assert placed is not None, "pinned entity never appeared on game2"
+    assert all(
+        e.attrs.get("name") != "pinned"
+        for e in worlds[0].entities.values() if e.type_name == "Avatar"
+    )

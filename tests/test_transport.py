@@ -295,3 +295,23 @@ def test_bot_over_kcp(kcp_cluster):
     avatars = [e for e in world.entities.values()
                if e.type_name == "Avatar" and not e.destroyed]
     assert len(avatars) == 1 and avatars[0].client is not None
+
+
+def test_bot_swarm_over_kcp(kcp_cluster):
+    """A strict bot swarm over the reliable-UDP edge (the reference CI
+    drives test_client -kcp against its gates)."""
+    from goworld_tpu.net.botclient import run_swarm
+
+    harness, world, gs = kcp_cluster
+    host, port = harness.gate_kcp_addrs[0]
+    bots = harness.submit(
+        run_swarm(host, port, 12, 4.0, strict=True, kcp=True)
+    ).result(timeout=60)
+    errs = [e for b in bots for e in b.errors]
+    assert not errs, errs[:5]
+    # every bot's boot entity arrived over reliable UDP (this fixture's
+    # Account stays in the nil space, so no AOI syncs are expected)
+    assert all(b.player is not None for b in bots)
+    accounts = [e for e in world.entities.values()
+                if e.type_name == "Account" and not e.destroyed]
+    assert len(accounts) == 12
