@@ -1,0 +1,81 @@
+"""The new function-level facade surface (docs/API_MAP.md) in-process:
+accessors, service-call variants, and the runtime guard."""
+
+import numpy as np
+import pytest
+
+from goworld_tpu import api
+from goworld_tpu.core import WorldConfig
+from goworld_tpu.entity import World
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.service import ServiceManager
+from goworld_tpu.entity.space import Space
+from goworld_tpu.ops.aoi import GridSpec
+
+
+class Counter(Entity):
+    calls: list = []
+
+    def Bump(self, tag):
+        Counter.calls.append((self.id, tag))
+
+
+class Arena(Space):
+    pass
+
+
+@pytest.fixture()
+def rt():
+    api._reset_for_tests()
+    w = World(WorldConfig(
+        capacity=64,
+        grid=GridSpec(radius=20.0, extent_x=100.0, extent_z=100.0),
+        input_cap=16,
+    ))
+    svc = ServiceManager(w)
+    w.service_mgr = svc
+    w.register_entity("Counter", Counter)
+    w.register_space("Arena", Arena)
+    svc.register("CounterSvc", Counter, shard_count=3)
+    w.create_nil_space()
+    api._rt = api._Runtime(w, None, None, None, None)
+    svc.start()
+    w.tick()
+    Counter.calls.clear()
+    yield w, svc
+    api._reset_for_tests()
+
+
+def test_accessors(rt):
+    w, svc = rt
+    sp = api.create_space("Arena")
+    e = api.create_entity("Counter", space=sp, pos=(5.0, 0.0, 5.0))
+    assert api.get_entity(e.id) is e
+    assert api.get_entity(sp.id) is None          # spaces are not entities
+    assert api.get_space(sp.id) is sp
+    assert api.get_game_id() == w.game_id
+    assert api.get_nil_space() is w.nil_space
+    assert e.id in api.entities()
+    # single-controller, no cluster: the view is just this game
+    assert api.get_online_games() == {w.game_id}
+
+
+def test_call_service_variants(rt):
+    w, svc = rt
+    w.tick()
+    api.call_service("CounterSvc", "Bump", "any")
+    api.call_service("CounterSvc", "Bump", "k", shard_key="alpha")
+    api.call_service("CounterSvc", "Bump", "idx", shard_index=2)
+    api.call_service("CounterSvc", "Bump", "all", all_shards=True)
+    w.tick()
+    tags = [t for _, t in Counter.calls]
+    assert tags.count("any") == 1
+    assert tags.count("k") == 1
+    assert tags.count("idx") == 1
+    assert tags.count("all") == 3                 # every shard
+
+
+def test_requires_run():
+    api._reset_for_tests()
+    with pytest.raises(RuntimeError, match="run"):
+        api.get_game_id()

@@ -17,6 +17,28 @@ def run(coro, timeout=60):
     return asyncio.run(asyncio.wait_for(coro, timeout))
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def fake_clock(step_ms=10):
+    """Patch the module clock; yields advance() stepping it forward."""
+    import goworld_tpu.net.kcp as kcpmod
+
+    t0 = kcpmod._now_ms()
+    real = kcpmod._now_ms
+    state = {"step": 0}
+
+    def advance():
+        state["step"] += 1
+        kcpmod._now_ms = lambda: t0 + state["step"] * step_ms
+
+    try:
+        yield advance
+    finally:
+        kcpmod._now_ms = real
+
+
 def test_core_loopback_lossless():
     """Two cores wired back to back deliver a byte stream in order."""
     a_out, b_out = [], []
@@ -50,15 +72,11 @@ def test_core_retransmit_under_loss():
     payload = bytes(rng.getrandbits(8) for _ in range(40000))
     a.send(payload)
     got = bytearray()
-    import goworld_tpu.net.kcp as kcpmod
-    t = kcpmod._now_ms()
-    real_now = kcpmod._now_ms
     step = 0
-    try:
+    with fake_clock() as advance:
         while len(got) < len(payload) and step < 4000:
             step += 1
-            # simulate time passing so RTOs fire
-            kcpmod._now_ms = lambda: t + step * 10
+            advance()             # simulate time passing so RTOs fire
             a.flush()
             for d in a_out:
                 b.input(d)
@@ -69,8 +87,6 @@ def test_core_retransmit_under_loss():
             b_out.clear()
             while (chunk := b.recv()) is not None:
                 got += chunk
-    finally:
-        kcpmod._now_ms = real_now
     assert bytes(got) == payload, (
         f"got {len(got)}/{len(payload)} bytes after {step} steps"
     )
@@ -132,17 +148,12 @@ def test_dead_link_detected():
     limit instead of retrying forever."""
     a = KcpCore(3, lambda d: None)   # all output dropped
     a.send(b"hello")
-    import goworld_tpu.net.kcp as kcpmod
-    t = kcpmod._now_ms()
-    real_now = kcpmod._now_ms
-    try:
-        for step in range(1, 20000):
-            kcpmod._now_ms = lambda: t + step * 50
+    with fake_clock(step_ms=50) as advance:
+        for _ in range(20000):
+            advance()
             a.flush()
             if a.dead:
                 break
-    finally:
-        kcpmod._now_ms = real_now
     assert a.dead
 
 
@@ -175,15 +186,12 @@ def test_native_core_interop_under_loss(a_native, b_native):
     a.send(payload)
     b.send(payload[::-1])    # full-duplex
     got_b, got_a = bytearray(), bytearray()
-    import goworld_tpu.net.kcp as kcpmod
-    t = kcpmod._now_ms()
-    real_now = kcpmod._now_ms
     step = 0
-    try:
+    with fake_clock() as advance:
         while (len(got_b) < len(payload) or len(got_a) < len(payload)) \
                 and step < 4000:
             step += 1
-            kcpmod._now_ms = lambda: t + step * 10
+            advance()
             a.flush()
             for d in a_out:
                 b.input(d)
@@ -196,8 +204,6 @@ def test_native_core_interop_under_loss(a_native, b_native):
                 got_b += chunk
             while (chunk := a.recv()) is not None:
                 got_a += chunk
-    finally:
-        kcpmod._now_ms = real_now
     assert bytes(got_b) == payload
     assert bytes(got_a) == payload[::-1]
 
@@ -265,3 +271,59 @@ def test_crafted_len_field_rejected(use_native):
     while (c := core.recv()) is not None:
         chunks.append(c)
     assert b"".join(chunks) == b"data"
+
+
+@pytest.mark.parametrize("use_native", [False, True])
+def test_corrupted_datagrams_never_break_the_stream(use_native):
+    """Fuzz: random corruption (bit flips, truncation, garbage, foreign
+    conv ids) injected alongside real traffic must never crash the core
+    or corrupt the delivered byte stream — only well-formed segments of
+    the right conversation count."""
+    if use_native and not _native_available():
+        pytest.skip("no native kcp core")
+    from goworld_tpu.net.kcp import NativeKcpCore
+
+    rng = random.Random(77)
+    cls = NativeKcpCore if use_native else KcpCore
+    a_out, b_out = [], []
+    a = cls(9, a_out.append)
+    b = cls(9, b_out.append)
+    payload = bytes(rng.getrandbits(8) for _ in range(20000))
+    a.send(payload)
+    got = bytearray()
+    step = 0
+    with fake_clock() as advance:
+        while len(got) < len(payload) and step < 3000:
+            step += 1
+            advance()
+            a.flush()
+            for d in a_out:
+                r = rng.random()
+                if r < 0.1:
+                    # corrupt the conv field -> foreign-conversation
+                    # datagram, must be rejected cleanly (payload-level
+                    # bit flips are out of scope: KCP has no checksum,
+                    # same as kcp-go without its crypto layer)
+                    d = bytearray(d)
+                    d[rng.randrange(4)] ^= 1 << rng.randrange(8)
+                    d = bytes(d)
+                    b.input(d)
+                    continue   # the real copy is lost (drop + corrupt)
+                elif r < 0.15:
+                    d = d[:rng.randrange(len(d))]      # truncate
+                elif r < 0.2:
+                    d = bytes(rng.getrandbits(8)
+                              for _ in range(rng.randrange(1, 200)))
+                b.input(d)
+                if rng.random() < 0.05:
+                    # replay/duplicate delivery
+                    b.input(d)
+            a_out.clear()
+            b.flush()
+            for d in b_out:
+                a.input(d)
+            b_out.clear()
+            while (chunk := b.recv()) is not None:
+                got += chunk
+    # rejected datagrams behave as loss: ARQ recovers the exact stream
+    assert bytes(got) == payload, (len(got), len(payload), step)
