@@ -179,3 +179,60 @@ def test_port_collisions_detected(tmp_path):
     )
     with pytest.raises(ValueError, match="collides"):
         config_mod.load(str(ini))
+
+
+@pytest.mark.slow
+def test_cli_start_megaspace_demo(tmp_path):
+    """The flagship path through production ops: `start` the megaspace
+    demo (one space over a 4x2 8-device mesh, btree NPCs), log a real
+    client in over the gate, `stop` — the same flow a reference operator
+    runs, with the device mesh underneath."""
+    import shutil as _shutil
+
+    src = os.path.join(REPO, "examples", "megaspace_demo")
+    dst = str(tmp_path / "megaspace_demo")
+    _shutil.copytree(src, dst)
+    gport = _free_port()
+    ini = os.path.join(dst, "goworld_tpu.ini")
+    with open(ini) as f:
+        text = f.read()
+    text = text.replace("port = 15400", f"port = {gport}")
+    with open(ini, "w") as f:
+        f.write(text)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+    env["PYTHONPATH"] = REPO
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "goworld_tpu", "start", dst],
+            env=env, cwd=dst, capture_output=True, text=True, timeout=240,
+        )
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+        async def login():
+            from goworld_tpu.net.botclient import BotClient
+
+            bot = BotClient("127.0.0.1", gport, strict=True)
+            await bot.connect()
+            recv = asyncio.ensure_future(bot._recv_loop())
+            try:
+                await asyncio.wait_for(bot.player_ready.wait(), 20)
+                bot.call_server("Login_Client", "opstest")
+                for _ in range(150):
+                    if bot.player is not None \
+                            and bot.player.type_name == "Avatar":
+                        break
+                    await asyncio.sleep(0.1)
+                assert bot.player.type_name == "Avatar"
+            finally:
+                recv.cancel()
+                await bot.conn.close()
+
+        asyncio.run(asyncio.wait_for(login(), 60))
+    finally:
+        subprocess.run(
+            [sys.executable, "-m", "goworld_tpu", "stop", dst],
+            env=env, cwd=dst, capture_output=True, text=True, timeout=120,
+        )
