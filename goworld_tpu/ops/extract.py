@@ -45,9 +45,18 @@ def bounded_extract(
     """Returns (flat int32[cap] indices into mask.ravel(), valid bool[cap],
     count int32). Entries past ``count`` point at 0 and are invalid."""
     if _use_pallas():
-        from goworld_tpu.ops.pallas_extract import bounded_extract_pallas
+        # the kernel serves unsharded contexts (the single-chip tick the
+        # r02 profile measured). Under shard_map the value varies over
+        # mesh axes (vma non-empty) and interpret-mode pallas does not
+        # propagate that reliably yet — keep those on the XLA path
+        # (round-3: revisit on hardware, where interpret mode is not
+        # involved).
+        if not getattr(jax.typeof(mask), "vma", None):
+            from goworld_tpu.ops.pallas_extract import (
+                bounded_extract_pallas,
+            )
 
-        return bounded_extract_pallas(mask, cap)
+            return bounded_extract_pallas(mask, cap)
     flat = jnp.flatnonzero(mask.ravel(), size=cap, fill_value=0)
     count = mask.sum().astype(jnp.int32)
     valid = jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(count, cap)

@@ -98,6 +98,10 @@ def compact_indices(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
+    # under shard_map, outputs must declare which mesh axes they vary
+    # over (check_vma); they vary exactly like the per-shard mask input
+    vma = getattr(jax.typeof(mask_p), "vma", None)
+    kw = {} if not vma else {"vma": vma}
     out, cnt = pl.pallas_call(
         partial(_compact_kernel, block=block),
         grid=(nblocks,),
@@ -111,8 +115,8 @@ def compact_indices(
             pl.BlockSpec((1,), lambda i: (0,)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((cap + block,), jnp.int32),
-            jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((cap + block,), jnp.int32, **kw),
+            jax.ShapeDtypeStruct((1,), jnp.int32, **kw),
         ],
         interpret=interpret,
     )(mask_p, bases)
