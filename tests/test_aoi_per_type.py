@@ -165,3 +165,45 @@ def test_world_per_type_distance():
     # ...but is visible to both at the space radius (30)
     assert near.id in close.interested_in
     assert near.id in far.interested_in
+
+
+def test_random_per_entity_radii_vs_oracle():
+    """Fuzz the full per-type semantics at once: a population with mixed
+    radii — excluded (0), short-sighted (5..radius), unbounded (inf) —
+    must match a per-watcher oracle: i sees j iff both participate and
+    cheb(i, j) <= min(radius_i, spec.radius)."""
+    rng = np.random.default_rng(21)
+    n = 500
+    spec = _spec(k=128, cell_cap=128, row_block=128)
+    pos = np.zeros((n, 3), np.float32)
+    pos[:, 0] = rng.uniform(0, 200, n)
+    pos[:, 2] = rng.uniform(0, 200, n)
+    alive = rng.uniform(size=n) < 0.9
+    wr = np.full(n, np.inf, np.float32)
+    kinds = rng.integers(0, 3, n)
+    wr[kinds == 0] = 0.0                          # excluded
+    wr[kinds == 1] = rng.uniform(5, 25, (kinds == 1).sum())  # bounded
+
+    nbr, cnt = grid_neighbors(
+        spec, jnp.asarray(pos), jnp.asarray(alive),
+        watch_radius=jnp.asarray(wr),
+    )
+    nbr, cnt = np.asarray(nbr), np.asarray(cnt)
+
+    participates = alive & (wr > 0)
+    for i in range(n):
+        got = set(nbr[i][nbr[i] < n].tolist())
+        if not participates[i]:
+            assert got == set() and cnt[i] == 0
+            continue
+        reach = min(wr[i], spec.radius)
+        dx = np.abs(pos[:, 0] - pos[i, 0])
+        dz = np.abs(pos[:, 2] - pos[i, 2])
+        want = set(np.nonzero(
+            (np.maximum(dx, dz) <= reach) & participates
+        )[0].tolist()) - {i}
+        assert got == want, (
+            f"row {i} (radius {wr[i]}): extra {got - want}, "
+            f"missing {want - got}"
+        )
+        assert cnt[i] == len(want)
