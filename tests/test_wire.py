@@ -148,3 +148,25 @@ def test_proto_create_entity_on_client_roundtrip():
     assert q.read_bool() is True
     assert [q.read_f32() for _ in range(4)] == [1.0, 2.0, 3.0, 0.5]
     assert q.read_data() == {"name": "bob"}
+
+
+def test_create_load_anywhere_carry_routing_gameid():
+    """The placement messages carry a leading routing gameid (0 = choose)
+    that the dispatcher consumes and the game skips — both readers must
+    agree with the packer."""
+    from goworld_tpu.net import proto
+
+    p = proto.pack_create_entity_anywhere("Avatar", {"hp": 5},
+                                          "abcdefghabcdefgh", gameid=3)
+    p.rpos = 2
+    assert p.read_u16() == 3
+    assert p.read_var_str() == "Avatar"
+    assert p.read_var_str() == "abcdefghabcdefgh"
+    assert p.read_data() == {"hp": 5}
+
+    p = proto.pack_load_entity_anywhere("Avatar", "abcdefghabcdefgh",
+                                        gameid=0)
+    p.rpos = 2
+    assert p.read_u16() == 0
+    assert p.read_var_str() == "Avatar"
+    assert p.read_entity_id() == "abcdefghabcdefgh"
