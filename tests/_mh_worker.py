@@ -13,6 +13,7 @@ import sys
 def main() -> int:
     pid = int(sys.argv[1])
     port = sys.argv[2]
+    mode = sys.argv[3] if len(sys.argv) > 3 else "walker"
 
     from goworld_tpu.parallel.multihost import (
         global_mesh, init_distributed, local_shard_indices,
@@ -46,6 +47,9 @@ def main() -> int:
     st = create_mega_state(mc)
 
     from tests.conftest import spawn_on
+
+    if mode == "stress":
+        return stress(pid, mesh, mc, cfg, step, st, spawn_on)
 
     # IDENTICAL program on both controllers (SPMD): a walker just west of
     # the tile-3/tile-4 border (the process boundary: devices 0-3 are
@@ -93,6 +97,63 @@ def main() -> int:
         "migrated_tick": migrated_tick,
         "enters": enters_seen[:16],
         "global_alive": ga,
+    }), flush=True)
+    return 0
+
+
+def stress(pid, mesh, mc, cfg, step, st, spawn_on) -> int:
+    """Churny SPMD run: 60 movers spread over all 8 tiles for 40 ticks
+    with the deterministic random walk (identical device rng on both
+    controllers). Reports per-tick global_alive, local shard occupancy
+    and migration counts for cross-controller consistency checks."""
+    import jax
+    import numpy as np
+    from goworld_tpu.parallel.multihost import (
+        local_shard_indices, local_shard_outputs,
+    )
+
+    n_dev = mc.n_dev
+    rng = np.random.default_rng(13)           # same seed on BOTH
+    next_slot = [0] * n_dev
+    for _ in range(60):
+        tile = int(rng.integers(0, n_dev))
+        slot = next_slot[tile]
+        next_slot[tile] += 1
+        st = spawn_on(
+            st, tile, slot,
+            pos=(rng.uniform(tile * mc.tile_w, (tile + 1) * mc.tile_w),
+                 0.0, rng.uniform(0, 100.0)),
+            npc_moving=True,
+        )
+    from goworld_tpu.parallel.mesh import shard_state
+    st = shard_state(st, mesh)
+    from goworld_tpu.parallel import MultiTickInputs
+    inputs = MultiTickInputs.empty(cfg, n_dev)
+
+    galive = []
+    migrations = 0
+    dropped = 0
+    for _ in range(40):
+        st, out = step(st, inputs, None)
+        idxs, outs = local_shard_outputs(out, mesh)
+        galive.append(int(np.asarray(
+            out.global_alive.addressable_shards[0].data
+        ).ravel()[0]))
+        for o in outs:
+            migrations += int(o.arr_n)
+            dropped += int(o.migrate_dropped)
+    # local occupancy from addressable state shards only
+    occ = {}
+    for s_ in st.alive.addressable_shards:
+        row = s_.index[0].start or 0
+        occ[row] = int(np.asarray(s_.data).sum())
+    print(json.dumps({
+        "process": pid,
+        "local_shards": local_shard_indices(mesh),
+        "global_alive": galive,
+        "occupancy": occ,
+        "migrations": migrations,
+        "dropped": dropped,
     }), flush=True)
     return 0
 

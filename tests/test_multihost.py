@@ -109,3 +109,40 @@ def test_world_api_multihost():
         tuple(e) for e in r1["events"]
     ]
     assert "walker_walker_00" not in r0["watcher_interested_in"]
+
+
+@pytest.mark.slow
+def test_two_process_stress_consistency():
+    """40 churny ticks with 60 movers over the 2-controller mesh: both
+    controllers agree on the global population every tick, nobody is
+    lost or duplicated (the union of local occupancies is exactly the
+    population), and cross-process migrations actually happened."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tests._mh_worker",
+             str(pid), str(port), "stress"],
+            cwd=REPO, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        for pid in (0, 1)
+    ]
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        assert p.returncode == 0, f"worker failed:\n{err[-2500:]}"
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        r = json.loads(line)
+        results[r["process"]] = r
+    r0, r1 = results[0], results[1]
+    assert r0["global_alive"] == r1["global_alive"] == [60] * 40
+    total = sum(r0["occupancy"].values()) + sum(r1["occupancy"].values())
+    assert total == 60, (r0["occupancy"], r1["occupancy"])
+    assert r0["dropped"] == 0 and r1["dropped"] == 0
+    # churn actually crossed tiles (and with 4x2... 8 tiles over 2
+    # processes, some hops crossed the process boundary)
+    assert r0["migrations"] + r1["migrations"] > 0
