@@ -123,13 +123,17 @@ class BotClient:
                  strict: bool = False, move_interval: float = 0.1,
                  speed: float = 5.0, seed: int | None = None,
                  ws: bool = False, kcp: bool = False,
-                 compress: bool = False, tls: bool = False):
+                 compress: bool = False, tls: bool = False,
+                 nosync: bool = False):
         self.host = host
         self.port = port
         self.ws = ws
         self.kcp = kcp
         self.compress = compress
         self.tls = tls
+        # reference test_client -nosync: connect and mirror but never
+        # send position syncs (isolates the downstream pipeline)
+        self.nosync = nosync
         self.bot_id = bot_id
         self.strict = strict
         self.move_interval = move_interval
@@ -267,7 +271,8 @@ class BotClient:
             await self.player_ready.wait()
             while not self._stop:
                 await asyncio.sleep(self.move_interval)
-                if self.player is None or self.rng.random() < 0.5:
+                if self.nosync or self.player is None \
+                        or self.rng.random() < 0.5:
                     continue
                 x, y, z = self.player.pos
                 x += self.rng.uniform(-self.speed, self.speed)
@@ -306,13 +311,13 @@ class BotClient:
 
 async def run_swarm(host: str, port: int, n_bots: int, duration: float,
                     *, strict: bool = True, compress: bool = False,
-                    tls: bool = False, kcp: bool = False
-                    ) -> list[BotClient]:
-    """Run N bots concurrently (reference ``test_client -N``; ``kcp``
-    mirrors its ``-kcp`` flag — dial the gate's reliable-UDP port)."""
+                    tls: bool = False, kcp: bool = False,
+                    nosync: bool = False) -> list[BotClient]:
+    """Run N bots concurrently (reference ``test_client`` flags:
+    ``-N -strict -duration -ws -kcp -nosync``)."""
     bots = [
         BotClient(host, port, bot_id=i, strict=strict, compress=compress,
-                  tls=tls, kcp=kcp)
+                  tls=tls, kcp=kcp, nosync=nosync)
         for i in range(n_bots)
     ]
     await asyncio.gather(*(b.run(duration) for b in bots))
