@@ -313,8 +313,9 @@ async def run_swarm(host: str, port: int, n_bots: int, duration: float,
                     *, strict: bool = True, compress: bool = False,
                     tls: bool = False, kcp: bool = False,
                     nosync: bool = False) -> list[BotClient]:
-    """Run N bots concurrently (reference ``test_client`` flags:
-    ``-N -strict -duration -ws -kcp -nosync``)."""
+    """Run N bots concurrently (reference ``test_client -N``; mirrors
+    the ``-strict``/``-kcp``/``-nosync`` flags; per-bot ``ws`` is a
+    BotClient option)."""
     bots = [
         BotClient(host, port, bot_id=i, strict=strict, compress=compress,
                   tls=tls, kcp=kcp, nosync=nosync)

@@ -251,3 +251,35 @@ def test_create_space_anywhere_and_kvreg_traverse(cluster):
     seen = []
     gs.kvreg_traverse("Zone/", lambda k, v: seen.append((k, v)))
     assert seen == [("Zone/alpha", "1"), ("Zone/beta", "2")]
+
+
+def test_nosync_bot_mirrors_without_sending(cluster):
+    """-nosync parity: the bot logs in and mirrors entities but never
+    sends a position sync upstream (reference test_client -nosync)."""
+    import asyncio
+
+    harness, world, gs = cluster
+    host, port = harness.gate_addrs[0]
+    from goworld_tpu.net.botclient import BotClient
+
+    bot = BotClient(host, port, strict=True, nosync=True)
+
+    sent = []
+    orig = bot.send_position
+    bot.send_position = lambda *a: sent.append(a) or orig(*a)
+
+    async def script():
+        await bot.connect()
+        recv = asyncio.ensure_future(bot._recv_loop())
+        move = asyncio.ensure_future(bot._move_loop())
+        try:
+            await asyncio.wait_for(bot.player_ready.wait(), 15)
+            await asyncio.sleep(1.0)   # move loop runs; must stay silent
+        finally:
+            move.cancel()
+            recv.cancel()
+            await bot.conn.close()
+
+    harness.submit(script()).result(timeout=40)
+    assert bot.player is not None
+    assert not sent, "nosync bot sent position syncs"
