@@ -356,6 +356,7 @@ def cmd_run_gate(gateid: int, configfile: str | None,
             gateid, gc.host, gc.port, cfg.dispatcher_addrs(),
             ws_port=gc.ws_port,
             kcp_port=gc.kcp_port,
+            kcp_idle_timeout=gc.kcp_idle_timeout,
             heartbeat_timeout=gc.heartbeat_timeout,
             position_sync_interval_ms=gc.position_sync_interval_ms,
             compress=gc.compress,

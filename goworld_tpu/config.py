@@ -63,6 +63,9 @@ class GateConfig:
     ws_port: int = 0          # 0 = no websocket listener
     kcp_port: int = 0         # 0 = no KCP (reliable-UDP) listener
                               # (reference GateService.go:129-161)
+    kcp_idle_timeout: float = 60.0  # reap KCP sessions with no inbound
+                              # datagram for this long (UDP has no
+                              # connection_lost; 0 disables)
     # client-edge transport (reference goworld.ini.sample compress/encrypt
     # flags; ClientProxy.go:38-53). encrypt=TLS on the TCP listener; the
     # cert/key are generated self-signed on first use when paths are empty.

@@ -44,9 +44,13 @@ class WorldConfig:
     sync_cap: int = consts.DEFAULT_SYNC_CAP
     attr_sync_cap: int = consts.DEFAULT_EVENT_CAP
     input_cap: int = consts.DEFAULT_INPUT_CAP
-    delta_rows_cap: int = consts.DEFAULT_EVENT_CAP  # max rows whose AOI
-    # list may change per tick before enter/leave events overflow
-    # (ops.delta.interest_pairs)
+    delta_rows_cap: int = 0  # max rows whose AOI list may change per tick
+    # before enter/leave events overflow (ops.delta.interest_pairs).
+    # <= 0 means "capacity": the row pre-filter then never drops events
+    # the enter/leave pair caps had headroom for (a mass-spawn/teleport
+    # tick changes nearly every row; a sub-capacity default silently lost
+    # its surplus rows' events). Set explicitly to trade compare work for
+    # drop risk — it is a pure optimization knob, not a correctness one.
 
     def __post_init__(self):
         if self.behavior not in ("random_walk", "mlp", "btree"):
@@ -56,6 +60,12 @@ class WorldConfig:
                 f"behavior must be random_walk|mlp|btree, "
                 f"got {self.behavior!r}"
             )
+
+    @property
+    def delta_rows_cap_eff(self) -> int:
+        """``delta_rows_cap`` resolved: <= 0 tracks ``capacity``."""
+        return self.delta_rows_cap if self.delta_rows_cap > 0 \
+            else self.capacity
 
     @property
     def bounds_min(self) -> tuple[float, float, float]:

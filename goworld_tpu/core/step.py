@@ -191,7 +191,7 @@ def tick_body(
     (enter_w, enter_j, enter_n, leave_w, leave_j, leave_n,
      delta_rows_n) = interest_pairs(
         state.nbr, nbr, n, cfg.enter_cap, cfg.leave_cap,
-        min(cfg.delta_rows_cap, n),
+        min(cfg.delta_rows_cap_eff, n),
     )
 
     # 6. position sync records (CollectEntitySyncInfos analog).

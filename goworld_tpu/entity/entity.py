@@ -32,16 +32,29 @@ class GameClient:
     """Handle to the (gate_id, client_id) pair owning an entity
     (reference ``GameClient.go:17-21``). Messages go through the world's
     client sink — the gateway in a full deployment, a capture list in
-    tests."""
+    tests.
 
-    __slots__ = ("gate_id", "client_id", "_world")
+    ``owner`` is the bound entity (set by ``World.set_entity_client``).
+    Under a multi-controller World, host logic runs SPMD on EVERY
+    controller, so each client-bound message would be emitted once per
+    controller; :meth:`send` consults ``World.client_emit_ok(owner)`` so
+    exactly one controller (the one owning the entity's shard) puts it on
+    the wire — the device-plane analog of the reference dispatcher
+    routing client packets from whichever game hosts the entity
+    (``components/gate/GateService.go:258-306``)."""
 
-    def __init__(self, gate_id: int, client_id: str, world: "World"):
+    __slots__ = ("gate_id", "client_id", "_world", "owner")
+
+    def __init__(self, gate_id: int, client_id: str, world: "World",
+                 owner: "Entity | None" = None):
         self.gate_id = gate_id
         self.client_id = client_id
         self._world = world
+        self.owner = owner
 
     def send(self, msg: dict) -> None:
+        if not self._world.client_emit_ok(self.owner):
+            return
         self._world.send_to_client(self.gate_id, self.client_id, msg)
 
     def __repr__(self) -> str:

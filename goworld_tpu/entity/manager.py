@@ -241,8 +241,12 @@ class World:
             from goworld_tpu.parallel.multihost import local_shard_indices
 
             self.local_shards = local_shard_indices(mesh)
+            self.mh_rank = jax.process_index()
         else:
             self.local_shards = list(range(n_spaces))
+            self.mh_rank = 0
+        # deterministic auto-eid sequence for multihost (see _gen_eid)
+        self._mh_eid_seq = 0
 
         # pluggable sinks (the gateway overrides these; defaults capture)
         self.client_messages: list[tuple[int, str, dict]] = []
@@ -287,6 +291,20 @@ class World:
         e.world = self
         e.attrs = make_root(lambda d, _e=e: self._on_attr_delta(_e, d))
         self._setup_save_timer(e)
+
+    def _gen_eid(self) -> str:
+        """Auto-generated entity id. Multi-controller worlds draw from a
+        DETERMINISTIC per-world sequence: SPMD-replicated host code (e.g.
+        a replayed client RPC spawning an Avatar) must mint the SAME id
+        on every controller or host/device state forks. Random
+        time+machine+pid ids remain for single-controller worlds
+        (reference ``uuid.go:27-60`` semantics)."""
+        if not self._multihost:
+            return ids.gen_entity_id()
+        self._mh_eid_seq += 1
+        return ids.gen_fixed_id(
+            f"goworld_tpu.mh.{self.game_id}.{self._mh_eid_seq}"
+        )
 
     def _setup_save_timer(self, e: Entity) -> None:
         """Schedule the periodic save for a persistent entity (reference
@@ -340,7 +358,7 @@ class World:
         # honor a caller-supplied id (CreateSpaceAnywhere pre-generates one
         # and routes by it — the space must be findable under that id,
         # goworld.go CreateSpaceAnywhere / space_ops.go)
-        self._attach(sp, eid or ids.gen_entity_id())
+        self._attach(sp, eid or self._gen_eid())
         aoi = desc.use_aoi if use_aoi is None else use_aoi
         if desc.megaspace:
             if self.mega is None:
@@ -407,7 +425,7 @@ class World:
             raise TypeError(f"use create_space for space type {type_name}")
         e: Entity = desc.cls()
         e._type_desc = desc
-        new_id = eid or ids.gen_entity_id()
+        new_id = eid or self._gen_eid()
         if new_id in self.entities:
             raise ValueError(f"entity id collision: {new_id}")
         self._attach(e, new_id)
@@ -708,6 +726,8 @@ class World:
         AllClients attrs)."""
         old = e.client
         e.client = client
+        if client is not None:
+            client.owner = e  # multihost send-dedup needs the backref
         if e.slot is not None and e.shard is not None:
             self._staged_client.append((
                 e.shard, e.slot,
@@ -894,6 +914,21 @@ class World:
     # ==================================================================
     # client message sink
     # ==================================================================
+    def client_emit_ok(self, e: Entity | None) -> bool:
+        """Multi-controller send dedup: SPMD host logic (attr journals,
+        call_client, bind-time create_entity) runs on EVERY controller, so
+        exactly one may emit each client-bound message. Rule: the
+        controller owning the entity's shard emits; slotless entities
+        (nil-space boot entities, mid-migration rows) belong to the
+        leader. Single-controller worlds always emit. The owner-local
+        event decode in :meth:`_process_outputs` satisfies this rule by
+        construction (a watcher's events decode on its shard's owner)."""
+        if not self._multihost:
+            return True
+        if e is None or e.shard is None:
+            return self.mh_rank == 0
+        return e.shard in self.local_shards
+
     def send_to_client(self, gate_id: int, client_id: str, msg: dict) -> None:
         if self.client_sink is not None:
             self.client_sink(gate_id, client_id, msg)
@@ -953,7 +988,7 @@ class World:
             # quietly" (no create_entity resend; the client already has
             # the entity)
             e.client = GameClient(
-                data["client"][0], data["client"][1], self
+                data["client"][0], data["client"][1], self, owner=e
             )
         sp = space or self.nil_space
         if sp is not None:
@@ -1341,7 +1376,7 @@ class World:
             self._mega_apply_arrivals(mega_pending, outs)
         for shard in self.local_shards:
             drn = int(base.delta_rows_n[shard])
-            drc = min(cfg.delta_rows_cap, cfg.capacity)
+            drc = min(cfg.delta_rows_cap_eff, cfg.capacity)
             if drn > drc:
                 # the ROW cap overflowed: surplus rows' enter/leave events
                 # are gone and widening enter/leave caps won't help

@@ -224,7 +224,8 @@ def restore_world(world: World, data: dict) -> None:
         world.entities[e.id] = e
         _load_attrs_quiet(e, ed.get("attrs", {}))
         if ed.get("client"):
-            e.client = GameClient(ed["client"][0], ed["client"][1], world)
+            e.client = GameClient(ed["client"][0], ed["client"][1], world,
+                                  owner=e)
         target = world.spaces.get(ed.get("space_id") or "") or world.nil_space
         world._enter_space_local(
             e, target, tuple(ed["pos"]), moving=bool(ed.get("moving"))

@@ -28,6 +28,14 @@ constexpr int OVERHEAD = 24;
 constexpr uint8_t CMD_PUSH = 81, CMD_ACK = 82, CMD_WASK = 83, CMD_WINS = 84;
 constexpr int DEAD_LINK = 20;
 
+// Signed serial distance under u32 wrap (kcp-go's _itimediff): every
+// sn/una window compare must go through this so a conversation that
+// crosses sn 2^32 keeps flowing (and so the Python core's _sn_diff
+// arithmetic stays bit-identical).
+inline int32_t sn_diff(uint32_t a, uint32_t b) {
+    return (int32_t)(a - b);
+}
+
 struct Seg {
     uint32_t sn;
     uint32_t ts;
@@ -56,6 +64,7 @@ struct Kcp {
     int64_t rx_srtt = 0, rx_rttval = 0, rx_rto = 200;
     bool dead = false;
     bool wins_pending = false;
+    bool wask_pending = false;  // liveness probe: WASK elicits a WINS
 
     Kcp(uint32_t c, int mtu_, int sw, int rw, int iv, int rs, int minrto)
         : conv(c), mtu(mtu_), mss(mtu_ - OVERHEAD), snd_wnd(sw),
@@ -84,7 +93,7 @@ struct Kcp {
     }
 
     void parse_una(uint32_t una) {
-        while (!snd_buf.empty() && snd_buf.front().sn < una)
+        while (!snd_buf.empty() && sn_diff(snd_buf.front().sn, una) < 0)
             snd_buf.pop_front();
         snd_una = snd_buf.empty() ? snd_nxt : snd_buf.front().sn;
     }
@@ -94,10 +103,10 @@ struct Kcp {
         if (rtt < 60000) update_rtt((int64_t)rtt);
         for (auto it = snd_buf.begin(); it != snd_buf.end(); ++it) {
             if (it->sn == sn) { snd_buf.erase(it); break; }
-            if (it->sn > sn) break;
+            if (sn_diff(it->sn, sn) > 0) break;
         }
         for (auto& seg : snd_buf)
-            if (seg.sn < sn) seg.fastack++;
+            if (sn_diff(seg.sn, sn) < 0) seg.fastack++;
         snd_una = snd_buf.empty() ? snd_nxt : snd_buf.front().sn;
     }
 
@@ -127,7 +136,8 @@ struct Kcp {
             if (cmd == CMD_ACK) {
                 parse_ack(sn, ts, now32);
             } else if (cmd == CMD_PUSH) {
-                if (sn >= rcv_nxt && sn < rcv_nxt + (uint32_t)rcv_wnd) {
+                int32_t ahead = sn_diff(sn, rcv_nxt);
+                if (ahead >= 0 && ahead < rcv_wnd) {
                     acklist.emplace_back(sn, ts);
                     if (!rcv_buf.count(sn))
                         rcv_buf[sn] = std::vector<char>(data, data + len);
@@ -140,9 +150,9 @@ struct Kcp {
                         if (!it->second.empty())
                             rcv_queue.push_back(std::move(it->second));
                         rcv_buf.erase(it);
-                        rcv_nxt++;
+                        rcv_nxt++;  // uint32_t: wraps with the wire
                     }
-                } else if (sn < rcv_nxt) {
+                } else if (ahead < 0) {
                     acklist.emplace_back(sn, ts);  // re-ack duplicate
                 }
             } else if (cmd == CMD_WASK) {
@@ -189,10 +199,14 @@ struct Kcp {
             emit(CMD_WINS, 0, now32, wnd, nullptr, 0);
             wins_pending = false;
         }
+        if (wask_pending) {
+            emit(CMD_WASK, 0, now32, wnd, nullptr, 0);
+            wask_pending = false;
+        }
         uint32_t cwnd = (uint32_t)snd_wnd;
         uint32_t rw = rmt_wnd > 0 ? rmt_wnd : 1;
         if (rw < cwnd) cwnd = rw;
-        while (!snd_queue.empty() && snd_nxt < snd_una + cwnd) {
+        while (!snd_queue.empty() && sn_diff(snd_nxt, snd_una + cwnd) < 0) {
             Seg s;
             s.sn = snd_nxt++;
             s.data = std::move(snd_queue.front());
@@ -287,6 +301,22 @@ void kcp_announce(void* k, int64_t now_ms) {
     Kcp* kc = (Kcp*)k;
     kc->emit(CMD_WINS, 0, (uint32_t)now_ms,
              (uint16_t)kc->wnd_unused(), nullptr, 0);
+}
+
+// Queue a WASK (window probe) for the next flush. The peer answers with
+// a WINS, so this doubles as a liveness probe for idle-session reaping
+// (KcpServer): a silent-but-alive peer refreshes last_heard, a dead one
+// does not.
+void kcp_probe(void* k) { ((Kcp*)k)->wask_pending = true; }
+
+// TEST HOOK: preset the serial counters so u32-wrap behavior can be
+// exercised without pushing 2^32 segments (tests/test_kcp.py).
+void kcp_test_set_serials(void* k, uint32_t snd_nxt, uint32_t snd_una,
+                          uint32_t rcv_nxt) {
+    Kcp* kc = (Kcp*)k;
+    kc->snd_nxt = snd_nxt;
+    kc->snd_una = snd_una;
+    kc->rcv_nxt = rcv_nxt;
 }
 
 }  // extern "C"

@@ -33,6 +33,26 @@ from goworld_tpu.utils import consts, log, opmon
 
 logger = log.get("game")
 
+# Dispatcher packets that MUTATE the World. Under a multi-controller
+# (multihost) World these land on ONE controller's dispatcher connection
+# but must be applied on ALL controllers in the same tick (the SPMD
+# contract, parallel/multihost.py) — so they are queued raw and exchanged
+# through a per-tick allgather before World.tick (see
+# _mh_exchange_mutations). The reference has no analog: its dispatcher
+# star routes each packet to the single game hosting the entity
+# (DispatcherService.go); here one World spans every controller.
+_MH_WORLD_MSGTYPES = frozenset({
+    proto.MT_NOTIFY_CLIENT_CONNECTED,
+    proto.MT_NOTIFY_CLIENT_DISCONNECTED,
+    proto.MT_NOTIFY_GATE_DISCONNECTED,
+    proto.MT_SYNC_POSITION_YAW_FROM_CLIENT,
+    proto.MT_CALL_ENTITY_METHOD,
+    proto.MT_CALL_ENTITY_METHOD_FROM_CLIENT,
+    proto.MT_CREATE_ENTITY_ANYWHERE,
+    proto.MT_LOAD_ENTITY_ANYWHERE,
+    proto.MT_CALL_NIL_SPACES,
+})
+
 
 class GameServer:
     """One game process: a World + connections to every dispatcher."""
@@ -86,6 +106,9 @@ class GameServer:
         # per-gate downstream sync batches for the current tick
         self._sync_out: dict[int, list] = {}
         self.on_deployment_ready: Callable[[], None] | None = None
+        # multihost World-mutation log (see _MH_WORLD_MSGTYPES)
+        self._mh_pending: list[tuple[int, bytes]] = []
+        self._mh_replaying = False
 
         # wire the world's pluggable edges to the cluster
         w = world
@@ -195,14 +218,72 @@ class GameServer:
             n += 1
 
     def tick(self) -> None:
+        if self.world._multihost:
+            self._mh_exchange_mutations()
         self.world.tick()
         self._flush_sync_out()
+
+    def _mh_exchange_mutations(self) -> None:
+        """Multi-controller mutation exchange: allgather every controller's
+        queued World-mutating packets and replay the union in process
+        order, so all controllers apply IDENTICAL mutations this tick no
+        matter whose dispatcher connection a packet arrived on. Runs every
+        tick on every controller (the collectives must pair up); the
+        blocking allgather also keeps the controllers' tick loops in
+        lockstep — the host-plane counterpart of the device step's own
+        collectives."""
+        import struct as _st
+
+        from jax.experimental import multihost_utils
+
+        blob = bytearray()
+        for mt, payload in self._mh_pending:
+            blob += _st.pack("<HI", mt, len(payload))
+            blob += payload
+        self._mh_pending.clear()
+        lengths = np.asarray(
+            multihost_utils.process_allgather(np.int32(len(blob)))
+        ).ravel()
+        max_len = int(lengths.max())
+        if max_len == 0:
+            return
+        padded = np.zeros(max_len, np.uint8)
+        if blob:
+            padded[: len(blob)] = np.frombuffer(bytes(blob), np.uint8)
+        all_blobs = np.asarray(multihost_utils.process_allgather(padded))
+        self._mh_replaying = True
+        try:
+            for pid in range(all_blobs.shape[0]):
+                data = all_blobs[pid].tobytes()[: int(lengths[pid])]
+                off = 0
+                while off + 6 <= len(data):
+                    mt, ln = _st.unpack_from("<HI", data, off)
+                    off += 6
+                    try:
+                        self._handle_packet(
+                            -1, mt, Packet(data[off:off + ln])
+                        )
+                    except Exception:
+                        logger.exception(
+                            "game%d: multihost replay of msgtype %d "
+                            "failed", self.game_id, mt,
+                        )
+                    off += ln
+        finally:
+            self._mh_replaying = False
 
     # ==================================================================
     # networking thread side
     # ==================================================================
     async def _handshake(self, conn: DispatcherConn) -> None:
-        census = list(self.world.entities.keys())
+        # multihost followers register NO entities: the leader alone
+        # represents the shared World in the dispatcher's entity table
+        # (eid-routed packets then reach exactly one controller and are
+        # replicated from there via _mh_exchange_mutations)
+        census = (
+            [] if self._mh_follower()
+            else list(self.world.entities.keys())
+        )
         p = proto.pack_set_game_id(
             self.game_id, is_reconnect=self.deployment_ready,
             is_restore=self._is_restore, ban_boot=self.ban_boot,
@@ -303,21 +384,38 @@ class GameServer:
 
     def _remote_call(self, eid: str, method: str, args: tuple,
                      from_client: str | None) -> None:
+        if self._mh_follower():
+            return  # SPMD-replicated call; the leader sends it once
         p = proto.pack_call_entity_method(eid, method, args, from_client)
         self._send(self.cluster.select_by_entity_id(eid), p)
 
     def _filtered_sink(self, key: str, op: str, val: str, method: str,
                        args: tuple) -> None:
+        if self._mh_follower():
+            return
         p = proto.pack_call_filtered_clients(key, op, val, "", method, args)
         self._send(self.cluster.conns[0], p)
 
+    def _mh_follower(self) -> bool:
+        """True on non-leader controllers of a multihost World. Cluster
+        messages originated by SPMD-replicated host code (entity
+        registration, anywhere-placement, filtered broadcasts) would be
+        sent once per controller; only the leader (process 0) puts them on
+        the wire. Client-bound traffic is NOT gated here — it is deduped
+        per-entity by World.client_emit_ok (the shard owner emits)."""
+        return self.world._multihost and self.world.mh_rank != 0
+
     def _notify_entity_created(self, e: Entity) -> None:
+        if self._mh_follower():
+            return  # the leader alone owns the dispatcher entity table
         p = new_packet(proto.MT_NOTIFY_CREATE_ENTITY)
         p.append_entity_id(e.id)
         p.append_u16(self.game_id)
         self._send(self.cluster.select_by_entity_id(e.id), p)
 
     def _notify_entity_destroyed(self, e: Entity) -> None:
+        if self._mh_follower():
+            return
         p = new_packet(proto.MT_NOTIFY_DESTROY_ENTITY)
         p.append_entity_id(e.id)
         self._send(self.cluster.select_by_entity_id(e.id), p)
@@ -331,6 +429,8 @@ class GameServer:
         the target (``CreateEntityOnGame`` / ``CreateSpaceOnGame``)."""
         from goworld_tpu.utils import ids as _ids
 
+        if self._mh_follower():
+            return  # replicated caller; leader alone requests placement
         eid = _ids.gen_entity_id()
         p = proto.pack_create_entity_anywhere(type_name, attrs or {}, eid,
                                               gameid)
@@ -338,6 +438,8 @@ class GameServer:
 
     def load_entity_anywhere(self, type_name: str, eid: str,
                              gameid: int = 0) -> None:
+        if self._mh_follower():
+            return
         p = proto.pack_load_entity_anywhere(type_name, eid, gameid)
         self._send(self.cluster.select_by_entity_id(eid), p)
 
@@ -364,6 +466,8 @@ class GameServer:
         )
 
     def call_nil_spaces(self, method: str, *args) -> None:
+        if self._mh_follower():
+            return
         p = proto.pack_call_nil_spaces(method, args)
         self._send(self.cluster.conns[0], p)
 
@@ -381,6 +485,14 @@ class GameServer:
     # ==================================================================
     def _handle_packet(self, didx: int, msgtype: int, pkt: Packet) -> None:
         w = self.world
+        if w._multihost and not self._mh_replaying \
+                and msgtype in _MH_WORLD_MSGTYPES:
+            # defer to the per-tick allgather so every controller applies
+            # this mutation, in the same order, in the same tick
+            self._mh_pending.append(
+                (msgtype, bytes(memoryview(pkt.buf)[pkt.rpos:]))
+            )
+            return
         if msgtype == proto.MT_SET_GAME_ID_ACK:
             disp_id = pkt.read_u16()
             self.handshake_acks.add(disp_id)

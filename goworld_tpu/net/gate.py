@@ -101,6 +101,7 @@ class GateService:
         *,
         ws_port: int = 0,
         kcp_port: int = 0,
+        kcp_idle_timeout: float = 60.0,
         heartbeat_timeout: float = 0.0,
         position_sync_interval_ms: int = 100,
         compress: bool = False,
@@ -115,6 +116,11 @@ class GateService:
         # serveKCP with turbo tuning): same framed protocol over
         # net/kcp.py sessions; 0 = no KCP listener
         self.kcp_port = kcp_port
+        # KCP sessions self-reap after this many seconds without an
+        # inbound datagram — independent of heartbeat_timeout, which
+        # defaults off; without it a vanished UDP peer (no connection_lost,
+        # no unacked data) would pin its session forever
+        self.kcp_idle_timeout = kcp_idle_timeout
         # client-edge transport options (reference ClientProxy.go:38-53
         # snappy + TLS; see net/transport.py for the codec choice and the
         # KCP deviation note). Compression/TLS apply to the TCP listener;
@@ -175,6 +181,7 @@ class GateService:
             self._kcp_server = await start_kcp_server(
                 self._handle_client, self.host,
                 max(self.kcp_port, 0),
+                idle_timeout=self.kcp_idle_timeout,
             )
         self.started.set()
         logger.info("gate%d listening on %s:%d", self.gate_id, self.host,

@@ -58,6 +58,16 @@ CMD_WINS = 84
 
 _DEAD_LINK = 20                   # retransmits before declaring the conn dead
 
+_U32 = 0xFFFFFFFF
+
+
+def _sn_diff(a: int, b: int) -> int:
+    """Signed serial-number distance a-b under u32 wrap (the kcp-go
+    ``_itimediff`` idiom). All sn/una window compares go through this so
+    the Python core wraps exactly like the native/kcp-go cores instead of
+    diverging past 2^32 segments."""
+    return ((a - b + 0x80000000) & _U32) - 0x80000000
+
 
 def _now_ms() -> int:
     # unbounded python int for all local arithmetic; masked to u32 only
@@ -120,6 +130,7 @@ class KcpCore:
         self.rx_rto = 200
         self.dead = False
         self._wins_pending = False
+        self._wask_pending = False
 
     # ---------------------------------------------------------- sending --
     def send(self, data: bytes) -> None:
@@ -145,7 +156,7 @@ class KcpCore:
         self.rx_rto = min(max(self.rx_minrto, rto), 60000)
 
     def _parse_una(self, una: int) -> None:
-        while self.snd_buf and self.snd_buf[0].sn < una:
+        while self.snd_buf and _sn_diff(self.snd_buf[0].sn, una) < 0:
             self.snd_buf.popleft()
         self.snd_una = (
             self.snd_buf[0].sn if self.snd_buf else self.snd_nxt
@@ -159,12 +170,12 @@ class KcpCore:
             if seg.sn == sn:
                 del self.snd_buf[i]
                 break
-            if seg.sn > sn:
+            if _sn_diff(seg.sn, sn) > 0:
                 break
         # fast-retransmit bookkeeping: older in-flight segments were
         # skipped by this newer ack
         for seg in self.snd_buf:
-            if seg.sn < sn:
+            if _sn_diff(seg.sn, sn) < 0:
                 seg.fastack += 1
         self.snd_una = (
             self.snd_buf[0].sn if self.snd_buf else self.snd_nxt
@@ -190,17 +201,18 @@ class KcpCore:
             if cmd == CMD_ACK:
                 self._parse_ack(sn, ts)
             elif cmd == CMD_PUSH:
-                if self.rcv_nxt <= sn < self.rcv_nxt + self.rcv_wnd:
+                ahead = _sn_diff(sn, self.rcv_nxt)
+                if 0 <= ahead < self.rcv_wnd:
                     self.acklist.append((sn, ts))
-                    if sn not in self.rcv_buf and sn >= self.rcv_nxt:
+                    if sn not in self.rcv_buf:
                         self.rcv_buf[sn] = data
                     # drain in-order prefix
                     while self.rcv_nxt in self.rcv_buf:
                         self.rcv_queue.append(
                             self.rcv_buf.pop(self.rcv_nxt)
                         )
-                        self.rcv_nxt += 1
-                elif sn < self.rcv_nxt:
+                        self.rcv_nxt = (self.rcv_nxt + 1) & _U32
+                elif ahead < 0:
                     # duplicate of something already delivered: re-ack
                     self.acklist.append((sn, ts))
             elif cmd == CMD_WASK:
@@ -223,6 +235,13 @@ class KcpCore:
                       _now_ms() & 0xFFFFFFFF, 0, self.rcv_nxt)
             + struct.pack("<I", 0)
         )
+
+    def probe(self) -> None:
+        """Queue a WASK (window probe) for the next flush. The peer
+        answers with a WINS, so this doubles as a liveness probe for
+        idle-session reaping: a silent-but-alive peer refreshes
+        ``last_heard``, a dead one does not."""
+        self._wask_pending = True
 
     # ------------------------------------------------------------ flush --
     def _wnd_unused(self) -> int:
@@ -249,14 +268,18 @@ class KcpCore:
         if self._wins_pending:
             emit(CMD_WINS, 0, now)
             self._wins_pending = False
+        if self._wask_pending:
+            emit(CMD_WASK, 0, now)
+            self._wask_pending = False
 
         # admit new segments into the in-flight window (turbo mode: no
         # congestion window; a zero remote window still admits one
         # segment so progress is made without WASK probing)
         cwnd = min(self.snd_wnd, max(self.rmt_wnd, 1))
-        while self.snd_queue and self.snd_nxt < self.snd_una + cwnd:
+        while self.snd_queue and \
+                _sn_diff(self.snd_nxt, (self.snd_una + cwnd) & _U32) < 0:
             seg = _Seg(self.snd_nxt, self.snd_queue.popleft())
-            self.snd_nxt += 1
+            self.snd_nxt = (self.snd_nxt + 1) & _U32
             self.snd_buf.append(seg)
 
         for seg in self.snd_buf:
@@ -290,7 +313,9 @@ class KcpCore:
 # GOWORLD_TPU_PURE_KCP=1 forces the Python core.
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "native")
-_KCP_SO = os.path.abspath(os.path.join(_NATIVE_DIR, "_kcp_core.so"))
+# versioned: v2 added kcp_probe/kcp_test_set_serials and the u32
+# serial-wrap fix — a stale v1 .so must not satisfy the lazy build
+_KCP_SO = os.path.abspath(os.path.join(_NATIVE_DIR, "_kcp_core_v2.so"))
 _kcp_lib: ctypes.CDLL | None = None
 _kcp_lib_tried = False
 _kcp_build_lock = threading.Lock()
@@ -361,6 +386,10 @@ def _load_native() -> ctypes.CDLL | None:
         lib.kcp_dead.restype = ctypes.c_int
         lib.kcp_dead.argtypes = [ctypes.c_void_p]
         lib.kcp_announce.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.kcp_probe.argtypes = [ctypes.c_void_p]
+        lib.kcp_test_set_serials.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_uint32]
         _kcp_lib = lib
         return lib
 
@@ -429,6 +458,9 @@ class NativeKcpCore:
     def announce(self) -> None:
         self._lib.kcp_announce(self._h, _now_ms())
         self._drain()
+
+    def probe(self) -> None:
+        self._lib.kcp_probe(self._h)
 
     def __del__(self):
         h, lib = getattr(self, "_h", None), getattr(self, "_lib", None)
@@ -502,10 +534,12 @@ class _Session:
         self.writer = KcpWriter(self.core, addr, self.close)
         self.await_peer = False   # client side: re-announce until heard
         self._heard_peer = False
+        self.last_heard = time.monotonic()
         self._task = asyncio.ensure_future(self._update_loop())
 
     def feed(self, datagram: bytes) -> None:
         self._heard_peer = True
+        self.last_heard = time.monotonic()
         self.core.input(datagram)
         while (chunk := self.core.recv()) is not None:
             self.reader.feed_data(chunk)
@@ -539,18 +573,70 @@ class KcpServer(asyncio.DatagramProtocol):
     """UDP listener demultiplexing sessions by (addr, conv); calls
     ``client_connected(reader, writer)`` exactly like
     ``asyncio.start_server`` so the gate's connection handler is shared
-    with the TCP path (``GateService.go:129-161``)."""
+    with the TCP path (``GateService.go:129-161``).
+
+    Self-defending independently of the gate's (optional) heartbeat:
+
+    - **idle reaping** — UDP has no connection_lost and dead-link
+      detection only fires while unacked OUTBOUND data exists, so a
+      silently-vanished peer (or a spoofed datagram that passed mint
+      validation) would otherwise pin a session + its update task
+      forever and exhaust MAX_SESSIONS. A session with no inbound
+      datagram for ``idle_timeout`` seconds is closed here.
+    - **TIME_WAIT tombstones** — after a server-initiated close the peer
+      may keep retransmitting unacked PUSH segments; without a tombstone
+      each would re-pass mint validation and resurrect the connection
+      (fresh ClientProxy + boot entity per kick). Recently-closed
+      (addr, conv) keys drop datagrams for ``TIME_WAIT`` seconds.
+    - **per-IP mint cap** — one source IP may hold at most
+      ``max_sessions_per_ip`` live sessions, bounding what a single
+      spoofing host can pin (ports are free to forge; IPs less so).
+    """
 
     MAX_SESSIONS = 65536  # bound state growth from spoofed/garbage UDP
+    TIME_WAIT = 3.0       # s; covers several nodelay RTO backoff rounds
 
-    def __init__(self, client_connected, loss_hook=None):
+    def __init__(self, client_connected, loss_hook=None, *,
+                 idle_timeout: float = 60.0,
+                 max_sessions_per_ip: int = 4096):
         self._cb = client_connected
         self._sessions: dict[tuple, _Session] = {}
         self._transport = None
         self._loss_hook = loss_hook
+        self._idle_timeout = idle_timeout
+        self._max_per_ip = max_sessions_per_ip
+        self._per_ip: dict[str, int] = {}
+        self._tombstones: dict[tuple, float] = {}
+        self._reaper: asyncio.Task | None = None
 
     def connection_made(self, transport) -> None:
         self._transport = transport
+        if self._idle_timeout > 0:
+            self._reaper = asyncio.ensure_future(self._reap_loop())
+
+    async def _reap_loop(self) -> None:
+        period = max(0.5, min(self._idle_timeout / 4.0, 10.0))
+        try:
+            while True:
+                await asyncio.sleep(period)
+                now = time.monotonic()
+                for key, sess in list(self._sessions.items()):
+                    idle = now - sess.last_heard
+                    if idle > self._idle_timeout:
+                        logger.info("kcp: reaping idle session %s", key)
+                        sess.close()  # close_and_forget -> tombstone
+                    elif idle > self._idle_timeout / 2.0:
+                        # half-idle liveness probe: a WASK elicits a WINS
+                        # from a live-but-quiet peer (refreshing
+                        # last_heard), so only truly dead peers reap —
+                        # an idle player standing in a quiet area with
+                        # zero traffic both ways must NOT be kicked
+                        sess.core.probe()
+                self._tombstones = {
+                    k: t for k, t in self._tombstones.items() if t > now
+                }
+        except asyncio.CancelledError:
+            pass
 
     @property
     def bound_port(self) -> int:
@@ -573,34 +659,58 @@ class KcpServer(asyncio.DatagramProtocol):
                 or cmd not in (CMD_PUSH, CMD_ACK, CMD_WASK, CMD_WINS)
                 or OVERHEAD + length > len(data)
                 or len(self._sessions) >= self.MAX_SESSIONS
+                or self._tombstones.get(key, 0.0) > time.monotonic()
+                or self._per_ip.get(addr[0], 0) >= self._max_per_ip
             ):
                 return
             sess = _Session(conv, self._transport, addr, self._loss_hook)
             self._sessions[key] = sess
+            self._per_ip[addr[0]] = self._per_ip.get(addr[0], 0) + 1
             orig_close = sess.close
 
             def close_and_forget() -> None:
                 orig_close()
-                self._sessions.pop(key, None)
+                if self._sessions.pop(key, None) is not None:
+                    left = self._per_ip.get(addr[0], 1) - 1
+                    if left > 0:
+                        self._per_ip[addr[0]] = left
+                    else:
+                        self._per_ip.pop(addr[0], None)
+                    now = time.monotonic()
+                    if len(self._tombstones) > 256:
+                        # prune here too: with idle_timeout=0 the reaper
+                        # never runs, and closed-session tombstones must
+                        # not accumulate forever in a long-lived gate
+                        self._tombstones = {
+                            k2: t for k2, t in self._tombstones.items()
+                            if t > now
+                        }
+                    self._tombstones[key] = now + self.TIME_WAIT
             sess.close = close_and_forget
             sess.writer._closer = close_and_forget
             asyncio.ensure_future(self._cb(sess.reader, sess.writer))
         sess.feed(data)
 
     def close(self) -> None:
+        if self._reaper is not None:
+            self._reaper.cancel()
         for sess in list(self._sessions.values()):
             sess.close()
         self._sessions.clear()
+        self._per_ip.clear()
         if self._transport is not None:
             self._transport.close()
 
 
 async def start_kcp_server(
-    client_connected, host: str, port: int, *, loss_hook=None
+    client_connected, host: str, port: int, *, loss_hook=None,
+    idle_timeout: float = 60.0, max_sessions_per_ip: int = 4096,
 ) -> KcpServer:
     loop = asyncio.get_running_loop()
     _, proto = await loop.create_datagram_endpoint(
-        lambda: KcpServer(client_connected, loss_hook=loss_hook),
+        lambda: KcpServer(client_connected, loss_hook=loss_hook,
+                          idle_timeout=idle_timeout,
+                          max_sessions_per_ip=max_sessions_per_ip),
         local_addr=(host, port),
     )
     return proto

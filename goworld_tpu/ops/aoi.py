@@ -91,6 +91,18 @@ class GridSpec:
     k: int = consts.DEFAULT_MAX_NEIGHBORS
     cell_cap: int = consts.DEFAULT_CELL_CAP
     row_block: int = consts.DEFAULT_ROW_BLOCK
+    # "exact" = lax.top_k; "approx" = lax.approx_min_k over the packed
+    # keys bitcast to f32 (TPU has a fast partial-reduce lowering for
+    # approximate min-k). CAVEAT: on TPU the approx lowering may MISS a
+    # true neighbor with small probability (recall_target=0.98 per
+    # call), even without k-overflow — a lost AOI enter for that tick.
+    # It is a throughput/accuracy knob for huge worlds, NOT a default;
+    # exactness-critical deployments keep "exact". On CPU the lowering
+    # is exact, so CPU tests only prove plumbing, not recall. The
+    # approx encoding keeps every valid key finite as f32 (8-bit
+    # distance quantization, +inf sentinel) — 0x7FFFFFFF would be NaN
+    # and break the float ordering.
+    topk_impl: str = "exact"
 
     @property
     def cells_x(self) -> int:
@@ -226,8 +238,16 @@ def _sweep(
             # affects WHICH neighbors win when the true count exceeds k
             # (already best-effort); flags sit below the id so they never
             # influence the ranking.
-            invalid_key = jnp.int32(2**31 - 1)
-            if want_flags:
+            approx = spec.topk_impl == "approx"
+            if approx:
+                # +inf bit pattern: ordered above every finite key and,
+                # unlike 0x7FFFFFFF (a NaN), safe for float min-k
+                invalid_key = jnp.int32(0x7F800000)
+            else:
+                invalid_key = jnp.int32(2**31 - 1)
+            if want_flags or approx:
+                # 8-bit distance: max key (254<<23)|word stays a FINITE
+                # f32 pattern, which the approx path requires
                 qd = jnp.minimum(
                     (dist * (255.0 / spec.radius)).astype(jnp.int32),
                     _QD_MAX,
@@ -242,7 +262,12 @@ def _sweep(
                 packed_key = jnp.where(
                     valid, (qd << _ID_BITS) | cand_w, invalid_key
                 )
-            top = -lax.top_k(-packed_key, k)[0]      # k smallest
+            if approx:
+                fk = lax.bitcast_convert_type(packed_key, jnp.float32)
+                vals, _ = lax.approx_min_k(fk, k, recall_target=0.98)
+                top = lax.bitcast_convert_type(vals, jnp.int32)
+            else:
+                top = -lax.top_k(-packed_key, k)[0]  # k smallest
             ok = top < invalid_key
             if want_flags:
                 # the (id << 2) | flags words are already id-ordered:

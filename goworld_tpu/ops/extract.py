@@ -45,13 +45,17 @@ def bounded_extract(
     """Returns (flat int32[cap] indices into mask.ravel(), valid bool[cap],
     count int32). Entries past ``count`` point at 0 and are invalid."""
     if _use_pallas():
-        # the kernel serves unsharded contexts (the single-chip tick the
-        # r02 profile measured). Under shard_map the value varies over
-        # mesh axes (vma non-empty) and interpret-mode pallas does not
-        # propagate that reliably yet — keep those on the XLA path
-        # (round-3: revisit on hardware, where interpret mode is not
-        # involved).
-        if not getattr(jax.typeof(mask), "vma", None):
+        # Under shard_map the value varies over mesh axes (vma
+        # non-empty). On real TPU the compiled kernel handles that (the
+        # out_shape vma annotation in pallas_extract); in INTERPRET mode
+        # (CPU rigs) pallas's own block slicing mixes unvarying grid
+        # indices with varying operands and trips check_vma — a JAX
+        # interpret-mode limitation, so those calls keep the XLA path.
+        # Net effect: with the flag set, the megaspace/shard_map path
+        # uses the Pallas kernel exactly where it matters (hardware).
+        vma = getattr(jax.typeof(mask), "vma", None)
+        interpret = jax.default_backend() != "tpu"
+        if not (vma and interpret):
             from goworld_tpu.ops.pallas_extract import (
                 bounded_extract_pallas,
             )

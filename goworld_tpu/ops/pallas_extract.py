@@ -54,9 +54,16 @@ def _compact_kernel(mask_ref, base_ref, out_ref, cnt_ref, *, block: int):
 
     m = mask_ref[:].reshape(block).astype(jnp.int32)          # [B]
     prefix = jnp.cumsum(m)                                    # [B]
-    local = jax.lax.broadcasted_iota(jnp.float32, (block, 1), 0)
+    # iotas DERIVED FROM the mask operand (cumsum of ones), not
+    # broadcasted_iota: under shard_map's interpret-mode vma checking a
+    # kernel-created iota carries an empty varying-axes set and every
+    # binary op mixing it with the (mesh-varying) mask errors out;
+    # deriving from m inherits its vma in interpret mode and lowers to
+    # the same cheap scan on hardware
+    idx = jnp.cumsum(m * 0 + 1) - 1                           # [B] iota
+    local = idx.astype(jnp.float32)[:, None]                  # [B, 1]
     # onehot[i, j] = 1 where set bit i lands in compacted lane j
-    lanes = jax.lax.broadcasted_iota(jnp.int32, (block, block), 1)
+    lanes = jnp.broadcast_to(idx[None, :], (block, block))
     onehot = ((prefix[:, None] - 1 == lanes) & (m[:, None] == 1))
     compacted = jax.lax.dot_general(
         onehot.astype(jnp.float32), local,
@@ -99,9 +106,13 @@ def compact_indices(
         interpret = jax.default_backend() != "tpu"
 
     # under shard_map, outputs must declare which mesh axes they vary
-    # over (check_vma); they vary exactly like the per-shard mask input
+    # over (check_vma); they vary exactly like the per-shard mask input.
+    # The bases operand is mesh-invariant — pvary it to the mask's axes
+    # so kernel ops mixing the two agree (interpret-mode vma checking)
     vma = getattr(jax.typeof(mask_p), "vma", None)
     kw = {} if not vma else {"vma": vma}
+    if vma:
+        bases = jax.lax.pvary(bases, tuple(vma))
     out, cnt = pl.pallas_call(
         partial(_compact_kernel, block=block),
         grid=(nblocks,),

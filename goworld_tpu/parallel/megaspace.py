@@ -318,7 +318,7 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
         (enter_w, enter_j, enter_n, leave_w, leave_j, leave_n,
          delta_rows_n) = interest_pairs(
             state.nbr, nbr_gid, gsent, cfg.enter_cap, cfg.leave_cap,
-            min(cfg.delta_rows_cap, n),
+            min(cfg.delta_rows_cap_eff, n),
         )
 
         # 6. sync records over the extended population; subjects -> gids.

@@ -1,0 +1,243 @@
+"""Worker for test_multihost.py::test_cross_controller_client_visibility.
+
+The full cluster plane ON TOP of a 2-controller megaspace World: one
+dispatcher (process 0), one gate per controller, a GameServer per
+controller, and a strict-mirror bot on controller 0's gate whose Avatar
+lives on a tile owned by controller 1. The bot must receive
+create-entity and position-sync traffic for a Walker moving on that
+remote tile — events decoded by controller 1 and routed to gate 1 over
+the dispatcher wire by gate id (reference: any client on any gate sees
+any entity, ``components/gate/GateService.go:258-306``).
+
+Cross-controller mutation consistency rides the GameServer's per-tick
+allgathered mutation log (net/game.py ``_mh_exchange_mutations``): the
+client-connect / Login RPC packets land on one controller's dispatcher
+connection but are applied on both (the SPMD contract).
+
+Invoked as: python -m tests._mh_cluster_worker <pid> <coord_port> <disp_port>
+(env: JAX_PLATFORMS=cpu, XLA_FLAGS=--xla_force_host_platform_device_count=4).
+"""
+
+import asyncio
+import json
+import sys
+import threading
+import time
+
+TICKS = 700
+TICK_SLEEP = 0.02
+
+
+def main() -> int:
+    pid = int(sys.argv[1])
+    coord_port = sys.argv[2]
+    disp_port = int(sys.argv[3])
+
+    from goworld_tpu.parallel.multihost import global_mesh, init_distributed
+    init_distributed(f"127.0.0.1:{coord_port}", num_processes=2,
+                     process_id=pid)
+
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.entity.entity import Entity
+    from goworld_tpu.entity.manager import World
+    from goworld_tpu.entity.space import Space
+    from goworld_tpu.net.botclient import BotClient
+    from goworld_tpu.net.dispatcher import DispatcherService
+    from goworld_tpu.net.game import GameServer
+    from goworld_tpu.net.gate import GateService
+    from goworld_tpu.ops.aoi import GridSpec
+
+    n_dev, tile_w, radius = 8, 100.0, 10.0
+    cfg = WorldConfig(
+        capacity=16,
+        grid=GridSpec(radius=radius, extent_x=tile_w + 2 * radius,
+                      extent_z=100.0, k=8, cell_cap=16, row_block=16),
+        npc_speed=0.0,   # motion comes from staged set_position only
+        enter_cap=256, leave_cap=256, sync_cap=256, input_cap=64,
+    )
+    mesh = global_mesh()
+    w = World(cfg, n_spaces=n_dev, mesh=mesh, megaspace=True,
+              halo_cap=8, migrate_cap=4)
+
+    mega_box = {}
+
+    class Mega(Space):
+        pass
+
+    class Account(Entity):
+        ATTRS = {"status": "client"}
+
+        def OnClientConnected(self):
+            self.attrs["status"] = "online"
+
+        def Login_Client(self, name):
+            # Avatar lands on tile 4 (x=415) — controller 1's side
+            avatar = self.world.create_entity(
+                "Avatar", space=mega_box["sp"], pos=(415.0, 0.0, 50.0),
+            )
+            avatar.attrs["name"] = name
+            self.give_client_to(avatar)
+            self.destroy()
+
+    class Avatar(Entity):
+        ATTRS = {"name": "allclients"}
+
+    class Walker(Entity):
+        pass
+
+    w.registry.register("Mega", Mega, is_space=True, megaspace=True)
+    w.register_entity("Account", Account)
+    w.register_entity("Avatar", Avatar)
+    w.register_entity("Walker", Walker)
+    w.create_nil_space()
+    mega_box["sp"] = w.create_space("Mega")
+    walker = w.create_entity(
+        "Walker", space=mega_box["sp"], pos=(418.0, 0.0, 50.0),
+        eid="walker_walker_00",
+    )
+
+    # ---- cluster plane services on a background asyncio thread --------
+    services_ready = threading.Event()
+    gate_port_box = {}
+    loop_box = {}
+
+    def services_thread() -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        loop_box["loop"] = loop
+
+        async def boot():
+            if pid == 0:
+                d = DispatcherService(
+                    1, "127.0.0.1", disp_port,
+                    desired_games=2, desired_gates=2,
+                )
+                asyncio.ensure_future(d.serve())
+                await d.started.wait()
+            else:
+                await asyncio.sleep(1.0)  # let the dispatcher bind first
+            g = GateService(
+                pid + 1, "127.0.0.1", 0, [("127.0.0.1", disp_port)],
+                position_sync_interval_ms=20,
+                exit_on_dispatcher_loss=False,
+            )
+            asyncio.ensure_future(g.serve())
+            await g.started.wait()
+            gate_port_box["port"] = g.bound_port
+
+        loop.run_until_complete(boot())
+        services_ready.set()
+        loop.run_forever()
+
+    t = threading.Thread(target=services_thread, daemon=True)
+    t.start()
+    assert services_ready.wait(30), "cluster services failed to start"
+
+    gs = GameServer(pid + 1, w, [("127.0.0.1", disp_port)],
+                    boot_entity="Account")
+    gs.start_network()
+
+    # count what THIS controller emits to clients (proof that controller
+    # 1 — not 0 — fans out the remote tile's events to gate 1's bot)
+    sent = {"create_entity": 0, "sync_records": 0, "attrs": 0,
+            "destroy_entity": 0, "rpc": 0, "filter_prop": 0}
+    orig_client_sink = w.client_sink
+    orig_sync_sink = w.sync_sink
+
+    def counting_client_sink(gate_id, client_id, msg):
+        sent[msg["type"]] = sent.get(msg["type"], 0) + 1
+        orig_client_sink(gate_id, client_id, msg)
+
+    def counting_sync_sink(gate_id, cids, eids, vals):
+        sent["sync_records"] += len(cids)
+        orig_sync_sink(gate_id, cids, eids, vals)
+
+    w.client_sink = counting_client_sink
+    w.sync_sink = counting_sync_sink
+
+    # ---- the bot (controller 0's gate only) ---------------------------
+    bot = None
+    bot_future = None
+    if pid == 0:
+        bot = BotClient("127.0.0.1", gate_port_box["port"], strict=True,
+                        nosync=True)
+
+        async def bot_script():
+            while not gs.ready_event.is_set():
+                await asyncio.sleep(0.1)
+            await bot.connect()
+            recv = asyncio.ensure_future(bot._recv_loop())
+            try:
+                await asyncio.wait_for(bot.player_ready.wait(), 120)
+                bot.call_server("Login_Client", "bob")
+                t0 = time.time()
+                while time.time() - t0 < 120:
+                    if bot.player is not None \
+                            and bot.player.type_name == "Avatar":
+                        break
+                    await asyncio.sleep(0.05)
+                # wait until the remote tile's walker is mirrored AND its
+                # synced position has visibly advanced
+                t0 = time.time()
+                while time.time() - t0 < 120:
+                    me = bot.entities.get("walker_walker_00")
+                    if me is not None and me.pos[0] > 420.5 \
+                            and bot.sync_count >= 3:
+                        break
+                    await asyncio.sleep(0.05)
+            finally:
+                recv.cancel()
+        bot_future = asyncio.run_coroutine_threadsafe(
+            bot_script(), loop_box["loop"]
+        )
+
+    # ---- lockstep tick loop (identical count on both controllers) ----
+    walk_x = 418.0
+    for _t in range(TICKS):
+        gs.pump()
+        has_avatar = any(
+            e.type_name == "Avatar" and not e.destroyed
+            for e in w.entities.values()
+        )
+        if has_avatar and walk_x < 424.0:
+            walk_x += 0.25
+            walker.set_position((walk_x, 0.0, 50.0))
+        gs.tick()
+        time.sleep(TICK_SLEEP)
+
+    out = {
+        "process": pid,
+        "local_shards": w.local_shards,
+        "walker_shard": walker.shard,
+        "sent": sent,
+    }
+    avatars = [e for e in w.entities.values()
+               if e.type_name == "Avatar" and not e.destroyed]
+    out["avatar_shard"] = avatars[0].shard if avatars else None
+    out["avatar_has_client"] = bool(avatars and avatars[0].client)
+    out["avatar_gate"] = (
+        avatars[0].client.gate_id
+        if avatars and avatars[0].client else None
+    )
+    if pid == 0:
+        try:
+            bot_future.result(timeout=30)
+        except Exception as exc:  # surface, don't hang the exchange
+            out["bot_script_error"] = repr(exc)
+        me = bot.entities.get("walker_walker_00")
+        out["bot_errors"] = bot.errors
+        out["bot_player_type"] = (
+            bot.player.type_name if bot.player else None
+        )
+        out["bot_player_name"] = (
+            bot.player.attrs.get("name") if bot.player else None
+        )
+        out["walker_mirror_x"] = me.pos[0] if me is not None else None
+        out["bot_sync_count"] = bot.sync_count
+        out["bot_mirrors"] = sorted(bot.entities.keys())
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -34,6 +34,11 @@ def pytest_configure(config):
         "markers",
         "slow: multi-process / long tests (multihost mesh, soak)",
     )
+    config.addinivalue_line(
+        "markers",
+        "soak_full: the reference CI's 200-bot/300s profile "
+        "(RUN_SOAK_FULL=1 to enable; ~7 min)",
+    )
 
 
 def spawn_on(states, dev, slot, **kw):

@@ -112,6 +112,61 @@ def test_world_api_multihost():
 
 
 @pytest.mark.slow
+def test_cross_controller_client_visibility():
+    """The reference's any-client-sees-any-entity contract
+    (``components/gate/GateService.go:258-306``) across CONTROLLERS: a
+    strict-mirror bot on controller 0's gate logs in, its Avatar lands on
+    a tile owned by controller 1, and a Walker moving on that remote tile
+    must appear and position-sync in the bot's mirror — controller 1
+    decodes the events and the dispatcher wire carries them to gate 1 by
+    gate id. Exercises the multihost mutation log (client connect + Login
+    RPC arrive on one controller, applied on both) and the per-entity
+    client-send ownership dedup."""
+    coord = _free_port()
+    disp = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tests._mh_cluster_worker",
+             str(pid), str(coord), str(disp)],
+            cwd=REPO, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        for pid in (0, 1)
+    ]
+    results = {}
+    for p in procs:
+        out, err = p.communicate(timeout=420)
+        assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        r = json.loads(line)
+        results[r["process"]] = r
+
+    r0, r1 = results[0], results[1]
+    assert "bot_script_error" not in r0, r0
+    assert r0["bot_errors"] == [], r0["bot_errors"]
+    # SPMD bookkeeping: both controllers agree the Avatar sits on tile 4
+    # (controller 1's side) and owns the gate-1 client
+    assert r0["avatar_shard"] == r1["avatar_shard"] == 4, (r0, r1)
+    assert r0["avatar_has_client"] and r1["avatar_has_client"]
+    assert r0["avatar_gate"] == r1["avatar_gate"] == 1
+    # the bot completed the Account -> Avatar handoff
+    assert r0["bot_player_type"] == "Avatar", r0
+    assert r0["bot_player_name"] == "bob", r0
+    # the remote tile's walker reached the bot's mirror and kept syncing
+    assert "walker_walker_00" in r0["bot_mirrors"], r0["bot_mirrors"]
+    assert r0["walker_mirror_x"] is not None \
+        and r0["walker_mirror_x"] > 420.5, r0
+    assert r0["bot_sync_count"] >= 3, r0
+    # and the traffic was emitted by CONTROLLER 1 (the tile owner), not 0
+    assert r1["sent"]["create_entity"] >= 1, r1["sent"]
+    assert r1["sent"]["sync_records"] >= 3, r1["sent"]
+
+
+@pytest.mark.slow
 def test_two_process_stress_consistency():
     """40 churny ticks with 60 movers over the 2-controller mesh: both
     controllers agree on the global population every tick, nobody is

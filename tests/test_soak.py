@@ -89,6 +89,23 @@ def _drive(gs, stop):
 
 @pytest.mark.soak
 def test_soak_100_bots_reload_under_load(tmp_path):
+    _run_soak(N_BOTS, SOAK_BEFORE_RELOAD, SOAK_AFTER_RELOAD, tmp_path)
+
+
+@pytest.mark.soak
+@pytest.mark.soak_full
+def test_soak_reference_scale(tmp_path):
+    """The reference CI's exact elasticity profile — 200 strict bots,
+    300 s soak, live reload, 60 s after
+    (.github/workflows/test_game.yml:34-46). ~7 min wall: skipped unless
+    RUN_SOAK_FULL=1 so the quick suite stays quick; run (and its result
+    recorded in docs/ROUND*.md) once per round."""
+    if _os.environ.get("RUN_SOAK_FULL") != "1":
+        pytest.skip("reference-scale soak: set RUN_SOAK_FULL=1 to run")
+    _run_soak(200, 300.0, 60.0, tmp_path)
+
+
+def _run_soak(n_bots, before_s, after_s, tmp_path):
     harness = ClusterHarness(
         n_dispatchers=2, n_gates=1, desired_games=1,
         position_sync_interval_ms=50,
@@ -110,18 +127,18 @@ def test_soak_100_bots_reload_under_load(tmp_path):
         host, port = harness.gate_addrs[0]
         bots = [
             BotClient(host, port, bot_id=i, strict=True, move_interval=0.2)
-            for i in range(N_BOTS)
+            for i in range(n_bots)
         ]
-        total = SOAK_BEFORE_RELOAD + SOAK_AFTER_RELOAD + 20.0
+        total = before_s + after_s + 20.0
         futures = [harness.submit(b.run(total)) for b in bots]
 
         # phase 1: soak
-        deadline = time.monotonic() + SOAK_BEFORE_RELOAD
+        deadline = time.monotonic() + before_s
         while time.monotonic() < deadline:
             time.sleep(0.5)
         ready_bots = sum(1 for b in bots if b.player is not None)
-        assert ready_bots >= N_BOTS * 0.9, (
-            f"only {ready_bots}/{N_BOTS} bots got avatars before reload"
+        assert ready_bots >= n_bots * 0.9, (
+            f"only {ready_bots}/{n_bots} bots got avatars before reload"
         )
         syncs_before = sum(b.sync_count for b in bots)
         assert syncs_before > 0, "no position syncs flowed before reload"
@@ -161,7 +178,7 @@ def test_soak_100_bots_reload_under_load(tmp_path):
             "client bindings lost in restore"
 
         # phase 3: soak after reload — traffic must resume
-        deadline = time.monotonic() + SOAK_AFTER_RELOAD
+        deadline = time.monotonic() + after_s
         while time.monotonic() < deadline:
             time.sleep(0.5)
         syncs_after = sum(b.sync_count for b in bots)
@@ -189,8 +206,8 @@ def test_soak_100_bots_reload_under_load(tmp_path):
             assert b.player.attrs.get("level") == srv.attrs.get("level"), \
                 f"bot {b.bot_id} level mirror diverged"
             checked += 1
-        assert checked >= N_BOTS * 0.9, (
-            f"only {checked}/{N_BOTS} mirrors verifiable after reload"
+        assert checked >= n_bots * 0.9, (
+            f"only {checked}/{n_bots} mirrors verifiable after reload"
         )
     finally:
         stop.set()

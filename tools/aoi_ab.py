@@ -1,0 +1,106 @@
+"""A/B microbench for the AOI sweep (VERDICT r2 item 3: get the sweep
+under ~60 ms/tick at 1M on TPU).
+
+Times ``grid_neighbors_flags`` alone over a scan of T iterations (pos
+perturbed per iteration from the counts so the compiler cannot collapse
+the loop; ONE fetched scalar forces execution — block_until_ready lies on
+the tunneled backend, see .claude/skills/verify/SKILL.md). Sweeps the
+tuning knobs from docs/TODO_R3.md #4: cell_cap, k, row_block, topk_impl.
+
+Usage (CPU rig or TPU):
+    python tools/aoi_ab.py                    # default grid of configs
+    AB_N=1048576 AB_TICKS=10 python tools/aoi_ab.py
+    AB_CONFIGS='[{"cell_cap":8},{"cell_cap":12}]' python tools/aoi_ab.py
+
+One JSON line per config on stdout.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+N = int(os.environ.get("AB_N", 262144))
+T = int(os.environ.get("AB_TICKS", 10))
+
+DEFAULT_CONFIGS = [
+    {"cell_cap": 12, "k": 32, "topk_impl": "exact"},
+    {"cell_cap": 12, "k": 32, "topk_impl": "approx"},
+    {"cell_cap": 10, "k": 32, "topk_impl": "exact"},
+    {"cell_cap": 8, "k": 32, "topk_impl": "exact"},
+    {"cell_cap": 8, "k": 32, "topk_impl": "approx"},
+    {"cell_cap": 12, "k": 24, "topk_impl": "exact"},
+    {"cell_cap": 12, "k": 32, "topk_impl": "exact", "row_block": 32768},
+    {"cell_cap": 12, "k": 32, "topk_impl": "exact", "row_block": 131072},
+]
+
+
+def main() -> int:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from goworld_tpu.ops.aoi import GridSpec, grid_neighbors_flags
+
+    configs = json.loads(os.environ.get("AB_CONFIGS", "null")) \
+        or DEFAULT_CONFIGS
+    extent = float(int((N * 10000 / 12) ** 0.5))  # bench.py density
+    key = jax.random.PRNGKey(0)
+    k1, k2, k3 = jax.random.split(key, 3)
+    pos = jnp.stack(
+        [jax.random.uniform(k1, (N,), maxval=extent),
+         jnp.zeros(N),
+         jax.random.uniform(k2, (N,), maxval=extent)], axis=1)
+    alive = jnp.ones(N, bool)
+    flags = (jax.random.uniform(k3, (N,)) < 0.5).astype(jnp.int32)
+
+    for cfgd in configs:
+        spec = GridSpec(
+            radius=50.0, extent_x=extent, extent_z=extent,
+            k=cfgd.get("k", 32), cell_cap=cfgd.get("cell_cap", 12),
+            row_block=min(N, cfgd.get("row_block", 65536)),
+            topk_impl=cfgd.get("topk_impl", "exact"),
+        )
+
+        def make_run(length, spec=spec):
+            @jax.jit
+            def run(p):
+                def body(carry, _):
+                    pp = carry
+                    nbr, cnt, fl = grid_neighbors_flags(
+                        spec, pp, alive, flag_bits=flags
+                    )
+                    pp = pp + (cnt[:, None] % 2).astype(pp.dtype) * 1e-6
+                    return pp, cnt.sum() + fl.sum()
+                pp, s = lax.scan(body, p, None, length=length)
+                return s.sum() + pp.sum()
+            return run
+
+        run1, run2 = make_run(T), make_run(2 * T)
+        t0 = time.perf_counter()
+        float(np.asarray(run1(pos)))
+        compile_s = time.perf_counter() - t0
+        float(np.asarray(run2(pos + 0.001)))
+        t0 = time.perf_counter()
+        float(np.asarray(run1(pos + 0.002)))
+        e1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(np.asarray(run2(pos + 0.003)))
+        e2 = time.perf_counter() - t0
+        per_tick_ms = 1000.0 * max(e2 - e1, 1e-9) / T
+        print(json.dumps({
+            "n": N, "ticks": T, **cfgd,
+            "sweep_ms_per_tick": round(per_tick_ms, 3),
+            "scale_2x": round(e2 / max(e1, 1e-9), 2),
+            "compile_s": round(compile_s, 1),
+            "platform": jax.devices()[0].platform,
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
