@@ -46,6 +46,7 @@ BENCH_TPU_ATTEMPTS (default 2), BENCH_CHILD_TIMEOUT seconds (default
 import argparse
 import json
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -66,7 +67,10 @@ SMOKE_T = int(os.environ.get("BENCH_SMOKE_TICKS", 5))
 TPU_ATTEMPTS = int(os.environ.get("BENCH_TPU_ATTEMPTS", 2))
 CHILD_TIMEOUT = float(os.environ.get("BENCH_CHILD_TIMEOUT", 1200))
 N_CPU = int(os.environ.get("BENCH_N_CPU", 131072))
-PHASES = os.environ.get("BENCH_PHASES", "0") == "1"
+PHASES = os.environ.get("BENCH_PHASES", "1") == "1"  # default ON: the
+# per-phase decomposition is the round's main diagnostic and costs ~3
+# extra compiles inside the same child
+VARIANT_DEADLINE = float(os.environ.get("BENCH_VARIANT_DEADLINE", 900))
 
 
 def log(msg: str) -> None:
@@ -482,9 +486,19 @@ def child_main(args) -> int:
 # --------------------------------------------------------------- parent ----
 
 def run_child(env_extra: dict, n: int, timeout: float,
-              uses_tpu: bool = True, phases: bool | None = None
-              ) -> tuple[list, str]:
-    """Run one child attempt; returns (parsed stage dicts, failure note)."""
+              uses_tpu: bool = True, phases: bool | None = None,
+              live: list | None = None) -> tuple[list, str]:
+    """Run one child attempt; returns (parsed stage dicts, failure note).
+
+    Child stdout is STREAMED (reader thread), not buffered until exit:
+    stages the child already printed are visible immediately — in
+    particular to the parent's signal handler, so a driver-side kill
+    mid-child still ships every completed stage. ``live`` (optional) is
+    a shared list the parsed stages are also appended to for exactly
+    that consumer."""
+    import collections
+    import threading
+
     env = dict(os.environ)
     for k, v in env_extra.items():
         if v is None:
@@ -503,16 +517,38 @@ def run_child(env_extra: dict, n: int, timeout: float,
         cmd, env=env, cwd=REPO,
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
+    stages: list = []
+    err_tail: collections.deque = collections.deque(maxlen=12)
+
+    def read_out() -> None:
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith("{"):
+                try:
+                    s = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                stages.append(s)
+                if live is not None:
+                    live.append(s)
+
+    def read_err() -> None:
+        for line in proc.stderr:
+            err_tail.append(line.rstrip())
+
+    t_out = threading.Thread(target=read_out, daemon=True)
+    t_err = threading.Thread(target=read_err, daemon=True)
+    t_out.start()
+    t_err.start()
     extended = False
     deadline = time.monotonic() + timeout
     note = ""
     while True:
         try:
-            out, err = proc.communicate(
-                timeout=max(0.1, deadline - time.monotonic())
-            )
-            if proc.returncode != 0:
-                note = f"rc={proc.returncode}: {err.strip().splitlines()[-1][:300] if err.strip() else 'no stderr'}"
+            rc = proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            if rc != 0:
+                last = err_tail[-1][:300] if err_tail else "no stderr"
+                note = f"rc={rc}: {last}"
             break
         except subprocess.TimeoutExpired:
             # killing a live child mid-TPU-RPC can wedge the relay
@@ -527,19 +563,13 @@ def run_child(env_extra: dict, n: int, timeout: float,
                     "extending once")
                 continue
             proc.kill()
-            out, err = proc.communicate()
+            proc.wait()
             note = f"timeout after {timeout * (2 if extended else 1):.0f}s"
             break
-    for line in err.strip().splitlines()[-12:]:
+    t_out.join(timeout=10)
+    t_err.join(timeout=10)
+    for line in list(err_tail):
         log(f"  child# {line[:240]}")
-    stages = []
-    for line in out.splitlines():
-        line = line.strip()
-        if line.startswith("{"):
-            try:
-                stages.append(json.loads(line))
-            except json.JSONDecodeError:
-                pass
     return stages, note
 
 
@@ -560,12 +590,117 @@ def relay_up() -> bool:
 
 
 def parent_main() -> int:
+    t_start = time.monotonic()
     attempts_log = []
     best = None          # preferred-platform full result, timing-sane
     suspect_best = None  # full result whose 2x-scale self-check failed
     partial = None       # any stage result at all (smoke counts)
     p99 = None           # the optional per-tick latency stage (full n)
     p99_shard = None     # same, at the 131K north-star per-chip shard
+    variants = {}        # config-5 behavior variants (btree/mlp)
+
+    live_stages: list = []   # current child's streamed stages
+
+    def compose() -> dict:
+        """Build the single stdout JSON line from whatever has been
+        harvested SO FAR — called at the end, and from the signal
+        handler if the driver loses patience mid-run. When no attempt
+        has OFFICIALLY completed, stages streamed from the in-flight
+        child count too (they are per-line complete results)."""
+        b, sb, pt = best, suspect_best, partial
+        cp99, cp99s = p99, p99_shard
+        if b is None:
+            for s in list(live_stages):
+                st = s.get("stage")
+                if st == "full":
+                    if s.get("timing_suspect"):
+                        sb = sb or s
+                    else:
+                        b = b or s
+                elif st == "p99":
+                    cp99 = s
+                elif st == "p99_shard":
+                    cp99s = s
+                elif pt is None:
+                    pt = s
+        chosen = b or sb or pt
+        best_final = b
+        # latency only attaches when a same-child headline exists
+        if b is None:
+            cp99 = None
+            cp99s = None
+        if chosen is not None and cp99 is not None:
+            chosen = dict(chosen)
+            for k in ("tick_p50_ms", "tick_p99_ms",
+                      "p99_includes_host_roundtrip",
+                      "p99_loop_carried_fetch", "p99_samples"):
+                if k in cp99:
+                    chosen[k] = cp99[k]
+            # consistency gate (r02: p99=3.2 ms printed next to
+            # tick_ms=776 was physically impossible): with the
+            # loop-carried fetch each sample covers a full tick plus a
+            # host roundtrip, so p50 below ~70% of the scan-marginal
+            # tick cost means the fetch chain did not serialize —
+            # flag it, never report it silently
+            tick_ms = chosen.get("tick_ms")
+            if tick_ms and cp99.get("tick_p50_ms", 0) < 0.7 * tick_ms:
+                chosen["p99_suspect"] = (
+                    f"p50 {cp99['tick_p50_ms']} ms < 0.7x scan-marginal "
+                    f"tick {tick_ms} ms; latency chain did not serialize"
+                )
+        if chosen is not None and cp99s is not None:
+            chosen = dict(chosen)
+            chosen["shard_p99"] = {
+                k: cp99s[k]
+                for k in ("p99_n", "tick_p50_ms", "tick_p99_ms",
+                          "p99_samples")
+                if k in cp99s
+            }
+        result = {
+            "metric": "entity_ticks_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "entity-ticks/s/chip",
+            "vs_baseline": 0.0,
+        }
+        if variants:
+            result["behavior_variants"] = variants
+        if chosen is not None:
+            chosen = dict(chosen)
+            value = chosen.pop("value")
+            result.update(
+                value=value,
+                vs_baseline=round(
+                    value / BASELINE_ENTITY_TICKS_PER_CHIP, 3
+                ),
+                **chosen,
+            )
+            if chosen.get("platform") == "cpu" and \
+                    os.environ.get("PALLAS_AXON_POOL_IPS"):
+                result["fallback"] = "cpu"  # TPU env, measured on CPU
+            if best_final is None:
+                result["partial"] = True  # full run never landed
+        else:
+            result["error"] = "no stage completed on any backend"
+        result["attempts"] = list(attempts_log)
+        return result
+
+    emitted = []
+
+    def emit_once() -> None:
+        if emitted:
+            return
+        emitted.append(True)
+        print(json.dumps(compose()), flush=True)
+
+    def on_term(signum, frame):
+        log(f"signal {signum}: emitting best-so-far result before exit")
+        try:
+            emit_once()
+        finally:
+            os._exit(3)
+
+    signal.signal(signal.SIGTERM, on_term)
+    signal.signal(signal.SIGINT, on_term)
 
     for i in range(TPU_ATTEMPTS):
         # re-probe before EVERY attempt: a kill during attempt i can take
@@ -577,7 +712,8 @@ def parent_main() -> int:
                 "stages": [], "error": "relay port 8082 refused/unreachable",
             })
             break
-        stages, note = run_child({}, N, CHILD_TIMEOUT)
+        live_stages.clear()
+        stages, note = run_child({}, N, CHILD_TIMEOUT, live=live_stages)
         had_suspect = False
         child_p99 = None
         child_p99_shard = None
@@ -631,8 +767,9 @@ def parent_main() -> int:
             "PALLAS_AXON_POOL_IPS": None,
             "JAX_PLATFORMS": "cpu",
         }
+        live_stages.clear()
         stages, note = run_child(cpu_env, N_CPU, CHILD_TIMEOUT,
-                                 uses_tpu=False)
+                                 uses_tpu=False, live=live_stages)
         attempts_log.append({
             "attempt": "cpu-fallback", "env": {"BENCH_FORCE_CPU": "1"},
             "stages": [s.get("stage") for s in stages], "error": note or None,
@@ -658,45 +795,21 @@ def parent_main() -> int:
         p99 = child_p99 if got_best else None
         p99_shard = child_p99_shard if got_best else None
 
-    chosen = best or suspect_best or partial
-    if best is None:
-        p99 = None  # no same-child headline to attach latency to
-        p99_shard = None
-    if chosen is not None and p99 is not None:
-        chosen = dict(chosen)
-        for k in ("tick_p50_ms", "tick_p99_ms",
-                  "p99_includes_host_roundtrip", "p99_loop_carried_fetch",
-                  "p99_samples"):
-            if k in p99:
-                chosen[k] = p99[k]
-        # consistency gate (r02: p99=3.2 ms printed next to tick_ms=776
-        # was physically impossible): with the loop-carried fetch each
-        # sample covers a full tick plus a host roundtrip, so p50 below
-        # ~70% of the scan-marginal tick cost means the fetch chain did
-        # not serialize with execution — flag it, never report it silently
-        tick_ms = chosen.get("tick_ms")
-        if tick_ms and p99.get("tick_p50_ms", 0) < 0.7 * tick_ms:
-            chosen["p99_suspect"] = (
-                f"p50 {p99['tick_p50_ms']} ms < 0.7x scan-marginal "
-                f"tick {tick_ms} ms; latency chain did not serialize"
-            )
-    if chosen is not None and p99_shard is not None:
-        chosen = dict(chosen)
-        chosen["shard_p99"] = {
-            k: p99_shard[k]
-            for k in ("p99_n", "tick_p50_ms", "tick_p99_ms", "p99_samples")
-            if k in p99_shard
-        }
     # BASELINE config 5 (fused NPC behavior kernels): once a TPU headline
     # is in hand, time the btree and mlp behaviors at the same N so the
     # stretch-goal configs get hardware numbers in the same artifact.
     # Never attempted on the CPU fallback (no chip to characterize) and
     # skippable with BENCH_VARIANTS=0.
-    variants = {}
     if (best is not None and best.get("platform") != "cpu"
             and BEHAVIOR == "random_walk"
             and os.environ.get("BENCH_VARIANTS", "1") == "1"):
         for b in ("btree", "mlp"):
+            if time.monotonic() - t_start > VARIANT_DEADLINE:
+                # never risk the headline: if the driver's patience may
+                # be running out, ship what we have (stdout only flushes
+                # at the end — a mid-variant kill would lose everything)
+                log(f"variant deadline passed; skipping {b}")
+                break
             if not relay_up():
                 log(f"relay gone before behavior variant {b}; stopping")
                 break
@@ -718,32 +831,8 @@ def parent_main() -> int:
                         if k in s
                     }
 
-    result = {
-        "metric": "entity_ticks_per_sec_per_chip",
-        "value": 0.0,
-        "unit": "entity-ticks/s/chip",
-        "vs_baseline": 0.0,
-    }
-    if variants:
-        result["behavior_variants"] = variants
-    if chosen is not None:
-        chosen = dict(chosen)
-        value = chosen.pop("value")
-        result.update(
-            value=value,
-            vs_baseline=round(value / BASELINE_ENTITY_TICKS_PER_CHIP, 3),
-            **chosen,
-        )
-        if chosen.get("platform") == "cpu" and \
-                os.environ.get("PALLAS_AXON_POOL_IPS"):
-            result["fallback"] = "cpu"  # TPU env, but measured on CPU
-        if best is None:
-            result["partial"] = True  # smoke-stage only; full run never landed
-    else:
-        result["error"] = "no stage completed on any backend"
-    result["attempts"] = attempts_log
-    print(json.dumps(result), flush=True)
-    return 0 if chosen is not None else 1
+    emit_once()
+    return 0 if (best or suspect_best or partial) is not None else 1
 
 
 def main() -> int:

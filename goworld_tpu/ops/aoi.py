@@ -151,8 +151,21 @@ def _sweep(
     row = (cx + 1) * czp + (cz + 1)
     srow = jnp.where(alive, row, n_rows)
 
-    order = jnp.argsort(srow).astype(jnp.int32)
-    sorted_row = srow[order]
+    if packed_path and n_rows < (1 << 10):
+        # single-array sort of (row << 21 | idx) packed keys instead of
+        # a key+payload argsort: half the sorted bytes, identical result
+        # (idx is unique, so ties cannot occur and within-row order is
+        # ascending idx — exactly the stable argsort's). Requires
+        # n < 2^21 and n_rows < 2^10 so the key fits nonneg int32;
+        # bigger worlds keep the argsort.
+        skey = jnp.sort(
+            (srow << _ID_BITS) | jnp.arange(n, dtype=jnp.int32)
+        )
+        order = skey & _ID_MASK
+        sorted_row = skey >> _ID_BITS
+    else:
+        order = jnp.argsort(srow).astype(jnp.int32)
+        sorted_row = srow[order]
 
     # rank of each sorted entity within its cell via a segment scan (no
     # per-entity binary searches — those are scalar gathers on TPU)
