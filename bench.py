@@ -79,7 +79,7 @@ def log(msg: str) -> None:
 
 # ---------------------------------------------------------------- child ----
 
-def build(n: int, client_frac: float):
+def build(n: int, client_frac: float, grid_overrides: dict | None = None):
     import jax
     import jax.numpy as jnp
 
@@ -89,16 +89,20 @@ def build(n: int, client_frac: float):
 
     # ~12 avg Chebyshev neighbors at radius 50 (north-star AOI density)
     extent = float(int((n * 10000 / 12) ** 0.5))
+    grid_kw = dict(
+        # ~1.3 entities/cell at this density: cap 12 is ~9x headroom
+        # (overflow drops are the documented AOI-cap tradeoff)
+        k=int(os.environ.get("BENCH_K", 32)),
+        cell_cap=int(os.environ.get("BENCH_CELL_CAP", 12)),
+        row_block=min(n, int(os.environ.get("BENCH_ROW_BLOCK", 65536))),
+        topk_impl=os.environ.get("BENCH_TOPK", "exact"),
+    )
+    grid_kw.update(grid_overrides or {})
+    grid_kw["row_block"] = min(n, grid_kw["row_block"])
     cfg = WorldConfig(
         capacity=n,
         grid=GridSpec(
-            radius=50.0, extent_x=extent, extent_z=extent,
-            # ~1.3 entities/cell at this density: cap 12 is ~9x headroom
-            # (overflow drops are the documented AOI-cap tradeoff)
-            k=int(os.environ.get("BENCH_K", 32)),
-            cell_cap=int(os.environ.get("BENCH_CELL_CAP", 12)),
-            row_block=min(n, int(os.environ.get("BENCH_ROW_BLOCK", 65536))),
-            topk_impl=os.environ.get("BENCH_TOPK", "exact"),
+            radius=50.0, extent_x=extent, extent_z=extent, **grid_kw
         ),
         npc_speed=5.0,
         behavior=BEHAVIOR,  # "mlp" = config 5 (fused NPC behavior kernel)
@@ -153,14 +157,107 @@ def build(n: int, client_frac: float):
     return cfg, st, inputs
 
 
-def measure(n: int, ticks: int, client_frac: float, phases: bool) -> dict:
+def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
+    """On-chip knob pick for the AOI sweep: time the sweep ALONE at the
+    131K per-chip shard and return (grid overrides for the winner,
+    per-config ms log). Only ``row_block`` variants are SELECTABLE —
+    pure execution-blocking knobs that cannot change which neighbors are
+    found. cell_cap=8 and the approx top-k are timed as DIAGNOSTICS
+    only: at 1M-entity density cap 8 drops neighbors in a few
+    overflowing cells per tick and approx trades ~2% recall, and
+    autotune must never silently change what the headline measures.
+    Knobs the caller pinned via env are never overridden. Bounded cost:
+    4 sweep-only compiles at 131K; any failure falls back to
+    defaults."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from goworld_tpu.ops.aoi import GridSpec, grid_neighbors_flags
+
+    n = int(os.environ.get("BENCH_AUTOTUNE_N", 131072))
+    extent = float(int((n * 10000 / 12) ** 0.5))
+    key = jax.random.PRNGKey(2)
+    k1, k2, k3 = jax.random.split(key, 3)
+    pos = jnp.stack(
+        [jax.random.uniform(k1, (n,), maxval=extent),
+         jnp.zeros(n),
+         jax.random.uniform(k2, (n,), maxval=extent)], axis=1)
+    alive = jnp.ones(n, bool)
+    flags = (jax.random.uniform(k3, (n,)) < 0.5).astype(jnp.int32)
+    candidates = [        # (selectable, overrides)
+        (True, {}),
+        (True, {"row_block": 32768}),
+        (False, {"cell_cap": 8}),           # diagnostic: drop risk at 1M
+        (False, {"topk_impl": "approx"}),   # diagnostic: recall < 1
+    ]
+    env_pins = {
+        "cell_cap": "BENCH_CELL_CAP", "row_block": "BENCH_ROW_BLOCK",
+        "topk_impl": "BENCH_TOPK", "k": "BENCH_K",
+    }
+    log_d: dict = {}
+    best_ms, best_ov = None, {}
+    for selectable, ov in candidates:
+        gk = dict(
+            k=int(os.environ.get("BENCH_K", 32)),
+            cell_cap=int(os.environ.get("BENCH_CELL_CAP", 12)),
+            row_block=min(n, int(os.environ.get("BENCH_ROW_BLOCK",
+                                                65536))),
+            topk_impl=os.environ.get("BENCH_TOPK", "exact"),
+        )
+        gk.update(ov)
+        gk["row_block"] = min(n, gk["row_block"])
+        spec = GridSpec(radius=50.0, extent_x=extent, extent_z=extent,
+                        **gk)
+
+        def mk(length, spec=spec):
+            @jax.jit
+            def run(p):
+                def body(c, _):
+                    nbr, cnt, fl = grid_neighbors_flags(
+                        spec, c, alive, flag_bits=flags
+                    )
+                    c = c + (cnt[:, None] % 2).astype(c.dtype) * 1e-6
+                    return c, cnt.sum() + fl.sum()
+                pp, s = lax.scan(body, p, None, length=length)
+                return s.sum() + pp.sum()
+            return run
+
+        r1, r2 = mk(ticks), mk(2 * ticks)
+        float(np.asarray(r1(pos)))           # compile + warm
+        float(np.asarray(r2(pos + 0.001)))
+        t0 = time.perf_counter()
+        float(np.asarray(r1(pos + 0.002)))
+        e1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(np.asarray(r2(pos + 0.003)))
+        e2 = time.perf_counter() - t0
+        ms = 1000.0 * max(e2 - e1, 1e-9) / ticks
+        name = ",".join(f"{kk}={vv}" for kk, vv in ov.items()) or "default"
+        log_d[name] = round(ms, 3)
+        pinned = any(env_pins[kk] in os.environ for kk in ov)
+        if selectable and not pinned \
+                and (best_ms is None or ms < best_ms):
+            best_ms, best_ov = ms, ov
+    # only deviate from defaults for a clear (>5%) win
+    if best_ov and log_d.get("default") \
+            and best_ms > 0.95 * log_d["default"]:
+        best_ov = {}
+    log(f"autotune sweep@{n}: {log_d} -> {best_ov or 'default'}")
+    return best_ov, log_d
+
+
+def measure(n: int, ticks: int, client_frac: float, phases: bool,
+            grid_overrides: dict | None = None) -> dict:
     import jax
     import jax.numpy as jnp
     from jax import lax
 
     from goworld_tpu.core.step import tick_body
 
-    cfg, st, inputs = build(n, client_frac)
+    cfg, st, inputs = build(n, client_frac, grid_overrides)
 
     policy = None
     if cfg.behavior == "mlp":
@@ -442,12 +539,29 @@ def child_main(args) -> int:
         stages.append(("full", args.n, args.ticks, args.phases))
     else:
         stages[0] = ("full", args.n, args.ticks, args.phases)
+    overrides: dict = {}
+    atlog = None
     for name, n, ticks, phases in stages:
+        if name == "full" and os.environ.get("BENCH_AUTOTUNE", "1") == "1":
+            import jax
+
+            if jax.devices()[0].platform != "cpu" \
+                    and n > int(os.environ.get("BENCH_AUTOTUNE_N",
+                                               131072)):
+                try:
+                    overrides, atlog = autotune_sweep()
+                except Exception as exc:
+                    log(f"autotune failed ({exc}); using defaults")
         t0 = time.perf_counter()
-        r = measure(n, ticks, args.client_frac, phases)
+        r = measure(n, ticks, args.client_frac, phases,
+                    overrides if name == "full" else None)
         p99_args = r.pop("_p99_args", None)
         r["stage"] = name
         r["stage_wall_s"] = round(time.perf_counter() - t0, 1)
+        if name == "full" and atlog is not None:
+            r["autotune_sweep_ms"] = atlog
+            if overrides:
+                r["autotuned_grid"] = overrides
         print(json.dumps(r), flush=True)
         if name == "full" and p99_args is not None \
                 and os.environ.get("BENCH_SKIP_P99") != "1":
@@ -467,7 +581,11 @@ def child_main(args) -> int:
             shard_n = int(os.environ.get("BENCH_P99_SHARD_N", 131072))
             if shard_n and shard_n < n:
                 try:
-                    scfg, sst, sinputs = build(shard_n, args.client_frac)
+                    # same grid config as the headline full stage (incl.
+                    # any autotuned overrides): the two claims in one
+                    # report must describe the same config
+                    scfg, sst, sinputs = build(shard_n, args.client_frac,
+                                               overrides)
                     spolicy = None
                     if scfg.behavior == "mlp":
                         from goworld_tpu.models.npc_policy import init_policy

@@ -191,6 +191,16 @@ class World:
         self._slot_owner: list[dict[int, str]] = [
             {} for _ in range(n_spaces)
         ]
+        # numpy mirrors of slot -> (entity id, client id, gate), kept
+        # incrementally in lockstep with _slot_owner / client binding:
+        # the sync-record fan-out decodes tens of thousands of records
+        # per tick, and per-record dict lookups (the reference's per-
+        # entity Go loops, Entity.go:1208-1267) would rival the device
+        # tick itself at 1M-entity scale — with the mirrors the decode
+        # is pure numpy gather + groupby (see _process_outputs)
+        self._mir_eid = np.zeros((n_spaces, cfg.capacity), "S16")
+        self._mir_cid = np.zeros((n_spaces, cfg.capacity), "S16")
+        self._mir_gate = np.full((n_spaces, cfg.capacity), -1, np.int32)
         self._free: list[set[int]] = [
             set(range(cfg.capacity)) for _ in range(n_spaces)
         ]
@@ -484,12 +494,43 @@ class World:
             raise RuntimeError(
                 f"space shard {shard} is full ({self.cfg.capacity} slots)"
             ) from None
-        self._slot_owner[shard][slot] = eid
+        self._slot_set(shard, slot, eid)
         return slot
 
     def _owner_entity(self, shard: int, slot: int) -> Entity | None:
         eid = self._slot_owner[shard].get(slot)
         return self.entities.get(eid) if eid is not None else None
+
+    # -- slot/client numpy mirrors (all _slot_owner writes route here) --
+    def _write_client_cols(self, shard: int, slot: int,
+                           c: GameClient | None) -> None:
+        if c is not None:
+            self._mir_cid[shard, slot] = c.client_id.encode("ascii")
+            self._mir_gate[shard, slot] = c.gate_id
+        else:
+            self._mir_cid[shard, slot] = b""
+            self._mir_gate[shard, slot] = -1
+
+    def _slot_set(self, shard: int, slot: int, eid: str) -> None:
+        self._slot_owner[shard][slot] = eid
+        self._mir_eid[shard, slot] = eid.encode("ascii")
+        e = self.entities.get(eid)
+        self._write_client_cols(shard, slot,
+                                e.client if e is not None else None)
+
+    def _slot_clear(self, shard: int, slot: int) -> None:
+        self._slot_owner[shard].pop(slot, None)
+        self._mir_eid[shard, slot] = b""
+        self._write_client_cols(shard, slot, None)
+
+    def _mirror_client(self, e: Entity) -> None:
+        """Refresh the client columns for an entity's current slot (call
+        after any (re)bind/unbind; no-op for slotless or stale rows)."""
+        if e.shard is None or e.slot is None:
+            return
+        if self._slot_owner[e.shard].get(e.slot) != e.id:
+            return
+        self._write_client_cols(e.shard, e.slot, e.client)
 
     def _drop_staged_for(self, shard: int, slot: int) -> None:
         """Forget pending writes aimed at a row being despawned."""
@@ -728,6 +769,7 @@ class World:
         e.client = client
         if client is not None:
             client.owner = e  # multihost send-dedup needs the backref
+        self._mirror_client(e)
         if e.slot is not None and e.shard is not None:
             self._staged_client.append((
                 e.shard, e.slot,
@@ -968,6 +1010,7 @@ class World:
         if save_tid is not None:
             self.timers.cancel(save_tid)  # target game schedules its own
         e.client = None  # quiet detach; the data carries the binding
+        self._mirror_client(e)
         e.destroyed = True
         self._leave_space_host(e)
         if e.slot is None and e._migrating is None:
@@ -1419,23 +1462,31 @@ class World:
                 js = np.asarray(base.sync_j[shard])[:sn]
                 vs = np.asarray(base.sync_vals[shard])[:sn]
                 if self.sync_sink is not None:
-                    # batched path: one (cids, eids, vals) bundle per gate
-                    # per tick — feeds MT_SYNC_POSITION_YAW_ON_CLIENTS
-                    per_gate: dict[int, list] = {}
-                    for i, (w, j) in enumerate(zip(ws, js)):
-                        we = self._owner_entity(shard, int(w))
-                        je = self._owner_subject(shard, int(j))
-                        if we is None or we.client is None or je is None:
-                            continue
-                        per_gate.setdefault(we.client.gate_id, []).append(
-                            (we.client.client_id, je.id, i)
-                        )
-                    for gate_id, rows in per_gate.items():
+                    # batched path: one (cids, eids, vals) bundle per
+                    # gate per tick, feeding
+                    # MT_SYNC_POSITION_YAW_ON_CLIENTS — resolved through
+                    # the numpy slot mirrors (one gather + per-gate
+                    # groupby) instead of per-record dict lookups, which
+                    # at 1M-entity sync volumes would rival the device
+                    # tick itself (the reference's per-entity Go loop,
+                    # Entity.go:1208-1267, has the same shape)
+                    cids = self._mir_cid[shard, ws]
+                    gates = self._mir_gate[shard, ws]
+                    if self.mega is not None:
+                        tiles = js // cfg.capacity
+                        ok_sub = tiles < self.n_spaces
+                        jeids = self._mir_eid[
+                            np.minimum(tiles, self.n_spaces - 1),
+                            js % cfg.capacity,
+                        ]
+                    else:
+                        ok_sub = np.ones(len(js), bool)
+                        jeids = self._mir_eid[shard, js]
+                    ok = (cids != b"") & (jeids != b"") & ok_sub
+                    for gate_id in np.unique(gates[ok]):
+                        m = ok & (gates == gate_id)
                         self.sync_sink(
-                            gate_id,
-                            [r[0] for r in rows],
-                            [r[1] for r in rows],
-                            vs[[r[2] for r in rows]],
+                            int(gate_id), cids[m], jeids[m], vs[m]
                         )
                 else:
                     for w, j, v in zip(ws, js, vs):
@@ -1470,7 +1521,7 @@ class World:
         for shard, slot, expect in self._release_now:
             cur = self._slot_owner[shard].get(slot)
             if cur == expect:
-                self._slot_owner[shard].pop(slot, None)
+                self._slot_clear(shard, slot)
                 self._free[shard].add(slot)
             # forget destroyed host objects even when the slot was already
             # re-occupied by an arrival (cur != expect): destroy_entity
@@ -1530,7 +1581,7 @@ class World:
             # old slot keeps its owner mapping through THIS step's leave
             # events; released at the end of _process_outputs
             self._release_now.append((old_sh, old_sl, eid))
-            self._slot_owner[shard][s] = eid
+            self._slot_set(shard, s, eid)
             self._free[shard].discard(s)
             e = self.entities.get(eid)
             if e is not None:
@@ -1580,7 +1631,7 @@ class World:
             e = self.entities[eid]
             last_pos = tuple(self.read_pos(sh_, sl_).tolist())
             moving = bool(snap["moving"][sh_, sl_])
-            self._slot_owner[sh_].pop(sl_, None)
+            self._slot_clear(sh_, sl_)
             self._free[sh_].add(sl_)
             e.slot = None
             e.shard = None
@@ -1625,7 +1676,7 @@ class World:
                 e._migrating = None
                 e.slot = int(s)
                 e.shard = shard
-                self._slot_owner[shard][int(s)] = eid
+                self._slot_set(shard, int(s), eid)
                 self._free[shard].discard(int(s))
                 if e.destroyed:
                     # destroyed mid-flight after the row already moved:
@@ -1674,7 +1725,7 @@ class World:
                 if bool(alive_np[src_sh, src_sl]):
                     self._staged_despawn.append((src_sh, src_sl))
                 else:
-                    self._slot_owner[src_sh].pop(src_sl, None)
+                    self._slot_clear(src_sh, src_sl)
                     self._free[src_sh].add(src_sl)
                     self.entities.pop(eid, None)
                 e.slot = None
@@ -1711,7 +1762,7 @@ class World:
                     "migrant %s dropped at full destination; respawning",
                     eid,
                 )
-                self._slot_owner[src_sh].pop(src_sl, None)
+                self._slot_clear(src_sh, src_sl)
                 self._free[src_sh].add(src_sl)
                 tgt = e.space
                 e.slot = None

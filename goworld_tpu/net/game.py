@@ -358,25 +358,37 @@ class GameServer:
 
     def _flush_sync_out(self) -> None:
         for gate_id, chunks in self._sync_out.items():
+            # per-chunk ARRAYS concatenated once — never element-wise
+            # Python appends (the world's mirror path hands us S16
+            # batches; decomposing them would reintroduce the per-record
+            # cost that path exists to remove)
             cids: list = []
             eids: list = []
             vals: list = []
             for c in chunks:
-                if isinstance(c[0], list):  # batched (cids, eids, vals)
-                    cids.extend(c[0])
-                    eids.extend(c[1])
-                    vals.extend(np.asarray(c[2]))
+                if isinstance(c[0], (list, np.ndarray)):
+                    if len(c[0]) == 0:
+                        continue
+                    cids.append(np.asarray(c[0], "S16"))
+                    eids.append(np.asarray(c[1], "S16"))
+                    vals.append(
+                        np.asarray(c[2], np.float32).reshape(-1, 4)
+                    )
                 else:                        # single legacy record
-                    cids.append(c[0])
-                    eids.append(c[1])
-                    vals.append(np.asarray(c[2], np.float32))
+                    cids.append(np.asarray([c[0]], "S16"))
+                    eids.append(np.asarray([c[1]], "S16"))
+                    vals.append(
+                        np.asarray(c[2], np.float32).reshape(1, 4)
+                    )
             if not cids:
                 continue
             p = new_packet(proto.MT_SYNC_POSITION_YAW_ON_CLIENTS)
             p.append_u16(gate_id)
             p.append_bytes(
                 codec.encode_client_sync_batch(
-                    cids, eids, np.asarray(vals, np.float32)
+                    np.concatenate(cids) if len(cids) > 1 else cids[0],
+                    np.concatenate(eids) if len(eids) > 1 else eids[0],
+                    np.concatenate(vals) if len(vals) > 1 else vals[0],
                 )
             )
             self._send(self.cluster.select_by_gate_id(gate_id), p)
@@ -562,6 +574,7 @@ class GameServer:
                 if e is not None and e.client is not None \
                         and e.client.client_id == client_id:
                     e.client = None  # connection already gone: quiet unbind
+                    w._mirror_client(e)
                     if e.slot is not None and e.shard is not None:
                         w._staged_client.append(
                             (e.shard, e.slot, False, -1)
@@ -644,6 +657,7 @@ class GameServer:
             for e in list(w.entities.values()):
                 if e.client is not None and e.client.gate_id == gate_id:
                     e.client = None
+                    w._mirror_client(e)
                     if e.slot is not None and e.shard is not None:
                         w._staged_client.append(
                             (e.shard, e.slot, False, -1)
