@@ -79,6 +79,24 @@ def log(msg: str) -> None:
 
 # ---------------------------------------------------------------- child ----
 
+def _grid_kw_from_env(n: int, overrides: dict | None = None) -> dict:
+    """The bench grid knobs, env-defaulted then override-patched — the
+    ONE place build() and autotune_sweep() both draw from, so autotune
+    always times exactly the config family the headline run will use."""
+    grid_kw = dict(
+        # ~1.3 entities/cell at bench density: cap 12 is ~9x headroom
+        # (overflow drops are the documented AOI-cap tradeoff)
+        k=int(os.environ.get("BENCH_K", 32)),
+        cell_cap=int(os.environ.get("BENCH_CELL_CAP", 12)),
+        row_block=min(n, int(os.environ.get("BENCH_ROW_BLOCK", 65536))),
+        topk_impl=os.environ.get("BENCH_TOPK", "exact"),
+        sweep_impl=os.environ.get("BENCH_SWEEP", "table"),
+    )
+    grid_kw.update(overrides or {})
+    grid_kw["row_block"] = min(n, grid_kw["row_block"])
+    return grid_kw
+
+
 def build(n: int, client_frac: float, grid_overrides: dict | None = None):
     import jax
     import jax.numpy as jnp
@@ -89,16 +107,7 @@ def build(n: int, client_frac: float, grid_overrides: dict | None = None):
 
     # ~12 avg Chebyshev neighbors at radius 50 (north-star AOI density)
     extent = float(int((n * 10000 / 12) ** 0.5))
-    grid_kw = dict(
-        # ~1.3 entities/cell at this density: cap 12 is ~9x headroom
-        # (overflow drops are the documented AOI-cap tradeoff)
-        k=int(os.environ.get("BENCH_K", 32)),
-        cell_cap=int(os.environ.get("BENCH_CELL_CAP", 12)),
-        row_block=min(n, int(os.environ.get("BENCH_ROW_BLOCK", 65536))),
-        topk_impl=os.environ.get("BENCH_TOPK", "exact"),
-    )
-    grid_kw.update(grid_overrides or {})
-    grid_kw["row_block"] = min(n, grid_kw["row_block"])
+    grid_kw = _grid_kw_from_env(n, grid_overrides)
     cfg = WorldConfig(
         capacity=n,
         grid=GridSpec(
@@ -160,15 +169,19 @@ def build(n: int, client_frac: float, grid_overrides: dict | None = None):
 def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
     """On-chip knob pick for the AOI sweep: time the sweep ALONE at the
     131K per-chip shard and return (grid overrides for the winner,
-    per-config ms log). Only ``row_block`` variants are SELECTABLE —
-    pure execution-blocking knobs that cannot change which neighbors are
-    found. cell_cap=8 and the approx top-k are timed as DIAGNOSTICS
-    only: at 1M-entity density cap 8 drops neighbors in a few
-    overflowing cells per tick and approx trades ~2% recall, and
-    autotune must never silently change what the headline measures.
-    Knobs the caller pinned via env are never overridden. Bounded cost:
-    4 sweep-only compiles at 131K; any failure falls back to
-    defaults."""
+    per-config ms log). SELECTABLE candidates are those whose fidelity
+    at the bench workload is identical-or-better than the default:
+    row_block variants (pure execution blocking — cannot change which
+    neighbors are found) and the tableless ranges sweep (bit-identical
+    while per-cell occupancy <= cell_cap — a 9x margin at bench density
+    — and beyond that it only ever ADDS true neighbors the per-cell cap
+    dropped). cell_cap=8 and the approx top-k are DIAGNOSTICS only:
+    cap 8 drops neighbors in overflowing cells at 1M density and approx
+    trades ~2% recall — autotune must never make the headline measure
+    LESS than the documented default does. Knobs the caller pinned via
+    env are never overridden. Bounded cost: 5 candidates x 2 jitted
+    scan lengths = 10 sweep-only compiles at 131K; any failure falls
+    back to defaults."""
     import numpy as np
 
     import jax
@@ -190,25 +203,21 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
     candidates = [        # (selectable, overrides)
         (True, {}),
         (True, {"row_block": 32768}),
+        # tableless sweep: identical results while occupancy <= cell_cap
+        # (true at bench density by 9x margin), never-worse beyond
+        (True, {"sweep_impl": "ranges"}),
         (False, {"cell_cap": 8}),           # diagnostic: drop risk at 1M
         (False, {"topk_impl": "approx"}),   # diagnostic: recall < 1
     ]
     env_pins = {
         "cell_cap": "BENCH_CELL_CAP", "row_block": "BENCH_ROW_BLOCK",
         "topk_impl": "BENCH_TOPK", "k": "BENCH_K",
+        "sweep_impl": "BENCH_SWEEP",
     }
     log_d: dict = {}
     best_ms, best_ov = None, {}
     for selectable, ov in candidates:
-        gk = dict(
-            k=int(os.environ.get("BENCH_K", 32)),
-            cell_cap=int(os.environ.get("BENCH_CELL_CAP", 12)),
-            row_block=min(n, int(os.environ.get("BENCH_ROW_BLOCK",
-                                                65536))),
-            topk_impl=os.environ.get("BENCH_TOPK", "exact"),
-        )
-        gk.update(ov)
-        gk["row_block"] = min(n, gk["row_block"])
+        gk = _grid_kw_from_env(n, ov)
         spec = GridSpec(radius=50.0, extent_x=extent, extent_z=extent,
                         **gk)
 

@@ -103,6 +103,23 @@ class GridSpec:
     # distance quantization, +inf sentinel) — 0x7FFFFFFF would be NaN
     # and break the float ordering.
     topk_impl: str = "exact"
+    # Candidate-fetch strategy:
+    #   "table"  — scatter the sorted entities into a dense per-cell
+    #              table, then read 3 strided (3, 3*cell_cap) windows
+    #              per query (the r02 design).
+    #   "ranges" — TABLELESS: each query's 3 z-triples are CONTIGUOUS
+    #              RANGES of the cell-sorted entity array (padded border
+    #              cells are never occupied, so the triple (cz-1..cz+1)
+    #              of an x-row is one run). Candidates slice straight
+    #              out of the sorted [N, 3] array: no dense table to
+    #              init (12M elements at 1M entities), no 3M-element
+    #              scatter, and every window read is CONTIGUOUS. The
+    #              per-cell occupancy cap becomes a POOLED cap of
+    #              3*cell_cap per z-triple — identical results while
+    #              occupancy <= cell_cap, strictly fewer drops beyond
+    #              (pooling only ever admits candidates the per-cell cap
+    #              dropped).
+    sweep_impl: str = "table"
 
     @property
     def cells_x(self) -> int:
@@ -157,7 +174,8 @@ def _sweep(
         # (idx is unique, so ties cannot occur and within-row order is
         # ascending idx — exactly the stable argsort's). Requires
         # n < 2^21 and n_rows < 2^10 so the key fits nonneg int32;
-        # bigger worlds keep the argsort.
+        # bigger worlds keep the argsort. (Megaspace per-tile grids fit;
+        # a 1M-entity single grid does not.)
         skey = jnp.sort(
             (srow << _ID_BITS) | jnp.arange(n, dtype=jnp.int32)
         )
@@ -167,39 +185,63 @@ def _sweep(
         order = jnp.argsort(srow).astype(jnp.int32)
         sorted_row = srow[order]
 
-    # rank of each sorted entity within its cell via a segment scan (no
-    # per-entity binary searches — those are scalar gathers on TPU)
     idx = jnp.arange(n, dtype=jnp.int32)
-    new_seg = jnp.concatenate(
-        [jnp.ones((1,), bool), sorted_row[1:] != sorted_row[:-1]]
-    )
-    seg_start = lax.cummax(jnp.where(new_seg, idx, 0))
-    rank = idx - seg_start
-
-    # ONE dense per-cell table, px/pz/word packed side by side, gathered by
-    # the sorted order in a single [N, 3]-row gather. The word carries the
-    # slot id plus caller flag bits (dirty/has_client) on the fast path so
-    # consumers never re-gather them per neighbor.
+    # The word carries the slot id plus caller flag bits
+    # (dirty/has_client) on the fast path so consumers never re-gather
+    # them per neighbor.
     if packed_path and want_flags:
         word = (idx << 2) | (flag_bits.astype(jnp.int32) & 3)
         table_sentinel = sentinel << 2
     else:
         word = idx
         table_sentinel = sentinel
+    sentinel_bits = jnp.full((), table_sentinel, jnp.int32).view(jnp.float32)
     src = jnp.stack(
         [pos[:, 0], pos[:, 2], word.view(jnp.float32)], axis=1
     )[order]
 
-    valid_src = (rank < cc) & (sorted_row < n_rows)
-    base = jnp.where(valid_src, sorted_row * (3 * cc) + rank, n_rows * 3 * cc)
-    sentinel_bits = jnp.full((), table_sentinel, jnp.int32).view(jnp.float32)
-    lane = jnp.arange(3 * cc, dtype=jnp.int32)
-    init_row = jnp.where(lane >= 2 * cc, sentinel_bits, jnp.inf)
-    table = jnp.tile(init_row, n_rows) \
-        .at[base].set(src[:, 0], mode="drop") \
-        .at[base + cc].set(src[:, 1], mode="drop") \
-        .at[base + 2 * cc].set(src[:, 2], mode="drop")
-    table = table.reshape(n_rows, 3 * cc)
+    ranges_impl = spec.sweep_impl == "ranges"
+    if ranges_impl:
+        # TABLELESS (see GridSpec.sweep_impl): candidates come straight
+        # out of the sorted array. row_start[r] = first sorted position
+        # of cell row r, from a bincount + exclusive cumsum (dead
+        # entities land in the n_rows bin, excluded).
+        counts = jnp.zeros(n_rows + 1, jnp.int32).at[srow].add(
+            1, mode="drop"
+        )
+        row_start = jnp.concatenate([
+            jnp.zeros((1,), jnp.int32),
+            jnp.cumsum(counts[:n_rows], dtype=jnp.int32),
+        ])
+        # component-major sorted view padded with 3cc sentinel columns
+        # so every (3, 3cc) window slice is in bounds
+        pad = jnp.stack([
+            jnp.full((3 * cc,), jnp.inf, jnp.float32),
+            jnp.full((3 * cc,), jnp.inf, jnp.float32),
+            jnp.full((3 * cc,), sentinel_bits, jnp.float32),
+        ])
+        s_t = jnp.concatenate([src.T, pad], axis=1)   # [3, n + 3cc]
+        table = None
+    else:
+        # dense per-cell table: rank each sorted entity within its cell
+        # via a segment scan (no per-entity binary searches — those are
+        # scalar gathers on TPU), scatter px/pz/word side by side.
+        new_seg = jnp.concatenate(
+            [jnp.ones((1,), bool), sorted_row[1:] != sorted_row[:-1]]
+        )
+        seg_start = lax.cummax(jnp.where(new_seg, idx, 0))
+        rank = idx - seg_start
+        valid_src = (rank < cc) & (sorted_row < n_rows)
+        base = jnp.where(
+            valid_src, sorted_row * (3 * cc) + rank, n_rows * 3 * cc
+        )
+        lane = jnp.arange(3 * cc, dtype=jnp.int32)
+        init_row = jnp.where(lane >= 2 * cc, sentinel_bits, jnp.inf)
+        table = jnp.tile(init_row, n_rows) \
+            .at[base].set(src[:, 0], mode="drop") \
+            .at[base + cc].set(src[:, 1], mode="drop") \
+            .at[base + 2 * cc].set(src[:, 2], mode="drop")
+        table = table.reshape(n_rows, 3 * cc)
 
     dxs = jnp.array([-1, 0, 1], jnp.int32)
     px = pos[:, 0]
@@ -211,22 +253,50 @@ def _sweep(
         b = rows.shape[0]
         # z-triple windows: for each x-offset, rows ((cx+dx+1)*czp + cz)
         # .. +2 are the contiguous (cz-1, cz, cz+1) padded cells. Dead
-        # query rows read window 0 — border rows, all sentinel.
+        # query rows read window 0 — border rows, all sentinel/empty.
         starts = (cx[rows][:, None] + dxs[None, :] + 1) * czp \
             + cz[rows][:, None]
         starts = jnp.where(alive[rows][:, None], starts, 0)
 
-        win = jax.vmap(
-            jax.vmap(
-                lambda s: lax.dynamic_slice(table, (s, 0), (3, 3 * cc)),
-            )
-        )(starts)                                    # [B, 3, 3, 3cc]
-        win = win.reshape(b, 9, 3 * cc)
-        cand_px = win[:, :, :cc].reshape(b, 9 * cc)
-        cand_pz = win[:, :, cc:2 * cc].reshape(b, 9 * cc)
-        cand_w = lax.bitcast_convert_type(
-            win[:, :, 2 * cc:], jnp.int32
-        ).reshape(b, 9 * cc)
+        if ranges_impl:
+            lo = row_start[starts]                   # [B, 3]
+            hi = row_start[starts + 3]
+            win = jax.vmap(
+                jax.vmap(
+                    lambda s: lax.dynamic_slice(
+                        s_t, (0, s), (3, 3 * cc)
+                    ),
+                )
+            )(lo)                                    # [B, 3, 3, 3cc]
+            cand_px = win[:, :, 0, :].reshape(b, 9 * cc)
+            cand_pz = win[:, :, 1, :].reshape(b, 9 * cc)
+            cand_w = lax.bitcast_convert_type(
+                win[:, :, 2, :], jnp.int32
+            ).reshape(b, 9 * cc)
+            lanes3 = jnp.arange(3 * cc, dtype=jnp.int32)
+            in_range = (
+                lanes3[None, None, :] < (hi - lo)[:, :, None]
+            ).reshape(b, 9 * cc)
+            # out-of-range lanes may hold entities of OTHER cells (the
+            # sorted array is dense): hard-invalidate them — admitting
+            # one for some watchers but not others would make interest
+            # asymmetric
+            cand_px = jnp.where(in_range, cand_px, jnp.inf)
+            cand_w = jnp.where(in_range, cand_w, table_sentinel)
+        else:
+            win = jax.vmap(
+                jax.vmap(
+                    lambda s: lax.dynamic_slice(
+                        table, (s, 0), (3, 3 * cc)
+                    ),
+                )
+            )(starts)                                # [B, 3, 3, 3cc]
+            win = win.reshape(b, 9, 3 * cc)
+            cand_px = win[:, :, :cc].reshape(b, 9 * cc)
+            cand_pz = win[:, :, cc:2 * cc].reshape(b, 9 * cc)
+            cand_w = lax.bitcast_convert_type(
+                win[:, :, 2 * cc:], jnp.int32
+            ).reshape(b, 9 * cc)
 
         ddx = jnp.abs(cand_px - px[rows][:, None])
         ddz = jnp.abs(cand_pz - pz[rows][:, None])

@@ -27,7 +27,10 @@ T = int(os.environ.get("AB_TICKS", 10))
 
 DEFAULT_CONFIGS = [
     {"cell_cap": 12, "k": 32, "topk_impl": "exact"},
+    {"cell_cap": 12, "k": 32, "sweep_impl": "ranges"},
     {"cell_cap": 12, "k": 32, "topk_impl": "approx"},
+    {"cell_cap": 12, "k": 32, "topk_impl": "approx",
+     "sweep_impl": "ranges"},
     {"cell_cap": 10, "k": 32, "topk_impl": "exact"},
     {"cell_cap": 8, "k": 32, "topk_impl": "exact"},
     {"cell_cap": 8, "k": 32, "topk_impl": "approx"},
@@ -64,6 +67,7 @@ def main() -> int:
             k=cfgd.get("k", 32), cell_cap=cfgd.get("cell_cap", 12),
             row_block=min(N, cfgd.get("row_block", 65536)),
             topk_impl=cfgd.get("topk_impl", "exact"),
+            sweep_impl=cfgd.get("sweep_impl", "table"),
         )
 
         def make_run(length, spec=spec):
