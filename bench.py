@@ -456,6 +456,21 @@ def measure_phases(cfg, st, inputs, ticks: int) -> dict:
         pos, s = lax.scan(body, state.pos, None, length=ticks)
         return s.sum() + pos.sum()
 
+    def make_sweep_probe(phase):
+        from goworld_tpu.ops.aoi import sweep_phase_checksum
+
+        @jax.jit
+        def probe(state):
+            def body(carry, _):
+                pos = carry
+                s = sweep_phase_checksum(cfg.grid, pos, state.alive,
+                                         phase)
+                pos = pos + (s.astype(pos.dtype) % 2) * 1e-7
+                return pos, s
+            pos, ss = lax.scan(body, state.pos, None, length=ticks)
+            return ss.astype(jnp.float32).sum() + pos.sum()
+        return probe
+
     @jax.jit
     def move_only(state):
         def body(carry, _):
@@ -521,6 +536,11 @@ def measure_phases(cfg, st, inputs, ticks: int) -> dict:
     )
     for name, fn, args in (
         ("aoi", aoi_only, (st,)),
+        # sweep sub-phases (cumulative: sort ⊂ build ⊂ aoi): where the
+        # AOI milliseconds go — cell sort vs candidate-structure build
+        # vs window gather + top_k (= aoi - build)
+        ("aoi_sort", make_sweep_probe("sort"), (st,)),
+        ("aoi_build", make_sweep_probe("build"), (st,)),
         ("move", move_only, (st,)),
         ("collect", collect_only, (st, nbr, fl)),
     ):

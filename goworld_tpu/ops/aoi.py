@@ -130,21 +130,8 @@ class GridSpec:
         return max(1, int(-(-self.extent_z // self.radius)))
 
 
-def _sweep(
-    spec: GridSpec,
-    pos: jax.Array,
-    alive: jax.Array,
-    query_rows: int | None,
-    watch_radius: jax.Array | None,
-    flag_bits: jax.Array | None,
-) -> tuple[jax.Array, jax.Array, jax.Array | None]:
-    n = pos.shape[0]
-    q = n if query_rows is None else query_rows
-    k = spec.k
-    cc = spec.cell_cap
-    sentinel = n
-    packed_path = n < (1 << _ID_BITS)
-    want_flags = flag_bits is not None
+def _cell_rows(spec: GridSpec, pos, alive, watch_radius):
+    """Front half, stage 1: per-entity padded cell-row ids."""
     czp = spec.cells_z + 2          # padded (border) cell columns
     cxp = spec.cells_x + 2
     n_rows = cxp * czp
@@ -167,8 +154,12 @@ def _sweep(
     # padded row id; dead entities scatter out of bounds (dropped)
     row = (cx + 1) * czp + (cz + 1)
     srow = jnp.where(alive, row, n_rows)
+    return cx, cz, srow, alive, czp, n_rows
 
-    if packed_path and n_rows < (1 << 10):
+
+def _sort_cells(n: int, n_rows: int, srow):
+    """Front half, stage 2: entities ordered by cell row."""
+    if n < (1 << _ID_BITS) and n_rows < (1 << 10):
         # single-array sort of (row << 21 | idx) packed keys instead of
         # a key+payload argsort: half the sorted bytes, identical result
         # (idx is unique, so ties cannot occur and within-row order is
@@ -179,17 +170,19 @@ def _sweep(
         skey = jnp.sort(
             (srow << _ID_BITS) | jnp.arange(n, dtype=jnp.int32)
         )
-        order = skey & _ID_MASK
-        sorted_row = skey >> _ID_BITS
-    else:
-        order = jnp.argsort(srow).astype(jnp.int32)
-        sorted_row = srow[order]
+        return skey & _ID_MASK, skey >> _ID_BITS
+    order = jnp.argsort(srow).astype(jnp.int32)
+    return order, srow[order]
 
+
+def _sorted_src(spec: GridSpec, pos, flag_bits, order):
+    """Front half, stage 3: sorted (px, pz, packed word) triples. The
+    word carries the slot id plus caller flag bits (dirty/has_client) on
+    the fast path so consumers never re-gather them per neighbor."""
+    n = pos.shape[0]
+    sentinel = n
     idx = jnp.arange(n, dtype=jnp.int32)
-    # The word carries the slot id plus caller flag bits
-    # (dirty/has_client) on the fast path so consumers never re-gather
-    # them per neighbor.
-    if packed_path and want_flags:
+    if n < (1 << _ID_BITS) and flag_bits is not None:
         word = (idx << 2) | (flag_bits.astype(jnp.int32) & 3)
         table_sentinel = sentinel << 2
     else:
@@ -199,49 +192,90 @@ def _sweep(
     src = jnp.stack(
         [pos[:, 0], pos[:, 2], word.view(jnp.float32)], axis=1
     )[order]
+    return src, table_sentinel, sentinel_bits
+
+
+def _build_ranges(cc: int, n_rows: int, srow, src, sentinel_bits):
+    """Front half, stage 4 (ranges impl): row_start offsets + padded
+    component-major sorted view. row_start[r] = first sorted position of
+    cell row r, from a bincount + exclusive cumsum (dead entities land
+    in the n_rows bin, excluded)."""
+    counts = jnp.zeros(n_rows + 1, jnp.int32).at[srow].add(
+        1, mode="drop"
+    )
+    row_start = jnp.concatenate([
+        jnp.zeros((1,), jnp.int32),
+        jnp.cumsum(counts[:n_rows], dtype=jnp.int32),
+    ])
+    # padded with 3cc sentinel columns so every window slice is in bounds
+    pad = jnp.stack([
+        jnp.full((3 * cc,), jnp.inf, jnp.float32),
+        jnp.full((3 * cc,), jnp.inf, jnp.float32),
+        jnp.full((3 * cc,), sentinel_bits, jnp.float32),
+    ])
+    s_t = jnp.concatenate([src.T, pad], axis=1)       # [3, n + 3cc]
+    return row_start, s_t
+
+
+def _build_table(cc: int, n_rows: int, sorted_row, src, sentinel_bits):
+    """Front half, stage 4 (table impl): dense per-cell table. Ranks
+    each sorted entity within its cell via a segment scan (no per-entity
+    binary searches — those are scalar gathers on TPU), then scatters
+    px/pz/word side by side."""
+    n = src.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    new_seg = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_row[1:] != sorted_row[:-1]]
+    )
+    seg_start = lax.cummax(jnp.where(new_seg, idx, 0))
+    rank = idx - seg_start
+    valid_src = (rank < cc) & (sorted_row < n_rows)
+    base = jnp.where(
+        valid_src, sorted_row * (3 * cc) + rank, n_rows * 3 * cc
+    )
+    lane = jnp.arange(3 * cc, dtype=jnp.int32)
+    init_row = jnp.where(lane >= 2 * cc, sentinel_bits, jnp.inf)
+    table = jnp.tile(init_row, n_rows) \
+        .at[base].set(src[:, 0], mode="drop") \
+        .at[base + cc].set(src[:, 1], mode="drop") \
+        .at[base + 2 * cc].set(src[:, 2], mode="drop")
+    return table.reshape(n_rows, 3 * cc)
+
+
+def _sweep(
+    spec: GridSpec,
+    pos: jax.Array,
+    alive: jax.Array,
+    query_rows: int | None,
+    watch_radius: jax.Array | None,
+    flag_bits: jax.Array | None,
+) -> tuple[jax.Array, jax.Array, jax.Array | None]:
+    n = pos.shape[0]
+    q = n if query_rows is None else query_rows
+    k = spec.k
+    cc = spec.cell_cap
+    sentinel = n
+    packed_path = n < (1 << _ID_BITS)
+    want_flags = flag_bits is not None
+
+    cx, cz, srow, alive, czp, n_rows = _cell_rows(
+        spec, pos, alive, watch_radius
+    )
+    order, sorted_row = _sort_cells(n, n_rows, srow)
+    src, table_sentinel, sentinel_bits = _sorted_src(
+        spec, pos, flag_bits, order
+    )
 
     ranges_impl = spec.sweep_impl == "ranges"
     if ranges_impl:
         # TABLELESS (see GridSpec.sweep_impl): candidates come straight
-        # out of the sorted array. row_start[r] = first sorted position
-        # of cell row r, from a bincount + exclusive cumsum (dead
-        # entities land in the n_rows bin, excluded).
-        counts = jnp.zeros(n_rows + 1, jnp.int32).at[srow].add(
-            1, mode="drop"
+        # out of the sorted array.
+        row_start, s_t = _build_ranges(
+            cc, n_rows, srow, src, sentinel_bits
         )
-        row_start = jnp.concatenate([
-            jnp.zeros((1,), jnp.int32),
-            jnp.cumsum(counts[:n_rows], dtype=jnp.int32),
-        ])
-        # component-major sorted view padded with 3cc sentinel columns
-        # so every (3, 3cc) window slice is in bounds
-        pad = jnp.stack([
-            jnp.full((3 * cc,), jnp.inf, jnp.float32),
-            jnp.full((3 * cc,), jnp.inf, jnp.float32),
-            jnp.full((3 * cc,), sentinel_bits, jnp.float32),
-        ])
-        s_t = jnp.concatenate([src.T, pad], axis=1)   # [3, n + 3cc]
         table = None
     else:
-        # dense per-cell table: rank each sorted entity within its cell
-        # via a segment scan (no per-entity binary searches — those are
-        # scalar gathers on TPU), scatter px/pz/word side by side.
-        new_seg = jnp.concatenate(
-            [jnp.ones((1,), bool), sorted_row[1:] != sorted_row[:-1]]
-        )
-        seg_start = lax.cummax(jnp.where(new_seg, idx, 0))
-        rank = idx - seg_start
-        valid_src = (rank < cc) & (sorted_row < n_rows)
-        base = jnp.where(
-            valid_src, sorted_row * (3 * cc) + rank, n_rows * 3 * cc
-        )
-        lane = jnp.arange(3 * cc, dtype=jnp.int32)
-        init_row = jnp.where(lane >= 2 * cc, sentinel_bits, jnp.inf)
-        table = jnp.tile(init_row, n_rows) \
-            .at[base].set(src[:, 0], mode="drop") \
-            .at[base + cc].set(src[:, 1], mode="drop") \
-            .at[base + 2 * cc].set(src[:, 2], mode="drop")
-        table = table.reshape(n_rows, 3 * cc)
+        table = _build_table(cc, n_rows, sorted_row, src, sentinel_bits)
 
     dxs = jnp.array([-1, 0, 1], jnp.int32)
     px = pos[:, 0]
@@ -470,6 +504,31 @@ def grid_neighbors_flags(
         spec, pos, alive, query_rows, watch_radius, flag_bits
     )
     return nbr, cnt, fl
+
+
+def sweep_phase_checksum(spec: GridSpec, pos, alive, phase: str):
+    """Sub-phase probe for on-chip attribution (bench.py phase harness):
+    runs the sweep's front half UP TO ``phase`` and reduces to one
+    scalar. Phases: "sort" = cell ids + cell sort; "build" = sort plus
+    the candidate structure (table scatter or ranges row_start/padded
+    view, per ``spec.sweep_impl``). Calls the exact helpers the real
+    sweep uses, so timings attribute the real code — NOT a reimplement.
+    Un-jitted; callers wrap in their own jit/scan with loop-carried
+    inputs (see bench.measure_phases)."""
+    n = pos.shape[0]
+    cc = spec.cell_cap
+    cx, cz, srow, alive2, czp, n_rows = _cell_rows(spec, pos, alive, None)
+    order, sorted_row = _sort_cells(n, n_rows, srow)
+    if phase == "sort":
+        return order.sum() + sorted_row.sum()
+    src, _ts, sentinel_bits = _sorted_src(spec, pos, None, order)
+    if spec.sweep_impl == "ranges":
+        row_start, s_t = _build_ranges(cc, n_rows, srow, src,
+                                       sentinel_bits)
+        return row_start.sum().astype(jnp.float32) \
+            + jnp.where(jnp.isfinite(s_t), s_t, 0.0).sum()
+    table = _build_table(cc, n_rows, sorted_row, src, sentinel_bits)
+    return jnp.where(jnp.isfinite(table), table, 0.0).sum()
 
 
 def neighbors_oracle(pos, alive, radius):
