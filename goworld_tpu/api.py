@@ -177,11 +177,15 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
             extent_x=tile_w + 2 * gc.aoi_radius,
             extent_z=(gc.extent_z / tz + 2 * gc.aoi_radius) if tz > 1
             else gc.extent_z,
+            sweep_impl=gc.aoi_sweep_impl,
+            topk_impl=gc.aoi_topk_impl,
         )
         mega_shape = (tx, tz)
     else:
         grid = GridSpec(radius=gc.aoi_radius, extent_x=gc.extent_x,
-                        extent_z=gc.extent_z)
+                        extent_z=gc.extent_z,
+                        sweep_impl=gc.aoi_sweep_impl,
+                        topk_impl=gc.aoi_topk_impl)
     wc = WorldConfig(
         capacity=gc.capacity,
         grid=grid,

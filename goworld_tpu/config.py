@@ -39,6 +39,11 @@ class GameConfig:
     capacity: int = 16384
     n_spaces: int = 1
     aoi_radius: float = 50.0
+    # AOI kernel tuning (ops/aoi.py GridSpec): sweep candidate fetch
+    # ("table" | "ranges") and top-k select ("exact" | "approx" —
+    # approx may miss a true neighbor with ~2% probability on TPU)
+    aoi_sweep_impl: str = "table"
+    aoi_topk_impl: str = "exact"
     extent_x: float = 1000.0
     extent_z: float = 1000.0
     mesh_devices: int = 0  # 0 = single-device vmap path

@@ -172,6 +172,17 @@ class GameServer:
         ``GameService.go:474-478``)."""
         if self.run_state != "running":
             return
+        if self.world._multihost:
+            # freezing ONE controller of an SPMD world would leave its
+            # peers blocked in the next tick's collectives forever; a
+            # coordinated multi-controller freeze is future work —
+            # refuse loudly instead of hanging the cluster
+            logger.error(
+                "game%d: freeze is not supported for multi-controller "
+                "worlds (peers would deadlock in tick collectives); "
+                "use World checkpoints instead", self.game_id,
+            )
+            return
         self._freeze_acks.clear()
         p = new_packet(proto.MT_START_FREEZE_GAME)
         for conn in self.cluster.conns:
@@ -470,6 +481,18 @@ class GameServer:
         """Attach a kvreg-backed ServiceManager (reference ``service.Setup``,
         started on deployment-ready)."""
         from goworld_tpu.entity.service import ServiceManager
+
+        if self.world._multihost:
+            # service placement races through kvreg per game process;
+            # on an SPMD world the winning controller would create the
+            # service entity alone and fork host state. Replicating the
+            # kvreg decisions through the mutation log is future work.
+            logger.warning(
+                "game%d: ServiceManager on a multi-controller world is "
+                "unsupported — service creation is not SPMD-replicated; "
+                "host services on a separate (single-controller) game",
+                self.game_id,
+            )
 
         return ServiceManager(
             self.world, game_id=self.game_id,

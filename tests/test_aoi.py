@@ -172,3 +172,20 @@ def test_ranges_sweep_pools_cell_cap():
         cnt[impl] = int(np.asarray(c)[0])
     assert cnt["ranges"] >= cnt["table"]
     assert cnt["ranges"] >= 20          # pooled cap 24 admits most of 29
+
+
+def test_big_grid_argsort_path_matches_oracle():
+    """Worlds with >= 2^10 padded cell rows take the argsort path (the
+    packed single-array sort can't encode the row id); it must agree
+    with the oracle exactly like the packed path does."""
+    n = 400
+    pos, alive = random_world(n, 31)
+    spec = GridSpec(radius=2.0, extent_x=200.0, extent_z=200.0,
+                    k=32, cell_cap=16, row_block=128)
+    assert (spec.cells_x + 2) * (spec.cells_z + 2) >= (1 << 10)
+    nbr, cnt = grid_neighbors(spec, jnp.asarray(pos), jnp.asarray(alive))
+    nbr = np.asarray(nbr)
+    oracle = neighbors_oracle(pos, alive, 2.0)
+    for i in range(n):
+        got = set(nbr[i][nbr[i] < n].tolist())
+        assert got == (oracle[i] if alive[i] else set()), i
