@@ -187,12 +187,20 @@ def main() -> int:
                     await asyncio.sleep(0.05)
             finally:
                 recv.cancel()
+                # hang up: the gate's disconnect notification must
+                # propagate through the mutation log and unbind the
+                # avatar on BOTH controllers (checked after the loop)
+                await bot.conn.close()
         bot_future = asyncio.run_coroutine_threadsafe(
             bot_script(), loop_box["loop"]
         )
 
     # ---- lockstep tick loop (identical count on both controllers) ----
+    # had-client bookkeeping reads WORLD state, which is SPMD-identical,
+    # so both controllers record the same facts at the same ticks
     walk_x = 418.0
+    avatar_had_client = False
+    avatar_gate = None
     for _t in range(TICKS):
         gs.pump()
         has_avatar = any(
@@ -203,7 +211,29 @@ def main() -> int:
             walk_x += 0.25
             walker.set_position((walk_x, 0.0, 50.0))
         gs.tick()
+        for e in w.entities.values():
+            if e.type_name == "Avatar" and e.client is not None:
+                avatar_had_client = True
+                avatar_gate = e.client.gate_id
         time.sleep(TICK_SLEEP)
+
+    def _client_bound() -> bool:
+        return any(
+            e.type_name == "Avatar" and not e.destroyed
+            and e.client is not None
+            for e in w.entities.values()
+        )
+
+    # the bot hung up during (or right after) the main loop; keep
+    # ticking until the disconnect propagates through the mutation log
+    # — the condition is world state, so BOTH controllers run the same
+    # number of extra ticks (lockstep preserved)
+    extra = 0
+    while extra < 400 and _client_bound():
+        gs.pump()
+        gs.tick()
+        time.sleep(TICK_SLEEP)
+        extra += 1
 
     out = {
         "process": pid,
@@ -214,11 +244,10 @@ def main() -> int:
     avatars = [e for e in w.entities.values()
                if e.type_name == "Avatar" and not e.destroyed]
     out["avatar_shard"] = avatars[0].shard if avatars else None
-    out["avatar_has_client"] = bool(avatars and avatars[0].client)
-    out["avatar_gate"] = (
-        avatars[0].client.gate_id
-        if avatars and avatars[0].client else None
-    )
+    out["avatar_had_client"] = avatar_had_client
+    out["avatar_gate"] = avatar_gate
+    out["disconnect_propagated"] = not _client_bound()
+    out["extra_ticks"] = extra
     if pid == 0:
         try:
             bot_future.result(timeout=30)

@@ -22,6 +22,24 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _drain(procs, timeout):
+    """communicate() every worker, KILLING all of them on a timeout —
+    a leaked worker pair keeps burning CPU (and its jax.distributed
+    rendezvous) long after the test fails."""
+    outs = []
+    try:
+        for p in procs:
+            outs.append(p.communicate(timeout=timeout))
+    except subprocess.TimeoutExpired:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        for p in procs:
+            p.communicate()
+        raise
+    return outs
+
+
 @pytest.mark.slow
 def test_two_process_megaspace_migration_and_ghosts():
     port = _free_port()
@@ -38,8 +56,7 @@ def test_two_process_megaspace_migration_and_ghosts():
         for pid in (0, 1)
     ]
     results = {}
-    for p in procs:
-        out, err = p.communicate(timeout=300)
+    for p, (out, err) in zip(procs, _drain(procs, 300)):
         assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
         line = [l for l in out.splitlines() if l.startswith("{")][-1]
         r = json.loads(line)
@@ -87,8 +104,7 @@ def test_world_api_multihost():
         for pid in (0, 1)
     ]
     results = {}
-    for p in procs:
-        out, err = p.communicate(timeout=300)
+    for p, (out, err) in zip(procs, _drain(procs, 300)):
         assert p.returncode == 0, f"worker failed:\n{err[-2500:]}"
         line = [l for l in out.splitlines() if l.startswith("{")][-1]
         r = json.loads(line)
@@ -138,8 +154,7 @@ def test_cross_controller_client_visibility():
         for pid in (0, 1)
     ]
     results = {}
-    for p in procs:
-        out, err = p.communicate(timeout=420)
+    for p, (out, err) in zip(procs, _drain(procs, 700)):
         assert p.returncode == 0, f"worker failed:\n{err[-3000:]}"
         line = [l for l in out.splitlines() if l.startswith("{")][-1]
         r = json.loads(line)
@@ -149,10 +164,14 @@ def test_cross_controller_client_visibility():
     assert "bot_script_error" not in r0, r0
     assert r0["bot_errors"] == [], r0["bot_errors"]
     # SPMD bookkeeping: both controllers agree the Avatar sits on tile 4
-    # (controller 1's side) and owns the gate-1 client
+    # (controller 1's side) and owned the gate-1 client
     assert r0["avatar_shard"] == r1["avatar_shard"] == 4, (r0, r1)
-    assert r0["avatar_has_client"] and r1["avatar_has_client"]
+    assert r0["avatar_had_client"] and r1["avatar_had_client"]
     assert r0["avatar_gate"] == r1["avatar_gate"] == 1
+    # the bot's hang-up propagated through the mutation log: BOTH
+    # controllers unbound the avatar's client
+    assert r0["disconnect_propagated"] and r1["disconnect_propagated"], \
+        (r0.get("extra_ticks"), r1.get("extra_ticks"))
     # the bot completed the Account -> Avatar handoff
     assert r0["bot_player_type"] == "Avatar", r0
     assert r0["bot_player_name"] == "bob", r0
@@ -187,8 +206,7 @@ def test_two_process_stress_consistency():
         for pid in (0, 1)
     ]
     results = {}
-    for p in procs:
-        out, err = p.communicate(timeout=420)
+    for p, (out, err) in zip(procs, _drain(procs, 420)):
         assert p.returncode == 0, f"worker failed:\n{err[-2500:]}"
         line = [l for l in out.splitlines() if l.startswith("{")][-1]
         r = json.loads(line)
