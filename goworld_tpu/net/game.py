@@ -234,6 +234,31 @@ class GameServer:
         self.world.tick()
         self._flush_sync_out()
 
+    # cap on raw mutation bytes shipped per controller per tick; the
+    # surplus stays queued IN ORDER for the next tick (backpressure —
+    # an unbounded allgather payload would stall every controller)
+    MH_LOG_BYTES_PER_TICK = 1 << 20
+
+    def _mh_drain_pending(self) -> bytearray:
+        blob = bytearray()
+        import struct as _st
+
+        taken = 0
+        for mt, payload in self._mh_pending:
+            if taken and len(blob) + 6 + len(payload) > \
+                    self.MH_LOG_BYTES_PER_TICK:
+                logger.warning(
+                    "game%d: multihost mutation log full; deferring %d "
+                    "packets to the next tick", self.game_id,
+                    len(self._mh_pending) - taken,
+                )
+                break
+            blob += _st.pack("<HI", mt, len(payload))
+            blob += payload
+            taken += 1
+        del self._mh_pending[:taken]
+        return blob
+
     def _mh_exchange_mutations(self) -> None:
         """Multi-controller mutation exchange: allgather every controller's
         queued World-mutating packets and replay the union in process
@@ -247,11 +272,7 @@ class GameServer:
 
         from jax.experimental import multihost_utils
 
-        blob = bytearray()
-        for mt, payload in self._mh_pending:
-            blob += _st.pack("<HI", mt, len(payload))
-            blob += payload
-        self._mh_pending.clear()
+        blob = self._mh_drain_pending()
         lengths = np.asarray(
             multihost_utils.process_allgather(np.int32(len(blob)))
         ).ravel()

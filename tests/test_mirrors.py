@@ -153,3 +153,26 @@ def test_mirror_sync_decode_matches_bruteforce():
         assert set(got_by_gate) == set(want)
         for g in want:
             assert got_by_gate[g] == want[g], g
+
+
+def test_mh_mutation_log_backpressure():
+    """The multihost mutation log drains at most MH_LOG_BYTES_PER_TICK
+    per tick; surplus packets stay queued IN ORDER (never dropped), and
+    an oversized single packet still ships alone."""
+    from goworld_tpu.net.game import GameServer
+
+    gs = GameServer.__new__(GameServer)   # drain logic only, no network
+    gs.game_id = 1
+    gs._mh_pending = [(100 + i, bytes([i]) * 400_000) for i in range(5)]
+    blob1 = gs._mh_drain_pending()
+    # 2 x 400KB fits under 1MB; the 3rd would overflow
+    assert len(blob1) == 2 * (6 + 400_000)
+    assert len(gs._mh_pending) == 3
+    assert gs._mh_pending[0][0] == 102   # order preserved
+    blob2 = gs._mh_drain_pending()
+    assert len(blob2) == 2 * (6 + 400_000)
+    # an oversized single packet still ships (taken==0 bypasses the cap)
+    gs._mh_pending = [(7, bytes(2 * GameServer.MH_LOG_BYTES_PER_TICK))]
+    blob3 = gs._mh_drain_pending()
+    assert len(blob3) == 6 + 2 * GameServer.MH_LOG_BYTES_PER_TICK
+    assert not gs._mh_pending
