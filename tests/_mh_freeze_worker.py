@@ -1,0 +1,107 @@
+"""Worker for test_multihost.py::test_multihost_checkpoint_restore —
+freeze_world/restore_world on a TWO-CONTROLLER megaspace.
+
+Every controller invokes freeze_world at the same point (its device
+snapshot is a process_allgather, so the collective legs pair up) and
+gets the identical global snapshot; restore_world replays the world API
+SPMD-identically into a fresh World over the same mesh. §5.4
+checkpoint/resume, extended across controllers (the reference freezes a
+single game process, ``GameService.go:220-313``).
+
+Invoked as: python -m tests._mh_freeze_worker <pid> <port>
+(env: JAX_PLATFORMS=cpu, XLA_FLAGS=--xla_force_host_platform_device_count=4).
+"""
+
+import json
+import sys
+
+
+def main() -> int:
+    pid = int(sys.argv[1])
+    port = sys.argv[2]
+
+    from goworld_tpu.parallel.multihost import global_mesh, init_distributed
+    init_distributed(f"127.0.0.1:{port}", num_processes=2, process_id=pid)
+
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.entity.entity import Entity
+    from goworld_tpu.entity.manager import World
+    from goworld_tpu.entity.space import Space
+    from goworld_tpu.freeze import freeze_world, restore_world
+    from goworld_tpu.ops.aoi import GridSpec
+
+    n_dev, tile_w, radius = 8, 100.0, 10.0
+    cfg = WorldConfig(
+        capacity=16,
+        grid=GridSpec(radius=radius, extent_x=tile_w + 2 * radius,
+                      extent_z=100.0, k=8, cell_cap=16, row_block=16),
+        npc_speed=0.0,
+        enter_cap=256, leave_cap=256, sync_cap=256,
+    )
+    mesh = global_mesh()
+
+    class Mega(Space):
+        pass
+
+    class Npc(Entity):
+        ATTRS = {"hp": "client"}
+
+    def build_world() -> World:
+        w = World(cfg, n_spaces=n_dev, mesh=mesh, megaspace=True,
+                  halo_cap=8, migrate_cap=4)
+        w.registry.register("Mega", Mega, is_space=True, megaspace=True)
+        w.register_entity("Npc", Npc)
+        w.create_nil_space()
+        return w
+
+    w = build_world()
+    sp = w.create_space("Mega")
+    walker = w.create_entity("Npc", space=sp, pos=(398.5, 0.0, 50.0),
+                             eid="walker_walker_00")
+    watcher = w.create_entity("Npc", space=sp, pos=(406.0, 0.0, 50.0),
+                              eid="watcher_watcher0")
+    walker.attrs["hp"] = 7
+
+    # drive the walker across the controller boundary (tile 3 -> 4)
+    x = 398.5
+    for _ in range(5):
+        x += 1.5
+        walker.set_position((x, 0.0, 50.0))
+        w.tick()
+    pre = {
+        "walker_shard": walker.shard,
+        "walker_x": float(walker.position[0]),
+        "watcher_sees": sorted(watcher.interested_in),
+    }
+
+    # identical call on both controllers: the device snapshot inside is
+    # an allgather, so this is itself a lockstep point
+    snap = freeze_world(w)
+
+    w2 = build_world()
+    restore_world(w2, snap)
+    walker2 = w2.entities["walker_walker_00"]
+    watcher2 = w2.entities["watcher_watcher0"]
+    # interest re-forms from the restored positions on the next sweep
+    for _ in range(3):
+        w2.tick()
+
+    out = {
+        "process": pid,
+        "pre": pre,
+        "restored_walker_shard": walker2.shard,
+        "restored_walker_x": float(walker2.position[0]),
+        "restored_hp": walker2.attrs.get("hp"),
+        "restored_watcher_sees": sorted(watcher2.interested_in),
+        "restored_alive": int(
+            __import__("numpy").asarray(
+                w2.last_outputs.global_alive
+            )[0]
+        ),
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

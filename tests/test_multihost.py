@@ -219,3 +219,48 @@ def test_two_process_stress_consistency():
     # churn actually crossed tiles (and with 4x2... 8 tiles over 2
     # processes, some hops crossed the process boundary)
     assert r0["migrations"] + r1["migrations"] > 0
+
+
+@pytest.mark.slow
+def test_multihost_checkpoint_restore():
+    """§5.4 checkpoint/resume EXTENDED across controllers: every
+    controller calls freeze_world at the same point (the device snapshot
+    is an allgather — itself a lockstep point), gets the identical
+    global snapshot, and restore_world rebuilds a fresh World over the
+    same mesh with positions, attrs, tile ownership, and (after one
+    sweep) interest sets intact. The reference can only freeze a single
+    game process (GameService.go:220-313)."""
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tests._mh_freeze_worker",
+             str(pid), str(port)],
+            cwd=REPO, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        for pid in (0, 1)
+    ]
+    results = {}
+    for p, (out, err) in zip(procs, _drain(procs, 420)):
+        assert p.returncode == 0, f"worker failed:\n{err[-2500:]}"
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        r = json.loads(line)
+        results[r["process"]] = r
+
+    r0, r1 = results[0], results[1]
+    # the walker had crossed onto controller 1's tile pre-freeze, and the
+    # restored world agrees on every controller
+    assert r0["pre"]["walker_shard"] == 4
+    assert r0["restored_walker_shard"] == r1["restored_walker_shard"] == 4
+    for r in (r0, r1):
+        assert abs(r["restored_walker_x"] - r0["pre"]["walker_x"]) < 1e-3
+        assert r["restored_hp"] == 7
+        assert r["restored_alive"] == 2
+    # interest was re-derived from restored positions; fan-out stays
+    # owner-local, so the watcher's set updates on controller 1
+    assert r1["restored_watcher_sees"] == r1["pre"]["watcher_sees"] \
+        == ["walker_walker_00"]
