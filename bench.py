@@ -950,6 +950,20 @@ def parent_main() -> int:
     if (best is not None and best.get("platform") != "cpu"
             and BEHAVIOR == "random_walk"
             and os.environ.get("BENCH_VARIANTS", "1") == "1"):
+        # variants measure the SAME grid config the headline ran with:
+        # forward any autotuned overrides as env pins and disable their
+        # own autotune pass (it would burn ~2 min per variant re-deriving
+        # the same answer — or a different one)
+        _ov_env = {"row_block": "BENCH_ROW_BLOCK",
+                   "cell_cap": "BENCH_CELL_CAP",
+                   "topk_impl": "BENCH_TOPK", "k": "BENCH_K",
+                   "sweep_impl": "BENCH_SWEEP"}
+        var_env = {
+            _ov_env[kk]: str(vv)
+            for kk, vv in (best.get("autotuned_grid") or {}).items()
+            if kk in _ov_env
+        }
+        var_env["BENCH_AUTOTUNE"] = "0"
         for b in ("btree", "mlp"):
             if time.monotonic() - t_start > VARIANT_DEADLINE:
                 # never risk the headline: if the driver's patience may
@@ -961,7 +975,7 @@ def parent_main() -> int:
                 log(f"relay gone before behavior variant {b}; stopping")
                 break
             stages, note = run_child(
-                {"BENCH_BEHAVIOR": b, "BENCH_SKIP_P99": "1"},
+                {"BENCH_BEHAVIOR": b, "BENCH_SKIP_P99": "1", **var_env},
                 N, CHILD_TIMEOUT, phases=False,
             )
             attempts_log.append({
