@@ -54,6 +54,16 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 BASELINE_ENTITY_TICKS_PER_CHIP = 7.5e6
 
+# grid knob -> env var pinning it (shared by _grid_kw_from_env's
+# consumers, autotune's pin detection, and the variant forwarding)
+GRID_ENV = {
+    "k": "BENCH_K",
+    "cell_cap": "BENCH_CELL_CAP",
+    "row_block": "BENCH_ROW_BLOCK",
+    "topk_impl": "BENCH_TOPK",
+    "sweep_impl": "BENCH_SWEEP",
+}
+
 N = int(os.environ.get("BENCH_N", 1_048_576))
 BEHAVIOR = os.environ.get("BENCH_BEHAVIOR", "random_walk")  # or "mlp"
                                                             # (config 5)
@@ -209,11 +219,7 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
         (False, {"cell_cap": 8}),           # diagnostic: drop risk at 1M
         (False, {"topk_impl": "approx"}),   # diagnostic: recall < 1
     ]
-    env_pins = {
-        "cell_cap": "BENCH_CELL_CAP", "row_block": "BENCH_ROW_BLOCK",
-        "topk_impl": "BENCH_TOPK", "k": "BENCH_K",
-        "sweep_impl": "BENCH_SWEEP",
-    }
+    env_pins = GRID_ENV
     log_d: dict = {}
     best_ms, best_ov = None, {}
     for selectable, ov in candidates:
@@ -954,14 +960,10 @@ def parent_main() -> int:
         # forward any autotuned overrides as env pins and disable their
         # own autotune pass (it would burn ~2 min per variant re-deriving
         # the same answer — or a different one)
-        _ov_env = {"row_block": "BENCH_ROW_BLOCK",
-                   "cell_cap": "BENCH_CELL_CAP",
-                   "topk_impl": "BENCH_TOPK", "k": "BENCH_K",
-                   "sweep_impl": "BENCH_SWEEP"}
         var_env = {
-            _ov_env[kk]: str(vv)
+            GRID_ENV[kk]: str(vv)
             for kk, vv in (best.get("autotuned_grid") or {}).items()
-            if kk in _ov_env
+            if kk in GRID_ENV
         }
         var_env["BENCH_AUTOTUNE"] = "0"
         for b in ("btree", "mlp"):

@@ -257,6 +257,10 @@ class World:
             self.mh_rank = 0
         # deterministic auto-eid sequence for multihost (see _gen_eid)
         self._mh_eid_seq = 0
+        # allgathered "every controller is deployment-ready" fact,
+        # published by the GameServer's mutation exchange each tick;
+        # standalone multihost worlds (no cluster plane) are always ready
+        self.mh_group_ready = True
 
         # pluggable sinks (the gateway overrides these; defaults capture)
         self.client_messages: list[tuple[int, str, dict]] = []
@@ -1058,6 +1062,15 @@ class World:
     # ==================================================================
     def tick(self) -> None:
         t_start = time.perf_counter()
+        if self._multihost and self.service_mgr is not None \
+                and self.mh_group_ready \
+                and self.tick_count % self.service_mgr.MH_CHECK_TICKS == 0:
+            # tick-cadence service reconcile (wall timers would fire at
+            # different instants per controller and desync the
+            # deterministic eid sequence; tick_count is SPMD-consistent,
+            # and mh_group_ready comes from the GameServer's per-tick
+            # allgather — True by construction for standalone worlds)
+            self.service_mgr.check_services()
         self.timers.tick(self._fire_timer)
         self.crontab.tick()
         self.post_q.tick()

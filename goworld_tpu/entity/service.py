@@ -51,15 +51,26 @@ class ServiceManager:
     dict immediately.
     """
 
+    #: multihost reconcile cadence in TICKS (driven by World.tick —
+    #: wall timers fire at different instants per controller and would
+    #: desync the deterministic eid sequence)
+    MH_CHECK_TICKS = 25
+
     def __init__(
         self,
         world: "World",
         game_id: int = 1,
         kv_write: Callable[[str, str], None] | None = None,
         kv_get: Callable[[str], str | None] | None = None,
+        claim_token: Callable[[], str] | None = None,
     ):
         self.world = world
         self.game_id = game_id
+        # Multi-controller worlds claim shards as ONE group: the token
+        # must be identical on every controller AND unique per group —
+        # the GameServer supplies the allgathered leader game id; the
+        # local-dict fallback (no cluster) uses World.game_id.
+        self._claim_token = claim_token
         self._local_kv: dict[str, str] = {}
         self._kv_write = kv_write or self._local_write
         self._kv_get = kv_get or self._local_kv.get
@@ -67,6 +78,14 @@ class ServiceManager:
         self._services: dict[str, int] = {}
         self._local_shards: dict[tuple[str, int], str] = {}  # -> eid
         world.service_mgr = self
+
+    @property
+    def _claim(self) -> str:
+        if self._claim_token is not None:
+            return self._claim_token()
+        if getattr(self.world, "_multihost", False):
+            return f"mh:{self.world.game_id}"   # local-dict SPMD group
+        return str(self.game_id)
 
     # -- local fallback kv ------------------------------------------------
     def _local_write(self, key: str, val: str) -> None:
@@ -82,7 +101,15 @@ class ServiceManager:
 
     def start(self) -> None:
         """Begin reconciling (call on deployment ready; reference
-        ``OnDeploymentReady -> checkServices``)."""
+        ``OnDeploymentReady -> checkServices``). Multi-controller worlds
+        do NOT reconcile from here: readiness flips at different wall
+        instants per controller, and a reconcile that creates an entity
+        on one controller before another desyncs the deterministic eid
+        sequence — World.tick drives check_services every
+        ``MH_CHECK_TICKS`` ticks instead (gated on the allgathered
+        group readiness when a GameServer is attached)."""
+        if getattr(self.world, "_multihost", False):
+            return
         self.check_services()
         self.world.timers.add(
             CHECK_INTERVAL, interval=CHECK_INTERVAL, cb=self.check_services
@@ -99,9 +126,9 @@ class ServiceManager:
                 if owner is None:
                     # race for it; the dispatcher (or local dict) keeps the
                     # first writer — we may or may not win
-                    self._kv_write(skey, str(self.game_id))
+                    self._kv_write(skey, self._claim)
                     owner = self._kv_get(skey)
-                if owner != str(self.game_id):
+                if owner != self._claim:
                     continue
                 if (name, idx) in self._local_shards:
                     continue

@@ -51,6 +51,11 @@ _MH_WORLD_MSGTYPES = frozenset({
     proto.MT_CREATE_ENTITY_ANYWHERE,
     proto.MT_LOAD_ENTITY_ANYWHERE,
     proto.MT_CALL_NIL_SPACES,
+    # kvreg updates drive service-shard decisions (entity/service.py);
+    # logging them makes the kvreg mirror SPMD-consistent at the
+    # tick-driven reconcile points, so every controller of the group
+    # reaches the same claim/create conclusions
+    proto.MT_KVREG_REGISTER,
 })
 
 
@@ -109,6 +114,8 @@ class GameServer:
         # multihost World-mutation log (see _MH_WORLD_MSGTYPES)
         self._mh_pending: list[tuple[int, bytes]] = []
         self._mh_replaying = False
+        self._mh_all_ready = False       # allgathered group readiness
+        self._mh_leader_game_id = self.game_id  # allgathered, row 0
 
         # wire the world's pluggable edges to the cluster
         w = world
@@ -230,6 +237,8 @@ class GameServer:
 
     def tick(self) -> None:
         if self.world._multihost:
+            # the exchange also publishes world.mh_group_ready, which
+            # gates the World's own tick-cadence service reconcile
             self._mh_exchange_mutations()
         self.world.tick()
         self._flush_sync_out()
@@ -273,9 +282,21 @@ class GameServer:
         from jax.experimental import multihost_utils
 
         blob = self._mh_drain_pending()
-        lengths = np.asarray(
-            multihost_utils.process_allgather(np.int32(len(blob)))
-        ).ravel()
+        # (blob length, deployment-ready flag, game id): the extra
+        # fields ride the same collective so every controller derives
+        # the SAME "whole group is ready" fact and the SAME leader game
+        # id at the same tick — wall-clock readiness differs per
+        # controller and must never gate SPMD decisions directly
+        meta = np.asarray(
+            multihost_utils.process_allgather(
+                np.asarray([len(blob), int(self.deployment_ready),
+                            self.game_id], np.int32)
+            )
+        ).reshape(-1, 3)
+        self.world.mh_group_ready = self._mh_all_ready = \
+            bool(meta[:, 1].all())
+        self._mh_leader_game_id = int(meta[0, 2])
+        lengths = meta[:, 0]
         max_len = int(lengths.max())
         if max_len == 0:
             return
@@ -488,6 +509,8 @@ class GameServer:
         self._send(self.cluster.select_by_entity_id(eid), p)
 
     def kvreg_register(self, key: str, val: str, force: bool = False) -> None:
+        if self._mh_follower():
+            return  # the leader writes once on the whole group's behalf
         p = proto.pack_kvreg_register(key, val, force)
         self._send(self.cluster.select_by_srv_id(key), p)
 
@@ -503,22 +526,17 @@ class GameServer:
         started on deployment-ready)."""
         from goworld_tpu.entity.service import ServiceManager
 
-        if self.world._multihost:
-            # service placement races through kvreg per game process;
-            # on an SPMD world the winning controller would create the
-            # service entity alone and fork host state. Replicating the
-            # kvreg decisions through the mutation log is future work.
-            logger.warning(
-                "game%d: ServiceManager on a multi-controller world is "
-                "unsupported — service creation is not SPMD-replicated; "
-                "host services on a separate (single-controller) game",
-                self.game_id,
-            )
-
         return ServiceManager(
             self.world, game_id=self.game_id,
             kv_write=lambda k, v: self.kvreg_register(k, v),
             kv_get=self.kvreg.get,
+            # multihost: the whole controller group claims shards as ONE
+            # entity under the LEADER's game id (allgathered each tick —
+            # unique per group, unlike World.game_id which defaults to 1)
+            claim_token=(
+                (lambda: f"mh:{self._mh_leader_game_id}")
+                if self.world._multihost else None
+            ),
         )
 
     def call_nil_spaces(self, method: str, *args) -> None:
@@ -543,6 +561,14 @@ class GameServer:
         w = self.world
         if w._multihost and not self._mh_replaying \
                 and msgtype in _MH_WORLD_MSGTYPES:
+            if msgtype == proto.MT_KVREG_REGISTER \
+                    and self._mh_follower():
+                # kvreg updates are dispatcher-BROADCAST (every game
+                # gets a copy, unlike the eid-routed types): only the
+                # leader logs them, or the union would replay each
+                # update once per controller and fire kvreg watchers
+                # N times per write
+                return
             # defer to the per-tick allgather so every controller applies
             # this mutation, in the same order, in the same tick
             self._mh_pending.append(

@@ -1,0 +1,122 @@
+"""Worker for test_multihost.py::test_multihost_services — sharded
+singleton services on a TWO-CONTROLLER world.
+
+The kvreg claim/create cycle must reach identical conclusions on every
+controller: kvreg updates replicate through the mutation log, the group
+claims shards under one token (``mh:<world.game_id>``), and reconciles
+run on the allgathered-ready + tick-count cadence — so both controllers
+create the SAME service entities with the SAME deterministic ids, and a
+service RPC invoked from SPMD logic executes on both.
+
+Invoked as: python -m tests._mh_service_worker <pid> <coord> <disp>.
+"""
+
+import asyncio
+import json
+import sys
+import threading
+import time
+
+TICKS = 260
+TICK_SLEEP = 0.02
+
+
+def main() -> int:
+    pid = int(sys.argv[1])
+    coord_port = sys.argv[2]
+    disp_port = int(sys.argv[3])
+
+    from goworld_tpu.parallel.multihost import global_mesh, init_distributed
+    init_distributed(f"127.0.0.1:{coord_port}", num_processes=2,
+                     process_id=pid)
+
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.entity.entity import Entity
+    from goworld_tpu.entity.manager import World
+    from goworld_tpu.entity.space import Space
+    from goworld_tpu.net.dispatcher import DispatcherService
+    from goworld_tpu.net.game import GameServer
+    from goworld_tpu.ops.aoi import GridSpec
+
+    cfg = WorldConfig(
+        capacity=16,
+        grid=GridSpec(radius=10.0, extent_x=120.0, extent_z=100.0,
+                      k=8, cell_cap=16, row_block=16),
+        npc_speed=0.0,
+        enter_cap=128, leave_cap=128, sync_cap=128,
+    )
+    w = World(cfg, n_spaces=8, mesh=global_mesh(), megaspace=True,
+              halo_cap=8, migrate_cap=4)
+
+    class Mega(Space):
+        pass
+
+    class Counter(Entity):
+        calls: list = []
+
+        def Incr(self, amount):
+            Counter.calls.append(int(amount))
+
+    w.registry.register("Mega", Mega, is_space=True, megaspace=True)
+    w.create_nil_space()
+    w.create_space("Mega")
+
+    ready = threading.Event()
+
+    def services_thread() -> None:
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        async def boot():
+            if pid == 0:
+                d = DispatcherService(1, "127.0.0.1", disp_port,
+                                      desired_games=2, desired_gates=0)
+                asyncio.ensure_future(d.serve())
+                await d.started.wait()
+
+        loop.run_until_complete(boot())
+        ready.set()
+        loop.run_forever()
+
+    threading.Thread(target=services_thread, daemon=True).start()
+    assert ready.wait(30)
+    if pid == 1:
+        time.sleep(1.0)  # let the dispatcher bind first
+
+    gs = GameServer(pid + 1, w, [("127.0.0.1", disp_port)])
+    svc = gs.setup_services()
+    svc.register("Counter", Counter, shard_count=2)
+    gs.start_network()
+
+    called_at = None
+    for t in range(TICKS):
+        gs.pump()
+        # SPMD service call once both shards resolve (world state +
+        # kvreg mirror are SPMD-consistent, so both controllers fire
+        # at the same tick)
+        if called_at is None \
+                and svc.entity_id_of("Counter", 0) is not None \
+                and svc.entity_id_of("Counter", 1) is not None:
+            svc.call("Counter", "Incr", (5,), shard_index=0)
+            called_at = t
+        gs.tick()
+        time.sleep(TICK_SLEEP)
+
+    eids = [svc.entity_id_of("Counter", i) for i in (0, 1)]
+    out = {
+        "process": pid,
+        "service_eids": eids,
+        "local_entities": sorted(
+            e.id for e in w.entities.values()
+            if e.type_name == "Counter" and not e.destroyed
+        ),
+        "incr_calls": Counter.calls,
+        "claim": svc._claim,
+        "called": called_at is not None,
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -264,3 +264,47 @@ def test_multihost_checkpoint_restore():
     # owner-local, so the watcher's set updates on controller 1
     assert r1["restored_watcher_sees"] == r1["pre"]["watcher_sees"] \
         == ["walker_walker_00"]
+
+
+@pytest.mark.slow
+def test_multihost_services():
+    """Sharded singleton services on a multi-controller world: kvreg
+    updates replicate through the mutation log, the group claims shards
+    under one token, reconciles run on the allgathered-ready tick
+    cadence — both controllers create the SAME service entities with
+    the SAME deterministic ids, and a service RPC from SPMD logic
+    executes on both (reference service.go:106-238 kvreg race,
+    single-process-per-claim)."""
+    coord = _free_port()
+    disp = _free_port()
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-m", "tests._mh_service_worker",
+             str(pid), str(coord), str(disp)],
+            cwd=REPO, env=env, text=True,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        )
+        for pid in (0, 1)
+    ]
+    results = {}
+    for p, (out, err) in zip(procs, _drain(procs, 420)):
+        assert p.returncode == 0, f"worker failed:\n{err[-2500:]}"
+        line = [l for l in out.splitlines() if l.startswith("{")][-1]
+        r = json.loads(line)
+        results[r["process"]] = r
+
+    r0, r1 = results[0], results[1]
+    assert r0["claim"] == r1["claim"] == "mh:1"
+    # both shards placed, identical ids on both controllers, and the
+    # entities EXIST locally on both (SPMD host replication)
+    assert all(r0["service_eids"]), r0
+    assert r0["service_eids"] == r1["service_eids"]
+    assert r0["local_entities"] == r1["local_entities"] \
+        == sorted(r0["service_eids"])
+    # the SPMD service RPC executed exactly once on each controller
+    assert r0["called"] and r1["called"]
+    assert r0["incr_calls"] == r1["incr_calls"] == [5]
