@@ -1,0 +1,72 @@
+"""Multihost demo, CLI-managed: ``[game1] mesh_processes = 2`` makes the
+ops CLI run THIS script as two SPMD controller processes over one
+8-device mesh (4 local devices each) — one logical game spanning both.
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        python -m goworld_tpu start examples/multihost_demo
+
+World population happens in ``@gw.on_boot`` — the SPMD-safe hook that
+runs before the network/ticks, so every controller creates the identical
+world (``on_deployment_ready`` fires at different wall instants per
+controller and must not mutate a multi-controller world). The login
+Avatar is placed on the SECOND controller's half of the world: its
+create/sync traffic reaches the client through the dispatcher wire
+(cross-controller client visibility).
+
+See ``run_cluster.py`` for the same topology driven programmatically.
+"""
+
+import numpy as np
+
+import goworld_tpu as gw
+
+
+@gw.register_space("World", megaspace=True)
+class World(gw.Space):
+    pass
+
+
+@gw.register_entity("Monster")
+class Monster(gw.Entity):
+    ATTRS = {"hp": "allclients"}
+
+
+@gw.register_entity("Avatar")
+class Avatar(gw.Entity):
+    ATTRS = {"name": "allclients"}
+
+
+@gw.register_entity("Account")
+class Account(gw.Entity):
+    ATTRS = {"status": "client"}
+
+    def OnClientConnected(self):
+        self.attrs["status"] = "online"
+
+    def Login_Client(self, name):
+        # x=600 of the 800-wide world = the second controller's half
+        avatar = gw.create_entity(
+            "Avatar", space=gw.world()._mega_space,
+            pos=(600.0, 0.0, 200.0),
+        )
+        avatar.attrs["name"] = name
+        self.give_client_to(avatar)
+        self.destroy()
+
+
+@gw.on_boot
+def populate(world):
+    sp = world.create_space("World")
+    world._mega_space = sp
+    rng = np.random.default_rng(7)   # same seed => identical world on
+    for _ in range(400):             # every controller (SPMD contract)
+        world.create_entity(
+            "Monster", space=sp, moving=True,
+            pos=(float(rng.uniform(0, 800)), 0.0,
+                 float(rng.uniform(0, 400))),
+            attrs={"hp": 100},
+        )
+
+
+if __name__ == "__main__":
+    gw.run()

@@ -34,7 +34,7 @@ logger = log.get("api")
 __all__ = [
     "Entity", "Space", "GameClient",
     "register_entity", "register_space", "register_service",
-    "on_deployment_ready",
+    "on_deployment_ready", "on_boot",
     "run", "world", "game_server", "checkpoint_async",
     "create_space", "create_entity", "create_entity_anywhere",
     "create_space_anywhere", "create_entity_on_game",
@@ -54,6 +54,7 @@ __all__ = [
 # RegisterEntity also runs before Run(), goworld.go:42-50)
 _registrations: list[tuple[str, str, type, dict]] = []
 _ready_callbacks: list[Callable[[], None]] = []
+_boot_callbacks: list = []
 _rt: "_Runtime | None" = None
 
 
@@ -96,6 +97,19 @@ def register_space(name: str, cls: type | None = None, **kw):
         return c
 
     return _reg if cls is None else _reg(cls)
+
+
+def on_boot(cb):
+    """Run ``cb(world)`` right after the World is built — BEFORE the
+    network connects or any tick runs. This is the SPMD-SAFE place to
+    create spaces and populate entities on a MULTI-CONTROLLER game
+    (``mesh_processes > 1``): ``on_deployment_ready`` fires at a
+    different wall instant on each controller, so world mutations there
+    would fork SPMD state, while pre-network creation completes before
+    the first staging flush on every controller identically.
+    Single-controller games may use either hook."""
+    _boot_callbacks.append(cb)
+    return cb
 
 
 def on_deployment_ready(cb: Callable[[], None]):
@@ -251,6 +265,34 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
     gid = args.gid
     gc = cfg.games.get(gid) or config_mod.GameConfig()
 
+    # Multi-controller game: the CLI spawned mesh_processes OS processes
+    # for this gid and passed the shared coordinator through the env.
+    # Join the jax.distributed cluster BEFORE any backend use — after
+    # that, jax.devices() is the GLOBAL device list and _build_world's
+    # mesh spans every controller (the SPMD World detects
+    # process_count() > 1 and runs in multihost mode).
+    mh_procs = int(os.environ.get("GOWORLD_MH_PROCS", "1"))
+    mh_rank = int(os.environ.get("GOWORLD_MH_PROC_ID", "0"))
+    if gid >= consts.MH_FOLLOWER_GAME_ID_BASE:
+        raise SystemExit(
+            f"game id {gid} collides with the multihost follower id "
+            f"range (>= {consts.MH_FOLLOWER_GAME_ID_BASE})"
+        )
+    if mh_procs > 1:
+        # follower wire ids are base + gid*64 + rank in a u16 field:
+        # bound both factors so they can never wrap onto real game ids
+        if mh_procs > 64:
+            raise SystemExit("mesh_processes > 64 is not supported")
+        if gid > 500:
+            raise SystemExit(
+                "multihost games need game id <= 500 (follower wire-id "
+                "range)"
+            )
+        from goworld_tpu.parallel.multihost import init_distributed
+
+        init_distributed(os.environ["GOWORLD_MH_COORD"],
+                         num_processes=mh_procs, process_id=mh_rank)
+
     # storage + kvdb (reference game.go:99-103)
     from goworld_tpu.kvdb import KVDB, open_kvdb_backend
     from goworld_tpu.storage import Storage, open_backend
@@ -270,15 +312,33 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
     from goworld_tpu import freeze as freeze_mod
     from goworld_tpu.net.game import GameServer
 
-    restoring = args.restore and os.path.exists(
+    restoring = args.restore and mh_procs <= 1 and os.path.exists(
         freeze_mod.freeze_filename(gid)
     )
     if not restoring:
         world.create_nil_space()
+        for cb in _boot_callbacks:
+            try:
+                cb(world)
+            except Exception:
+                logger.exception("on_boot callback failed")
+    # follower controllers need their OWN dispatcher identity (the
+    # dispatcher keys connections by game id; a duplicate id would be
+    # treated as a reconnect and replace the leader's connection) —
+    # but the LOGICAL game keeps gid: the leader registers the world's
+    # entities under it and eid-routed traffic lands there
+    server_gid = (
+        gid if mh_rank == 0
+        else consts.MH_FOLLOWER_GAME_ID_BASE + gid * 64 + mh_rank
+    )
     server = GameServer(
-        gid, world, cfg.dispatcher_addrs(),
+        server_gid, world, cfg.dispatcher_addrs(),
         boot_entity=gc.boot_entity,
-        ban_boot=gc.ban_boot_entity,
+        # followers never take boot entities directly: the leader alone
+        # represents the group in the dispatcher's boot round-robin, or
+        # the logical game would be weighted once per controller (the
+        # boot itself still replicates group-wide via the mutation log)
+        ban_boot=gc.ban_boot_entity or mh_rank > 0,
         restore=restoring,
     )
     svc = server.setup_services()

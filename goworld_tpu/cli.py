@@ -101,6 +101,27 @@ def _entry_script(cfg: config_mod.ClusterConfig, server_dir: str) -> str:
     return os.path.join(server_dir, entry)
 
 
+def _game_instances(cfg: config_mod.ClusterConfig):
+    """One (gid, rank, n_procs, pid-label) per game OS process. A game
+    with ``mesh_processes > 1`` is ONE logical game run as that many
+    SPMD controller processes (rank-labelled pidfiles ``gameNcR``)."""
+    out = []
+    for gid in sorted(cfg.games):
+        procs = max(1, getattr(cfg.games[gid], "mesh_processes", 1))
+        for rank in range(procs):
+            label = gid if procs == 1 else f"{gid}c{rank}"
+            out.append((gid, rank, procs, label))
+    return out
+
+
+def _free_port() -> int:
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
 def _spawn(server_dir: str, role: str, idx: int, cmd: list[str],
            extra_env: dict | None = None) -> int:
     """Start the process; returns the byte offset of its log so readiness
@@ -177,20 +198,56 @@ def cmd_start(server_dir: str) -> int:
             return 1
 
     for gid in sorted(cfg.games):
-        if _alive(_read_pid(server_dir, "game", gid)):
-            print(f"game{gid}: already running")
+        procs = max(1, getattr(cfg.games[gid], "mesh_processes", 1))
+        labels = [gid if procs == 1 else f"{gid}c{r}"
+                  for r in range(procs)]
+        alive = [lb for lb in labels
+                 if _alive(_read_pid(server_dir, "game", lb))]
+        if len(alive) == len(labels):
+            for lb in labels:
+                print(f"game{lb}: already running")
             continue
-        cmd = [py, entry, "-gid", str(gid)]
-        if rel_cfg:
-            cmd += ["-configfile", rel_cfg]
-        freeze_file = os.path.join(server_dir, f"game{gid}_freezed.dat")
-        if os.path.exists(freeze_file):
-            cmd.append("-restore")
-        off = _spawn(server_dir, "game", gid, cmd)
-        ok = _wait_started(server_dir, "game", gid, off)
-        print(f"game{gid}: {'started' if ok else 'FAILED'}")
-        if not ok:
+        if alive:
+            # a PARTIAL multihost group cannot be healed in place: the
+            # dead ranks would join a brand-new coordinator the live
+            # ranks never dialed and block forever in init_distributed
+            print(
+                f"game{gid}: controllers {alive} still running — stop "
+                "the whole group before restarting it", file=sys.stderr,
+            )
             return 1
+        coord = f"127.0.0.1:{_free_port()}" if procs > 1 else None
+        waits: list[tuple[str, int]] = []
+        for rank, label in enumerate(labels):
+            cmd = [py, entry, "-gid", str(gid)]
+            if rel_cfg:
+                cmd += ["-configfile", rel_cfg]
+            extra_env = None
+            if procs > 1:
+                # one jax.distributed coordinator per multihost game;
+                # every rank joins it before building the (global) mesh
+                extra_env = {
+                    "GOWORLD_MH_PROCS": str(procs),
+                    "GOWORLD_MH_PROC_ID": str(rank),
+                    "GOWORLD_MH_COORD": coord,
+                }
+            else:
+                freeze_file = os.path.join(server_dir,
+                                           f"game{gid}_freezed.dat")
+                if os.path.exists(freeze_file):
+                    cmd.append("-restore")
+            waits.append((
+                label,
+                _spawn(server_dir, "game", label, cmd,
+                       extra_env=extra_env),
+            ))
+        # controllers block in collectives until every rank is up, so
+        # the whole group is spawned before any readiness wait
+        for lbl, off in waits:
+            ok = _wait_started(server_dir, "game", lbl, off)
+            print(f"game{lbl}: {'started' if ok else 'FAILED'}")
+            if not ok:
+                return 1
 
     for gid in sorted(cfg.gates):
         if _alive(_read_pid(server_dir, "gate", gid)):
@@ -240,7 +297,10 @@ def _stop_role(server_dir: str, role: str, indices, sig,
 def cmd_stop(server_dir: str, sig=signal.SIGTERM) -> int:
     cfg = config_mod.load(_find_config(server_dir))
     ok = _stop_role(server_dir, "gate", sorted(cfg.gates), sig)
-    ok &= _stop_role(server_dir, "game", sorted(cfg.games), sig)
+    ok &= _stop_role(
+        server_dir, "game",
+        [label for _, _, _, label in _game_instances(cfg)], sig,
+    )
     ok &= _stop_role(server_dir, "dispatcher", sorted(cfg.dispatchers), sig)
     return 0 if ok else 1
 
@@ -255,6 +315,13 @@ def cmd_reload(server_dir: str) -> int:
     py = sys.executable
     rel_cfg = os.path.basename(cfgfile) if cfgfile else ""
     for gid in sorted(cfg.games):
+        if getattr(cfg.games[gid], "mesh_processes", 1) > 1:
+            # hot reload = freeze-to-exit + -restore, which is
+            # single-controller only (net/game.py request_freeze); a
+            # multihost group restarts via stop + start instead
+            print(f"game{gid}: multihost game — reload unsupported, "
+                  "use stop/start", file=sys.stderr)
+            continue
         pid = _read_pid(server_dir, "game", gid)
         if not _alive(pid):
             print(f"game{gid}: not running; skipping")
@@ -288,7 +355,7 @@ def cmd_status(server_dir: str) -> int:
     cfg = config_mod.load(_find_config(server_dir))
     rows = (
         [("dispatcher", i) for i in sorted(cfg.dispatchers)]
-        + [("game", i) for i in sorted(cfg.games)]
+        + [("game", label) for _, _, _, label in _game_instances(cfg)]
         + [("gate", i) for i in sorted(cfg.gates)]
     )
     all_up = True

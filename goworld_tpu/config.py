@@ -46,7 +46,12 @@ class GameConfig:
     aoi_topk_impl: str = "exact"
     extent_x: float = 1000.0
     extent_z: float = 1000.0
-    mesh_devices: int = 0  # 0 = single-device vmap path
+    mesh_devices: int = 0  # 0 = single-device vmap path (GLOBAL count
+                           # when mesh_processes > 1)
+    mesh_processes: int = 1  # SPMD controller OS processes for this
+                           # game: the CLI spawns one per rank with a
+                           # shared jax.distributed coordinator; ONE
+                           # logical game spans them (multihost)
     npc_speed: float = 5.0
     behavior: str = "random_walk"  # random_walk | mlp | btree (the fused
                                    # NPC kernels, BASELINE config 5)

@@ -263,6 +263,11 @@ class DispatcherService:
         self._broadcast_to_games(
             self._mk_game_connected(game_id), exclude=game_id
         )
+        if self.deployment_ready:
+            # late joiner (reconnect, or a multihost follower controller
+            # connecting after the threshold): it missed the broadcast
+            # and would never learn the cluster is live
+            conn.send(new_packet(proto.MT_NOTIFY_DEPLOYMENT_READY))
         self._check_deployment_ready()
         return ("game", game_id)
 
@@ -277,7 +282,14 @@ class DispatcherService:
         process counts are met, tell everyone."""
         if self.deployment_ready:
             return
-        live_games = sum(1 for g in self.games.values() if g.conn is not None)
+        # multihost FOLLOWER controllers (ids >= MH_FOLLOWER_GAME_ID_BASE)
+        # are extra connections of an already-counted logical game — they
+        # must not inflate the readiness count past desired_games
+        live_games = sum(
+            1 for g in self.games.values()
+            if g.conn is not None
+            and g.game_id < consts.MH_FOLLOWER_GAME_ID_BASE
+        )
         if live_games >= self.desired_games and \
                 len(self.gates) >= self.desired_gates:
             self.deployment_ready = True

@@ -58,6 +58,18 @@ _MH_WORLD_MSGTYPES = frozenset({
     proto.MT_KVREG_REGISTER,
 })
 
+# The subset of _MH_WORLD_MSGTYPES the dispatcher BROADCASTS to every
+# game connection (the rest are eid/owner-routed and reach exactly one
+# controller). Each of a group's N controllers receives its own copy,
+# so only the LEADER logs them — otherwise the allgather union would
+# replay every broadcast N times (N nil-space invocations per
+# call_nil_spaces, N-fold kvreg watcher fires, ...).
+_MH_BROADCAST_MSGTYPES = frozenset({
+    proto.MT_KVREG_REGISTER,
+    proto.MT_CALL_NIL_SPACES,
+    proto.MT_NOTIFY_GATE_DISCONNECTED,
+})
+
 
 class GameServer:
     """One game process: a World + connections to every dispatcher."""
@@ -561,14 +573,9 @@ class GameServer:
         w = self.world
         if w._multihost and not self._mh_replaying \
                 and msgtype in _MH_WORLD_MSGTYPES:
-            if msgtype == proto.MT_KVREG_REGISTER \
+            if msgtype in _MH_BROADCAST_MSGTYPES \
                     and self._mh_follower():
-                # kvreg updates are dispatcher-BROADCAST (every game
-                # gets a copy, unlike the eid-routed types): only the
-                # leader logs them, or the union would replay each
-                # update once per controller and fire kvreg watchers
-                # N times per write
-                return
+                return  # broadcast copy; the leader's is the one logged
             # defer to the per-tick allgather so every controller applies
             # this mutation, in the same order, in the same tick
             self._mh_pending.append(

@@ -44,3 +44,8 @@ OPTIMIZE_LOCAL_ENTITY_CALL = True  # set False in tests to force the full
 # --- networking ----------------------------------------------------------
 SUPERVISOR_STARTED_TAG = "GOWORLD_TPU_PROCESS_STARTED"  # consts.go:108-112
 FREEZE_EXIT_CODE = 23  # game exited via freeze; CLI restarts with -restore
+
+# Dispatcher game-ids for multihost FOLLOWER controllers: the logical
+# game keeps its gid (leader), followers get base + gid*64 + rank so
+# their connections don't collide with real game ids (u16 wire field)
+MH_FOLLOWER_GAME_ID_BASE = 30000

@@ -236,3 +236,85 @@ def test_cli_start_megaspace_demo(tmp_path):
             [sys.executable, "-m", "goworld_tpu", "stop", dst],
             env=env, cwd=dst, capture_output=True, text=True, timeout=120,
         )
+
+
+@pytest.mark.slow
+def test_cli_start_multihost_demo(tmp_path):
+    """Production ops for a MULTI-CONTROLLER game: `start` spawns two
+    SPMD controller processes for game1 (shared jax.distributed
+    coordinator from the ini's mesh_processes = 2), a real client logs
+    in through the gate, its Avatar lands on the SECOND controller's
+    half of the world and still receives create/sync traffic
+    (cross-controller visibility through the dispatcher wire), `status`
+    shows both controller processes, `stop` tears everything down."""
+    import shutil as _shutil
+
+    src = os.path.join(REPO, "examples", "multihost_demo")
+    dst = str(tmp_path / "multihost_demo")
+    _shutil.copytree(src, dst)
+    gport = _free_port()
+    dport = _free_port()
+    ini = os.path.join(dst, "goworld_tpu.ini")
+    with open(ini) as f:
+        text = f.read()
+    text = text.replace("port = 15500", f"port = {gport}")
+    text = text.replace("port = 14500", f"port = {dport}")
+    with open(ini, "w") as f:
+        f.write(text)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # 4 virtual devices PER controller process -> 8-device global mesh
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "goworld_tpu", "start", dst],
+            env=env, cwd=dst, capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+        assert "game1c0: started" in r.stdout, r.stdout
+        assert "game1c1: started" in r.stdout, r.stdout
+
+        st = subprocess.run(
+            [sys.executable, "-m", "goworld_tpu", "status", dst],
+            env=env, cwd=dst, capture_output=True, text=True, timeout=60,
+        )
+        assert st.returncode == 0, st.stdout
+        assert "game1c0: running" in st.stdout
+        assert "game1c1: running" in st.stdout
+
+        async def login():
+            from goworld_tpu.net.botclient import BotClient
+
+            bot = BotClient("127.0.0.1", gport, strict=True)
+            await bot.connect()
+            recv = asyncio.ensure_future(bot._recv_loop())
+            try:
+                await asyncio.wait_for(bot.player_ready.wait(), 30)
+                bot.call_server("Login_Client", "mhops")
+                for _ in range(200):
+                    if bot.player is not None \
+                            and bot.player.type_name == "Avatar" \
+                            and bot.sync_count > 0 \
+                            and any(not m.is_player
+                                    for m in bot.entities.values()):
+                        break
+                    await asyncio.sleep(0.1)
+                assert bot.player is not None
+                assert bot.player.type_name == "Avatar"
+                # the avatar sits at x=600: controller 1's half; its
+                # visible monsters + syncs crossed the dispatcher wire
+                assert any(not m.is_player for m in bot.entities.values())
+                assert bot.sync_count > 0
+                assert not bot.errors, bot.errors
+            finally:
+                recv.cancel()
+                await bot.conn.close()
+
+        asyncio.run(asyncio.wait_for(login(), 90))
+    finally:
+        subprocess.run(
+            [sys.executable, "-m", "goworld_tpu", "stop", dst],
+            env=env, cwd=dst, capture_output=True, text=True, timeout=120,
+        )
