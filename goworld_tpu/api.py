@@ -312,7 +312,9 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
     from goworld_tpu import freeze as freeze_mod
     from goworld_tpu.net.game import GameServer
 
-    restoring = args.restore and mh_procs <= 1 and os.path.exists(
+    # multihost ranks all read the SAME snapshot (the leader wrote it)
+    # and replay restore_world SPMD-identically before the network
+    restoring = args.restore and os.path.exists(
         freeze_mod.freeze_filename(gid)
     )
     if not restoring:

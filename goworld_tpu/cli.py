@@ -101,15 +101,21 @@ def _entry_script(cfg: config_mod.ClusterConfig, server_dir: str) -> str:
     return os.path.join(server_dir, entry)
 
 
+def _group_labels(cfg: config_mod.ClusterConfig, gid: int):
+    """(n_procs, pid-labels) for one game: a game with
+    ``mesh_processes > 1`` is ONE logical game run as that many SPMD
+    controller processes (rank-labelled pidfiles ``gameNcR``)."""
+    procs = max(1, getattr(cfg.games[gid], "mesh_processes", 1))
+    return procs, [gid if procs == 1 else f"{gid}c{r}"
+                   for r in range(procs)]
+
+
 def _game_instances(cfg: config_mod.ClusterConfig):
-    """One (gid, rank, n_procs, pid-label) per game OS process. A game
-    with ``mesh_processes > 1`` is ONE logical game run as that many
-    SPMD controller processes (rank-labelled pidfiles ``gameNcR``)."""
+    """One (gid, rank, n_procs, pid-label) per game OS process."""
     out = []
     for gid in sorted(cfg.games):
-        procs = max(1, getattr(cfg.games[gid], "mesh_processes", 1))
-        for rank in range(procs):
-            label = gid if procs == 1 else f"{gid}c{rank}"
+        procs, labels = _group_labels(cfg, gid)
+        for rank, label in enumerate(labels):
             out.append((gid, rank, procs, label))
     return out
 
@@ -120,6 +126,45 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
         return s.getsockname()[1]
+
+
+def _start_game_group(server_dir: str, cfg, gid: int, entry: str,
+                      py: str, rel_cfg: str,
+                      force_restore: bool = False) -> bool:
+    """Spawn every OS process of one (possibly multihost) game and wait
+    for all their readiness tags. Controllers block in collectives until
+    the whole group is up, so spawning precedes any waiting."""
+    procs, labels = _group_labels(cfg, gid)
+    coord = f"127.0.0.1:{_free_port()}" if procs > 1 else None
+    restore = force_restore or os.path.exists(
+        os.path.join(server_dir, f"game{gid}_freezed.dat")
+    )
+    waits: list[tuple[str, int]] = []
+    for rank, label in enumerate(labels):
+        cmd = [py, entry, "-gid", str(gid)]
+        if rel_cfg:
+            cmd += ["-configfile", rel_cfg]
+        if restore:
+            cmd.append("-restore")
+        extra_env = None
+        if procs > 1:
+            # one jax.distributed coordinator per multihost game; every
+            # rank joins it before building the (global) mesh
+            extra_env = {
+                "GOWORLD_MH_PROCS": str(procs),
+                "GOWORLD_MH_PROC_ID": str(rank),
+                "GOWORLD_MH_COORD": coord,
+            }
+        waits.append((
+            label,
+            _spawn(server_dir, "game", label, cmd, extra_env=extra_env),
+        ))
+    for lbl, off in waits:
+        ok = _wait_started(server_dir, "game", lbl, off)
+        print(f"game{lbl}: {'started' if ok else 'FAILED'}")
+        if not ok:
+            return False
+    return True
 
 
 def _spawn(server_dir: str, role: str, idx: int, cmd: list[str],
@@ -198,9 +243,7 @@ def cmd_start(server_dir: str) -> int:
             return 1
 
     for gid in sorted(cfg.games):
-        procs = max(1, getattr(cfg.games[gid], "mesh_processes", 1))
-        labels = [gid if procs == 1 else f"{gid}c{r}"
-                  for r in range(procs)]
+        procs, labels = _group_labels(cfg, gid)
         alive = [lb for lb in labels
                  if _alive(_read_pid(server_dir, "game", lb))]
         if len(alive) == len(labels):
@@ -216,38 +259,9 @@ def cmd_start(server_dir: str) -> int:
                 "the whole group before restarting it", file=sys.stderr,
             )
             return 1
-        coord = f"127.0.0.1:{_free_port()}" if procs > 1 else None
-        waits: list[tuple[str, int]] = []
-        for rank, label in enumerate(labels):
-            cmd = [py, entry, "-gid", str(gid)]
-            if rel_cfg:
-                cmd += ["-configfile", rel_cfg]
-            extra_env = None
-            if procs > 1:
-                # one jax.distributed coordinator per multihost game;
-                # every rank joins it before building the (global) mesh
-                extra_env = {
-                    "GOWORLD_MH_PROCS": str(procs),
-                    "GOWORLD_MH_PROC_ID": str(rank),
-                    "GOWORLD_MH_COORD": coord,
-                }
-            else:
-                freeze_file = os.path.join(server_dir,
-                                           f"game{gid}_freezed.dat")
-                if os.path.exists(freeze_file):
-                    cmd.append("-restore")
-            waits.append((
-                label,
-                _spawn(server_dir, "game", label, cmd,
-                       extra_env=extra_env),
-            ))
-        # controllers block in collectives until every rank is up, so
-        # the whole group is spawned before any readiness wait
-        for lbl, off in waits:
-            ok = _wait_started(server_dir, "game", lbl, off)
-            print(f"game{lbl}: {'started' if ok else 'FAILED'}")
-            if not ok:
-                return 1
+        if not _start_game_group(server_dir, cfg, gid, entry, py,
+                                 rel_cfg):
+            return 1
 
     for gid in sorted(cfg.gates):
         if _alive(_read_pid(server_dir, "gate", gid)):
@@ -315,36 +329,49 @@ def cmd_reload(server_dir: str) -> int:
     py = sys.executable
     rel_cfg = os.path.basename(cfgfile) if cfgfile else ""
     for gid in sorted(cfg.games):
-        if getattr(cfg.games[gid], "mesh_processes", 1) > 1:
-            # hot reload = freeze-to-exit + -restore, which is
-            # single-controller only (net/game.py request_freeze); a
-            # multihost group restarts via stop + start instead
-            print(f"game{gid}: multihost game — reload unsupported, "
-                  "use stop/start", file=sys.stderr)
-            continue
-        pid = _read_pid(server_dir, "game", gid)
-        if not _alive(pid):
+        procs, labels = _group_labels(cfg, gid)
+        alive = [lb for lb in labels
+                 if _alive(_read_pid(server_dir, "game", lb))]
+        if not alive:
             print(f"game{gid}: not running; skipping")
             continue
-        os.kill(pid, signal.SIGHUP)  # freeze (reference FreezeSignal)
-        deadline = time.monotonic() + 60
-        while _alive(pid) and time.monotonic() < deadline:
+        if len(alive) < len(labels):
+            # same guard as cmd_start: a partial group can't be healed
+            print(
+                f"game{gid}: only controllers {alive} running — stop "
+                "the whole group first", file=sys.stderr,
+            )
+            return 1
+        leader_pid = _read_pid(server_dir, "game", labels[0])
+        # freeze (reference FreezeSignal). Multihost: the LEADER gets
+        # the signal; the freeze decision spreads to every controller
+        # through the mutation exchange and ALL rank processes exit
+        # after snapshotting at the same tick (leader writes the file)
+        t_sig = time.time()
+        os.kill(leader_pid, signal.SIGHUP)
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline and any(
+            _alive(_read_pid(server_dir, "game", lb)) for lb in labels
+        ):
             time.sleep(0.1)
-        if _alive(pid):
+        if any(_alive(_read_pid(server_dir, "game", lb))
+               for lb in labels):
             print(f"game{gid}: freeze did not complete", file=sys.stderr)
             return 1
         freeze_file = os.path.join(server_dir, f"game{gid}_freezed.dat")
-        if not os.path.exists(freeze_file):
-            print(f"game{gid}: no freeze file after exit", file=sys.stderr)
+        # the file must be FRESH: a stale snapshot from a previous
+        # reload would otherwise mask a failed freeze and silently
+        # restore outdated state
+        if not os.path.exists(freeze_file) \
+                or os.path.getmtime(freeze_file) < t_sig - 1.0:
+            print(f"game{gid}: no fresh freeze file after exit",
+                  file=sys.stderr)
             return 1
-        cmd = [py, entry, "-gid", str(gid), "-restore"]
-        if rel_cfg:
-            cmd += ["-configfile", rel_cfg]
-        off = _spawn(server_dir, "game", gid, cmd)
-        ok = _wait_started(server_dir, "game", gid, off)
-        print(f"game{gid}: {'reloaded' if ok else 'RESTORE FAILED'}")
-        if not ok:
+        if not _start_game_group(server_dir, cfg, gid, entry, py,
+                                 rel_cfg, force_restore=True):
+            print(f"game{gid}: RESTORE FAILED", file=sys.stderr)
             return 1
+        print(f"game{gid}: reloaded")
     return 0
 
 

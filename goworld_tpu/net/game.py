@@ -128,6 +128,7 @@ class GameServer:
         self._mh_replaying = False
         self._mh_all_ready = False       # allgathered group readiness
         self._mh_leader_game_id = self.game_id  # allgathered, row 0
+        self._mh_freeze_requested = False  # leader sets; exchange spreads
 
         # wire the world's pluggable edges to the cluster
         w = world
@@ -191,15 +192,12 @@ class GameServer:
         ``GameService.go:474-478``)."""
         if self.run_state != "running":
             return
-        if self.world._multihost:
-            # freezing ONE controller of an SPMD world would leave its
-            # peers blocked in the next tick's collectives forever; a
-            # coordinated multi-controller freeze is future work —
-            # refuse loudly instead of hanging the cluster
-            logger.error(
-                "game%d: freeze is not supported for multi-controller "
-                "worlds (peers would deadlock in tick collectives); "
-                "use World checkpoints instead", self.game_id,
+        if self.world._multihost and self.world.mh_rank != 0:
+            # the CLI signals the LEADER; a follower cannot drive the
+            # dispatcher ack dance (its wire id owns no entity routes)
+            logger.warning(
+                "game%d: multihost freeze must be requested on the "
+                "leader controller", self.game_id,
             )
             return
         self._freeze_acks.clear()
@@ -218,16 +216,22 @@ class GameServer:
         w = self.world
         w.post_q.tick()
         # snapshot FIRST: OnFreeze hooks may enqueue storage saves, which
-        # the drain below must still execute (reference doFreeze ordering)
+        # the drain below must still execute (reference doFreeze ordering).
+        # Multihost: EVERY controller reaches here after the same tick
+        # (the exchange spread the decision) and freeze_world's device
+        # snapshot is an allgather, so all ranks hold the identical
+        # global snapshot — the LEADER alone writes the file, which every
+        # rank reads back on the -restore start.
         data = _freeze.freeze_world(w)
         if w.storage is not None:
             w.storage.shutdown()
         path = os.path.join(
             self.freeze_dir, _freeze.freeze_filename(w.game_id)
         )
-        _freeze.write_freeze_file(path, data)
+        if not self._mh_follower():
+            _freeze.write_freeze_file(path, data)
+            logger.info("game%d: frozen to %s", self.game_id, path)
         self.run_state = "frozen"
-        logger.info("game%d: frozen to %s", self.game_id, path)
         self.stop()
 
     def pump(self) -> int:
@@ -302,12 +306,19 @@ class GameServer:
         meta = np.asarray(
             multihost_utils.process_allgather(
                 np.asarray([len(blob), int(self.deployment_ready),
-                            self.game_id], np.int32)
+                            self.game_id,
+                            int(self._mh_freeze_requested)], np.int32)
             )
-        ).reshape(-1, 3)
+        ).reshape(-1, 4)
         self.world.mh_group_ready = self._mh_all_ready = \
             bool(meta[:, 1].all())
         self._mh_leader_game_id = int(meta[0, 2])
+        if meta[:, 3].any() and self.run_state == "running":
+            # coordinated freeze: every controller learns the fact from
+            # the SAME collective, so all of them run _do_freeze after
+            # this very tick and the freeze_world snapshot's own
+            # collectives pair up
+            self.run_state = "freezing"
         lengths = meta[:, 0]
         max_len = int(lengths.max())
         if max_len == 0:
@@ -721,7 +732,12 @@ class GameServer:
             if len(self._freeze_acks) >= len(self.cluster.conns) \
                     and self.run_state == "running":
                 # every dispatcher is now blocking us: safe to snapshot
-                self.run_state = "freezing"
+                if w._multihost:
+                    # spread the decision through the NEXT exchange so
+                    # the whole controller group freezes at one tick
+                    self._mh_freeze_requested = True
+                else:
+                    self.run_state = "freezing"
             return
         if msgtype == proto.MT_NOTIFY_GAME_CONNECTED:
             self.online_games.add(pkt.read_u16())

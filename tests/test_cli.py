@@ -284,7 +284,7 @@ def test_cli_start_multihost_demo(tmp_path):
         assert "game1c0: running" in st.stdout
         assert "game1c1: running" in st.stdout
 
-        async def login():
+        async def session():
             from goworld_tpu.net.botclient import BotClient
 
             bot = BotClient("127.0.0.1", gport, strict=True)
@@ -308,11 +308,32 @@ def test_cli_start_multihost_demo(tmp_path):
                 assert any(not m.is_player for m in bot.entities.values())
                 assert bot.sync_count > 0
                 assert not bot.errors, bot.errors
+
+                # live reload of the WHOLE controller group: SIGHUP to
+                # the leader, freeze spreads through the exchange, both
+                # ranks snapshot + exit, the CLI restarts them with
+                # -restore — and the still-connected bot's syncs resume
+                r2 = await asyncio.to_thread(
+                    subprocess.run,
+                    [sys.executable, "-m", "goworld_tpu", "reload", dst],
+                    env=env, cwd=dst, capture_output=True, text=True,
+                    timeout=300,
+                )
+                assert r2.returncode == 0, \
+                    r2.stdout[-2000:] + r2.stderr[-2000:]
+                assert "game1: reloaded" in r2.stdout, r2.stdout
+                s0 = bot.sync_count
+                t0 = time.time()
+                while time.time() - t0 < 90 and bot.sync_count <= s0:
+                    await asyncio.sleep(0.2)
+                assert bot.sync_count > s0, \
+                    "syncs never resumed after the multihost reload"
+                assert not bot.errors, bot.errors
             finally:
                 recv.cancel()
                 await bot.conn.close()
 
-        asyncio.run(asyncio.wait_for(login(), 90))
+        asyncio.run(asyncio.wait_for(session(), 500))
     finally:
         subprocess.run(
             [sys.executable, "-m", "goworld_tpu", "stop", dst],
