@@ -332,17 +332,28 @@ def measure(n: int, ticks: int, client_frac: float, phases: bool,
     force(run2(variant(1)))
     compile2_s = time.perf_counter() - t0
 
-    t0 = time.perf_counter()
-    force(run(variant(2)))
-    elapsed_t = time.perf_counter() - t0
-
-    # a 2x-length scan on fresh input must take ~2x: if it doesn't, the
-    # harness is NOT measuring execution and the number can't be trusted
-    # (the marginal per-tick figure below also cancels the constant
-    # scalar-readback roundtrip these force() calls add)
-    t0 = time.perf_counter()
-    force(run2(variant(3)))
-    elapsed_2t = time.perf_counter() - t0
+    # Time each scan length REPEATS times and take the min (the standard
+    # noise-robust estimator: system-load spikes only ever ADD time).
+    # r03 shipped scale_2x=2.63 from single-shot timings — one slow run2
+    # inflated the marginal tick by ~63% and made the robust 64-sample
+    # p99 median look "impossibly fast" (p50 < 0.7x tick), tripping the
+    # consistency gate on a healthy harness. Min-of-k on both lengths
+    # makes the marginal estimate comparable to a median in robustness.
+    repeats = int(os.environ.get("BENCH_TIME_REPEATS", 3))
+    times_t, times_2t = [], []
+    for r_i in range(repeats):
+        t0 = time.perf_counter()
+        force(run(variant(2 + 2 * r_i)))
+        times_t.append(time.perf_counter() - t0)
+        # a 2x-length scan on fresh input must take ~2x: if it doesn't,
+        # the harness is NOT measuring execution and the number can't be
+        # trusted (the marginal per-tick figure below also cancels the
+        # constant scalar-readback roundtrip these force() calls add)
+        t0 = time.perf_counter()
+        force(run2(variant(3 + 2 * r_i)))
+        times_2t.append(time.perf_counter() - t0)
+    elapsed_t = min(times_t)
+    elapsed_2t = min(times_2t)
     scale = elapsed_2t / max(elapsed_t, 1e-9)
     # marginal per-tick cost cancels constant dispatch/transfer overhead
     per_tick = max(elapsed_2t - elapsed_t, 1e-9) / ticks
@@ -356,6 +367,9 @@ def measure(n: int, ticks: int, client_frac: float, phases: bool,
         "ticks_timed": ticks,
         "wall_t_s": round(elapsed_t, 3),
         "wall_2t_s": round(elapsed_2t, 3),
+        "wall_t_s_all": [round(x, 3) for x in times_t],
+        "wall_2t_s_all": [round(x, 3) for x in times_2t],
+        "time_repeats": repeats,
         "scale_2x": round(scale, 2),
         "compile_s": round(compile_s, 1),
         "compile2_s": round(compile2_s, 1),
@@ -377,7 +391,7 @@ def measure(n: int, ticks: int, client_frac: float, phases: bool,
     return result
 
 
-def measure_p99(cfg, st, inputs, policy, samples: int = 64) -> dict:
+def measure_p99(cfg, st, inputs, policy, samples: int | None = None) -> dict:
     """Per-tick latency distribution (BASELINE's second metric: AOI-sync
     p99 < 16 ms).
 
@@ -398,6 +412,9 @@ def measure_p99(cfg, st, inputs, policy, samples: int = 64) -> dict:
     import jax.numpy as jnp
 
     from goworld_tpu.core.step import tick_body
+
+    if samples is None:
+        samples = int(os.environ.get("BENCH_P99_SAMPLES", 64))
 
     @jax.jit
     def tick_fb(state, feedback, ins, pol):
@@ -569,6 +586,23 @@ def child_main(args) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("BENCH_COMPILE_CACHE", "1") == "1":
+        # persistent compilation cache: the 1M-entity scan costs 57-72 s
+        # to compile on TPU (r02 measurement) — cache it on disk so a
+        # re-run (or a second bench attempt after a child death) pays
+        # ~0 s. Harmless where the backend doesn't support it.
+        import jax
+
+        try:
+            jax.config.update(
+                "jax_compilation_cache_dir",
+                os.path.join(REPO, ".jax_compile_cache"),
+            )
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 2.0
+            )
+        except Exception as exc:  # unknown flag on this jax version
+            log(f"compile cache unavailable: {exc}")
     stages = [("smoke", min(SMOKE_N, args.n), SMOKE_T, False)]
     if args.n > SMOKE_N:
         stages.append(("full", args.n, args.ticks, args.phases))
@@ -998,9 +1032,148 @@ def parent_main() -> int:
     return 0 if (best or suspect_best or partial) is not None else 1
 
 
+def selftest_main() -> int:
+    """Harness self-test: exercise every bench.py code path at tiny N in
+    minutes, so scarce TPU relay time is never burned debugging the
+    harness itself (r03 verdict: 1,016 LoC of load-bearing,
+    TPU-untested orchestration). Three probes:
+
+    1. full orchestration (smoke+full staging, autotune, phases incl.
+       sweep sub-phases, loop-carried p99 + shard p99, repeats-min
+       timing) — asserts the composed artifact carries every expected
+       key and that the p99 consistency gate PASSES on it;
+    2. the CPU-fallback path (BENCH_TPU_ATTEMPTS=0);
+    3. the SIGTERM best-so-far emission path.
+
+    Run this FIRST on hardware: `python bench.py --selftest`."""
+    tiny = {
+        "BENCH_N": "4096", "BENCH_TICKS": "3",
+        "BENCH_SMOKE_N": "1024", "BENCH_SMOKE_TICKS": "2",
+        "BENCH_AUTOTUNE_N": "512", "BENCH_P99_SAMPLES": "8",
+        "BENCH_P99_SHARD_N": "1024", "BENCH_N_CPU": "2048",
+        "BENCH_CHILD_TIMEOUT": "420", "BENCH_TIME_REPEATS": "2",
+    }
+    failures: list[str] = []
+    report: dict = {}
+
+    def run_bench(extra: dict, timeout: float, sigterm_after: float = 0.0):
+        env = dict(os.environ)
+        env.update(tiny)
+        env.update(extra)
+        proc = subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, cwd=REPO, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        if sigterm_after:
+            # wait for the child-spawn diagnostic so the kill lands
+            # mid-measurement, then SIGTERM the PARENT. The stderr wait
+            # runs in a thread: a wedged bench that emits nothing must
+            # trip the deadline, not block forever on readline.
+            import threading
+
+            spawned = threading.Event()
+
+            def watch_err() -> None:
+                for line in proc.stderr:
+                    if "spawn child" in line:
+                        spawned.set()
+                        return
+
+            threading.Thread(target=watch_err, daemon=True).start()
+            if not spawned.wait(timeout):
+                proc.kill()
+                proc.communicate()
+                return None, "never spawned a child"
+            time.sleep(sigterm_after)
+            proc.send_signal(signal.SIGTERM)
+        try:
+            out, err = proc.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            out, err = proc.communicate()
+            return None, f"timeout after {timeout:.0f}s"
+        lines = [l for l in out.splitlines() if l.strip().startswith("{")]
+        if len(lines) != 1:
+            return None, f"expected exactly 1 JSON line, got {len(lines)}"
+        try:
+            return json.loads(lines[0]), ""
+        except json.JSONDecodeError as exc:
+            return None, f"unparseable stdout: {exc}"
+
+    def check(name: str, cond: bool, detail: str = "") -> None:
+        if not cond:
+            failures.append(f"{name}: {detail}")
+            log(f"selftest FAIL {name}: {detail}")
+        else:
+            log(f"selftest ok   {name}")
+
+    # --- probe 1: full orchestration ------------------------------------
+    t0 = time.monotonic()
+    art, err = run_bench({}, timeout=900)
+    report["full_s"] = round(time.monotonic() - t0, 1)
+    check("full.emitted", art is not None, err)
+    if art is not None:
+        report["full_platform"] = art.get("platform")
+        check("full.headline", art.get("stage") == "full"
+              and art.get("value", 0) > 0, json.dumps(art)[:200])
+        check("full.timing_sane", "timing_suspect" not in art,
+              art.get("timing_suspect", ""))
+        for k in ("wall_t_s_all", "wall_2t_s_all", "scale_2x",
+                  "compile_s", "attempts"):
+            check(f"full.{k}", k in art, "missing")
+        pm = art.get("phase_ms", {})
+        for k in ("aoi", "aoi_sort", "aoi_build", "move", "collect"):
+            check(f"full.phase.{k}", k in pm, f"phase_ms={pm}")
+        check("full.p99", "tick_p99_ms" in art, "missing p99 keys")
+        check("full.p99_gate", "p99_suspect" not in art,
+              art.get("p99_suspect", ""))
+        check("full.p99_shard", "shard_p99" in art
+              and art["shard_p99"].get("p99_n") == 1024,
+              str(art.get("shard_p99")))
+        if art.get("platform") != "cpu":
+            check("full.autotune", "autotune_sweep_ms" in art, "missing")
+            check("full.variants", "behavior_variants" in art, "missing")
+
+    # --- probe 2: CPU fallback path -------------------------------------
+    t0 = time.monotonic()
+    art, err = run_bench({"BENCH_TPU_ATTEMPTS": "0",
+                          "BENCH_VARIANTS": "0"}, timeout=600)
+    report["fallback_s"] = round(time.monotonic() - t0, 1)
+    check("fallback.emitted", art is not None, err)
+    if art is not None:
+        check("fallback.headline", art.get("value", 0) > 0,
+              json.dumps(art)[:200])
+        check("fallback.platform", art.get("platform") == "cpu",
+              art.get("platform", "?"))
+        check("fallback.attempt_logged",
+              any(a.get("attempt") == "cpu-fallback"
+                  for a in art.get("attempts", [])),
+              str(art.get("attempts")))
+
+    # --- probe 3: SIGTERM best-so-far emission --------------------------
+    # forced onto the CPU-fallback child: the signal path under test is
+    # the PARENT's handler (platform-independent), and orphaning a TPU
+    # child mid-RPC can wedge the relay (verify SKILL.md)
+    t0 = time.monotonic()
+    art, err = run_bench({"BENCH_TPU_ATTEMPTS": "0",
+                          "BENCH_VARIANTS": "0"}, timeout=600,
+                         sigterm_after=2.0)
+    report["sigterm_s"] = round(time.monotonic() - t0, 1)
+    check("sigterm.emitted", art is not None, err)
+    if art is not None:
+        check("sigterm.attempts", "attempts" in art, "missing")
+
+    report["result"] = "pass" if not failures else "fail"
+    report["failures"] = failures
+    print(json.dumps({"selftest": report}), flush=True)
+    return 0 if not failures else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", action="store_true")
+    ap.add_argument("--selftest", action="store_true")
     ap.add_argument("--n", type=int, default=N)
     ap.add_argument("--ticks", type=int, default=T)
     ap.add_argument("--client-frac", type=float, default=CLIENT_FRAC)
@@ -1009,6 +1182,8 @@ def main() -> int:
     if args.child:
         sys.path.insert(0, REPO)
         return child_main(args)
+    if args.selftest:
+        return selftest_main()
     return parent_main()
 
 

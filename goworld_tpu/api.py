@@ -148,6 +148,17 @@ def _parse_args(argv: list[str]):
     return ap.parse_args(argv)
 
 
+def _grid_caps(gc: config_mod.GameConfig) -> dict:
+    """ini AOI capacity overrides (0 = keep the GridSpec default);
+    re-provisioning target of the aoi_over_* overflow gauges."""
+    caps = {}
+    if gc.aoi_k > 0:
+        caps["k"] = gc.aoi_k
+    if gc.aoi_cell_cap > 0:
+        caps["cell_cap"] = gc.aoi_cell_cap
+    return caps
+
+
 def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
     from goworld_tpu.core.state import WorldConfig
     from goworld_tpu.ops.aoi import GridSpec
@@ -193,13 +204,15 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
             else gc.extent_z,
             sweep_impl=gc.aoi_sweep_impl,
             topk_impl=gc.aoi_topk_impl,
+            **_grid_caps(gc),
         )
         mega_shape = (tx, tz)
     else:
         grid = GridSpec(radius=gc.aoi_radius, extent_x=gc.extent_x,
                         extent_z=gc.extent_z,
                         sweep_impl=gc.aoi_sweep_impl,
-                        topk_impl=gc.aoi_topk_impl)
+                        topk_impl=gc.aoi_topk_impl,
+                        **_grid_caps(gc))
     wc = WorldConfig(
         capacity=gc.capacity,
         grid=grid,

@@ -44,6 +44,13 @@ class GameConfig:
     # approx may miss a true neighbor with ~2% probability on TPU)
     aoi_sweep_impl: str = "table"
     aoi_topk_impl: str = "exact"
+    # AOI capacity bounds (ops/aoi.py GridSpec k / cell_cap): exactness
+    # holds while true neighbor demand <= aoi_k and cell occupancy <=
+    # aoi_cell_cap; overflow degrades to nearest-k and fires the
+    # aoi_over_* opmon gauges. Re-provision from the gauges: aoi_k >
+    # aoi_demand_max, aoi_cell_cap > aoi_cell_max. 0 = library default.
+    aoi_k: int = 0
+    aoi_cell_cap: int = 0
     extent_x: float = 1000.0
     extent_z: float = 1000.0
     mesh_devices: int = 0  # 0 = single-device vmap path (GLOBAL count

@@ -85,6 +85,15 @@ class TickOutputs:
     attr_v: jax.Array    # f32[AC]
     attr_n: jax.Array
     alive_count: jax.Array  # i32
+    # AOI-cap overflow gauges (ops.aoi with_stats; all i32 scalars).
+    # Both zero <=> this tick's sweep was exact — the go-aoi sweep is
+    # exact at any density (Space.go:244-252); capping is the TPU
+    # tradeoff and the host alarms when either gauge fires
+    # (manager._process_outputs).
+    aoi_demand_max: jax.Array     # max true neighbor demand seen
+    aoi_over_k_rows: jax.Array    # rows truncated to nearest-k
+    aoi_cell_max: jax.Array       # max grid-cell occupancy
+    aoi_over_cap_cells: jax.Array  # cells past cell_cap (drop risk)
 
 
 def compute_velocity(
@@ -180,10 +189,11 @@ def tick_body(
     # bit rides the sweep's packed candidate words so sync collection
     # never re-gathers it over [N, k] (r02 TPU profile: that gather cost
     # as much as the sweep itself).
-    nbr, nbr_cnt, nbr_fl = grid_neighbors_flags(
+    nbr, nbr_cnt, nbr_fl, aoi_stats = grid_neighbors_flags(
         cfg.grid, pos, state.alive, watch_radius=state.aoi_radius,
         flag_bits=dirty.astype(jnp.int32)
         | (state.has_client.astype(jnp.int32) << 1),
+        with_stats=True,
     )
 
     # 5. interest deltas -> bounded enter/leave pair lists (changed rows
@@ -224,6 +234,8 @@ def tick_body(
         sync_w=sync_w, sync_j=sync_j, sync_vals=sync_vals, sync_n=sync_n,
         attr_e=attr_e, attr_i=attr_i, attr_v=attr_v, attr_n=attr_n,
         alive_count=state.alive.sum().astype(jnp.int32),
+        aoi_demand_max=aoi_stats[0], aoi_over_k_rows=aoi_stats[1],
+        aoi_cell_max=aoi_stats[2], aoi_over_cap_cells=aoi_stats[3],
     )
     return new_state, outputs
 

@@ -112,6 +112,13 @@ class Entity:
             return self._pending_pos
         if self.slot is None or self.shard is None:
             return (0.0, 0.0, 0.0)
+        # a batched client sync staged this tick is already the entity's
+        # position as far as host logic is concerned (the reference
+        # applies client syncs to the entity immediately,
+        # Entity.go:430-435)
+        v = self.world._peek_batch_pos(self.shard, self.slot)
+        if v is not None:
+            return (float(v[0]), float(v[1]), float(v[2]))
         p = self.world.read_pos(self.shard, self.slot)
         return (float(p[0]), float(p[1]), float(p[2]))
 
@@ -121,6 +128,9 @@ class Entity:
             return self._pending_yaw
         if self.slot is None or self.shard is None:
             return 0.0
+        v = self.world._peek_batch_pos(self.shard, self.slot)
+        if v is not None:
+            return float(v[3])
         return self.world.read_yaw(self.shard, self.slot)
 
     def set_position(self, pos) -> None:

@@ -221,6 +221,18 @@ class World:
         self._staged_moving: list[tuple[int, int, bool]] = []
         self._staged_client: list[tuple[int, int, bool, int]] = []
         self._staged_pos: dict[tuple[int, int], Entity] = {}
+        # upstream (client->server) pos-sync BATCH path: slot-addressed
+        # staging arrays + a lazily rebuilt eid->(shard,slot) intern
+        # index over the client-bound mirror columns, so a decoded
+        # MT_SYNC_POSITION_YAW_FROM_CLIENT batch resolves in one
+        # searchsorted instead of a per-record dict walk (the reference
+        # decodes per record in Go, GameService.go:395-407; at 10K+
+        # clients the Python equivalent becomes the host wall). Lazy
+        # allocation: worlds that never see a client batch pay nothing.
+        self._batch_pos_mask: np.ndarray | None = None
+        self._batch_pos_vals: np.ndarray | None = None
+        self._batch_pos_any = False
+        self._sync_index: tuple | None = None
         # (src_shard, src_slot, dst_shard, eid) — device-migration requests
         self._staged_migrate: list[tuple[int, int, int, str]] = []
         self._migrate_tags: dict[int, tuple[str, int, int]] = {}
@@ -288,6 +300,7 @@ class World:
         self.on_entity_created: Callable[[Entity], None] | None = None
         self.on_entity_destroyed: Callable[[Entity], None] | None = None
         self.op_stats: dict[str, float] = defaultdict(float)
+        self._aoi_alarm_tick = -(1 << 30)  # last AOI-overflow alarm tick
 
     # ==================================================================
     # registration / creation
@@ -514,6 +527,10 @@ class World:
         else:
             self._mir_cid[shard, slot] = b""
             self._mir_gate[shard, slot] = -1
+        # every slot/client mirror write funnels through here (_slot_set,
+        # _slot_clear, _mirror_client): the eid->(shard,slot) intern
+        # index over these columns is now stale
+        self._sync_index = None
 
     def _slot_set(self, shard: int, slot: int, eid: str) -> None:
         self._slot_owner[shard][slot] = eid
@@ -548,6 +565,8 @@ class World:
             x for x in self._staged_client if (x[0], x[1]) != (shard, slot)
         ]
         self._staged_pos.pop((shard, slot), None)
+        if self._batch_pos_mask is not None:
+            self._batch_pos_mask[shard, slot] = False
 
     # ==================================================================
     # space enter / leave / migration
@@ -755,6 +774,76 @@ class World:
     def stage_pos_set(self, e: Entity) -> None:
         if e.slot is not None and e.shard is not None:
             self._staged_pos[(e.shard, e.slot)] = e
+
+    def _sync_pos_index(self) -> tuple:
+        """eid -> (shard, slot) intern index over client-bound live
+        slots, rebuilt lazily after any client (re)bind/unbind or slot
+        change (all of which funnel through ``_write_client_cols``).
+        Built/probed via :func:`ids.build_eid_index` (u64 hash keys with
+        byte-exact verification, raw-S16 fallback on collision). The
+        rebuild is a vectorized argsort over the mirror columns — no
+        per-entity Python even at 1M rows (a few ms, paid only on ticks
+        with client churn)."""
+        if self._sync_index is None:
+            sh, sl = np.nonzero(self._mir_gate >= 0)
+            hashed, keys, sorted_eids, order = ids.build_eid_index(
+                self._mir_eid[sh, sl]
+            )
+            self._sync_index = (
+                hashed,
+                keys,
+                sorted_eids,
+                sh[order].astype(np.int32),
+                sl[order].astype(np.int32),
+            )
+        return self._sync_index
+
+    def stage_pos_sync_batch(self, eids, vals) -> int:
+        """Stage a decoded upstream sync batch (S16 eids[N], f32[N,4]
+        x/y/z/yaw) without touching per-entity Python objects: one
+        searchsorted against the intern index resolves every record to
+        its (shard, slot); records for unknown, client-less or slotless
+        entities are dropped (the reference's ``e == nil || e.client ==
+        nil`` skip, ``GameService.go:395-407`` — a record aimed at an
+        entity mid-migration is likewise dropped; the client re-syncs
+        within 100 ms). Last write wins per slot, both within a batch
+        and across batches in the same tick. Host reads
+        (``Entity.position``/``yaw``) see staged values immediately via
+        ``_peek_batch_pos``; host-side ``set_position`` writes staged
+        the same tick take precedence at flush. Returns #staged."""
+        hashed, keys, sorted_eids, ish, isl = self._sync_pos_index()
+        eids = np.ascontiguousarray(np.asarray(eids, "S16"))
+        if eids.shape[0] == 0 or keys.size == 0:
+            return 0
+        p, ok = ids.probe_eid_index(hashed, keys, sorted_eids, eids)
+        if not ok.any():
+            return 0
+        sh = ish[p[ok]]
+        sl = isl[p[ok]]
+        v = np.asarray(vals, np.float32).reshape(-1, 4)[ok]
+        if self._batch_pos_mask is None:
+            self._batch_pos_mask = np.zeros(
+                (self.n_spaces, self.cfg.capacity), bool
+            )
+            self._batch_pos_vals = np.zeros(
+                (self.n_spaces, self.cfg.capacity, 4), np.float32
+            )
+        # in-batch duplicates: keep the LAST record per slot (wire
+        # arrival order), selected via unique on the reversed keys
+        lin = sh.astype(np.int64) * self.cfg.capacity + sl
+        _, first_of_rev = np.unique(lin[::-1], return_index=True)
+        sel = lin.size - 1 - first_of_rev
+        self._batch_pos_mask[sh[sel], sl[sel]] = True
+        self._batch_pos_vals[sh[sel], sl[sel]] = v[sel]
+        self._batch_pos_any = True
+        return int(sel.size)
+
+    def _peek_batch_pos(self, shard: int, slot: int):
+        """Staged-but-unflushed client sync for a slot (or None)."""
+        if self._batch_pos_any and self._batch_pos_mask is not None \
+                and self._batch_pos_mask[shard, slot]:
+            return self._batch_pos_vals[shard, slot]
+        return None
 
     def set_moving(self, e: Entity, moving: bool) -> None:
         if e.slot is not None and e.shard is not None:
@@ -1122,6 +1211,7 @@ class World:
                 for k, e in self._staged_pos.items()
             ),
             sorted(self._staged_migrate),
+            self._batch_sig(),
         )).encode()
         h = np.uint32(zlib.crc32(sig))
         hs = multihost_utils.process_allgather(h)
@@ -1132,6 +1222,14 @@ class World:
                 "identical World mutations each tick "
                 "(parallel/multihost.py contract)", np.asarray(hs),
             )
+
+    def _batch_sig(self) -> bytes:
+        """Staged-batch-sync content for the SPMD divergence tripwire."""
+        if not self._batch_pos_any or self._batch_pos_mask is None:
+            return b""
+        bsh, bsl = np.nonzero(self._batch_pos_mask)
+        return (bsh.tobytes() + bsl.tobytes()
+                + self._batch_pos_vals[bsh, bsl].tobytes())
 
     def _flush_staging(self):
         cfg = self.cfg
@@ -1345,6 +1443,41 @@ class World:
                 "pos-sync input overflow: %d updates deferred a tick",
                 len(overflow),
             )
+
+        # batched client syncs (stage_pos_sync_batch) fill the remaining
+        # input rows; host-side writes staged this tick shadow a client
+        # record for the same slot (idx duplicates would make the device
+        # scatter order-undefined), and rows that don't fit stay staged
+        # for the next tick
+        if self._batch_pos_any:
+            bm = self._batch_pos_mask
+            if entries:
+                hsh = np.array([k[0] for k, _ in entries], np.int32)
+                hsl = np.array([k[1] for k, _ in entries], np.int32)
+                bm[hsh, hsl] = False
+            bsh, bsl = np.nonzero(bm)
+            deferred = 0
+            if bsh.size:
+                bv = self._batch_pos_vals[bsh, bsl]
+                for shard in np.unique(bsh):
+                    m = np.nonzero(bsh == shard)[0]
+                    room = max(ic - int(counts[shard]), 0)
+                    take = m[:room]
+                    k = take.size
+                    if k:
+                        c0 = int(counts[shard])
+                        idx[shard, c0:c0 + k] = bsl[take]
+                        vals[shard, c0:c0 + k] = bv[take]
+                        counts[shard] = c0 + k
+                        bm[shard, bsl[take]] = False
+                    deferred += m.size - k
+            if deferred:
+                logger.warning(
+                    "pos-sync input overflow: %d client sync records "
+                    "deferred a tick", deferred,
+                )
+            self._batch_pos_any = bool(bm.any())
+
         base = TickInputs(
             pos_sync_idx=jnp.asarray(idx),
             pos_sync_vals=jnp.asarray(vals),
@@ -1529,6 +1662,37 @@ class World:
 
         if self.mesh is not None and self.mega is None:
             self._process_arrivals(outs)
+
+        # AOI-cap overflow gauges (ops.aoi with_stats): live worlds must
+        # never degrade to nearest-k / dropped candidates SILENTLY (the
+        # go-aoi sweep is exact at any density, Space.go:244-252). The
+        # gauges are exposed every tick; the alarm is rate-limited.
+        dem_max = int(np.max(base.aoi_demand_max))
+        over_k = int(np.sum(base.aoi_over_k_rows))
+        cell_max = int(np.max(base.aoi_cell_max))
+        over_cap = int(np.sum(base.aoi_over_cap_cells))
+        opmon.expose("aoi_demand_max", dem_max)
+        opmon.expose("aoi_over_k_rows", over_k)
+        opmon.expose("aoi_cell_max", cell_max)
+        opmon.expose("aoi_over_cap_cells", over_cap)
+        self.op_stats["aoi_demand_max"] = dem_max
+        self.op_stats["aoi_over_k_rows"] = over_k
+        self.op_stats["aoi_cell_max"] = cell_max
+        self.op_stats["aoi_over_cap_cells"] = over_cap
+        if (over_k or over_cap) and \
+                self.tick_count - self._aoi_alarm_tick >= 64:
+            self._aoi_alarm_tick = self.tick_count
+            logger.warning(
+                "AOI cap overflow: %d rows truncated to nearest-%d "
+                "(demand max %d), %d cells past cell_cap=%d (occupancy "
+                "max %d). Interest sets are degraded this tick. "
+                "Re-provision: raise GridSpec.k above the demand max "
+                "and/or cell_cap above the occupancy max (ini "
+                "[gameN] aoi_k / aoi_cell_cap), or shard the hotspot "
+                "(megaspace tiles / more spaces).",
+                over_k, self.cfg.grid.k, dem_max,
+                over_cap, self.cfg.grid.cell_cap, cell_max,
+            )
 
         # release slots whose leave events have now been processed
         for shard, slot, expect in self._release_now:

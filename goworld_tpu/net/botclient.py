@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import asyncio
 import random
+import time
 
 from goworld_tpu.net import codec, proto
 from goworld_tpu.net.packet import (
@@ -27,6 +28,87 @@ from goworld_tpu.net.packet import (
 from goworld_tpu.utils import log
 
 logger = log.get("bot")
+
+
+class BotProfiler:
+    """Client-side per-second op profiler (reference
+    ``examples/test_client/profile.go:20-52``): every op records into the
+    current 1-second window; a reporter task prints count / avg / max per
+    op each second and folds the window into a cumulative table readable
+    at the end (``summary()``). One instance is shared by a whole swarm —
+    the reference's profiler is likewise process-global across its bot
+    goroutines."""
+
+    def __init__(self, interval: float = 1.0):
+        self.interval = interval
+        self._window: dict[str, list] = {}   # op -> [count, total, max]
+        self._total: dict[str, list] = {}
+        self.lines: list[str] = []           # printed per-second reports
+
+    def record(self, op: str, seconds: float) -> None:
+        for table in (self._window, self._total):
+            row = table.get(op)
+            if row is None:
+                row = table[op] = [0, 0.0, 0.0]
+            row[0] += 1
+            row[1] += seconds
+            if seconds > row[2]:
+                row[2] = seconds
+
+    def op(self, name: str):
+        """``with profiler.op("sync"): ...`` timing context."""
+        return _ProfOp(self, name)
+
+    def flush(self) -> str | None:
+        """Format + reset the current window (one per-second report)."""
+        if not self._window:
+            return None
+        parts = [
+            f"{op}: {c}x avg {t / c * 1e3:.2f}ms max {m * 1e3:.2f}ms"
+            for op, (c, t, m) in sorted(self._window.items())
+        ]
+        self._window = {}
+        line = " | ".join(parts)
+        self.lines.append(line)
+        return line
+
+    async def reporter(self) -> None:
+        """Per-second print loop; run as a task, cancel to stop."""
+        try:
+            while True:
+                await asyncio.sleep(self.interval)
+                line = self.flush()
+                if line:
+                    logger.info("bot profile: %s", line)
+        except asyncio.CancelledError:
+            line = self.flush()
+            if line:
+                logger.info("bot profile: %s", line)
+
+    def summary(self) -> dict[str, dict[str, float]]:
+        return {
+            op: {
+                "count": c,
+                "avg_ms": t / c * 1e3 if c else 0.0,
+                "max_ms": m * 1e3,
+            }
+            for op, (c, t, m) in sorted(self._total.items())
+        }
+
+
+class _ProfOp:
+    __slots__ = ("_p", "_name", "_t0")
+
+    def __init__(self, p: BotProfiler, name: str):
+        self._p = p
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._p.record(self._name, time.perf_counter() - self._t0)
 
 
 class MirrorEntity:
@@ -124,7 +206,8 @@ class BotClient:
                  speed: float = 5.0, seed: int | None = None,
                  ws: bool = False, kcp: bool = False,
                  compress: bool = False, tls: bool = False,
-                 nosync: bool = False):
+                 nosync: bool = False,
+                 profiler: BotProfiler | None = None):
         self.host = host
         self.port = port
         self.ws = ws
@@ -146,6 +229,7 @@ class BotClient:
         self.rpc_log: list[tuple[str, str, list]] = []
         self.sync_count = 0
         self.errors: list[str] = []
+        self.profiler = profiler
         self._stop = False
 
     # ------------------------------------------------------------------
@@ -200,7 +284,24 @@ class BotClient:
                 asyncio.CancelledError):
             pass
 
+    # op names for the per-second profiler, keyed by msgtype
+    _PROF_OPS = {
+        proto.MT_CREATE_ENTITY_ON_CLIENT: "create_entity",
+        proto.MT_DESTROY_ENTITY_ON_CLIENT: "destroy_entity",
+        proto.MT_NOTIFY_ATTR_CHANGE_ON_CLIENT: "attr_change",
+        proto.MT_CALL_ENTITY_METHOD_ON_CLIENT: "rpc_on_client",
+        proto.MT_CLIENT_SYNC_POSITION_YAW: "sync_batch",
+    }
+
     def _handle(self, msgtype: int, pkt: Packet) -> None:
+        if self.profiler is not None:
+            op = self._PROF_OPS.get(msgtype, f"msgtype_{msgtype}")
+            with self.profiler.op(op):
+                self._handle_inner(msgtype, pkt)
+            return
+        self._handle_inner(msgtype, pkt)
+
+    def _handle_inner(self, msgtype: int, pkt: Packet) -> None:
         if msgtype == proto.MT_CREATE_ENTITY_ON_CLIENT:
             eid = pkt.read_entity_id()
             type_name = pkt.read_var_str()
@@ -293,6 +394,8 @@ class BotClient:
             codec.encode_sync_batch([self.player.eid], [[x, y, z, yaw]])
         )
         self.conn.send(p)
+        if self.profiler is not None:
+            self.profiler.record("send_position", 0.0)
 
     def call_server(self, method: str, *args) -> None:
         """Client->server RPC on the player entity."""
@@ -312,14 +415,30 @@ class BotClient:
 async def run_swarm(host: str, port: int, n_bots: int, duration: float,
                     *, strict: bool = True, compress: bool = False,
                     tls: bool = False, kcp: bool = False,
-                    nosync: bool = False) -> list[BotClient]:
+                    nosync: bool = False,
+                    profile: bool = False) -> list[BotClient]:
     """Run N bots concurrently (reference ``test_client -N``; mirrors
     the ``-strict``/``-kcp``/``-nosync`` flags; per-bot ``ws`` is a
-    BotClient option)."""
+    BotClient option). ``profile=True`` shares one :class:`BotProfiler`
+    across the swarm with a per-second report task (the reference's
+    ``profile.go`` loop); read ``bots[0].profiler.summary()`` after."""
+    profiler = BotProfiler() if profile else None
     bots = [
         BotClient(host, port, bot_id=i, strict=strict, compress=compress,
-                  tls=tls, kcp=kcp, nosync=nosync)
+                  tls=tls, kcp=kcp, nosync=nosync, profiler=profiler)
         for i in range(n_bots)
     ]
-    await asyncio.gather(*(b.run(duration) for b in bots))
+    rep = (
+        asyncio.ensure_future(profiler.reporter())
+        if profiler is not None else None
+    )
+    try:
+        await asyncio.gather(*(b.run(duration) for b in bots))
+    finally:
+        if rep is not None:
+            rep.cancel()
+            try:
+                await rep
+            except asyncio.CancelledError:
+                pass
     return bots

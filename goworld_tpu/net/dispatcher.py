@@ -21,23 +21,51 @@ import asyncio
 import time
 from collections import deque
 
+import numpy as np
+
 from goworld_tpu.net import proto
 from goworld_tpu.net.packet import Packet, PacketConnection, new_packet
-from goworld_tpu.utils import consts, log
+from goworld_tpu.utils import consts, ids, log
 
 logger = log.get("dispatcher")
+
+# one 32-byte upstream sync record: 16-char eid + x/y/z/yaw f32 payload,
+# kept opaque ("V16") — the dispatcher routes, it never interprets
+_SYNC_REC_DTYPE = np.dtype([("eid", "S16"), ("v", "V16")])
+
+
+# bumped on ANY change to entity routing (eid->game assignment or table
+# membership, in any dispatcher instance): the vectorized upstream-sync
+# route index (see _h_sync_upstream) caches against it. Module-global so
+# _EntityDispatchInfo's setter can bump it without a dispatcher backref;
+# a bump in one instance merely costs the others one lazy rebuild.
+_route_version = 0
+
+
+def _bump_route_version() -> None:
+    global _route_version
+    _route_version += 1
 
 
 class _EntityDispatchInfo:
     """Per-entity routing record (reference ``entityDispatchInfo``,
     ``DispatcherService.go:28-77``)."""
 
-    __slots__ = ("game_id", "block_until", "pending")
+    __slots__ = ("_game_id", "block_until", "pending")
 
     def __init__(self):
-        self.game_id = 0
+        self._game_id = 0
         self.block_until = 0.0
         self.pending: deque[Packet] = deque()
+
+    @property
+    def game_id(self) -> int:
+        return self._game_id
+
+    @game_id.setter
+    def game_id(self, v: int) -> None:
+        self._game_id = v
+        _bump_route_version()
 
     @property
     def blocked(self) -> bool:
@@ -103,6 +131,14 @@ class DispatcherService:
         # per-game re-batched upstream sync records, flushed on a short
         # timer like the reference's 5ms tick (DispatcherService.go:797-808)
         self._sync_pending: dict[int, bytearray] = {}
+        # vectorized upstream-sync routing: (version, sorted S16 eids,
+        # aligned i32 game_ids), rebuilt lazily when _route_version moves
+        self._route_cache: tuple | None = None
+        # eid(bytes) -> block_until deadline, maintained at the block/
+        # unblock sites so the vectorized path can drop blocked records
+        # (the reference's per-record `blocked` skip, :770-795) without
+        # touching per-entity Python
+        self._blocked_until: dict[bytes, float] = {}
         self.open_conns: set[PacketConnection] = set()
         self.started = asyncio.Event()
 
@@ -328,6 +364,7 @@ class DispatcherService:
             gi.send(pkt, release=False)
 
     def _unblock_entity(self, eid: str) -> None:
+        self._blocked_until.pop(eid.encode("ascii"), None)
         info = self.entities.get(eid)
         if info is None:
             return
@@ -352,7 +389,8 @@ class DispatcherService:
 
     def _h_destroy_entity(self, conn, role, msgtype, pkt: Packet) -> None:
         eid = pkt.read_entity_id()
-        self.entities.pop(eid, None)
+        if self.entities.pop(eid, None) is not None:
+            _bump_route_version()
 
     def _choose_game(self, boot: bool = False) -> _GameInfo | None:
         """Load-balanced placement (reference ``chooseGame`` min-CPU heap
@@ -403,6 +441,7 @@ class DispatcherService:
             return
         info.game_id = gi.game_id
         info.block(consts.LOAD_TIMEOUT)
+        self._blocked_until[eid.encode("ascii")] = info.block_until
         pkt.rpos = 2
         gi.send(pkt, release=False)
 
@@ -427,21 +466,64 @@ class DispatcherService:
             for gi in self.games.values():
                 gi.send(Packet(bytes(pkt.buf)), release=False)
 
+    def _route_index(self) -> tuple[bool, np.ndarray, np.ndarray,
+                                    np.ndarray]:
+        """(hashed?, sorted keys, aligned S16 eids, aligned i32
+        game_ids) over the routing table, cached against
+        ``_route_version`` and rebuilt lazily on the first sync batch
+        after any routing change. Built/probed via
+        :func:`ids.build_eid_index` (u64 hash keys with byte-exact
+        verification, raw-S16 fallback on collision). Rebuild is
+        O(E log E) vectorized — paid per routing churn, not per record."""
+        ver = _route_version
+        if self._route_cache is None or self._route_cache[0] != ver:
+            eids = np.array(list(self.entities.keys()), dtype="S16") \
+                if self.entities else np.empty(0, "S16")
+            games = np.fromiter(
+                (i.game_id for i in self.entities.values()),
+                np.int32, count=len(self.entities),
+            )
+            hashed, keys, sorted_eids, order = ids.build_eid_index(eids)
+            self._route_cache = (ver, hashed, keys, sorted_eids,
+                                 games[order])
+        return self._route_cache[1:]
+
     def _h_sync_upstream(self, conn, role, msgtype, pkt: Packet) -> None:
         """Split a gate's 32B-record batch by eid->game and re-batch per
-        game (reference ``handleSyncPositionYawFromClient`` ``:770-795``)."""
+        game (reference ``handleSyncPositionYawFromClient`` ``:770-795``)
+        — vectorized: one searchsorted against the cached route index
+        routes the whole batch; unroutable and blocked records drop, as
+        in the reference's per-record skip."""
         buf = memoryview(pkt.buf)[pkt.rpos:]
-        for off in range(0, len(buf), proto.SYNC_RECORD_SIZE):
-            rec = buf[off:off + proto.SYNC_RECORD_SIZE]
-            if len(rec) < proto.SYNC_RECORD_SIZE:
-                break
-            eid = bytes(rec[:16]).decode("ascii", "replace")
-            info = self.entities.get(eid)
-            if info is None or info.game_id == 0 or info.blocked:
+        nrec = len(buf) // proto.SYNC_RECORD_SIZE
+        if nrec == 0:
+            return
+        rec = np.frombuffer(
+            buf[: nrec * proto.SYNC_RECORD_SIZE], dtype=_SYNC_REC_DTYPE
+        )
+        hashed, keys, sorted_eids, games = self._route_index()
+        if keys.size == 0:
+            return
+        eids = rec["eid"]
+        p, ok = ids.probe_eid_index(hashed, keys, sorted_eids, eids)
+        gm = np.where(ok, games[p], 0)
+        if self._blocked_until:
+            now = time.monotonic()
+            for k in [k for k, t in self._blocked_until.items()
+                      if t <= now]:
+                del self._blocked_until[k]
+            if self._blocked_until:
+                gm = np.where(
+                    np.isin(eids, np.array(list(self._blocked_until),
+                                           dtype="S16")),
+                    0, gm,
+                )
+        for g in np.unique(gm):
+            if g == 0:
                 continue
-            self._sync_pending.setdefault(
-                info.game_id, bytearray()
-            ).extend(rec)
+            self._sync_pending.setdefault(int(g), bytearray()).extend(
+                rec[gm == g].tobytes()
+            )
 
     def _h_sync_downstream(self, conn, role, msgtype, pkt: Packet) -> None:
         """Game -> gate leg: the packet is [gate_id][48B records...]
@@ -495,7 +577,9 @@ class DispatcherService:
         eid = pkt.read_entity_id()
         space_id = pkt.read_entity_id()
         space_game = pkt.read_u16()
-        self._entity_info(eid).block(consts.MIGRATE_TIMEOUT)
+        info = self._entity_info(eid)
+        info.block(consts.MIGRATE_TIMEOUT)
+        self._blocked_until[eid.encode("ascii")] = info.block_until
         ack = new_packet(proto.MT_MIGRATE_REQUEST_ACK)
         ack.append_entity_id(eid)
         ack.append_entity_id(space_id)
@@ -553,6 +637,8 @@ class DispatcherService:
                 ]
                 for eid in stale:
                     del self.entities[eid]
+                if stale:
+                    _bump_route_version()
                 p = new_packet(proto.MT_NOTIFY_GAME_DISCONNECTED)
                 p.append_u16(rid)
                 self._broadcast_to_games(p, exclude=rid)

@@ -125,6 +125,7 @@ class GameServer:
         self.on_deployment_ready: Callable[[], None] | None = None
         # multihost World-mutation log (see _MH_WORLD_MSGTYPES)
         self._mh_pending: list[tuple[int, bytes]] = []
+        self._mh_backlog_ticks = 0  # consecutive ticks with carry-over
         self._mh_replaying = False
         self._mh_all_ready = False       # allgathered group readiness
         self._mh_leader_game_id = self.game_id  # allgathered, row 0
@@ -282,6 +283,30 @@ class GameServer:
             blob += payload
             taken += 1
         del self._mh_pending[:taken]
+        # backlog observability (VERDICT r3 #6): the ordered carry-over
+        # keeps correctness under overflow, but a backlog that GROWS
+        # tick over tick means the cluster plane produces mutations
+        # faster than 1 MB/controller/tick forever — surfaced as gauges
+        # (debug_http /vars) + a rate-limited alarm, never silently
+        backlog_b = sum(6 + len(p) for _, p in self._mh_pending)
+        opmon.expose("mh_mutation_backlog_packets", len(self._mh_pending))
+        opmon.expose("mh_mutation_backlog_bytes", backlog_b)
+        self.world.op_stats["mh_mutation_backlog_bytes"] = backlog_b
+        if self._mh_pending:
+            self._mh_backlog_ticks += 1
+            if self._mh_backlog_ticks >= 8 \
+                    and self._mh_backlog_ticks % 64 == 8:
+                logger.warning(
+                    "game%d: multihost mutation backlog sustained for "
+                    "%d ticks (%d packets / %d bytes queued): the "
+                    "cluster plane outruns MH_LOG_BYTES_PER_TICK "
+                    "(%d B/tick) — shed load or raise the cap",
+                    self.game_id, self._mh_backlog_ticks,
+                    len(self._mh_pending), backlog_b,
+                    self.MH_LOG_BYTES_PER_TICK,
+                )
+        else:
+            self._mh_backlog_ticks = 0
         return blob
 
     def _mh_exchange_mutations(self) -> None:
@@ -673,13 +698,11 @@ class GameServer:
             eids, vals = codec.decode_sync_batch(
                 memoryview(pkt.buf)[pkt.rpos:]
             )
-            for eid_b, v in zip(eids, vals):
-                e = w.entities.get(eid_b.decode("ascii", "replace"))
-                if e is None or e.client is None:
-                    continue
-                e._pending_pos = (float(v[0]), float(v[1]), float(v[2]))
-                e._pending_yaw = float(v[3])
-                w.stage_pos_set(e)
+            # vectorized: one searchsorted resolves the whole batch to
+            # (shard, slot) rows; no per-record Python (the host wall at
+            # 10K+ clients — reference decodes per record in Go,
+            # GameService.go:395-407)
+            w.stage_pos_sync_batch(eids, vals)
             return
         if msgtype == proto.MT_CREATE_ENTITY_ANYWHERE:
             pkt.read_u16()  # routing gameid (consumed by the dispatcher)

@@ -249,7 +249,8 @@ def _sweep(
     query_rows: int | None,
     watch_radius: jax.Array | None,
     flag_bits: jax.Array | None,
-) -> tuple[jax.Array, jax.Array, jax.Array | None]:
+    with_stats: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array | None, tuple | None]:
     n = pos.shape[0]
     q = n if query_rows is None else query_rows
     k = spec.k
@@ -261,6 +262,16 @@ def _sweep(
     cx, cz, srow, alive, czp, n_rows = _cell_rows(
         spec, pos, alive, watch_radius
     )
+    if with_stats:
+        # per-cell occupancy vs cell_cap (overflow = members dropped
+        # from candidate pools; the go-aoi sweep is exact at any
+        # density, Space.go:244-252 — capping is the TPU tradeoff and
+        # must NEVER degrade silently). One [N] scatter-add.
+        occ = jnp.zeros(n_rows + 1, jnp.int32).at[srow].add(
+            1, mode="drop"
+        )[:n_rows]
+        cell_max = occ.max().astype(jnp.int32)
+        over_cap_cells = (occ > cc).sum().astype(jnp.int32)
     order, sorted_row = _sort_cells(n, n_rows, srow)
     src, table_sentinel, sentinel_bits = _sorted_src(
         spec, pos, flag_bits, order
@@ -399,7 +410,10 @@ def _sweep(
                     jnp.where(ok, top & _ID_MASK, sentinel), axis=1
                 )
                 fl_b = None
-            return nbr_b, ok.sum(axis=1).astype(jnp.int32), fl_b
+            dem_b = (
+                valid.sum(axis=1).astype(jnp.int32) if with_stats else None
+            )
+            return nbr_b, ok.sum(axis=1).astype(jnp.int32), fl_b, dem_b
 
         valid = (
             (cand_w != sentinel)
@@ -421,7 +435,8 @@ def _sweep(
                 nbr_b == sentinel, 0,
                 flag_bits[nbr_c].astype(jnp.int32) & 3,
             )
-        return nbr_b, ok.sum(axis=1).astype(jnp.int32), fl_b
+        dem_b = valid.sum(axis=1).astype(jnp.int32) if with_stats else None
+        return nbr_b, ok.sum(axis=1).astype(jnp.int32), fl_b, dem_b
 
     # never let the block exceed the query count: a small space with the
     # default row_block would otherwise pad up to a full block and do
@@ -432,16 +447,31 @@ def _sweep(
     all_rows = jnp.minimum(jnp.arange(padded, dtype=jnp.int32), q - 1)
     blocks = all_rows.reshape(nblocks, rb)
     if nblocks == 1:
-        nbr, cnt, fl = row_block(blocks[0])
+        nbr, cnt, fl, dem = row_block(blocks[0])
     else:
-        nbr, cnt, fl = lax.map(row_block, blocks)
+        nbr, cnt, fl, dem = lax.map(row_block, blocks)
         nbr = nbr.reshape(padded, k)
         cnt = cnt.reshape(padded)
         if fl is not None:
             fl = fl.reshape(padded, k)
+        if dem is not None:
+            dem = dem.reshape(padded)
     if fl is not None:
         fl = fl[:q]
-    return nbr[:q], cnt[:q], fl
+    stats = None
+    if with_stats:
+        dem = dem[:q]
+        # demand is measured WITHIN the candidate pool: if cells
+        # overflowed (over_cap_cells > 0) it is itself a lower bound —
+        # but then the cell gauge already fires, so "both gauges zero"
+        # still proves the sweep was exact this tick
+        stats = (
+            dem.max().astype(jnp.int32),              # aoi_demand_max
+            (dem > k).sum().astype(jnp.int32),        # aoi_over_k_rows
+            cell_max,                                 # aoi_cell_max
+            over_cap_cells,                           # aoi_over_cap_cells
+        )
+    return nbr[:q], cnt[:q], fl, stats
 
 
 @partial(jax.jit, static_argnums=(0, 3))
@@ -475,11 +505,12 @@ def grid_neighbors(
       nbr: int32[Q, k] neighbor slot ids, ascending, padded with sentinel N.
       cnt: int32[Q] number of valid neighbors per row. (Q = query_rows or N)
     """
-    nbr, cnt, _ = _sweep(spec, pos, alive, query_rows, watch_radius, None)
+    nbr, cnt, _, _ = _sweep(spec, pos, alive, query_rows, watch_radius,
+                            None)
     return nbr, cnt
 
 
-@partial(jax.jit, static_argnums=(0, 3))
+@partial(jax.jit, static_argnums=(0, 3, 6))
 def grid_neighbors_flags(
     spec: GridSpec,
     pos: jax.Array,
@@ -487,7 +518,8 @@ def grid_neighbors_flags(
     query_rows: int | None = None,
     watch_radius: jax.Array | None = None,
     flag_bits: jax.Array | None = None,
-) -> tuple[jax.Array, jax.Array, jax.Array]:
+    with_stats: bool = False,
+) -> tuple:
     """:func:`grid_neighbors` plus per-neighbor flag propagation.
 
     ``flag_bits`` is int32/uint32[N] with 2 meaningful low bits per entity
@@ -497,12 +529,22 @@ def grid_neighbors_flags(
     nothing on the packed fast path (n < 2^21) — the bits ride the packed
     candidate words through top_k — and one bounded [Q, k] gather on the
     wide-id fallback.
+
+    ``with_stats=True`` additionally returns 4 i32 scalars
+    ``(demand_max, over_k_rows, cell_max, over_cap_cells)`` — true
+    neighbor demand vs ``k`` and cell occupancy vs ``cell_cap``, the
+    AOI-cap overflow gauges (both zero <=> this tick's sweep was exact;
+    see GridSpec's capacity-bounds note). Cost: one [N] scatter-add and
+    a few reductions.
     """
     if flag_bits is None:
         raise ValueError("grid_neighbors_flags requires flag_bits")
-    nbr, cnt, fl = _sweep(
-        spec, pos, alive, query_rows, watch_radius, flag_bits
+    nbr, cnt, fl, stats = _sweep(
+        spec, pos, alive, query_rows, watch_radius, flag_bits,
+        with_stats=with_stats,
     )
+    if with_stats:
+        return nbr, cnt, fl, stats
     return nbr, cnt, fl
 
 

@@ -287,11 +287,12 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
             state.has_client,
             jnp.zeros((ghost_rows,), bool),
         ])
-        nbr_ext, nbr_cnt, nbr_fl = grid_neighbors_flags(
+        nbr_ext, nbr_cnt, nbr_fl, aoi_stats = grid_neighbors_flags(
             cfg.grid, pos_ext - shift, alive_ext, query_rows=n,
             watch_radius=wr_ext,
             flag_bits=dirty_ext.astype(jnp.int32)
             | (hc_ext.astype(jnp.int32) << 1),
+            with_stats=True,
         )
 
         # 5. neighbor features for next tick's MLP observation (computed
@@ -359,6 +360,10 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
                 sync_n=sync_n,
                 attr_e=attr_e, attr_i=attr_i, attr_v=attr_v, attr_n=attr_n,
                 alive_count=state.alive.sum().astype(jnp.int32),
+                aoi_demand_max=aoi_stats[0],
+                aoi_over_k_rows=aoi_stats[1],
+                aoi_cell_max=aoi_stats[2],
+                aoi_over_cap_cells=aoi_stats[3],
             ),
             arr_tag=arr_tag, arr_slot=arr_slot, arr_n=arr_n,
             migrate_dropped=dropped,

@@ -65,6 +65,72 @@ def nil_space_id(game_id: int) -> str:
     return gen_fixed_id(f"goworld_tpu.nilspace.{game_id}")
 
 
+def eid_hash64(eids) -> "np.ndarray":
+    """Vectorized 64-bit hash of an S16 EntityID array.
+
+    The batched sync decoders (``World.stage_pos_sync_batch``,
+    ``DispatcherService._h_sync_upstream``) key their intern indexes on
+    this instead of the raw S16 bytes: ``searchsorted`` over u64 is ~4x
+    cheaper than over S16 (one integer compare vs a memcmp per probe).
+    Splitmix64-style mix of the two 8-byte halves. Collisions are handled
+    by the callers (exact-match verify on candidates; index falls back to
+    raw-byte keys if two LIVE ids ever collide — ~1e-7 at 1M ids).
+    """
+    import numpy as np
+
+    a = np.ascontiguousarray(np.asarray(eids, "S16"))
+    h = a.view(np.uint64).reshape(-1, 2)
+    return (
+        (h[:, 0] ^ (h[:, 0] >> np.uint64(31)))
+        * np.uint64(0x9E3779B97F4A7C15)
+    ) ^ (h[:, 1] + np.uint64(0xD1B54A32D192ED03))
+
+
+def build_eid_index(eids) -> tuple:
+    """Build a sorted lookup index over an S16 EntityID array.
+
+    Returns ``(hashed, keys, sorted_eids, order)``: ``keys`` is sorted
+    :func:`eid_hash64` values (fast u64 probes) unless two input ids
+    hash-collide, in which case it falls back to the raw S16 bytes
+    (``hashed=False``); ``sorted_eids``/``order`` align the inputs with
+    ``keys`` so callers can permute their payload columns. Shared by the
+    two vectorized sync decoders (game leg ``World._sync_pos_index``,
+    router leg ``DispatcherService._route_index``) so the collision
+    fallback and verify logic live in exactly one place.
+    """
+    import numpy as np
+
+    eids = np.ascontiguousarray(np.asarray(eids, "S16"))
+    keys = eid_hash64(eids)
+    order = np.argsort(keys, kind="stable")
+    keys = keys[order]
+    hashed = True
+    if keys.size and (keys[1:] == keys[:-1]).any():
+        order = np.argsort(eids, kind="stable")
+        keys = eids[order]
+        hashed = False
+    return hashed, keys, eids[order], order
+
+
+def probe_eid_index(hashed: bool, keys, sorted_eids, query_eids) -> tuple:
+    """Resolve S16 ``query_eids`` against a :func:`build_eid_index`.
+
+    Returns ``(p, ok)``: candidate positions into the sorted index and
+    the exact-match mask (hash candidates are byte-verified here, so a
+    hash false positive can never resolve; ~1e-19/record with 64-bit
+    keys, and zero once the build fell back to raw bytes).
+    """
+    import numpy as np
+
+    query_eids = np.ascontiguousarray(np.asarray(query_eids, "S16"))
+    probe = eid_hash64(query_eids) if hashed else query_eids
+    p = np.minimum(np.searchsorted(keys, probe), keys.size - 1)
+    ok = keys[p] == probe
+    if hashed:
+        ok &= sorted_eids[p] == query_eids
+    return p, ok
+
+
 def is_valid_entity_id(eid: str) -> bool:
     if not isinstance(eid, str) or len(eid) != ENTITYID_LENGTH:
         return False

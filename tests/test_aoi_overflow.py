@@ -1,0 +1,152 @@
+"""AOI cap-overflow observability (VERDICT r3 #5).
+
+The reference go-aoi sweep is exact at any density (``Space.go:244-252``);
+the TPU grid sweep's ``k``/``cell_cap`` bounds degrade to nearest-k under
+overflow — which must NEVER happen silently. These tests pin the device
+gauges (``ops.aoi`` ``with_stats``), the World's opmon exposure + alarm,
+and recovery: a mass teleport into one cell fires the alarm that tick and
+interest is exact again the tick after the crowd disperses.
+
+Gauge semantics under test: ``demand`` is measured within the candidate
+pool, so when cells overflow it is a lower bound — but then
+``over_cap_cells`` fires instead (occupancy comes from an unclipped
+bincount). "Both gauges zero" <=> the sweep was exact; there is no silent
+case.
+"""
+
+import logging
+
+import numpy as np
+import pytest
+
+from goworld_tpu.core.state import WorldConfig
+from goworld_tpu.entity.entity import Entity
+from goworld_tpu.entity.manager import World
+from goworld_tpu.entity.space import Space
+from goworld_tpu.ops.aoi import GridSpec, grid_neighbors_flags
+from goworld_tpu.utils import opmon
+
+
+class Npc(Entity):
+    pass
+
+
+class Arena(Space):
+    pass
+
+
+def _stats(spec, pos, alive=None):
+    import jax.numpy as jnp
+
+    n = pos.shape[0]
+    alive = np.ones(n, bool) if alive is None else alive
+    _, cnt, _, stats = grid_neighbors_flags(
+        spec, jnp.asarray(np.asarray(pos, np.float32)),
+        jnp.asarray(alive),
+        flag_bits=jnp.zeros(n, jnp.int32), with_stats=True,
+    )
+    return int(cnt.max()), tuple(map(int, stats))
+
+
+@pytest.mark.parametrize("sweep_impl", ["table", "ranges"])
+def test_k_overflow_gauges(sweep_impl):
+    """Cells hold everyone (cell_cap=8 >= 6) but k=4 < demand 5: every
+    clustered row reports truncation."""
+    spec = GridSpec(radius=10.0, extent_x=100.0, extent_z=100.0,
+                    k=4, cell_cap=8, row_block=64, sweep_impl=sweep_impl)
+    pos = np.array(
+        [[5.0 + 0.1 * i, 0.0, 5.0] for i in range(6)]
+        + [[85.0, 0.0, 85.0], [55.0, 0.0, 15.0]],
+        np.float32,
+    )
+    cnt_max, (demand_max, over_k, cell_max, over_cap) = _stats(spec, pos)
+    assert demand_max == 5          # each clustered row sees 5 others
+    assert over_k == 6              # all six truncated to nearest-4
+    assert cell_max == 6
+    assert over_cap == 0
+    assert cnt_max == 4             # lists really were capped at k
+
+
+@pytest.mark.parametrize("sweep_impl", ["table", "ranges"])
+def test_cell_overflow_gauges(sweep_impl):
+    """cell_cap=4 < occupancy 6: the cell gauge fires even where the
+    pool-clipped demand cannot exceed k (the lower-bound case the
+    module docstring documents)."""
+    spec = GridSpec(radius=10.0, extent_x=100.0, extent_z=100.0,
+                    k=4, cell_cap=4, row_block=64, sweep_impl=sweep_impl)
+    pos = np.array(
+        [[5.0 + 0.1 * i, 0.0, 5.0] for i in range(6)]
+        + [[85.0, 0.0, 85.0], [55.0, 0.0, 15.0]],
+        np.float32,
+    )
+    _, (_, _, cell_max, over_cap) = _stats(spec, pos)
+    assert cell_max == 6            # occupancy bincount is UNclipped
+    assert over_cap == 1
+
+
+@pytest.mark.parametrize("sweep_impl", ["table", "ranges"])
+def test_exact_tick_reports_all_zero(sweep_impl):
+    spec = GridSpec(radius=10.0, extent_x=100.0, extent_z=100.0,
+                    k=4, cell_cap=4, row_block=64, sweep_impl=sweep_impl)
+    spread = np.array(
+        [[5.0 + 11.0 * i, 0.0, 5.0 + 9.0 * (i % 7)] for i in range(8)],
+        np.float32,
+    )
+    _, (_, over_k, _, over_cap) = _stats(spec, spread)
+    assert over_k == 0 and over_cap == 0
+
+
+def test_mass_teleport_alarms_and_recovers(caplog):
+    """~10K entities teleported into ONE cell: the overflow alarm fires
+    that same tick (cell gauge + log with re-provisioning guidance), and
+    after dispersing the gauges are zero again with exact interest."""
+    n = 10_000
+    cap = 16384
+    cfg = WorldConfig(
+        capacity=cap,
+        # k=16 / cell_cap=8: zero gauges at the spread density (~0.7
+        # entities per 10x10 cell), unmistakable overflow when 10K land
+        # in one cell
+        grid=GridSpec(radius=10.0, extent_x=1200.0, extent_z=1200.0,
+                      k=16, cell_cap=8, row_block=cap),
+        npc_speed=0.0, turn_prob=0.0,
+        enter_cap=131072, leave_cap=131072, sync_cap=4096,
+        input_cap=cap,
+    )
+    w = World(cfg, n_spaces=1)
+    w.register_entity("Npc", Npc)
+    w.register_space("Arena", Arena)
+    w.create_nil_space()
+    arena = w.create_space("Arena")
+    rng = np.random.default_rng(11)
+    home = rng.uniform(20, 1180, (n, 2)).astype(np.float32)
+    ents = [
+        w.create_entity("Npc", space=arena,
+                        pos=(float(home[i, 0]), 0.0, float(home[i, 1])))
+        for i in range(n)
+    ]
+    w.tick()
+    assert w.op_stats["aoi_over_cap_cells"] == 0
+    assert w.op_stats["aoi_over_k_rows"] == 0
+
+    for e in ents:  # the mass teleport: everyone into one cell
+        e.set_position((605.0, 0.0, 605.0))
+    with caplog.at_level(logging.WARNING):
+        w.tick()
+    assert w.op_stats["aoi_over_cap_cells"] >= 1
+    assert w.op_stats["aoi_cell_max"] == n  # occupancy gauge is exact
+    assert opmon.vars()["aoi_over_cap_cells"] >= 1
+    assert any("AOI cap overflow" in r.message for r in caplog.records)
+    assert any("aoi_k" in r.message for r in caplog.records)  # guidance
+
+    for i, e in enumerate(ents):  # disperse back home
+        e.set_position((float(home[i, 0]), 0.0, float(home[i, 1])))
+    w.tick()
+    assert w.op_stats["aoi_over_cap_cells"] == 0
+    assert w.op_stats["aoi_over_k_rows"] == 0
+    # interest is exact again: a probe pair within radius sees each other
+    a = w.create_entity("Npc", space=arena, pos=(300.0, 0.0, 300.0))
+    b = w.create_entity("Npc", space=arena, pos=(303.0, 0.0, 303.0))
+    w.tick()
+    assert b.id in a.interested_in
+    assert a.id in b.interested_in

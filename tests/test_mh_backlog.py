@@ -1,0 +1,55 @@
+"""Multihost mutation-log backpressure observability (VERDICT r3 #6).
+
+``GameServer._mh_drain_pending`` ships at most MH_LOG_BYTES_PER_TICK of
+queued World mutations per tick and carries the surplus over IN ORDER
+(r3 backpressure). These tests pin the new gauges: backlog bytes/packets
+exposed every drain, and the sustained-growth alarm after 8 consecutive
+carry-over ticks. Driven directly on a stub (the logic touches nothing
+but the queue + counters), so no multihost process pair is needed.
+"""
+
+import logging
+
+from goworld_tpu.net.game import GameServer
+from goworld_tpu.utils import opmon
+
+
+class _Stub:
+    MH_LOG_BYTES_PER_TICK = GameServer.MH_LOG_BYTES_PER_TICK
+    _mh_drain_pending = GameServer._mh_drain_pending
+
+    def __init__(self):
+        self.game_id = 1
+        self._mh_pending = []
+        self._mh_backlog_ticks = 0
+        self.world = type("W", (), {"op_stats": {}})()
+
+
+def test_drain_orders_and_reports_backlog():
+    s = _Stub()
+    big = b"x" * (600 << 10)  # 600 KB each: only one fits per tick
+    s._mh_pending = [(10, big), (11, big), (12, b"small")]
+    blob = s._mh_drain_pending()
+    assert blob[:2] == (10).to_bytes(2, "little")  # order preserved
+    assert len(s._mh_pending) == 2                 # carry-over intact
+    assert opmon.vars()["mh_mutation_backlog_packets"] == 2
+    assert opmon.vars()["mh_mutation_backlog_bytes"] > len(big)
+    assert s.world.op_stats["mh_mutation_backlog_bytes"] > len(big)
+    assert s._mh_backlog_ticks == 1
+
+    s._mh_drain_pending()  # drains 11
+    s._mh_drain_pending()  # drains 12 -> queue empty
+    assert not s._mh_pending
+    assert opmon.vars()["mh_mutation_backlog_bytes"] == 0
+    assert s._mh_backlog_ticks == 0
+
+
+def test_sustained_backlog_alarm(caplog):
+    s = _Stub()
+    big = b"x" * (600 << 10)
+    with caplog.at_level(logging.WARNING, logger="goworld_tpu.game"):
+        for _ in range(8):  # producer outruns the cap every tick
+            s._mh_pending.extend([(10, big), (11, big)])
+            s._mh_drain_pending()
+    assert s._mh_backlog_ticks == 8
+    assert any("backlog sustained" in r.message for r in caplog.records)

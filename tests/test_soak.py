@@ -15,7 +15,7 @@ from goworld_tpu.core.state import WorldConfig
 from goworld_tpu.entity.entity import Entity
 from goworld_tpu.entity.manager import World
 from goworld_tpu.entity.space import Space
-from goworld_tpu.net.botclient import BotClient
+from goworld_tpu.net.botclient import BotClient, BotProfiler
 from goworld_tpu.net.game import GameServer
 from goworld_tpu.net.standalone import ClusterHarness
 from goworld_tpu.ops.aoi import GridSpec
@@ -125,12 +125,17 @@ def _run_soak(n_bots, before_s, after_s, tmp_path):
         assert gs.ready_event.wait(20), "deployment never became ready"
 
         host, port = harness.gate_addrs[0]
+        # one shared per-second profiler across the swarm (reference
+        # examples/test_client/profile.go:20-52)
+        profiler = BotProfiler()
         bots = [
-            BotClient(host, port, bot_id=i, strict=True, move_interval=0.2)
+            BotClient(host, port, bot_id=i, strict=True, move_interval=0.2,
+                      profiler=profiler)
             for i in range(n_bots)
         ]
         total = before_s + after_s + 20.0
         futures = [harness.submit(b.run(total)) for b in bots]
+        rep_future = harness.submit(profiler.reporter())
 
         # phase 1: soak
         deadline = time.monotonic() + before_s
@@ -190,8 +195,20 @@ def _run_soak(n_bots, before_s, after_s, tmp_path):
         # wind the bots down and verify strict mirrors
         for f in futures:
             f.result(timeout=60)
+        rep_future.cancel()
         errors = [(b.bot_id, e) for b in bots for e in b.errors]
         assert not errors, f"strict mirror violations: {errors[:10]}"
+
+        # the per-second profiler saw the workload: per-second reports
+        # were printed and the cumulative table has the hot client ops
+        summary = profiler.summary()
+        assert summary.get("sync_batch", {}).get("count", 0) > 0
+        assert summary.get("send_position", {}).get("count", 0) > 0
+        assert summary.get("create_entity", {}).get("count", 0) >= n_bots
+        assert len(profiler.lines) >= before_s * 0.5, (
+            f"expected ~{before_s:.0f} per-second reports, "
+            f"got {len(profiler.lines)}"
+        )
 
         # mirror attr consistency against the live server state
         live = {e.id: e for e in w2.entities.values()
