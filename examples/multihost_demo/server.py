@@ -44,10 +44,17 @@ class Account(gw.Entity):
         self.attrs["status"] = "online"
 
     def Login_Client(self, name):
+        # look the megaspace up by type, never via an attribute only
+        # on_boot sets: after a -restore boot (reload OR watchdog crash
+        # recovery) on_boot is skipped and the space came from the
+        # snapshot
+        world = gw.world()
+        sp = next(
+            s for s in world.spaces.values() if s.type_name == "World"
+        )
         # x=600 of the 800-wide world = the second controller's half
         avatar = gw.create_entity(
-            "Avatar", space=gw.world()._mega_space,
-            pos=(600.0, 0.0, 200.0),
+            "Avatar", space=sp, pos=(600.0, 0.0, 200.0),
         )
         avatar.attrs["name"] = name
         self.give_client_to(avatar)

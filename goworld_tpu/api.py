@@ -326,10 +326,11 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
     from goworld_tpu.net.game import GameServer
 
     # multihost ranks all read the SAME snapshot (the leader wrote it)
-    # and replay restore_world SPMD-identically before the network
-    restoring = args.restore and os.path.exists(
-        freeze_mod.freeze_filename(gid)
-    )
+    # and replay restore_world SPMD-identically before the network;
+    # a crash-recovery checkpoint counts as a snapshot too (watchdog
+    # restarts pass -restore after a crash with no fresh freeze file)
+    restoring = args.restore and \
+        freeze_mod.latest_snapshot_path(gid) is not None
     if not restoring:
         world.create_nil_space()
         for cb in _boot_callbacks:
@@ -355,6 +356,7 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
         # boot itself still replicates group-wide via the mutation log)
         ban_boot=gc.ban_boot_entity or mh_rank > 0,
         restore=restoring,
+        checkpoint_interval=gc.checkpoint_interval,
     )
     svc = server.setup_services()
     _apply_registrations(world, svc=svc, services_only=True)

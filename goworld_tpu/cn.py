@@ -1,0 +1,57 @@
+# -*- coding: utf-8 -*-
+"""goworld_tpu 中文文档模块（文档专用，对应参考实现的 ``cn/goworld_cn.go:1-30``，
+该包同样只承载中文 API 说明；本模块按 TPU 原生架构重新撰写，并原样转出公开 API）。
+
+架构概览
+========
+
+goworld_tpu 是一个 TPU 原生的分布式游戏服务器框架。一套部署由三种进程组成：
+
+- **dispatcher（转发器）**：集群的消息路由中心。维护 EntityID 到 game 的路由表，
+  对正在迁移或加载中的实体按序排队消息；多个 dispatcher 以 EntityID 哈希分片，
+  组成星型拓扑。
+- **gate（网关）**：终结客户端连接（TCP / WebSocket / KCP，可选 TLS 与压缩），
+  把客户端上行的位置同步打包成 32 字节定长记录批量转发，并把下行同步按客户端
+  重新分组下发。
+- **game（游戏进程）**：承载全部游戏逻辑。与参考实现逐实体、逐消息的处理方式
+  不同，这里的"世界滴答"（tick）是一个 jit 编译的设备端程序：实体状态存放在
+  SoA（结构体数组）里，客户端输入经向量化散射写入，NPC 行为、移动积分、AOI
+  扫描、兴趣集增量、同步记录收集全部在一次编译后的 TPU 程序内完成。
+
+多芯扩展通过 ``jax.sharding.Mesh`` 完成：每个空间分片固定在一个设备上；跨分片
+的实体迁移是 tick 边界上的 ``all_to_all`` 行交换；巨型空间（megaspace）把一个
+逻辑空间切成 XZ 平面瓦片，邻域信息以 ``ppermute`` 环形光环（halo）交换——
+这正是序列并行 / 环形注意力在游戏服务器里的结构对应物。多机（多控制器）模式
+经 ``jax.distributed`` 组网，按 SPMD 约定每个控制器执行完全相同的世界变更。
+
+编程模型
+========
+
+逻辑开发沿用"空间与实体"（Space & Entity）模型：
+
+- 客户端登录后，会在某个 game 上创建一个启动实体（默认 ``Account``），即
+  ClientOwner。登录校验通过后，通常创建 ``Avatar`` 并调用
+  ``give_client_to`` 把客户端交接给它。
+- 实体可通过 ``enter_space`` 进入空间；目标空间在其他 game 上时，框架自动打包
+  全部属性、定时器与客户端绑定并在目标进程重建实体，对开发者透明。
+- 属性以 ``MapAttr``/``ListAttr`` 响应式树维护：每次修改按根路径生成增量并
+  自动同步给对应客户端（``client`` / ``allclients`` 标记决定受众；
+  ``persistent`` 决定落盘）。高频数值属性可标记 ``hot:N`` 直接镜像进设备 SoA。
+- 游戏逻辑运行在单一逻辑线程上（网络 IO 在独立线程），因此逻辑代码无需加锁，
+  也绝不能调用阻塞系统调用；耗时工作交给异步工作组（``utils/asyncwork``）。
+
+运维与容灾
+==========
+
+``python -m goworld_tpu start|stop|reload|status|watchdog <目录>``：
+``reload`` 对 game 发送冻结信号，全量快照落盘后以 ``-restore`` 原地重启
+（多控制器组经变更交换在同一 tick 冻结）；``watchdog`` 周期巡检，发现崩溃的
+控制器进程时整组回收并从最新快照（冻结文件或周期检查点
+``checkpoint_interval``）恢复重启。KV 注册表（kvreg）、KVDB、实体持久化、
+发布订阅、分片服务实体等与参考实现能力一一对应。
+
+本模块只是文档与转出口；全部符号来自 :mod:`goworld_tpu.api`。
+"""
+
+from goworld_tpu.api import *  # noqa: F401,F403 — 文档性转出（与参考 cn 包一致）
+from goworld_tpu.api import __all__  # noqa: F401

@@ -51,6 +51,12 @@ class GameConfig:
     # aoi_demand_max, aoi_cell_cap > aoi_cell_max. 0 = library default.
     aoi_k: int = 0
     aoi_cell_cap: int = 0
+    # periodic crash-recovery checkpoint cadence in seconds (0 = off):
+    # the game snapshots the running world on this interval so a
+    # watchdog restart (`ctl watchdog`) can -restore from it. Async
+    # off-thread on single-controller games; synchronous at a
+    # tick-count cadence on multihost groups (leader writes the file).
+    checkpoint_interval: float = 0.0
     extent_x: float = 1000.0
     extent_z: float = 1000.0
     mesh_devices: int = 0  # 0 = single-device vmap path (GLOBAL count

@@ -269,23 +269,40 @@ def freeze_to_file(world: World, directory: str = ".") -> str:
     return path
 
 
+def latest_snapshot_path(game_id: int, directory: str = ".") -> str | None:
+    """The freshest restorable snapshot for a game: the NEWER (by mtime)
+    of the freeze file (intentional reload) and the periodic crash-
+    recovery checkpoint. Mtime decides because either can be stale —
+    a freeze file left over from an old reload must not shadow hours of
+    newer checkpoints after a crash, and vice versa."""
+    cands = [
+        os.path.join(directory, freeze_filename(game_id)),
+        os.path.join(directory, checkpoint_filename(game_id)),
+    ]
+    best, best_m = None, -1.0
+    for p in cands:
+        try:
+            m = os.path.getmtime(p)
+        except OSError:
+            continue
+        if m > best_m:
+            best, best_m = p, m
+    return best
+
+
 def restore_from_file(world: World, directory: str = ".") -> None:
-    """Restore for a ``-restore`` boot: the freeze file (intentional
-    reload) wins when present; otherwise a crash-recovery checkpoint
-    written by :func:`checkpoint_async` is used — the capability the
-    reference lacks (a crashed, unfrozen game there loses everything
-    since the last persistence save; SURVEY.md §5.3)."""
-    path = os.path.join(directory, freeze_filename(world.game_id))
-    if not os.path.exists(path):
-        ckpt = os.path.join(
-            directory, checkpoint_filename(world.game_id)
+    """Restore for a ``-restore`` boot from the freshest snapshot
+    (:func:`latest_snapshot_path`): a freeze file written by a reload,
+    or a crash-recovery checkpoint written by the periodic cadence —
+    the capability the reference lacks (a crashed, unfrozen game there
+    loses everything since the last persistence save; SURVEY.md §5.3)."""
+    path = latest_snapshot_path(world.game_id, directory)
+    if path is None:
+        raise FileNotFoundError(
+            f"no freeze or checkpoint snapshot for game{world.game_id} "
+            f"in {directory!r}"
         )
-        if os.path.exists(ckpt):
-            logger.info(
-                "no freeze file; restoring from async checkpoint %s",
-                ckpt,
-            )
-            path = ckpt
+    logger.info("restoring game%d from %s", world.game_id, path)
     restore_world(world, read_freeze_file(path))
 
 

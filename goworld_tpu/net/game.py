@@ -17,6 +17,7 @@ sent as they happen; position sync records batch per gate per tick into one
 from __future__ import annotations
 
 import asyncio
+import os
 import queue
 import threading
 import time
@@ -85,6 +86,7 @@ class GameServer:
         tick_interval: float = 1.0 / consts.TICK_HZ,
         freeze_dir: str = ".",
         restore: bool = False,
+        checkpoint_interval: float = 0.0,
     ):
         self.game_id = game_id
         self.world = world
@@ -95,6 +97,9 @@ class GameServer:
         self.freeze_dir = freeze_dir
         self.run_state = "running"  # running | freezing | frozen | stopped
         self._freeze_acks: set[int] = set()
+        # periodic crash-recovery checkpoint cadence (seconds; 0 = off)
+        self.checkpoint_interval = checkpoint_interval
+        self._last_ckpt_mono = time.monotonic()
         self._is_restore = False
         if restore:
             from goworld_tpu import freeze as _freeze
@@ -130,6 +135,7 @@ class GameServer:
         self._mh_all_ready = False       # allgathered group readiness
         self._mh_leader_game_id = self.game_id  # allgathered, row 0
         self._mh_freeze_requested = False  # leader sets; exchange spreads
+        self._mh_ckpt_due = False          # leader's wall-clock verdict
 
         # wire the world's pluggable edges to the cluster
         w = world
@@ -216,6 +222,15 @@ class GameServer:
 
         w = self.world
         w.post_q.tick()
+        # an in-flight ASYNC checkpoint must finish before the freeze
+        # file is written: its atomic rename landing afterwards would
+        # give an OLDER-state checkpoint a NEWER mtime, and the
+        # -restore boot picks snapshots by mtime
+        # (freeze.latest_snapshot_path)
+        deadline = time.monotonic() + 30.0
+        while getattr(w, "_ckpt_inflight", False) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
         # snapshot FIRST: OnFreeze hooks may enqueue storage saves, which
         # the drain below must still execute (reference doFreeze ordering).
         # Multihost: EVERY controller reaches here after the same tick
@@ -259,6 +274,53 @@ class GameServer:
             self._mh_exchange_mutations()
         self.world.tick()
         self._flush_sync_out()
+        self._maybe_checkpoint()
+
+    def _maybe_checkpoint(self) -> None:
+        """Periodic crash-recovery snapshot (``checkpoint_interval`` ini
+        knob; VERDICT r3 #4): keeps a restorable file fresh so `ctl
+        watchdog` can tear down a crashed game (or multihost group) and
+        restart it ``-restore`` without losing the world since the last
+        reload. Single-controller games snapshot asynchronously
+        (``freeze.checkpoint_async``: tick loop keeps running through
+        the device fetch + file write). Multihost groups snapshot
+        SYNCHRONOUSLY at a tick-count cadence — the snapshot's device
+        fetch is a collective every rank must reach at the same tick, so
+        a wall-clock timer (per-rank instants differ) could deadlock;
+        all ranks pack the identical global snapshot, the leader writes."""
+        if self.checkpoint_interval <= 0 or self.run_state != "running":
+            return
+        from goworld_tpu import freeze as _freeze
+
+        w = self.world
+        if w._multihost:
+            # the leader's wall-clock verdict arrived through this
+            # tick's exchange, so EVERY rank reaches the snapshot's
+            # collectives here at the same tick
+            if not self._mh_ckpt_due:
+                return
+            self._mh_ckpt_due = False
+            self._last_ckpt_mono = time.monotonic()
+            data = _freeze.freeze_world(w, run_hooks=False)
+            if not self._mh_follower():
+                _freeze.write_freeze_file(
+                    os.path.join(
+                        self.freeze_dir,
+                        _freeze.checkpoint_filename(w.game_id),
+                    ),
+                    data,
+                )
+            return
+        now = time.monotonic()
+        if now - self._last_ckpt_mono < self.checkpoint_interval \
+                or getattr(w, "_ckpt_inflight", False):
+            return
+        self._last_ckpt_mono = now
+        try:
+            _freeze.checkpoint_async(w, self.freeze_dir)
+        except Exception:
+            logger.exception("game%d: periodic checkpoint failed",
+                             self.game_id)
 
     # cap on raw mutation bytes shipped per controller per tick; the
     # surplus stays queued IN ORDER for the next tick (backpressure —
@@ -328,13 +390,27 @@ class GameServer:
         # the SAME "whole group is ready" fact and the SAME leader game
         # id at the same tick — wall-clock readiness differs per
         # controller and must never gate SPMD decisions directly
+        # checkpoint cadence is WALL-CLOCK on the leader, spread through
+        # this same collective (like the freeze flag): tick counts drift
+        # from wall time under load, and per-rank clocks differ — the
+        # leader's verdict riding the exchange is the only instant every
+        # controller observes at the same tick
+        ckpt_due = int(
+            not self._mh_follower()
+            and self.checkpoint_interval > 0
+            and self.run_state == "running"
+            and time.monotonic() - self._last_ckpt_mono
+            >= self.checkpoint_interval
+        )
         meta = np.asarray(
             multihost_utils.process_allgather(
                 np.asarray([len(blob), int(self.deployment_ready),
                             self.game_id,
-                            int(self._mh_freeze_requested)], np.int32)
+                            int(self._mh_freeze_requested),
+                            ckpt_due], np.int32)
             )
-        ).reshape(-1, 4)
+        ).reshape(-1, 5)
+        self._mh_ckpt_due = bool(meta[:, 4].any())
         self.world.mh_group_ready = self._mh_all_ready = \
             bool(meta[:, 1].all())
         self._mh_leader_game_id = int(meta[0, 2])

@@ -7,6 +7,7 @@ it again, stop — but at unit scale with one bot."""
 import asyncio
 import os
 import shutil
+import signal
 import socket
 import subprocess
 import sys
@@ -94,6 +95,44 @@ def _logs(server_dir: str) -> str:
 def test_sample_config_prints(capsys):
     assert cli.main(["sample-config"]) == 0
     assert "[dispatcher1]" in capsys.readouterr().out
+
+
+def test_watchdog_single_process_crash_and_deliberate_stop(server_dir):
+    """Fast watchdog semantics on a 1-proc-per-role cluster: a healthy
+    scan is a no-op; a SIGKILLed game (crash = dead process with its
+    pidfile still present) is restarted; a gate crash respawns in
+    place; a DELIBERATE `stop` (pidfiles unlinked) is never resurrected."""
+    dst, gport = server_dir
+    assert cli.cmd_start(dst) == 0, _logs(dst)
+    try:
+        assert cli.watch_once(dst) == []  # healthy: nothing to do
+
+        # crash the game (SIGKILL leaves the pidfile behind)
+        pid = cli._read_pid(dst, "game", 1)
+        os.kill(pid, signal.SIGKILL)
+        t0 = time.time()
+        while time.time() - t0 < 10 and cli._alive(pid):
+            time.sleep(0.05)
+        actions = cli.watch_once(dst)
+        assert any(a.startswith("game1: restarted") for a in actions), \
+            actions
+        assert cli.cmd_status(dst) == 0, _logs(dst)
+        asyncio.run(_bot_session(gport))  # the restarted game serves
+
+        # crash the gate: respawned in place
+        gpid = cli._read_pid(dst, "gate", 1)
+        os.kill(gpid, signal.SIGKILL)
+        t0 = time.time()
+        while time.time() - t0 < 10 and cli._alive(gpid):
+            time.sleep(0.05)
+        actions = cli.watch_once(dst)
+        assert "gate1: restarted" in actions, actions
+        assert cli.cmd_status(dst) == 0, _logs(dst)
+    finally:
+        assert cli.cmd_stop(dst) == 0
+    # deliberate stop: watchdog must NOT resurrect anything
+    assert cli.watch_once(dst) == []
+    assert cli.cmd_status(dst) == 1
 
 
 def test_deployment_counts_autocreate_sections(tmp_path):
@@ -334,6 +373,135 @@ def test_cli_start_multihost_demo(tmp_path):
                 await bot.conn.close()
 
         asyncio.run(asyncio.wait_for(session(), 500))
+    finally:
+        subprocess.run(
+            [sys.executable, "-m", "goworld_tpu", "stop", dst],
+            env=env, cwd=dst, capture_output=True, text=True, timeout=120,
+        )
+
+
+@pytest.mark.slow
+def test_watchdog_recovers_killed_multihost_rank(tmp_path):
+    """Supervised crash recovery (VERDICT r3 #4): SIGKILL one controller
+    rank of a live 2-rank multihost group while a strict bot is
+    connected. `watchdog --once` detects the dead rank, tears down the
+    survivor (a partial group cannot be healed — the jax coordinator
+    cannot re-admit a rank), restarts the whole group with -restore from
+    the periodic checkpoint (checkpoint_interval in the demo ini), and
+    the still-connected bot's syncs resume. The reference's model is
+    reconnect-forever (DispatcherConnMgr.go:63-85) with total state loss
+    on an unfrozen crash; this recovers the world too."""
+    import shutil as _shutil
+
+    src = os.path.join(REPO, "examples", "multihost_demo")
+    dst = str(tmp_path / "multihost_demo")
+    _shutil.copytree(src, dst)
+    gport = _free_port()
+    dport = _free_port()
+    ini = os.path.join(dst, "goworld_tpu.ini")
+    with open(ini) as f:
+        text = f.read()
+    text = text.replace("port = 15500", f"port = {gport}")
+    text = text.replace("port = 14500", f"port = {dport}")
+    with open(ini, "w") as f:
+        f.write(text)
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["PYTHONPATH"] = REPO
+    try:
+        r = subprocess.run(
+            [sys.executable, "-m", "goworld_tpu", "start", dst],
+            env=env, cwd=dst, capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+
+        async def session():
+            from goworld_tpu.net.botclient import BotClient
+
+            bot = BotClient("127.0.0.1", gport, strict=True)
+            await bot.connect()
+            recv = asyncio.ensure_future(bot._recv_loop())
+            try:
+                # generous: the logic thread is blocked during the
+                # first-tick compile on a loaded CI box
+                await asyncio.wait_for(bot.player_ready.wait(), 90)
+                bot.call_server("Login_Client", "crashtest")
+                for _ in range(200):
+                    if bot.player is not None \
+                            and bot.player.type_name == "Avatar" \
+                            and bot.sync_count > 0:
+                        break
+                    await asyncio.sleep(0.1)
+                assert bot.player is not None
+                assert bot.player.type_name == "Avatar"
+
+                # wait for a periodic checkpoint NEWER than the login
+                # (3 s cadence): killing before the avatar is captured
+                # would restore a correctly-older world without it —
+                # bounded loss, but not what this test asserts on
+                t_login = time.time()
+                ckpt = os.path.join(dst, "game1_checkpoint.dat")
+                t0 = time.time()
+                while time.time() - t0 < 90 and (
+                    not os.path.exists(ckpt)
+                    or os.path.getmtime(ckpt) < t_login + 1.0
+                ):
+                    await asyncio.sleep(0.5)
+                assert os.path.exists(ckpt) \
+                    and os.path.getmtime(ckpt) >= t_login + 1.0, \
+                    "no post-login periodic checkpoint"
+
+                # CRASH: SIGKILL the rank-1 controller (no freeze, no
+                # goodbye)
+                with open(os.path.join(dst, "run", "game1c1.pid")) as f:
+                    pid1 = int(f.read().strip())
+                os.kill(pid1, signal.SIGKILL)
+                t0 = time.time()
+                while time.time() - t0 < 10:
+                    try:
+                        os.kill(pid1, 0)
+                        await asyncio.sleep(0.1)
+                    except OSError:
+                        break
+
+                # supervised recovery: one watchdog scan heals the group
+                wd = await asyncio.to_thread(
+                    subprocess.run,
+                    [sys.executable, "-m", "goworld_tpu", "watchdog",
+                     dst, "--once"],
+                    env=env, cwd=dst, capture_output=True, text=True,
+                    timeout=300,
+                )
+                assert wd.returncode == 0, \
+                    wd.stdout[-2000:] + wd.stderr[-2000:]
+                assert "restarted from" in wd.stdout, wd.stdout
+                assert "game1_checkpoint.dat" in wd.stdout \
+                    or "game1_freezed.dat" in wd.stdout, wd.stdout
+
+                st = await asyncio.to_thread(
+                    subprocess.run,
+                    [sys.executable, "-m", "goworld_tpu", "status", dst],
+                    env=env, cwd=dst, capture_output=True, text=True,
+                    timeout=60,
+                )
+                assert "game1c0: running" in st.stdout, st.stdout
+                assert "game1c1: running" in st.stdout, st.stdout
+
+                # the still-connected strict bot's traffic resumes
+                s0 = bot.sync_count
+                t0 = time.time()
+                while time.time() - t0 < 90 and bot.sync_count <= s0:
+                    await asyncio.sleep(0.2)
+                assert bot.sync_count > s0, \
+                    "syncs never resumed after crash recovery"
+                assert not bot.errors, bot.errors
+            finally:
+                recv.cancel()
+                await bot.conn.close()
+
+        asyncio.run(asyncio.wait_for(session(), 560))
     finally:
         subprocess.run(
             [sys.executable, "-m", "goworld_tpu", "stop", dst],
