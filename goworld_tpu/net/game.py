@@ -353,13 +353,9 @@ class GameServer:
         backlog_b = sum(6 + len(p) for _, p in self._mh_pending)
         opmon.expose("mh_mutation_backlog_packets", len(self._mh_pending))
         opmon.expose("mh_mutation_backlog_bytes", backlog_b)
-        w = getattr(self, "world", None)  # drain-only stubs have none
-        if w is not None:
-            w.op_stats["mh_mutation_backlog_bytes"] = backlog_b
+        self.world.op_stats["mh_mutation_backlog_bytes"] = backlog_b
         if self._mh_pending:
-            # getattr: drain-only stubs (tests) skip __init__
-            self._mh_backlog_ticks = \
-                getattr(self, "_mh_backlog_ticks", 0) + 1
+            self._mh_backlog_ticks += 1
             if self._mh_backlog_ticks >= 8 \
                     and self._mh_backlog_ticks % 64 == 8:
                 logger.warning(

@@ -102,6 +102,12 @@ class GridSpec:
     # approx encoding keeps every valid key finite as f32 (8-bit
     # distance quantization, +inf sentinel) — 0x7FFFFFFF would be NaN
     # and break the float ordering.
+    # "sort" = full minor-dim sort of the packed keys, keep the first k:
+    # EXACT (a total order over (distance, id) keys) and lowers to a
+    # vectorized sorting network over the 9*cell_cap lanes — on TPU this
+    # can beat lax.top_k's generic int32 lowering (r4 hardware
+    # attribution: the back half of the sweep, gather+top_k, was ~95% of
+    # the tick at 131K entities).
     topk_impl: str = "exact"
     # Candidate-fetch strategy:
     #   "table"  — scatter the sorted entities into a dense per-cell
@@ -119,6 +125,25 @@ class GridSpec:
     #              occupancy <= cell_cap, strictly fewer drops beyond
     #              (pooling only ever admits candidates the per-cell cap
     #              dropped).
+    #   "shift"  — CELL-MAJOR, gather-free: queries are the table slots
+    #              themselves ([cells_x, cells_z, cell_cap]), and every
+    #              one of the 9 neighbor windows is a STATIC slice of
+    #              the border-padded table (query cell (i, j) sees
+    #              table[i+dx, j+dz] for dx, dz in {-1,0,1} — a shift,
+    #              not a gather). The only per-entity indexed ops left
+    #              are the front-half build scatter (shared with
+    #              "table") and ONE [N, k]-row unsort scatter of the
+    #              finished lists back to slot order. Motivated by the
+    #              r4 TPU measurement: the per-entity windowed
+    #              dynamic-slice gather + top_k dominated the tick
+    #              (~535 of 567 ms at 131K entities) while sort+build
+    #              cost < 10 ms. Results are identical to "table" while
+    #              per-cell occupancy <= cell_cap; beyond the cap,
+    #              overflowed entities are dropped as WATCHERS too (they
+    #              keep an empty neighbor list for the tick) — the cell
+    #              gauge (`with_stats`) alarms in exactly that regime.
+    #              Packed-id fast path only (n < 2^21); wide worlds fall
+    #              back to "table".
     sweep_impl: str = "table"
 
     @property
@@ -217,12 +242,24 @@ def _build_ranges(cc: int, n_rows: int, srow, src, sentinel_bits):
     return row_start, s_t
 
 
-def _build_table(cc: int, n_rows: int, sorted_row, src, sentinel_bits):
-    """Front half, stage 4 (table impl): dense per-cell table. Ranks
-    each sorted entity within its cell via a segment scan (no per-entity
-    binary searches — those are scalar gathers on TPU), then scatters
-    px/pz/word side by side."""
-    n = src.shape[0]
+def _init_row(comp_init, cc: int):
+    """One empty table row: each component's init value repeated across
+    its cc lanes. Shared by _build_table and the shift impl's x-pad so
+    padded blocks can never diverge from the table's own empty lanes."""
+    return jnp.repeat(
+        jnp.stack([jnp.asarray(v, jnp.float32) for v in comp_init]), cc
+    )
+
+
+def _build_table(cc: int, n_rows: int, sorted_row, src, comp_init):
+    """Front half, stage 4 (table/shift impls): dense per-cell table.
+    Ranks each sorted entity within its cell via a segment scan (no
+    per-entity binary searches — those are scalar gathers on TPU), then
+    scatters the C components of ``src`` ([n, C]) side by side.
+    ``comp_init`` gives each component's empty-lane init value (f32
+    scalars; the packed-word component uses the sentinel's bit
+    pattern)."""
+    n, ncomp = src.shape
     idx = jnp.arange(n, dtype=jnp.int32)
     new_seg = jnp.concatenate(
         [jnp.ones((1,), bool), sorted_row[1:] != sorted_row[:-1]]
@@ -231,15 +268,247 @@ def _build_table(cc: int, n_rows: int, sorted_row, src, sentinel_bits):
     rank = idx - seg_start
     valid_src = (rank < cc) & (sorted_row < n_rows)
     base = jnp.where(
-        valid_src, sorted_row * (3 * cc) + rank, n_rows * 3 * cc
+        valid_src, sorted_row * (ncomp * cc) + rank, n_rows * ncomp * cc
     )
-    lane = jnp.arange(3 * cc, dtype=jnp.int32)
-    init_row = jnp.where(lane >= 2 * cc, sentinel_bits, jnp.inf)
-    table = jnp.tile(init_row, n_rows) \
-        .at[base].set(src[:, 0], mode="drop") \
-        .at[base + cc].set(src[:, 1], mode="drop") \
-        .at[base + 2 * cc].set(src[:, 2], mode="drop")
-    return table.reshape(n_rows, 3 * cc)
+    table = jnp.tile(_init_row(comp_init, cc), n_rows)
+    for c in range(ncomp):
+        table = table.at[base + c * cc].set(src[:, c], mode="drop")
+    return table.reshape(n_rows, ncomp * cc)
+
+
+def _invalid_key(topk_impl):
+    """Sentinel ranking key. approx min-k runs over the keys bitcast to
+    f32, so its invalid key is +inf's bit pattern (ordered above every
+    finite key; 0x7FFFFFFF would be a NaN and break the float order)."""
+    return jnp.int32(0x7F800000) if topk_impl == "approx" \
+        else jnp.int32(2**31 - 1)
+
+
+def _pack_keys(spec: GridSpec, dist, valid, cand_w, want_flags):
+    """Pack (quantized distance, word) into one int32 ranking key so a
+    single top_k yields ids AND flags — the take_along_axis re-gather it
+    replaces was the single most expensive op of the sweep (minor-axis
+    dynamic indexing serializes on TPU). Distance quantization (10 bits
+    plain / 8 bits with flags or approx) only affects WHICH neighbors
+    win when the true count exceeds k (already best-effort); flags sit
+    below the id so they never influence the ranking. Shared by the
+    entity-major and cell-major sweeps — their bit-parity contract
+    depends on one encoder."""
+    invalid_key = _invalid_key(spec.topk_impl)
+    if want_flags or spec.topk_impl == "approx":
+        # 8-bit distance: max key (254<<23)|word stays a FINITE f32
+        # pattern, which the approx path requires
+        qd = jnp.minimum(
+            (dist * (255.0 / spec.radius)).astype(jnp.int32), _QD_MAX
+        )
+        return jnp.where(valid, (qd << 23) | cand_w, invalid_key)
+    qd = jnp.minimum(
+        (dist * (1024.0 / spec.radius)).astype(jnp.int32), 1023
+    )
+    return jnp.where(valid, (qd << _ID_BITS) | cand_w, invalid_key)
+
+
+def _cell_occupancy_stats(srow, n_rows: int, cc: int):
+    """AOI-cap gauges' cell half: (cell_max, over_cap_cells) from the
+    UNclipped per-cell occupancy bincount (overflow = members dropped
+    from candidate pools; the go-aoi sweep is exact at any density,
+    Space.go:244-252 — capping is the TPU tradeoff and must NEVER
+    degrade silently). One [N] scatter-add; shared by every sweep
+    impl so the gauges cannot skew between them."""
+    occ = jnp.zeros(n_rows + 1, jnp.int32).at[srow].add(
+        1, mode="drop"
+    )[:n_rows]
+    return occ.max().astype(jnp.int32), (occ > cc).sum().astype(jnp.int32)
+
+
+def _rank_packed(packed_key, k, topk_impl, want_flags, sentinel,
+                 invalid_key):
+    """Back-half ranking shared by the entity-major and cell-major
+    sweeps: keep the k smallest packed (distance, id, flags) keys per
+    row and unpack to (nbr ascending ids, cnt, flags-or-None).
+    ``topk_impl``: "exact" = lax.top_k; "sort" = full minor-dim sort +
+    slice (exact too — the keys are totally ordered — but lowers to a
+    vectorized sorting network, which can beat the generic int32 top_k
+    lowering on TPU); "approx" = lax.approx_min_k over the keys bitcast
+    to f32 (see GridSpec.topk_impl for the recall caveat)."""
+    if topk_impl == "approx":
+        fk = lax.bitcast_convert_type(packed_key, jnp.float32)
+        vals, _ = lax.approx_min_k(fk, k, recall_target=0.98)
+        top = lax.bitcast_convert_type(vals, jnp.int32)
+    elif topk_impl == "sort":
+        top = jnp.sort(packed_key, axis=-1)[..., :k]
+    else:
+        top = -lax.top_k(-packed_key, k)[0]  # k smallest
+    ok = top < invalid_key
+    if want_flags:
+        # the (id << 2) | flags words are already id-ordered: one sort
+        # restores ascending ids with flags aligned
+        combo = jnp.sort(
+            jnp.where(ok, top & _WORD_MASK, sentinel << 2), axis=-1
+        )
+        nbr = combo >> 2
+        fl = jnp.where(nbr == sentinel, 0, combo & 3)
+    else:
+        nbr = jnp.sort(jnp.where(ok, top & _ID_MASK, sentinel), axis=-1)
+        fl = None
+    return nbr, ok.sum(-1).astype(jnp.int32), fl
+
+
+def _sweep_shift(
+    spec: GridSpec,
+    pos: jax.Array,
+    alive: jax.Array,
+    query_rows: int | None,
+    watch_radius: jax.Array | None,
+    flag_bits: jax.Array | None,
+    with_stats: bool = False,
+) -> tuple[jax.Array, jax.Array, jax.Array | None, tuple | None]:
+    """Cell-major, gather-free back half (GridSpec.sweep_impl="shift").
+
+    Queries ARE the table slots: the padded cell table is reshaped to
+    [cells_x+2, cells_z+2, C*cell_cap] and each of the 9 neighbor
+    windows of every query cell is one STATIC slice of it. No dynamic
+    per-entity window gather exists at all — the r4 TPU attribution
+    showed that gather plus top_k was ~95% of the tick. Finished
+    neighbor lists are scattered back to entity-slot order in ONE
+    [rows, k] scatter. Per-entity watch radii ride the table as a 4th
+    component, so the query side needs no gather either."""
+    n = pos.shape[0]
+    q = n if query_rows is None else query_rows
+    k = spec.k
+    cc = spec.cell_cap
+    sentinel = n
+    want_flags = flag_bits is not None
+
+    # cell-major: the per-entity cx/cz and filtered alive are never
+    # needed — queries are table slots, not entity rows
+    _cx, _cz, srow, _alive, czp, n_rows = _cell_rows(
+        spec, pos, alive, watch_radius
+    )
+    if with_stats:
+        cell_max, over_cap_cells = _cell_occupancy_stats(srow, n_rows, cc)
+    order, sorted_row = _sort_cells(n, n_rows, srow)
+    src, _table_sentinel, sentinel_bits = _sorted_src(
+        spec, pos, flag_bits, order
+    )
+    comp_init = [jnp.inf, jnp.inf, sentinel_bits]
+    if watch_radius is not None:
+        src = jnp.concatenate(
+            [src, watch_radius[order][:, None].astype(jnp.float32)],
+            axis=1,
+        )
+        comp_init.append(jnp.float32(0.0))
+    ncomp = src.shape[1]
+    table = _build_table(cc, n_rows, sorted_row, src, comp_init)
+    cxp = spec.cells_x + 2
+    CZ = spec.cells_z
+    t3 = table.reshape(cxp, czp, ncomp * cc)
+
+    # x-block the CELL grid (≈ row_block query slots per block) and pad
+    # x with border-init rows so every slab slice is in bounds
+    xb = max(1, min(spec.cells_x, spec.row_block // max(1, CZ * cc)))
+    nb = -(-spec.cells_x // xb)
+    pad_x = nb * xb + 2 - cxp
+    if pad_x > 0:
+        t3 = jnp.concatenate(
+            [
+                t3,
+                jnp.broadcast_to(
+                    _init_row(comp_init, cc), (pad_x, czp, ncomp * cc)
+                ),
+            ],
+            axis=0,
+        )
+
+    def do_block(bi):
+        slab = lax.dynamic_slice(
+            t3, (bi * xb, 0, 0), (xb + 2, czp, ncomp * cc)
+        )
+        qs = lax.slice(slab, (1, 1, 0), (1 + xb, 1 + CZ, ncomp * cc))
+        qpx = qs[..., :cc]
+        qpz = qs[..., cc:2 * cc]
+        qw = lax.bitcast_convert_type(qs[..., 2 * cc:3 * cc], jnp.int32)
+        qid = qw >> 2 if want_flags else qw
+        if watch_radius is not None:
+            reach = jnp.minimum(qs[..., 3 * cc:4 * cc], spec.radius)
+        else:
+            reach = jnp.full_like(qpx, spec.radius)
+        keys = []
+        dems = []
+        for dx in range(3):
+            for dz in range(3):
+                cs = lax.slice(
+                    slab, (dx, dz, 0), (dx + xb, dz + CZ, 3 * cc)
+                )
+                cpx = cs[..., :cc]
+                cpz = cs[..., cc:2 * cc]
+                cw = lax.bitcast_convert_type(
+                    cs[..., 2 * cc:3 * cc], jnp.int32
+                )
+                cid = cw >> 2 if want_flags else cw
+                dist = jnp.maximum(
+                    jnp.abs(qpx[..., :, None] - cpx[..., None, :]),
+                    jnp.abs(qpz[..., :, None] - cpz[..., None, :]),
+                )
+                valid = (
+                    (cid[..., None, :] != sentinel)
+                    & (dist <= reach[..., :, None])
+                    & (cid[..., None, :] != qid[..., :, None])
+                )
+                keys.append(
+                    _pack_keys(
+                        spec, dist, valid, cw[..., None, :], want_flags
+                    )
+                )
+                if with_stats:
+                    dems.append(valid.sum(-1, dtype=jnp.int32))
+        rows = xb * CZ * cc
+        packed = jnp.concatenate(keys, axis=-1).reshape(rows, 9 * cc)
+        nbr_b, cnt_b, fl_b = _rank_packed(
+            packed, k, spec.topk_impl, want_flags, sentinel,
+            _invalid_key(spec.topk_impl),
+        )
+        dem_b = (
+            sum(dems).reshape(rows).astype(jnp.int32)
+            if with_stats else jnp.zeros((rows,), jnp.int32)
+        )
+        if fl_b is None:
+            fl_b = jnp.zeros_like(nbr_b)
+        return qid.reshape(rows), nbr_b, cnt_b, fl_b, dem_b
+
+    if nb == 1:
+        qid_f, nbr_s, cnt_s, fl_s, dem_s = do_block(jnp.int32(0))
+    else:
+        qid_f, nbr_s, cnt_s, fl_s, dem_s = lax.map(
+            do_block, jnp.arange(nb, dtype=jnp.int32)
+        )
+        qid_f = qid_f.reshape(-1)
+        nbr_s = nbr_s.reshape(-1, k)
+        cnt_s = cnt_s.reshape(-1)
+        fl_s = fl_s.reshape(-1, k)
+        dem_s = dem_s.reshape(-1)
+
+    # ONE unsort scatter back to entity-slot order; empty query lanes,
+    # ghost rows (>= q) and cap-overflowed entities land in dump row n
+    tgt = jnp.where(qid_f < q, qid_f, n)
+    nbr = jnp.full((n + 1, k), sentinel, jnp.int32).at[tgt].set(
+        nbr_s
+    )[:q]
+    cnt = jnp.zeros(n + 1, jnp.int32).at[tgt].set(cnt_s)[:q]
+    fl = (
+        jnp.zeros((n + 1, k), jnp.int32).at[tgt].set(fl_s)[:q]
+        if want_flags else None
+    )
+    stats = None
+    if with_stats:
+        dem = jnp.zeros(n + 1, jnp.int32).at[tgt].set(dem_s)[:q]
+        stats = (
+            dem.max().astype(jnp.int32),
+            (dem > k).sum().astype(jnp.int32),
+            cell_max,
+            over_cap_cells,
+        )
+    return nbr, cnt, fl, stats
 
 
 def _sweep(
@@ -252,6 +521,11 @@ def _sweep(
     with_stats: bool = False,
 ) -> tuple[jax.Array, jax.Array, jax.Array | None, tuple | None]:
     n = pos.shape[0]
+    if spec.sweep_impl == "shift" and n < (1 << _ID_BITS):
+        return _sweep_shift(
+            spec, pos, alive, query_rows, watch_radius, flag_bits,
+            with_stats,
+        )
     q = n if query_rows is None else query_rows
     k = spec.k
     cc = spec.cell_cap
@@ -263,15 +537,7 @@ def _sweep(
         spec, pos, alive, watch_radius
     )
     if with_stats:
-        # per-cell occupancy vs cell_cap (overflow = members dropped
-        # from candidate pools; the go-aoi sweep is exact at any
-        # density, Space.go:244-252 — capping is the TPU tradeoff and
-        # must NEVER degrade silently). One [N] scatter-add.
-        occ = jnp.zeros(n_rows + 1, jnp.int32).at[srow].add(
-            1, mode="drop"
-        )[:n_rows]
-        cell_max = occ.max().astype(jnp.int32)
-        over_cap_cells = (occ > cc).sum().astype(jnp.int32)
+        cell_max, over_cap_cells = _cell_occupancy_stats(srow, n_rows, cc)
     order, sorted_row = _sort_cells(n, n_rows, srow)
     src, table_sentinel, sentinel_bits = _sorted_src(
         spec, pos, flag_bits, order
@@ -286,7 +552,8 @@ def _sweep(
         )
         table = None
     else:
-        table = _build_table(cc, n_rows, sorted_row, src, sentinel_bits)
+        table = _build_table(cc, n_rows, sorted_row, src,
+                             (jnp.inf, jnp.inf, sentinel_bits))
 
     dxs = jnp.array([-1, 0, 1], jnp.int32)
     px = pos[:, 0]
@@ -358,62 +625,15 @@ def _sweep(
                 & (dist <= reach)
                 & (cand_id != rows[:, None])
             )
-            # pack (quantized distance, word) into one int32 so a single
-            # top_k yields ids AND flags — the take_along_axis re-gather
-            # it replaces was the single most expensive op of the sweep
-            # (minor-axis dynamic indexing serializes on TPU). Distance
-            # quantization (10 bits plain / 8 bits with flags) only
-            # affects WHICH neighbors win when the true count exceeds k
-            # (already best-effort); flags sit below the id so they never
-            # influence the ranking.
-            approx = spec.topk_impl == "approx"
-            if approx:
-                # +inf bit pattern: ordered above every finite key and,
-                # unlike 0x7FFFFFFF (a NaN), safe for float min-k
-                invalid_key = jnp.int32(0x7F800000)
-            else:
-                invalid_key = jnp.int32(2**31 - 1)
-            if want_flags or approx:
-                # 8-bit distance: max key (254<<23)|word stays a FINITE
-                # f32 pattern, which the approx path requires
-                qd = jnp.minimum(
-                    (dist * (255.0 / spec.radius)).astype(jnp.int32),
-                    _QD_MAX,
-                )
-                packed_key = jnp.where(
-                    valid, (qd << 23) | cand_w, invalid_key
-                )
-            else:
-                qd = jnp.minimum(
-                    (dist * (1024.0 / spec.radius)).astype(jnp.int32), 1023
-                )
-                packed_key = jnp.where(
-                    valid, (qd << _ID_BITS) | cand_w, invalid_key
-                )
-            if approx:
-                fk = lax.bitcast_convert_type(packed_key, jnp.float32)
-                vals, _ = lax.approx_min_k(fk, k, recall_target=0.98)
-                top = lax.bitcast_convert_type(vals, jnp.int32)
-            else:
-                top = -lax.top_k(-packed_key, k)[0]  # k smallest
-            ok = top < invalid_key
-            if want_flags:
-                # the (id << 2) | flags words are already id-ordered:
-                # one sort restores ascending ids with flags aligned
-                combo = jnp.sort(
-                    jnp.where(ok, top & _WORD_MASK, sentinel << 2), axis=1
-                )
-                nbr_b = combo >> 2
-                fl_b = jnp.where(nbr_b == sentinel, 0, combo & 3)
-            else:
-                nbr_b = jnp.sort(
-                    jnp.where(ok, top & _ID_MASK, sentinel), axis=1
-                )
-                fl_b = None
+            packed_key = _pack_keys(spec, dist, valid, cand_w, want_flags)
+            nbr_b, cnt_b, fl_b = _rank_packed(
+                packed_key, k, spec.topk_impl, want_flags, sentinel,
+                _invalid_key(spec.topk_impl),
+            )
             dem_b = (
                 valid.sum(axis=1).astype(jnp.int32) if with_stats else None
             )
-            return nbr_b, ok.sum(axis=1).astype(jnp.int32), fl_b, dem_b
+            return nbr_b, cnt_b, fl_b, dem_b
 
         valid = (
             (cand_w != sentinel)
@@ -569,7 +789,8 @@ def sweep_phase_checksum(spec: GridSpec, pos, alive, phase: str):
                                        sentinel_bits)
         return row_start.sum().astype(jnp.float32) \
             + jnp.where(jnp.isfinite(s_t), s_t, 0.0).sum()
-    table = _build_table(cc, n_rows, sorted_row, src, sentinel_bits)
+    table = _build_table(cc, n_rows, sorted_row, src,
+                         (jnp.inf, jnp.inf, sentinel_bits))
     return jnp.where(jnp.isfinite(table), table, 0.0).sum()
 
 
