@@ -189,8 +189,8 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
     cap 8 drops neighbors in overflowing cells at 1M density and approx
     trades ~2% recall — autotune must never make the headline measure
     LESS than the documented default does. Knobs the caller pinned via
-    env are never overridden. Bounded cost: 5 candidates x 2 jitted
-    scan lengths = 10 sweep-only compiles at 131K; any failure falls
+    env are never overridden. Bounded cost: 8 candidates x 2 jitted
+    scan lengths = 16 sweep-only compiles at 131K; any failure falls
     back to defaults."""
     import numpy as np
 
@@ -216,6 +216,18 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
         # tableless sweep: identical results while occupancy <= cell_cap
         # (true at bench density by 9x margin), never-worse beyond
         (True, {"sweep_impl": "ranges"}),
+        # sorting-network top-k (r4: the windowed gather + top_k was
+        # ~95% of the TPU tick): exact under every workload — selectable
+        (True, {"topk_impl": "sort"}),
+        # cell-major gather-free sweep: DIAGNOSTIC despite its speed
+        # potential — beyond cell_cap it drops overflowed entities as
+        # watchers (strictly worse than table, unlike ranges' pooling),
+        # and at 1M/cc=12 the occupancy tail gives a small but nonzero
+        # per-run chance of that regime. Selecting it would need the
+        # headline run to verify the over-cap gauge stayed zero on the
+        # measured workload; pin BENCH_SWEEP=shift to A/B by hand.
+        (False, {"sweep_impl": "shift"}),
+        (False, {"sweep_impl": "shift", "topk_impl": "sort"}),
         (False, {"cell_cap": 8}),           # diagnostic: drop risk at 1M
         (False, {"topk_impl": "approx"}),   # diagnostic: recall < 1
     ]
@@ -610,6 +622,7 @@ def child_main(args) -> int:
         stages[0] = ("full", args.n, args.ticks, args.phases)
     overrides: dict = {}
     atlog = None
+    smoke_res: dict | None = None
     for name, n, ticks, phases in stages:
         if name == "full" and os.environ.get("BENCH_AUTOTUNE", "1") == "1":
             import jax
@@ -621,12 +634,44 @@ def child_main(args) -> int:
                     overrides, atlog = autotune_sweep()
                 except Exception as exc:
                     log(f"autotune failed ({exc}); using defaults")
+        if name == "full" and smoke_res is not None \
+                and os.environ.get("BENCH_EXEC_GUARD", "1") == "1":
+            # Execution-length guard (r4: both 1M TPU attempts died with
+            # "TPU worker process crashed or restarted" during the full
+            # stage — a 2*ticks=40-tick scan at the then ~4.3 s/tick is
+            # a ~170 s single device execution, beyond what the tunneled
+            # worker survives). Project the full-N per-tick cost from
+            # the smoke stage's scan-marginal tick (linear in n — every
+            # phase but the sort scales ~linearly, and this only guards
+            # an order-of-magnitude limit), corrected by autotune's own
+            # 131K measurement of the CHOSEN config vs the default the
+            # smoke ran (runs after autotune precisely so a fast
+            # autotuned config keeps its full scan length), and cut the
+            # scan so no single execution exceeds BENCH_MAX_EXEC_S.
+            est_tick_s = (smoke_res["tick_ms"] / 1000.0) \
+                * (n / max(1, smoke_res["entities"]))
+            if atlog and atlog.get("default"):
+                ov_name = ",".join(
+                    f"{kk}={vv}" for kk, vv in overrides.items()
+                ) or "default"
+                if atlog.get(ov_name):
+                    est_tick_s *= atlog[ov_name] / atlog["default"]
+            max_exec = float(os.environ.get("BENCH_MAX_EXEC_S", 45))
+            if est_tick_s * 2 * ticks > max_exec:
+                new_ticks = max(3, int(max_exec / (2 * est_tick_s)))
+                if new_ticks < ticks:
+                    log(f"exec guard: projected {est_tick_s:.2f}s/tick "
+                        f"at n={n}; cutting ticks {ticks} -> {new_ticks} "
+                        f"so one scan stays under {max_exec:.0f}s")
+                    ticks = new_ticks
         t0 = time.perf_counter()
         r = measure(n, ticks, args.client_frac, phases,
                     overrides if name == "full" else None)
         p99_args = r.pop("_p99_args", None)
         r["stage"] = name
         r["stage_wall_s"] = round(time.perf_counter() - t0, 1)
+        if name == "smoke":
+            smoke_res = r
         if name == "full" and atlog is not None:
             r["autotune_sweep_ms"] = atlog
             if overrides:

@@ -321,8 +321,7 @@ def _cell_occupancy_stats(srow, n_rows: int, cc: int):
     return occ.max().astype(jnp.int32), (occ > cc).sum().astype(jnp.int32)
 
 
-def _rank_packed(packed_key, k, topk_impl, want_flags, sentinel,
-                 invalid_key):
+def _rank_packed(packed_key, k, topk_impl, want_flags, sentinel):
     """Back-half ranking shared by the entity-major and cell-major
     sweeps: keep the k smallest packed (distance, id, flags) keys per
     row and unpack to (nbr ascending ids, cnt, flags-or-None).
@@ -330,7 +329,10 @@ def _rank_packed(packed_key, k, topk_impl, want_flags, sentinel,
     slice (exact too — the keys are totally ordered — but lowers to a
     vectorized sorting network, which can beat the generic int32 top_k
     lowering on TPU); "approx" = lax.approx_min_k over the keys bitcast
-    to f32 (see GridSpec.topk_impl for the recall caveat)."""
+    to f32 (see GridSpec.topk_impl for the recall caveat). The invalid
+    key is derived here from topk_impl (the one _pack_keys used) so the
+    pair can never mismatch."""
+    invalid_key = _invalid_key(topk_impl)
     if topk_impl == "approx":
         fk = lax.bitcast_convert_type(packed_key, jnp.float32)
         vals, _ = lax.approx_min_k(fk, k, recall_target=0.98)
@@ -465,8 +467,7 @@ def _sweep_shift(
         rows = xb * CZ * cc
         packed = jnp.concatenate(keys, axis=-1).reshape(rows, 9 * cc)
         nbr_b, cnt_b, fl_b = _rank_packed(
-            packed, k, spec.topk_impl, want_flags, sentinel,
-            _invalid_key(spec.topk_impl),
+            packed, k, spec.topk_impl, want_flags, sentinel
         )
         dem_b = (
             sum(dems).reshape(rows).astype(jnp.int32)
@@ -627,8 +628,7 @@ def _sweep(
             )
             packed_key = _pack_keys(spec, dist, valid, cand_w, want_flags)
             nbr_b, cnt_b, fl_b = _rank_packed(
-                packed_key, k, spec.topk_impl, want_flags, sentinel,
-                _invalid_key(spec.topk_impl),
+                packed_key, k, spec.topk_impl, want_flags, sentinel
             )
             dem_b = (
                 valid.sum(axis=1).astype(jnp.int32) if with_stats else None

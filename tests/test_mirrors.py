@@ -163,6 +163,8 @@ def test_mh_mutation_log_backpressure():
 
     gs = GameServer.__new__(GameServer)   # drain logic only, no network
     gs.game_id = 1
+    gs._mh_backlog_ticks = 0
+    gs.world = type("W", (), {"op_stats": {}})()
     gs._mh_pending = [(100 + i, bytes([i]) * 400_000) for i in range(5)]
     blob1 = gs._mh_drain_pending()
     # 2 x 400KB fits under 1MB; the 3rd would overflow
