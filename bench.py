@@ -219,6 +219,9 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
         # sorting-network top-k (r4: the windowed gather + top_k was
         # ~95% of the TPU tick): exact under every workload — selectable
         (True, {"topk_impl": "sort"}),
+        # exact top-k in the f32 bit-pattern domain: rides the fast TPU
+        # TopK custom-call instead of the generic int32 expansion
+        (True, {"topk_impl": "f32"}),
         # cell-major gather-free sweep: DIAGNOSTIC despite its speed
         # potential — beyond cell_cap it drops overflowed entities as
         # watchers (strictly worse than table, unlike ranges' pooling),

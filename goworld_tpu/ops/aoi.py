@@ -64,9 +64,15 @@ from goworld_tpu.utils import consts
 # ranking is exactly (distance, id) — flags can never bias which neighbors
 # survive a k-overflow (same id never appears twice, so the flag bits are
 # unreachable as a tie-break):
-#   with flags:    key = (qd8 << 23) | (id << 2) | flags,   qd8  in [0, 254]
+#   with flags:    key = (qd8 << 23) | (id << 2) | flags,   qd8  in [1, 254]
 #   without flags: key = (qd10 << 21) | id,                 qd10 in [0, 1023]
-# Every valid key stays strictly below INT32_MAX (the invalid key).
+# Every valid key stays strictly below INT32_MAX (the invalid key). qd8
+# is biased to start at 1 so that, viewed as an IEEE f32 bit pattern
+# (the "f32"/"approx" top-k paths bitcast the keys), every valid key has
+# a NONZERO exponent field: qd8=0 keys would be subnormal floats, which
+# TPU flushes to zero — the compare would return corrupted (zeroed) key
+# bits for near neighbors. Nonnegative normal floats order exactly like
+# their bit patterns, so int-domain and f32-domain ranking agree.
 _ID_BITS = 21
 _ID_MASK = (1 << _ID_BITS) - 1
 _WORD_MASK = (1 << 23) - 1
@@ -108,6 +114,14 @@ class GridSpec:
     # can beat lax.top_k's generic int32 lowering (r4 hardware
     # attribution: the back half of the sweep, gather+top_k, was ~95% of
     # the tick at 131K entities).
+    # "f32" = exact top_k over the packed keys bitcast to f32: XLA's
+    # fast TPU TopK custom-call is f32-only, so int32 keys fall back to
+    # a generic (slow) expansion — but the keys are nonnegative ints,
+    # and nonnegative NORMAL floats order exactly like their bit
+    # patterns (the qd8 bias above keeps every valid key normal), so
+    # `-top_k(-bitcast_f32(key))` ranks identically to the int domain.
+    # Uses the 8-bit finite-key encoding like "approx", without the
+    # recall caveat.
     topk_impl: str = "exact"
     # Candidate-fetch strategy:
     #   "table"  — scatter the sorted entities into a dense per-cell
@@ -145,6 +159,20 @@ class GridSpec:
     #              Packed-id fast path only (n < 2^21); wide worlds fall
     #              back to "table".
     sweep_impl: str = "table"
+
+    def __post_init__(self):
+        # a typo'd knob would otherwise silently fall through every
+        # impl branch to some default path
+        if self.topk_impl not in ("exact", "sort", "f32", "approx"):
+            raise ValueError(
+                f"topk_impl must be exact|sort|f32|approx, "
+                f"got {self.topk_impl!r}"
+            )
+        if self.sweep_impl not in ("table", "ranges", "shift"):
+            raise ValueError(
+                f"sweep_impl must be table|ranges|shift, "
+                f"got {self.sweep_impl!r}"
+            )
 
     @property
     def cells_x(self) -> int:
@@ -277,10 +305,11 @@ def _build_table(cc: int, n_rows: int, sorted_row, src, comp_init):
 
 
 def _invalid_key(topk_impl):
-    """Sentinel ranking key. approx min-k runs over the keys bitcast to
-    f32, so its invalid key is +inf's bit pattern (ordered above every
-    finite key; 0x7FFFFFFF would be a NaN and break the float order)."""
-    return jnp.int32(0x7F800000) if topk_impl == "approx" \
+    """Sentinel ranking key. The f32-domain rankings (approx min-k and
+    the exact "f32" top_k) run over the keys bitcast to f32, so their
+    invalid key is +inf's bit pattern (ordered above every finite key;
+    0x7FFFFFFF would be a NaN and break the float order)."""
+    return jnp.int32(0x7F800000) if topk_impl in ("approx", "f32") \
         else jnp.int32(2**31 - 1)
 
 
@@ -295,12 +324,14 @@ def _pack_keys(spec: GridSpec, dist, valid, cand_w, want_flags):
     entity-major and cell-major sweeps — their bit-parity contract
     depends on one encoder."""
     invalid_key = _invalid_key(spec.topk_impl)
-    if want_flags or spec.topk_impl == "approx":
-        # 8-bit distance: max key (254<<23)|word stays a FINITE f32
-        # pattern, which the approx path requires
+    if want_flags or spec.topk_impl in ("approx", "f32"):
+        # 8-bit distance in [1, 254]: max key (254<<23)|word stays a
+        # FINITE f32 pattern and min key (1<<23) stays a NORMAL one —
+        # the f32-domain rankings require both (subnormals flush to
+        # zero on TPU, corrupting returned key bits)
         qd = jnp.minimum(
-            (dist * (255.0 / spec.radius)).astype(jnp.int32), _QD_MAX
-        )
+            (dist * (253.0 / spec.radius)).astype(jnp.int32), _QD_MAX - 1
+        ) + 1
         return jnp.where(valid, (qd << 23) | cand_w, invalid_key)
     qd = jnp.minimum(
         (dist * (1024.0 / spec.radius)).astype(jnp.int32), 1023
@@ -328,15 +359,23 @@ def _rank_packed(packed_key, k, topk_impl, want_flags, sentinel):
     ``topk_impl``: "exact" = lax.top_k; "sort" = full minor-dim sort +
     slice (exact too — the keys are totally ordered — but lowers to a
     vectorized sorting network, which can beat the generic int32 top_k
-    lowering on TPU); "approx" = lax.approx_min_k over the keys bitcast
-    to f32 (see GridSpec.topk_impl for the recall caveat). The invalid
-    key is derived here from topk_impl (the one _pack_keys used) so the
-    pair can never mismatch."""
+    lowering on TPU); "f32" = exact top_k over the keys bitcast to f32
+    (nonneg normal floats order like their bit patterns; rides the fast
+    TPU TopK custom-call); "approx" = lax.approx_min_k over the same
+    f32 view (see GridSpec.topk_impl for the recall caveat). The
+    invalid key is derived here from topk_impl (the one _pack_keys
+    used) so the pair can never mismatch."""
     invalid_key = _invalid_key(topk_impl)
     if topk_impl == "approx":
         fk = lax.bitcast_convert_type(packed_key, jnp.float32)
         vals, _ = lax.approx_min_k(fk, k, recall_target=0.98)
         top = lax.bitcast_convert_type(vals, jnp.int32)
+    elif topk_impl == "f32":
+        # exact min-k in the f32 bit-pattern domain (keys are finite
+        # normal nonneg floats by construction): rides XLA's fast TPU
+        # TopK custom-call instead of the generic int32 expansion
+        fk = lax.bitcast_convert_type(packed_key, jnp.float32)
+        top = lax.bitcast_convert_type(-lax.top_k(-fk, k)[0], jnp.int32)
     elif topk_impl == "sort":
         top = jnp.sort(packed_key, axis=-1)[..., :k]
     else:

@@ -37,7 +37,7 @@ BASE = dict(radius=25.0, extent_x=800.0, extent_z=800.0, k=32,
             cell_cap=24)
 
 
-@pytest.mark.parametrize("topk_impl", ["exact", "sort"])
+@pytest.mark.parametrize("topk_impl", ["exact", "sort", "f32"])
 @pytest.mark.parametrize("row_block", [64, 100000])
 def test_shift_matches_table_flags(topk_impl, row_block):
     pos, alive, fb = _world(2000, 3)
@@ -110,11 +110,12 @@ def test_shift_matches_oracle():
 
 
 def test_sort_topk_matches_exact_entity_major():
-    """topk_impl='sort' is exact (total order over packed keys): the
-    entity-major impls must return identical lists under it."""
+    """topk_impl='sort' and 'f32' are exact (total order over packed
+    keys; f32 ranks nonneg normal-float bit patterns, which order like
+    the ints): the entity-major impl must return identical lists."""
     pos, alive, fb = _world(1200, 9)
     outs = []
-    for tk in ("exact", "sort"):
+    for tk in ("exact", "sort", "f32"):
         spec = GridSpec(**BASE, sweep_impl="table", topk_impl=tk,
                         row_block=4096)
         nbr, cnt, fl = grid_neighbors_flags(
@@ -122,8 +123,9 @@ def test_sort_topk_matches_exact_entity_major():
             flag_bits=jnp.asarray(fb),
         )
         outs.append(tuple(np.asarray(x) for x in (nbr, cnt, fl)))
-    for a, b in zip(*outs):
-        assert np.array_equal(a, b)
+    for other in outs[1:]:
+        for a, b in zip(outs[0], other):
+            assert np.array_equal(a, b)
 
 
 def test_shift_overflow_drops_watchers_with_alarm():
