@@ -601,6 +601,13 @@ def child_main(args) -> int:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
+    if os.environ.get("BENCH_RNG"):
+        # opt-in PRNG impl for the behavior kernels ("rbg" rides the
+        # TPU hardware RNG instead of ~20 threefry rounds per draw);
+        # affects only WHICH random walk is taken, never its statistics
+        import jax
+
+        jax.config.update("jax_default_prng_impl", os.environ["BENCH_RNG"])
     if os.environ.get("BENCH_COMPILE_CACHE", "1") == "1":
         # persistent compilation cache: the 1M-entity scan costs 57-72 s
         # to compile on TPU (r02 measurement) — cache it on disk so a

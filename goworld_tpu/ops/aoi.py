@@ -317,12 +317,14 @@ def _pack_keys(spec: GridSpec, dist, valid, cand_w, want_flags):
     """Pack (quantized distance, word) into one int32 ranking key so a
     single top_k yields ids AND flags — the take_along_axis re-gather it
     replaces was the single most expensive op of the sweep (minor-axis
-    dynamic indexing serializes on TPU). Distance quantization (10 bits
-    plain / 8 bits with flags or approx) only affects WHICH neighbors
-    win when the true count exceeds k (already best-effort); flags sit
-    below the id so they never influence the ranking. Shared by the
-    entity-major and cell-major sweeps — their bit-parity contract
-    depends on one encoder."""
+    dynamic indexing serializes on TPU). Distance quantization — 10
+    bits on the plain int path (no flags, "exact"/"sort"), 8 bits
+    whenever flags ride the word OR the ranking runs in the f32 domain
+    ("f32"/"approx", whose keys must be finite normal floats) — only
+    affects WHICH neighbors win when the true count exceeds k (already
+    best-effort); flags sit below the id so they never influence the
+    ranking. Shared by the entity-major and cell-major sweeps — their
+    bit-parity contract depends on one encoder."""
     invalid_key = _invalid_key(spec.topk_impl)
     if want_flags or spec.topk_impl in ("approx", "f32"):
         # 8-bit distance in [1, 254]: max key (254<<23)|word stays a

@@ -128,6 +128,23 @@ def test_sort_topk_matches_exact_entity_major():
             assert np.array_equal(a, b)
 
 
+def test_f32_topk_no_flags_matches_oracle():
+    """The no-flags 'f32' path uses the 8-bit biased key layout (plain
+    id word, no flag bits): its results must still match the oracle
+    exactly when nothing overflows — pins the `& _ID_MASK` unpack and
+    the normal-float guarantee for grid_neighbors users."""
+    n = 400
+    pos, alive, _ = _world(n, 13, extent=200.0)
+    oracle = neighbors_oracle(pos, alive, 25.0)
+    spec = GridSpec(radius=25.0, extent_x=200.0, extent_z=200.0,
+                    k=64, cell_cap=64, row_block=128, topk_impl="f32")
+    nbr, cnt = grid_neighbors(spec, jnp.asarray(pos), jnp.asarray(alive))
+    nbr = np.asarray(nbr)
+    for i in range(n):
+        got = set(nbr[i][nbr[i] < n].tolist())
+        assert got == (oracle[i] if alive[i] else set()), i
+
+
 def test_shift_overflow_drops_watchers_with_alarm():
     """Beyond cell_cap the shift impl drops overflowed entities as
     watchers too (empty list for the tick) — documented divergence from
