@@ -19,7 +19,12 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-from goworld_tpu.ops.extract import bounded_extract, bounded_extract_rows
+from goworld_tpu.ops.extract import (
+    SMALL_TIER_ROWS,
+    bounded_extract,
+    bounded_extract_rows,
+    two_tier,
+)
 
 
 def _not_in(a: jax.Array, b: jax.Array, sentinel) -> jax.Array:
@@ -100,23 +105,36 @@ def interest_pairs(
     n, k = old_nbr.shape
     changed = (old_nbr != new_nbr).any(axis=1)
     changed_total = changed.sum().astype(jnp.int32)
-    rows = jnp.flatnonzero(changed, size=row_cap, fill_value=n).astype(
-        jnp.int32
+
+    def tier(rcap):
+        # the k^2 membership compare and pair extraction at row budget
+        # rcap; identical output whenever changed_total <= rcap (every
+        # changed row selected, same row-major drop order)
+        rows = jnp.flatnonzero(
+            changed, size=rcap, fill_value=n
+        ).astype(jnp.int32)
+        rows_c = jnp.minimum(rows, n - 1)
+        row_ok = (rows < n)[:, None]
+        old_s = old_nbr[rows_c]                      # [R, k]
+        new_s = new_nbr[rows_c]
+        eq = new_s[:, :, None] == old_s[:, None, :]  # [R, k, k], R << N
+        enter_m = row_ok & (new_s != sentinel) & ~eq.any(axis=2)
+        leave_m = row_ok & (old_s != sentinel) & ~eq.any(axis=1)
+
+        def pairs(mask, values, cap):
+            flat, valid, count = bounded_extract(mask, cap)
+            watcher = jnp.where(valid, rows_c[flat // k], -1)
+            subject = jnp.where(valid, values.ravel()[flat], -1)
+            return watcher, subject, count
+
+        ew, ej, en = pairs(enter_m, new_s, enter_cap)
+        lw, lj, ln = pairs(leave_m, old_s, leave_cap)
+        return ew, ej, en, lw, lj, ln
+
+    # churn-adaptive (extract.two_tier): the eq compare is the cost —
+    # run it at a small row budget on ordinary ticks and keep the full
+    # row_cap graph for mass-event ticks only
+    out = two_tier(
+        changed_total, min(SMALL_TIER_ROWS, row_cap), row_cap, tier
     )
-    rows_c = jnp.minimum(rows, n - 1)
-    row_ok = (rows < n)[:, None]
-    old_s = old_nbr[rows_c]                       # [R, k]
-    new_s = new_nbr[rows_c]
-    eq = new_s[:, :, None] == old_s[:, None, :]   # [R, k, k] — R << N
-    enter_m = row_ok & (new_s != sentinel) & ~eq.any(axis=2)
-    leave_m = row_ok & (old_s != sentinel) & ~eq.any(axis=1)
-
-    def pairs(mask, values, cap):
-        flat, valid, count = bounded_extract(mask, cap)
-        watcher = jnp.where(valid, rows_c[flat // k], -1)
-        subject = jnp.where(valid, values.ravel()[flat], -1)
-        return watcher, subject, count
-
-    ew, ej, en = pairs(enter_m, new_s, enter_cap)
-    lw, lj, ln = pairs(leave_m, old_s, leave_cap)
-    return ew, ej, en, lw, lj, ln, changed_total
+    return (*out, changed_total)

@@ -67,23 +67,74 @@ def bounded_extract(
     return flat.astype(jnp.int32), valid, count
 
 
+# Small-tier row budget for the churn-adaptive extraction: most ticks
+# touch a few thousand rows, so the [cap_rows, k] second-level work runs
+# at this size and the full-cap graph only executes on mass-event ticks
+# (lax.cond picks ONE branch at runtime, unlike where/select).
+SMALL_TIER_ROWS = 8192
+
+
+def two_tier(count, small: int, full: int, tier_fn):
+    """Dispatch ``tier_fn(small)`` vs ``tier_fn(full)`` on the runtime
+    ``count`` — the churn-adaptive idiom shared by the delta and
+    extraction paths. The identity precondition (both tiers produce
+    IDENTICAL output whenever ``count <= small``, because every hot row
+    is selected in either and the drop order is row-major) is the
+    caller's contract.
+
+    Under vmap BATCHING, ``lax.cond`` lowers to ``select_n`` and BOTH
+    branches execute every tick — the adaptive graph would then be a
+    strict pessimization (full-tier work plus small-tier work). Batched
+    callers (the default single-device World wraps tick_body in
+    jax.jit(jax.vmap(...)) over spaces) therefore get the single
+    full-tier graph; unbatched jit/scan callers (bench) and shard_map
+    meshes (SPMD, not batching) keep the real branch."""
+    if small >= full:
+        return tier_fn(full)
+    # the public jax.interpreters.batching.BatchTracer alias is
+    # deprecated on this jax; the class itself is the stable way to ask
+    # "am I being traced for vmap right now"
+    from jax._src.interpreters import batching
+
+    if isinstance(count, batching.BatchTracer):
+        return tier_fn(full)
+    return jax.lax.cond(
+        count <= small,
+        lambda _: tier_fn(small),
+        lambda _: tier_fn(full),
+        None,
+    )
+
+
 def bounded_extract_rows(
     mask: jax.Array, cap: int
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Two-level :func:`bounded_extract` for 2-D masks (same contract,
-    same results; indices are into ``mask.ravel()``)."""
+    same results; indices are into ``mask.ravel()``).
+
+    Churn-adaptive: when the number of rows containing any set bit fits
+    in ``SMALL_TIER_ROWS``, a small-tier graph (second-level extraction
+    over [small, k] instead of [cap_rows, k]) produces IDENTICAL output
+    — every set row is present in either tier, and the first-cap-bits
+    drop order is row-major in both — at ~cap_rows/small times less
+    extraction work. ``lax.cond`` executes only the taken tier."""
     n, k = mask.shape
     count = mask.sum().astype(jnp.int32)
     row_any = mask.any(axis=1)
     cap_rows = min(cap, n)
-    # both nonzero levels route through bounded_extract so the Pallas
-    # opt-in covers the hot [N, k] event paths, not just the flat callers
-    rflat, rvalid, _ = bounded_extract(row_any, cap_rows)
-    rows = jnp.where(rvalid, rflat, n)
-    rows_c = jnp.minimum(rows, n - 1)
-    sub = mask[rows_c] & (rows[:, None] < n)          # [cap_rows, k]
-    flat2, _, _ = bounded_extract(sub, cap)
-    flat = rows_c[flat2 // k] * k + flat2 % k
     valid = jnp.arange(cap, dtype=jnp.int32) < jnp.minimum(count, cap)
-    flat = jnp.where(valid, flat, 0)
+
+    def tier(cr):
+        # both nonzero levels route through bounded_extract so the
+        # Pallas opt-in covers the hot [N, k] event paths too
+        rflat, rvalid, _ = bounded_extract(row_any, cr)
+        rows = jnp.where(rvalid, rflat, n)
+        rows_c = jnp.minimum(rows, n - 1)
+        sub = mask[rows_c] & (rows[:, None] < n)      # [cr, k]
+        flat2, _, _ = bounded_extract(sub, cap)
+        flat = rows_c[flat2 // k] * k + flat2 % k
+        return jnp.where(valid, flat, 0)
+
+    small = min(SMALL_TIER_ROWS, cap_rows)
+    flat = two_tier(row_any.sum(), small, cap_rows, tier)
     return flat, valid, count

@@ -1,0 +1,71 @@
+"""Churn-adaptive two-tier extraction (ops/extract.two_tier).
+
+Contract under test: identical outputs to the single-graph version on
+every path, a REAL lax.cond branch for unbatched callers (ordinary
+ticks skip the full-cap extraction work), and NO cond under vmap —
+batching would lower cond to select_n and execute both tiers, so the
+batched trace must contain the single full-tier graph only.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from goworld_tpu.ops.delta import interest_pairs
+from goworld_tpu.ops.extract import SMALL_TIER_ROWS, bounded_extract_rows
+
+
+def _mask(n, k, hot_rows, seed):
+    rng = np.random.default_rng(seed)
+    m = np.zeros((n, k), bool)
+    rows = rng.choice(n, hot_rows, replace=False)
+    for r in rows:
+        m[r, rng.choice(k, rng.integers(1, 4), replace=False)] = True
+    return m
+
+
+def test_rows_small_tier_matches_full_tier_output():
+    n, k = SMALL_TIER_ROWS * 2, 8
+    cap = n  # cap_rows = n > SMALL_TIER_ROWS: tiering active
+    for hot, seed in ((50, 0), (SMALL_TIER_ROWS + 7, 1)):
+        m = jnp.asarray(_mask(n, k, hot, seed))
+        flat, valid, count = bounded_extract_rows(m, cap)
+        # oracle: plain flat nonzero semantics
+        want = np.flatnonzero(np.asarray(m).ravel())
+        got = np.asarray(flat)[np.asarray(valid)]
+        assert int(count) == want.size
+        assert np.array_equal(got, want[:got.size])
+
+
+def test_unbatched_trace_has_cond_batched_has_none():
+    n, k = SMALL_TIER_ROWS * 2, 4
+    m = jnp.zeros((n, k), bool)
+
+    unbatched = str(jax.make_jaxpr(
+        lambda x: bounded_extract_rows(x, n)
+    )(m))
+    assert "cond" in unbatched
+
+    batched = str(jax.make_jaxpr(
+        jax.vmap(lambda x: bounded_extract_rows(x, n))
+    )(m[None]))
+    assert "cond" not in batched
+
+
+def test_vmapped_interest_pairs_matches_unbatched():
+    n, k = SMALL_TIER_ROWS + 32, 8
+    rng = np.random.default_rng(3)
+    old = np.sort(rng.integers(0, n + 1, (n, k)).astype(np.int32), axis=1)
+    new = old.copy()
+    rows = rng.choice(n, 40, replace=False)
+    new[rows] = np.sort(
+        rng.integers(0, n + 1, (40, k)).astype(np.int32), axis=1
+    )
+    old_j, new_j = jnp.asarray(old), jnp.asarray(new)
+    flat = interest_pairs(old_j, new_j, n, 256, 256, n)
+    vm = jax.vmap(
+        lambda a, b: interest_pairs(a, b, n, 256, 256, n)
+    )(old_j[None], new_j[None])
+    for a, b in zip(flat, vm):
+        assert np.array_equal(np.asarray(a), np.asarray(b)[0])
