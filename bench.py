@@ -99,7 +99,7 @@ def _grid_kw_from_env(n: int, overrides: dict | None = None) -> dict:
         k=int(os.environ.get("BENCH_K", 32)),
         cell_cap=int(os.environ.get("BENCH_CELL_CAP", 12)),
         row_block=min(n, int(os.environ.get("BENCH_ROW_BLOCK", 65536))),
-        topk_impl=os.environ.get("BENCH_TOPK", "exact"),
+        topk_impl=os.environ.get("BENCH_TOPK", "sort"),
         sweep_impl=os.environ.get("BENCH_SWEEP", "table"),
     )
     grid_kw.update(overrides or {})
@@ -216,9 +216,10 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
         # tableless sweep: identical results while occupancy <= cell_cap
         # (true at bench density by 9x margin), never-worse beyond
         (True, {"sweep_impl": "ranges"}),
-        # sorting-network top-k (r4: the windowed gather + top_k was
-        # ~95% of the TPU tick): exact under every workload — selectable
-        (True, {"topk_impl": "sort"}),
+        # the generic int32 lax.top_k (pre-r4 default; "sort" is the
+        # default now) — kept so autotune can still detect a platform
+        # where it wins
+        (True, {"topk_impl": "exact"}),
         # exact top-k in the f32 bit-pattern domain: rides the fast TPU
         # TopK custom-call instead of the generic int32 expansion
         (True, {"topk_impl": "f32"}),
@@ -234,6 +235,10 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
         (False, {"cell_cap": 8}),           # diagnostic: drop risk at 1M
         (False, {"topk_impl": "approx"}),   # diagnostic: recall < 1
     ]
+    if os.environ.get("BENCH_AUTOTUNE_DIAG", "0") != "1":
+        # diagnostics cost 2 compiles each at 131K (~1 min apiece over
+        # the tunnel) and can never be selected — skip them unless asked
+        candidates = [c for c in candidates if c[0]]
     env_pins = GRID_ENV
     log_d: dict = {}
     best_ms, best_ov = None, {}

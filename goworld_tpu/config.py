@@ -47,7 +47,7 @@ class GameConfig:
     # with ~2% probability on TPU). Unknown values are rejected at
     # GridSpec construction.
     aoi_sweep_impl: str = "table"
-    aoi_topk_impl: str = "exact"
+    aoi_topk_impl: str = "sort"
     # AOI capacity bounds (ops/aoi.py GridSpec k / cell_cap): exactness
     # holds while true neighbor demand <= aoi_k and cell occupancy <=
     # aoi_cell_cap; overflow degrades to nearest-k and fires the

@@ -43,6 +43,11 @@ class WorldConfig:
     leave_cap: int = consts.DEFAULT_EVENT_CAP
     sync_cap: int = consts.DEFAULT_SYNC_CAP
     attr_sync_cap: int = consts.DEFAULT_EVENT_CAP
+    # churn-adaptive two-tier event extraction (ops/extract.two_tier).
+    # MUST be False when tick_body runs under vmap (the single-device
+    # multi-space path): cond batches to select_n and both tiers would
+    # execute. The World manager clears it for its vmapped local step.
+    adaptive_extract: bool = True
     input_cap: int = consts.DEFAULT_INPUT_CAP
     delta_rows_cap: int = 0  # max rows whose AOI list may change per tick
     # before enter/leave events overflow (ops.delta.interest_pairs).

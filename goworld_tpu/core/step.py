@@ -202,17 +202,20 @@ def tick_body(
      delta_rows_n) = interest_pairs(
         state.nbr, nbr, n, cfg.enter_cap, cfg.leave_cap,
         min(cfg.delta_rows_cap_eff, n),
+        adaptive=cfg.adaptive_extract,
     )
 
     # 6. position sync records (CollectEntitySyncInfos analog).
     sync_w, sync_j, sync_vals, sync_n = collect_sync(
         nbr, dirty, state.has_client, pos, yaw, cfg.sync_cap,
         nbr_dirty=(nbr_fl & 1).astype(bool),
+        adaptive=cfg.adaptive_extract,
     )
 
     # 7. hot-attr deltas.
     attr_e, attr_i, attr_v, attr_n = collect_attr_deltas(
-        state.hot_attrs, state.attr_dirty, cfg.attr_sync_cap
+        state.hot_attrs, state.attr_dirty, cfg.attr_sync_cap,
+        adaptive=cfg.adaptive_extract,
     )
 
     new_state = state.replace(

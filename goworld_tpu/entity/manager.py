@@ -18,6 +18,7 @@ one tick later (``_release_next``).
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from collections import defaultdict
 from typing import Any, Callable
@@ -88,6 +89,9 @@ def _type_aoi_radius(desc) -> float:
 def _make_local_tick(cfg: WorldConfig):
     """jit(vmap(tick_body)) over stacked spaces on ONE device — the
     single-process analog of the mesh's shard_map step."""
+    # vmap would batch the churn-adaptive lax.cond into select_n (both
+    # tiers executing every tick) — run the single full-tier graph here
+    cfg = dataclasses.replace(cfg, adaptive_extract=False)
 
     @jax.jit
     def step(state, inputs, policy):

@@ -122,7 +122,11 @@ class GridSpec:
     # `-top_k(-bitcast_f32(key))` ranks identically to the int domain.
     # Uses the 8-bit finite-key encoding like "approx", without the
     # recall caveat.
-    topk_impl: str = "exact"
+    # DEFAULT is "sort": exact under every workload and 2.5x faster
+    # than the int32 lax.top_k on both platforms measured in r4 (the
+    # generic int32 top_k lowering is the worst case everywhere);
+    # autotune/benchmarks may still pick "f32" per platform.
+    topk_impl: str = "sort"
     # Candidate-fetch strategy:
     #   "table"  — scatter the sorted entities into a dense per-cell
     #              table, then read 3 strided (3, 3*cell_cap) windows

@@ -49,9 +49,9 @@ def interest_delta(
     return enter_mask, leave_mask
 
 
-@partial(jax.jit, static_argnums=2)
+@partial(jax.jit, static_argnums=2, static_argnames=("adaptive",))
 def masked_pairs(
-    mask: jax.Array, values: jax.Array, cap: int
+    mask: jax.Array, values: jax.Array, cap: int, adaptive: bool = True
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Extract up to ``cap`` (row, value) pairs where mask is set.
 
@@ -68,13 +68,14 @@ def masked_pairs(
       ``consts.go:26-28``).
     """
     k = mask.shape[1]
-    flat, valid, count = bounded_extract_rows(mask, cap)
+    flat, valid, count = bounded_extract_rows(mask, cap, adaptive)
     watcher = jnp.where(valid, flat // k, -1)
     subject = jnp.where(valid, values.ravel()[flat], -1)
     return watcher, subject, count
 
 
-@partial(jax.jit, static_argnums=(2, 3, 4, 5))
+@partial(jax.jit, static_argnums=(2, 3, 4, 5),
+         static_argnames=("adaptive",))
 def interest_pairs(
     old_nbr: jax.Array,
     new_nbr: jax.Array,
@@ -82,6 +83,7 @@ def interest_pairs(
     enter_cap: int,
     leave_cap: int,
     row_cap: int,
+    adaptive: bool = True,
 ) -> tuple[jax.Array, ...]:
     """Fused changed-rows-only interest diff + pair extraction.
 
@@ -133,8 +135,10 @@ def interest_pairs(
 
     # churn-adaptive (extract.two_tier): the eq compare is the cost —
     # run it at a small row budget on ordinary ticks and keep the full
-    # row_cap graph for mass-event ticks only
+    # row_cap graph for mass-event ticks only. adaptive=False for
+    # vmapped callers (see two_tier's docstring).
     out = two_tier(
-        changed_total, min(SMALL_TIER_ROWS, row_cap), row_cap, tier
+        changed_total, min(SMALL_TIER_ROWS, row_cap), row_cap, tier,
+        adaptive,
     )
     return (*out, changed_total)

@@ -74,7 +74,7 @@ def bounded_extract(
 SMALL_TIER_ROWS = 8192
 
 
-def two_tier(count, small: int, full: int, tier_fn):
+def two_tier(count, small: int, full: int, tier_fn, adaptive: bool = True):
     """Dispatch ``tier_fn(small)`` vs ``tier_fn(full)`` on the runtime
     ``count`` — the churn-adaptive idiom shared by the delta and
     extraction paths. The identity precondition (both tiers produce
@@ -82,21 +82,19 @@ def two_tier(count, small: int, full: int, tier_fn):
     is selected in either and the drop order is row-major) is the
     caller's contract.
 
-    Under vmap BATCHING, ``lax.cond`` lowers to ``select_n`` and BOTH
+    ``adaptive`` must be False for callers that will be vmapped: under
+    vmap BATCHING, ``lax.cond`` lowers to ``select_n`` and BOTH
     branches execute every tick — the adaptive graph would then be a
-    strict pessimization (full-tier work plus small-tier work). Batched
-    callers (the default single-device World wraps tick_body in
-    jax.jit(jax.vmap(...)) over spaces) therefore get the single
-    full-tier graph; unbatched jit/scan callers (bench) and shard_map
-    meshes (SPMD, not batching) keep the real branch."""
-    if small >= full:
-        return tier_fn(full)
-    # the public jax.interpreters.batching.BatchTracer alias is
-    # deprecated on this jax; the class itself is the stable way to ask
-    # "am I being traced for vmap right now"
-    from jax._src.interpreters import batching
-
-    if isinstance(count, batching.BatchTracer):
+    strict pessimization (full-tier work PLUS small-tier work). This is
+    a static flag threaded from the caller because no trace-time
+    introspection can see it reliably: the hot collectors are
+    themselves jitted, and under jit(vmap(...)) pjit batches the
+    already-traced jaxpr — the Python body never observes a
+    BatchTracer. The default single-device World (which vmaps tick_body
+    over spaces) passes adaptive=False via WorldConfig; unbatched
+    jit/scan callers (bench) and shard_map meshes (SPMD, not batching)
+    keep the real branch."""
+    if not adaptive or small >= full:
         return tier_fn(full)
     return jax.lax.cond(
         count <= small,
@@ -107,7 +105,7 @@ def two_tier(count, small: int, full: int, tier_fn):
 
 
 def bounded_extract_rows(
-    mask: jax.Array, cap: int
+    mask: jax.Array, cap: int, adaptive: bool = True
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Two-level :func:`bounded_extract` for 2-D masks (same contract,
     same results; indices are into ``mask.ravel()``).
@@ -136,5 +134,5 @@ def bounded_extract_rows(
         return jnp.where(valid, flat, 0)
 
     small = min(SMALL_TIER_ROWS, cap_rows)
-    flat = two_tier(row_any.sum(), small, cap_rows, tier)
+    flat = two_tier(row_any.sum(), small, cap_rows, tier, adaptive)
     return flat, valid, count

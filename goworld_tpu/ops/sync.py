@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from goworld_tpu.ops.extract import bounded_extract_rows
 
 
-@partial(jax.jit, static_argnums=5)
+@partial(jax.jit, static_argnums=5, static_argnames=("adaptive",))
 def collect_sync(
     nbr: jax.Array,
     dirty: jax.Array,
@@ -39,6 +39,7 @@ def collect_sync(
     yaw: jax.Array,
     cap: int,
     nbr_dirty: jax.Array | None = None,
+    adaptive: bool = True,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Collect position/yaw sync records for client-owning watchers.
 
@@ -73,7 +74,7 @@ def collect_sync(
         nbr_dirty = dirty[nbr_c]
     watch = has_client[:, None] & valid_nbr & nbr_dirty
 
-    flat, valid, count = bounded_extract_rows(watch, cap)
+    flat, valid, count = bounded_extract_rows(watch, cap, adaptive)
     watcher = jnp.where(valid, flat // k, -1)
     subject_raw = nbr_c.ravel()[flat]
     subject = jnp.where(valid, subject_raw, -1)
@@ -83,9 +84,10 @@ def collect_sync(
     return watcher, subject, vals, count
 
 
-@partial(jax.jit, static_argnums=2)
+@partial(jax.jit, static_argnums=2, static_argnames=("adaptive",))
 def collect_attr_deltas(
-    hot_attrs: jax.Array, attr_dirty: jax.Array, cap: int
+    hot_attrs: jax.Array, attr_dirty: jax.Array, cap: int,
+    adaptive: bool = True,
 ) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Flatten dirty (entity, attr) cells into bounded records.
 
@@ -99,7 +101,7 @@ def collect_attr_deltas(
     n, a = hot_attrs.shape
     bits = (attr_dirty[:, None] >> jnp.arange(a, dtype=jnp.uint32)) & 1
     mask = bits.astype(bool)
-    flat, valid, count = bounded_extract_rows(mask, cap)
+    flat, valid, count = bounded_extract_rows(mask, cap, adaptive)
     ent = jnp.where(valid, flat // a, -1)
     attr_idx = jnp.where(valid, flat % a, -1)
     value = jnp.where(valid, hot_attrs.ravel()[flat], 0.0)

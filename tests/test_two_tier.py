@@ -38,19 +38,61 @@ def test_rows_small_tier_matches_full_tier_output():
         assert np.array_equal(got, want[:got.size])
 
 
-def test_unbatched_trace_has_cond_batched_has_none():
+def test_adaptive_flag_controls_cond():
     n, k = SMALL_TIER_ROWS * 2, 4
     m = jnp.zeros((n, k), bool)
 
-    unbatched = str(jax.make_jaxpr(
+    adaptive = str(jax.make_jaxpr(
         lambda x: bounded_extract_rows(x, n)
     )(m))
-    assert "cond" in unbatched
+    assert "cond" in adaptive
 
+    fixed = str(jax.make_jaxpr(
+        lambda x: bounded_extract_rows(x, n, adaptive=False)
+    )(m))
+    assert "cond" not in fixed
+
+
+def test_vmapped_world_tick_has_no_cond():
+    """The production single-device World path (jit(vmap(tick_body)))
+    must carry NO churn cond: under vmap batching cond lowers to
+    select_n and BOTH tiers would execute every tick. Tracer
+    introspection cannot see this through the collectors' own jit
+    boundary (pjit batches the traced jaxpr), so the manager threads
+    adaptive_extract=False statically — this test pins that wiring
+    end to end."""
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.core.step import TickInputs, tick_body
+    from goworld_tpu.entity.manager import _make_local_tick
+    from goworld_tpu.ops.aoi import GridSpec
+
+    cfg = WorldConfig(
+        capacity=SMALL_TIER_ROWS * 2,
+        grid=GridSpec(radius=20.0, extent_x=200.0, extent_z=200.0,
+                      k=8, cell_cap=8, row_block=1024),
+    )
+    from goworld_tpu.core.state import create_state
+
+    st = create_state(cfg)
+    st_b = jax.tree.map(lambda x: x[None], st)
+    ins_b = jax.tree.map(lambda x: x[None], TickInputs.empty(cfg))
+    import dataclasses as _dc
+
+    cfg_off = _dc.replace(cfg, adaptive_extract=False)
     batched = str(jax.make_jaxpr(
-        jax.vmap(lambda x: bounded_extract_rows(x, n))
-    )(m[None]))
+        jax.vmap(lambda s, i: tick_body(cfg_off, s, i, None))
+    )(st_b, ins_b))
     assert "cond" not in batched
+    # the manager's local step must be built with the flag off even
+    # though the caller's cfg has it on (the manager clears it)
+    step = _make_local_tick(cfg)
+    mgr = str(jax.make_jaxpr(lambda s, i: step(s, i, None))(st_b, ins_b))
+    assert "cond" not in mgr
+    # while the unbatched tick keeps the real branch
+    unbatched = str(jax.make_jaxpr(
+        lambda s, i: tick_body(cfg, s, i, None)
+    )(st, TickInputs.empty(cfg)))
+    assert "cond" in unbatched
 
 
 def test_vmapped_interest_pairs_matches_unbatched():
