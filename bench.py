@@ -100,7 +100,7 @@ def _grid_kw_from_env(n: int, overrides: dict | None = None) -> dict:
         cell_cap=int(os.environ.get("BENCH_CELL_CAP", 12)),
         row_block=min(n, int(os.environ.get("BENCH_ROW_BLOCK", 65536))),
         topk_impl=os.environ.get("BENCH_TOPK", "sort"),
-        sweep_impl=os.environ.get("BENCH_SWEEP", "table"),
+        sweep_impl=os.environ.get("BENCH_SWEEP", "ranges"),
     )
     grid_kw.update(overrides or {})
     grid_kw["row_block"] = min(n, grid_kw["row_block"])
@@ -213,9 +213,10 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
     candidates = [        # (selectable, overrides)
         (True, {}),
         (True, {"row_block": 32768}),
-        # tableless sweep: identical results while occupancy <= cell_cap
-        # (true at bench density by 9x margin), never-worse beyond
-        (True, {"sweep_impl": "ranges"}),
+        # dense-table sweep (pre-r4 default; "ranges" won the r4 CPU A/B
+        # by 18% and is never-worse on fidelity, so it is the default
+        # now) — kept so autotune can pick table back on TPU
+        (True, {"sweep_impl": "table"}),
         # the generic int32 lax.top_k (pre-r4 default; "sort" is the
         # default now) — kept so autotune can still detect a platform
         # where it wins
