@@ -180,12 +180,14 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
     """On-chip knob pick for the AOI sweep: time the sweep ALONE at the
     131K per-chip shard and return (grid overrides for the winner,
     per-config ms log). SELECTABLE candidates are those whose fidelity
-    at the bench workload is identical-or-better than the default:
-    row_block variants (pure execution blocking — cannot change which
-    neighbors are found) and the tableless ranges sweep (bit-identical
-    while per-cell occupancy <= cell_cap — a 9x margin at bench density
-    — and beyond that it only ever ADDS true neighbors the per-cell cap
-    dropped). cell_cap=8 and the approx top-k are DIAGNOSTICS only:
+    at the bench workload is identical-or-better than the default
+    (which since r4 is ranges/sort — the r4 CPU winners): row_block
+    variants (pure execution blocking — cannot change which neighbors
+    are found), the dense-table sweep (bit-identical to ranges while
+    per-cell occupancy <= cell_cap, a 9x margin at bench density; the
+    default ranges impl only ever ADDS neighbors beyond that), and the
+    exact/f32 top-k lowerings (same total key order as sort).
+    cell_cap=8 and the approx top-k are DIAGNOSTICS only:
     cap 8 drops neighbors in overflowing cells at 1M density and approx
     trades ~2% recall — autotune must never make the headline measure
     LESS than the documented default does. Knobs the caller pinned via
@@ -1010,6 +1012,17 @@ def parent_main() -> int:
             ),
         })
         if best is not None:
+            break
+        if note and not stages \
+                and ("Unable to initialize backend" in note
+                     or "backend setup" in note):
+            # backend-init failure without a single completed stage:
+            # the r4 wedged-relay mode fails every init DETERMINISTICALLY
+            # after ~27 min (9 observed cycles) while the TCP probe still
+            # answers — a second attempt only burns another half hour.
+            # Fall through to the CPU fallback immediately (no kill is
+            # involved; the child died on its own).
+            log("backend init failed; skipping remaining TPU attempts")
             break
         if note or had_suspect:
             log(f"attempt {i + 1} failed: "
