@@ -71,7 +71,7 @@ def bounded_extract(
 # touch a few thousand rows, so the [cap_rows, k] second-level work runs
 # at this size and the full-cap graph only executes on mass-event ticks
 # (lax.cond picks ONE branch at runtime, unlike where/select).
-SMALL_TIER_ROWS = 8192
+SMALL_TIER_ROWS = 16384
 
 
 def two_tier(count, small: int, full: int, tier_fn, adaptive: bool = True):
