@@ -1,0 +1,97 @@
+"""Micro-bisect the collect phase at 131K: interest_pairs vs
+collect_sync vs collect_attr_deltas, marginal timing like bench."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from goworld_tpu.ops.delta import interest_pairs
+from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
+
+N = int(os.environ.get("PROBE_N", 131072))
+K = 32
+L = 5
+ENTER_CAP = LEAVE_CAP = SYNC_CAP = 65536
+ATTR_CAP = 4096
+DELTA_ROWS = 65536
+
+rng = np.random.default_rng(0)
+nbr = np.sort(
+    rng.integers(0, N + 1, (N, K)).astype(np.int32), axis=1
+)
+nbr = jnp.asarray(nbr)
+has_client = jnp.asarray(rng.random(N) < 0.01)
+pos = jnp.asarray(rng.random((N, 3)).astype(np.float32) * 1000)
+yaw = jnp.zeros(N)
+hot = jnp.zeros((N, 8))
+adirty = jnp.asarray((rng.random(N) < 0.03).astype(np.uint32))
+fl = jnp.asarray(rng.integers(0, 4, (N, K)).astype(np.int32))
+
+
+def timeit(name, mk):
+    r1, r2 = jax.jit(mk(L)), jax.jit(mk(2 * L))
+    float(np.asarray(r1(nbr)))
+    float(np.asarray(r2(nbr)))
+    es = []
+    for i in range(2):
+        t0 = time.perf_counter(); float(np.asarray(r1(nbr)))
+        e1 = time.perf_counter() - t0
+        t0 = time.perf_counter(); float(np.asarray(r2(nbr)))
+        e2 = time.perf_counter() - t0
+        es.append((e1, e2))
+    ms = 1000.0 * max(min(e[1] for e in es) - min(e[0] for e in es),
+                      1e-9) / L
+    print(f"{name:28s} {ms:9.3f} ms/iter", flush=True)
+
+
+def mk_pairs(length):
+    def run(nb):
+        def body(carry, _):
+            prev_dirty = carry
+            prev = jnp.where(prev_dirty[:, None],
+                             jnp.roll(nb, 1, axis=0), nb)
+            ew, ej, en, lw, lj, ln, drn = interest_pairs(
+                prev, nb, N, ENTER_CAP, LEAVE_CAP, DELTA_ROWS)
+            return jnp.roll(prev_dirty, 1), en + ln + drn + ew.sum()
+        c, s = lax.scan(body, (jnp.arange(N) % 16) == 0, None,
+                        length=length)
+        return s.sum()
+    return run
+
+
+def mk_sync(length):
+    def run(nb):
+        def body(carry, _):
+            dirty = carry
+            sw, sj, sv, sn = collect_sync(
+                nb, dirty, has_client, pos, yaw, SYNC_CAP,
+                nbr_dirty=(fl & 1).astype(bool) & dirty[:, None])
+            return jnp.roll(dirty, 3), sn + sw.sum() + sv.sum()
+        c, s = lax.scan(body, jnp.ones(N, bool), None, length=length)
+        return s.sum()
+    return run
+
+
+def mk_attrs(length):
+    def run(nb):
+        def body(carry, _):
+            ad = carry
+            ae, ai, av, an = collect_attr_deltas(hot, ad, ATTR_CAP)
+            return jnp.roll(ad, 1), an + ae.sum() + av.sum()
+        c, s = lax.scan(body, adirty, None, length=length)
+        return s.sum()
+    return run
+
+
+print(f"device={jax.devices()[0]} N={N}", flush=True)
+timeit("interest_pairs", mk_pairs)
+timeit("collect_sync", mk_sync)
+timeit("collect_attr_deltas", mk_attrs)
+print("done", flush=True)

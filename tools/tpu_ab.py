@@ -1,0 +1,280 @@
+"""One-shot TPU A/B session: runs every staged experiment in ONE
+process (one backend init), streaming results to stdout as they land.
+
+Order puts the decision-critical experiments first in case the backend
+dies mid-run:
+  1. full-sweep impl matrix at 131K (table/shift x exact/sort/f32 +
+     approx + ranges) — picks the production config.
+  2. back-half stage bisect (gather / +key / +topk / +final-sort).
+  3. collect-phase bisect (interest_pairs / collect_sync / attrs).
+  4. move-phase bisect (inputs scatter / random_walk / integrate).
+Never wrapped in `timeout`; exits cleanly on its own.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from goworld_tpu.ops.aoi import (
+    GridSpec, _cell_rows, _sort_cells, _sorted_src, _build_table,
+    grid_neighbors, grid_neighbors_flags,
+)
+
+N = int(os.environ.get("PROBE_N", 131072))
+L = int(os.environ.get("PROBE_TICKS", 5))
+K = 32
+CC = 12
+extent = float(int((N * 10000 / 12) ** 0.5))
+
+key = jax.random.PRNGKey(0)
+k1, k2, k3 = jax.random.split(key, 3)
+pos = jnp.stack([
+    jax.random.uniform(k1, (N,), maxval=extent),
+    jnp.zeros(N),
+    jax.random.uniform(k2, (N,), maxval=extent)], axis=1)
+alive = jnp.ones(N, bool)
+flags = (jax.random.uniform(k3, (N,)) < 0.5).astype(jnp.int32)
+
+print(f"device={jax.devices()[0]} N={N}", flush=True)
+
+
+def timeit(name, mk, arg=None):
+    a = pos if arg is None else arg
+    try:
+        r1, r2 = jax.jit(mk(L)), jax.jit(mk(2 * L))
+        t0 = time.perf_counter()
+        float(np.asarray(r1(a)))
+        c1 = time.perf_counter() - t0
+        float(np.asarray(r2(a + 0.001)))
+        es = []
+        for i in range(2):
+            t0 = time.perf_counter()
+            float(np.asarray(r1(a + 0.002 * (i + 1))))
+            e1 = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            float(np.asarray(r2(a + 0.003 * (i + 1))))
+            e2 = time.perf_counter() - t0
+            es.append((e1, e2))
+        ms = 1000.0 * max(min(e[1] for e in es) - min(e[0] for e in es),
+                          1e-9) / L
+        print(f"{name:34s} {ms:10.3f} ms/iter   (compile {c1:.1f}s)",
+              flush=True)
+        return ms
+    except Exception as exc:
+        print(f"{name:34s} FAILED: {str(exc)[:160]}", flush=True)
+        return None
+
+
+# ---- 1. full-sweep impl matrix (with flags = the real tick path) ----
+
+def mk_full(impl, topk):
+    sp = GridSpec(radius=50.0, extent_x=extent, extent_z=extent,
+                  k=K, cell_cap=CC, row_block=65536,
+                  sweep_impl=impl, topk_impl=topk)
+
+    def make(length):
+        def run(p0):
+            def body(p, _):
+                nbr, cnt, fl = grid_neighbors_flags(
+                    sp, p, alive, flag_bits=flags)
+                p = p + (cnt[:, None] % 2).astype(p.dtype) * 1e-6
+                return p, cnt.sum() + fl.sum()
+            pp, ss = lax.scan(body, p0, None, length=length)
+            return ss.sum().astype(jnp.float32) + pp.sum()
+        return run
+    return make
+
+
+for impl, topk in (("table", "f32"), ("table", "sort"),
+                   ("shift", "f32"), ("shift", "sort"),
+                   ("table", "exact"), ("shift", "exact"),
+                   ("ranges", "f32"), ("table", "approx")):
+    timeit(f"sweep {impl}/{topk}", mk_full(impl, topk))
+
+# ---- 2. back-half stage bisect (table impl, no flags) ---------------
+
+spec = GridSpec(radius=50.0, extent_x=extent, extent_z=extent,
+                k=K, cell_cap=CC, row_block=65536)
+cc = CC
+
+
+def front_half(p):
+    cx, cz, srow, alive2, czp, n_rows = _cell_rows(spec, p, alive, None)
+    order, sorted_row = _sort_cells(N, n_rows, srow)
+    src, table_sentinel, sentinel_bits = _sorted_src(spec, p, None, order)
+    table = _build_table(cc, n_rows, sorted_row, src,
+                         (jnp.inf, jnp.inf, sentinel_bits))
+    return cx, cz, czp, n_rows, table, table_sentinel
+
+
+def mk_stage(stage):
+    def make(length):
+        def run(p0):
+            def body(p, _):
+                cx, cz, czp, n_rows, table, sentinel = front_half(p)
+                rows = jnp.arange(spec.row_block, dtype=jnp.int32)
+                dxs = jnp.array([-1, 0, 1], jnp.int32)
+                starts = (cx[rows][:, None] + dxs[None, :] + 1) * czp \
+                    + cz[rows][:, None]
+                b = rows.shape[0]
+                if stage == "gather_take":
+                    rows9 = (starts[:, :, None]
+                             + jnp.arange(3)[None, None, :]) \
+                        .reshape(b, 9)
+                    win = jnp.take(table, rows9, axis=0)
+                    s = jnp.where(jnp.isfinite(win), win, 0.0).sum()
+                    return p + (s % 2) * 1e-7, s
+                win = jax.vmap(jax.vmap(
+                    lambda s: lax.dynamic_slice(table, (s, 0),
+                                                (3, 3 * cc))
+                ))(starts)
+                win = win.reshape(b, 9, 3 * cc)
+                if stage == "gather":
+                    s = jnp.where(jnp.isfinite(win), win, 0.0).sum()
+                    return p + (s % 2) * 1e-7, s
+                cand_px = win[:, :, :cc].reshape(b, 9 * cc)
+                cand_pz = win[:, :, cc:2 * cc].reshape(b, 9 * cc)
+                cand_w = lax.bitcast_convert_type(
+                    win[:, :, 2 * cc:], jnp.int32).reshape(b, 9 * cc)
+                ddx = jnp.abs(cand_px - p[rows, 0][:, None])
+                ddz = jnp.abs(cand_pz - p[rows, 2][:, None])
+                dist = jnp.maximum(ddx, ddz)
+                valid = ((cand_w != N) & (dist <= spec.radius)
+                         & (cand_w != rows[:, None]))
+                qd = jnp.minimum(
+                    (dist * (1024.0 / spec.radius)).astype(jnp.int32),
+                    1023)
+                packed = jnp.where(valid, (qd << 21) | cand_w,
+                                   jnp.int32(2**31 - 1))
+                if stage == "key":
+                    s = packed.sum().astype(jnp.float32)
+                    return p + (s % 2) * 1e-7, s
+                top = -lax.top_k(-packed, K)[0]
+                if stage == "topk":
+                    s = top.sum().astype(jnp.float32)
+                    return p + (s % 2) * 1e-7, s
+                ok = top < jnp.int32(2**31 - 1)
+                nbr_b = jnp.sort(
+                    jnp.where(ok, top & ((1 << 21) - 1), N), axis=1)
+                s = nbr_b.sum().astype(jnp.float32)
+                return p + (s % 2) * 1e-7, s
+            pp, ss = lax.scan(body, p0, None, length=length)
+            return ss.sum() + pp.sum()
+        return run
+    return make
+
+
+for st in ("gather", "gather_take", "key", "topk", "all"):
+    timeit(f"stage {st}", mk_stage(st))
+
+# ---- 3. collect bisect ---------------------------------------------
+
+from goworld_tpu.ops.delta import interest_pairs
+from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
+
+rngn = np.random.default_rng(0)
+nbr0 = jnp.asarray(np.sort(
+    rngn.integers(0, N + 1, (N, K)).astype(np.int32), axis=1))
+has_client = jnp.asarray(rngn.random(N) < 0.01)
+yaw = jnp.zeros(N)
+hot = jnp.zeros((N, 8))
+adirty = jnp.asarray((rngn.random(N) < 0.03).astype(np.uint32))
+flk = jnp.asarray(rngn.integers(0, 4, (N, K)).astype(np.int32))
+CAP = 65536
+
+
+def mk_pairs(length):
+    def run(_p):
+        def body(carry, _):
+            prev_dirty = carry
+            prev = jnp.where(prev_dirty[:, None],
+                             jnp.roll(nbr0, 1, axis=0), nbr0)
+            ew, ej, en, lw, lj, ln, drn = interest_pairs(
+                prev, nbr0, N, CAP, CAP, CAP)
+            return jnp.roll(prev_dirty, 1), en + ln + drn + ew.sum()
+        c, s = lax.scan(body, (jnp.arange(N) % 16) == 0, None,
+                        length=length)
+        return s.sum().astype(jnp.float32)
+    return run
+
+
+def mk_sync(length):
+    def run(_p):
+        def body(carry, _):
+            dirty = carry
+            sw, sj, sv, sn = collect_sync(
+                nbr0, dirty, has_client, pos, yaw, CAP,
+                nbr_dirty=(flk & 1).astype(bool) & dirty[:, None])
+            return jnp.roll(dirty, 3), sn + sw.sum() + sv.sum()
+        c, s = lax.scan(body, jnp.ones(N, bool), None, length=length)
+        return s.sum().astype(jnp.float32)
+    return run
+
+
+def mk_attrs(length):
+    def run(_p):
+        def body(carry, _):
+            ad = carry
+            ae, ai, av, an = collect_attr_deltas(hot, ad, 4096)
+            return jnp.roll(ad, 1), an + ae.sum() + av.sum()
+        c, s = lax.scan(body, adirty, None, length=length)
+        return s.sum().astype(jnp.float32)
+    return run
+
+
+timeit("collect interest_pairs", mk_pairs)
+timeit("collect sync", mk_sync)
+timeit("collect attrs", mk_attrs)
+
+# ---- 4. move bisect -------------------------------------------------
+
+from goworld_tpu.models.random_walk import random_walk_step
+from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
+
+in_idx = jnp.asarray(rngn.integers(0, N, 4096).astype(np.int32))
+in_vals = jnp.asarray(rngn.random((4096, 4)).astype(np.float32))
+npc_moving = jnp.ones(N, bool)
+
+
+def mk_move(stage):
+    def make(length):
+        def run(p0):
+            def body(carry, _):
+                p, rng = carry
+                if stage in ("inputs", "all"):
+                    p2, yw, touched = apply_pos_inputs(
+                        p, yaw, in_idx, in_vals,
+                        jnp.asarray(4096, jnp.int32))
+                else:
+                    p2 = p
+                if stage in ("walk", "all"):
+                    rng, kk = jax.random.split(rng)
+                    vel = random_walk_step(kk, jnp.zeros((N, 3)),
+                                           npc_moving, 5.0, 0.1)
+                else:
+                    vel = jnp.ones((N, 3)) * 0.01
+                if stage in ("integrate", "all"):
+                    p3, moved = integrate(p2, vel, npc_moving, 1 / 30,
+                                          jnp.zeros(3),
+                                          jnp.full(3, extent))
+                else:
+                    p3 = p2 + vel * 1e-6
+                return (p3, rng), p3.sum()
+            c, s = lax.scan(body, (p0, jax.random.PRNGKey(9)), None,
+                            length=length)
+            return s.sum() + c[0].sum()
+        return run
+    return make
+
+
+for st in ("inputs", "walk", "integrate", "all"):
+    timeit(f"move {st}", mk_move(st))
+
+print("AB done", flush=True)
