@@ -183,16 +183,19 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
     at the bench workload is identical-or-better than the default
     (which since r4 is ranges/sort — the r4 CPU winners): row_block
     variants (pure execution blocking — cannot change which neighbors
-    are found), the dense-table sweep (bit-identical to ranges while
-    per-cell occupancy <= cell_cap, a 9x margin at bench density; the
-    default ranges impl only ever ADDS neighbors beyond that), and the
-    exact/f32 top-k lowerings (same total key order as sort).
-    cell_cap=8 and the approx top-k are DIAGNOSTICS only:
+    are found), the dense-table sweep and its cellrow row-gather form
+    (cellrow is bit-identical to table always; both are bit-identical
+    to ranges while per-cell occupancy <= cell_cap, a 9x margin at
+    bench density, and the default ranges impl only ever ADDS neighbors
+    beyond that), and the exact/f32 top-k lowerings (same total key
+    order as sort). cell_cap=8 and the approx top-k are DIAGNOSTICS
+    only:
     cap 8 drops neighbors in overflowing cells at 1M density and approx
     trades ~2% recall — autotune must never make the headline measure
     LESS than the documented default does. Knobs the caller pinned via
-    env are never overridden. Bounded cost: 8 candidates x 2 jitted
-    scan lengths = 16 sweep-only compiles at 131K; any failure falls
+    env are never overridden. Bounded cost: 6 selectable candidates x 2
+    jitted scan lengths = 12 sweep-only compiles at 131K (plus 4 more
+    candidate pairs with BENCH_AUTOTUNE_DIAG=1); any failure falls
     back to defaults."""
     import numpy as np
 
@@ -219,6 +222,10 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
         # by 18% and is never-worse on fidelity, so it is the default
         # now) — kept so autotune can pick table back on TPU
         (True, {"sweep_impl": "table"}),
+        # table with premerged windows + one canonical row-gather per
+        # query (bit-identical to table ALWAYS; built for TPU where
+        # gather descriptors bound the sweep)
+        (True, {"sweep_impl": "cellrow"}),
         # the generic int32 lax.top_k (pre-r4 default; "sort" is the
         # default now) — kept so autotune can still detect a platform
         # where it wins

@@ -40,8 +40,10 @@ class GameConfig:
     n_spaces: int = 1
     aoi_radius: float = 50.0
     # AOI kernel tuning (ops/aoi.py GridSpec): sweep candidate fetch
-    # ("table" | "ranges" | "shift" — shift is cell-major/gather-free
-    # but drops cap-overflowed entities as watchers) and top-k select
+    # ("table" | "ranges" | "cellrow" — table with premerged windows +
+    # one row-gather per query, bit-identical to table | "shift" —
+    # cell-major/gather-free but drops cap-overflowed entities as
+    # watchers) and top-k select
     # ("exact" | "sort" | "f32" — all three exact; sort/f32 lower to
     # faster TPU kernels — or "approx", which may miss a true neighbor
     # with ~2% probability on TPU). Unknown values are rejected at

@@ -92,10 +92,11 @@ def mk_full(impl, topk):
     return make
 
 
-for impl, topk in (("table", "f32"), ("table", "sort"),
-                   ("shift", "f32"), ("shift", "sort"),
-                   ("table", "exact"), ("shift", "exact"),
-                   ("ranges", "f32"), ("table", "approx")):
+for impl, topk in (("ranges", "sort"), ("table", "sort"),
+                   ("cellrow", "sort"), ("cellrow", "f32"),
+                   ("table", "f32"), ("ranges", "f32"),
+                   ("shift", "sort"), ("shift", "f32"),
+                   ("table", "exact"), ("table", "approx")):
     timeit(f"sweep {impl}/{topk}", mk_full(impl, topk))
 
 # ---- 2. back-half stage bisect (table impl, no flags) ---------------
