@@ -278,4 +278,13 @@ def mk_move(stage):
 for st in ("inputs", "walk", "integrate", "all"):
     timeit(f"move {st}", mk_move(st))
 
+# rbg vs threefry for the walk stage (jax_default_prng_impl is read at
+# PRNGKey creation, so flipping it mid-process A/Bs cleanly; "rbg"
+# rides the TPU hardware RNG instead of ~20 threefry rounds per draw)
+try:
+    jax.config.update("jax_default_prng_impl", "rbg")
+    timeit("move walk (rbg)", mk_move("walk"))
+finally:
+    jax.config.update("jax_default_prng_impl", "threefry2x32")
+
 print("AB done", flush=True)
