@@ -26,6 +26,15 @@ N = int(os.environ.get("AB_N", 262144))
 T = int(os.environ.get("AB_TICKS", 10))
 
 DEFAULT_CONFIGS = [
+    # r4 front-runners first (see docs/R4_MEASUREMENTS.md)
+    {"cell_cap": 12, "k": 32, "sweep_impl": "ranges",
+     "topk_impl": "sort"},
+    {"cell_cap": 12, "k": 32, "sweep_impl": "cellrow",
+     "topk_impl": "sort"},
+    {"cell_cap": 12, "k": 32, "sweep_impl": "cellrow",
+     "topk_impl": "f32"},
+    {"cell_cap": 12, "k": 32, "topk_impl": "sort"},
+    {"cell_cap": 12, "k": 32, "topk_impl": "f32"},
     {"cell_cap": 12, "k": 32, "topk_impl": "exact"},
     {"cell_cap": 12, "k": 32, "sweep_impl": "ranges"},
     {"cell_cap": 12, "k": 32, "topk_impl": "approx"},
