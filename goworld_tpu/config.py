@@ -48,7 +48,7 @@ class GameConfig:
     # faster TPU kernels — or "approx", which may miss a true neighbor
     # with ~2% probability on TPU). Unknown values are rejected at
     # GridSpec construction.
-    aoi_sweep_impl: str = "table"
+    aoi_sweep_impl: str = "ranges"
     aoi_topk_impl: str = "sort"
     # AOI capacity bounds (ops/aoi.py GridSpec k / cell_cap): exactness
     # holds while true neighbor demand <= aoi_k and cell occupancy <=
