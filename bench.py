@@ -52,6 +52,10 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+# jax-free (verified: pure constants) — safe in the no-jax parent
+from goworld_tpu.utils import consts as _consts
 BASELINE_ENTITY_TICKS_PER_CHIP = 7.5e6
 
 # grid knob -> env var pinning it (shared by _grid_kw_from_env's
@@ -63,6 +67,42 @@ GRID_ENV = {
     "topk_impl": "BENCH_TOPK",
     "sweep_impl": "BENCH_SWEEP",
 }
+
+# autotune_sweep's candidate pool: (selectable, grid overrides).
+# Module-level so tests can assert the fidelity contract directly:
+# selectable=False marks DIAGNOSTICS — configs whose fidelity at the
+# bench workload can be WORSE than the default's, which autotune must
+# never pick on its own (tests/test_impl_defaults.py locks this in).
+AUTOTUNE_CANDIDATES = [
+    (True, {}),
+    (True, {"row_block": 32768}),
+    # dense-table sweep (pre-r4 default; "ranges" won the r4 CPU A/B
+    # by 18% and is never-worse on fidelity, so it is the default
+    # now) — kept so autotune can pick table back on TPU
+    (True, {"sweep_impl": "table"}),
+    # table with premerged windows + one canonical row-gather per
+    # query (bit-identical to table ALWAYS; built for TPU where
+    # gather descriptors bound the sweep)
+    (True, {"sweep_impl": "cellrow"}),
+    # the generic int32 lax.top_k (pre-r4 default; "sort" is the
+    # default now) — kept so autotune can still detect a platform
+    # where it wins
+    (True, {"topk_impl": "exact"}),
+    # exact top-k in the f32 bit-pattern domain: rides the fast TPU
+    # TopK custom-call instead of the generic int32 expansion
+    (True, {"topk_impl": "f32"}),
+    # cell-major gather-free sweep: DIAGNOSTIC despite its speed
+    # potential — beyond cell_cap it drops overflowed entities as
+    # watchers (strictly worse than table, unlike ranges' pooling),
+    # and at 1M/cc=12 the occupancy tail gives a small but nonzero
+    # per-run chance of that regime. Selecting it would need the
+    # headline run to verify the over-cap gauge stayed zero on the
+    # measured workload; pin BENCH_SWEEP=shift to A/B by hand.
+    (False, {"sweep_impl": "shift"}),
+    (False, {"sweep_impl": "shift", "topk_impl": "sort"}),
+    (False, {"cell_cap": 8}),           # diagnostic: drop risk at 1M
+    (False, {"topk_impl": "approx"}),   # diagnostic: recall < 1
+]
 
 N = int(os.environ.get("BENCH_N", 1_048_576))
 BEHAVIOR = os.environ.get("BENCH_BEHAVIOR", "random_walk")  # or "mlp"
@@ -99,8 +139,9 @@ def _grid_kw_from_env(n: int, overrides: dict | None = None) -> dict:
         k=int(os.environ.get("BENCH_K", 32)),
         cell_cap=int(os.environ.get("BENCH_CELL_CAP", 12)),
         row_block=min(n, int(os.environ.get("BENCH_ROW_BLOCK", 65536))),
-        topk_impl=os.environ.get("BENCH_TOPK", "sort"),
-        sweep_impl=os.environ.get("BENCH_SWEEP", "ranges"),
+        topk_impl=os.environ.get("BENCH_TOPK", _consts.DEFAULT_TOPK_IMPL),
+        sweep_impl=os.environ.get("BENCH_SWEEP",
+                                  _consts.DEFAULT_SWEEP_IMPL),
     )
     grid_kw.update(overrides or {})
     grid_kw["row_block"] = min(n, grid_kw["row_block"])
@@ -215,36 +256,7 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
          jax.random.uniform(k2, (n,), maxval=extent)], axis=1)
     alive = jnp.ones(n, bool)
     flags = (jax.random.uniform(k3, (n,)) < 0.5).astype(jnp.int32)
-    candidates = [        # (selectable, overrides)
-        (True, {}),
-        (True, {"row_block": 32768}),
-        # dense-table sweep (pre-r4 default; "ranges" won the r4 CPU A/B
-        # by 18% and is never-worse on fidelity, so it is the default
-        # now) — kept so autotune can pick table back on TPU
-        (True, {"sweep_impl": "table"}),
-        # table with premerged windows + one canonical row-gather per
-        # query (bit-identical to table ALWAYS; built for TPU where
-        # gather descriptors bound the sweep)
-        (True, {"sweep_impl": "cellrow"}),
-        # the generic int32 lax.top_k (pre-r4 default; "sort" is the
-        # default now) — kept so autotune can still detect a platform
-        # where it wins
-        (True, {"topk_impl": "exact"}),
-        # exact top-k in the f32 bit-pattern domain: rides the fast TPU
-        # TopK custom-call instead of the generic int32 expansion
-        (True, {"topk_impl": "f32"}),
-        # cell-major gather-free sweep: DIAGNOSTIC despite its speed
-        # potential — beyond cell_cap it drops overflowed entities as
-        # watchers (strictly worse than table, unlike ranges' pooling),
-        # and at 1M/cc=12 the occupancy tail gives a small but nonzero
-        # per-run chance of that regime. Selecting it would need the
-        # headline run to verify the over-cap gauge stayed zero on the
-        # measured workload; pin BENCH_SWEEP=shift to A/B by hand.
-        (False, {"sweep_impl": "shift"}),
-        (False, {"sweep_impl": "shift", "topk_impl": "sort"}),
-        (False, {"cell_cap": 8}),           # diagnostic: drop risk at 1M
-        (False, {"topk_impl": "approx"}),   # diagnostic: recall < 1
-    ]
+    candidates = AUTOTUNE_CANDIDATES
     if os.environ.get("BENCH_AUTOTUNE_DIAG", "0") != "1":
         # diagnostics cost 2 compiles each at 131K (~1 min apiece over
         # the tunnel) and can never be selected — skip them unless asked
@@ -1271,7 +1283,6 @@ def main() -> int:
     ap.add_argument("--phases", action="store_true", default=PHASES)
     args = ap.parse_args()
     if args.child:
-        sys.path.insert(0, REPO)
         return child_main(args)
     if args.selftest:
         return selftest_main()

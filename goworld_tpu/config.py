@@ -16,6 +16,8 @@ import configparser
 import dataclasses
 import os
 
+from goworld_tpu.utils import consts
+
 DEFAULT_CONFIG_PATHS = ("goworld_tpu.ini", "goworld.ini")
 
 
@@ -47,9 +49,11 @@ class GameConfig:
     # ("exact" | "sort" | "f32" — all three exact; sort/f32 lower to
     # faster TPU kernels — or "approx", which may miss a true neighbor
     # with ~2% probability on TPU). Unknown values are rejected at
-    # GridSpec construction.
-    aoi_sweep_impl: str = "ranges"
-    aoi_topk_impl: str = "sort"
+    # GridSpec construction. Defaults come from
+    # consts.DEFAULT_SWEEP_IMPL / DEFAULT_TOPK_IMPL — the one source
+    # of truth shared with GridSpec and bench.py.
+    aoi_sweep_impl: str = consts.DEFAULT_SWEEP_IMPL
+    aoi_topk_impl: str = consts.DEFAULT_TOPK_IMPL
     # AOI capacity bounds (ops/aoi.py GridSpec k / cell_cap): exactness
     # holds while true neighbor demand <= aoi_k and cell occupancy <=
     # aoi_cell_cap; overflow degrades to nearest-k and fires the

@@ -125,8 +125,10 @@ class GridSpec:
     # DEFAULT is "sort": exact under every workload and 2.5x faster
     # than the int32 lax.top_k on both platforms measured in r4 (the
     # generic int32 top_k lowering is the worst case everywhere);
-    # autotune/benchmarks may still pick "f32" per platform.
-    topk_impl: str = "sort"
+    # autotune/benchmarks may still pick "f32" per platform. The
+    # default literal lives in consts.DEFAULT_TOPK_IMPL — one source
+    # of truth shared with GameConfig.aoi_topk_impl and bench.py.
+    topk_impl: str = consts.DEFAULT_TOPK_IMPL
     # Candidate-fetch strategy:
     #   "table"  — scatter the sorted entities into a dense per-cell
     #              table, then read 3 strided (3, 3*cell_cap) windows
@@ -173,7 +175,11 @@ class GridSpec:
     #              gauge (`with_stats`) alarms in exactly that regime.
     #              Packed-id fast path only (n < 2^21); wide worlds fall
     #              back to "table".
-    sweep_impl: str = "table"
+    # The default literal lives in consts.DEFAULT_SWEEP_IMPL ("ranges",
+    # the r4 measured winner) — one source of truth shared with
+    # GameConfig.aoi_sweep_impl and bench.py, so kernel-level GridSpec
+    # users can't silently get a slower impl than the production stack.
+    sweep_impl: str = consts.DEFAULT_SWEEP_IMPL
 
     def __post_init__(self):
         # a typo'd knob would otherwise silently fall through every

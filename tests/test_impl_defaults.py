@@ -1,0 +1,69 @@
+"""The AOI impl defaults have ONE source of truth (VERDICT r4 weak #7).
+
+GridSpec (kernel level), GameConfig.aoi_* (ini level) and bench.py's
+env-defaulted grid knobs must all resolve to consts.DEFAULT_SWEEP_IMPL /
+DEFAULT_TOPK_IMPL, so a direct GridSpec user gets the same measured
+winner the production stack and the benchmark run. Also locks in that
+bench autotune can never silently select a fidelity-degrading config
+(the "approx" top-k's recall is unmeasurable off-TPU — VERDICT r4 weak
+#4/#6 — and "shift" drops cap-overflowed entities as watchers).
+"""
+
+import importlib.util
+import os
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from goworld_tpu.config import GameConfig
+from goworld_tpu.ops.aoi import GridSpec
+from goworld_tpu.utils import consts
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_one_source_of_truth():
+    gs = GridSpec(radius=10.0)
+    gc = GameConfig()
+    assert gs.sweep_impl == consts.DEFAULT_SWEEP_IMPL
+    assert gs.topk_impl == consts.DEFAULT_TOPK_IMPL
+    assert gc.aoi_sweep_impl == consts.DEFAULT_SWEEP_IMPL
+    assert gc.aoi_topk_impl == consts.DEFAULT_TOPK_IMPL
+
+
+def test_bench_grid_defaults_agree(monkeypatch):
+    for var in ("BENCH_TOPK", "BENCH_SWEEP"):
+        monkeypatch.delenv(var, raising=False)
+    bench = _load_bench()
+    kw = bench._grid_kw_from_env(131072)
+    assert kw["sweep_impl"] == consts.DEFAULT_SWEEP_IMPL
+    assert kw["topk_impl"] == consts.DEFAULT_TOPK_IMPL
+
+
+def test_autotune_never_selects_fidelity_degrading_configs(monkeypatch):
+    """Every autotune candidate using the approx top-k (recall < 1 on
+    TPU, unmeasurable off-TPU), the shift sweep (drops cap-overflowed
+    entities as watchers), or a REDUCED cell_cap (drops candidates in
+    overflowing cells) must be marked non-selectable so autotune cannot
+    pick a config whose fidelity at the bench workload is worse than
+    the default's."""
+    monkeypatch.delenv("BENCH_CELL_CAP", raising=False)
+    bench = _load_bench()
+    default_cap = bench._grid_kw_from_env(131072)["cell_cap"]
+
+    def degrading(ov: dict) -> bool:
+        return (ov.get("topk_impl") == "approx"
+                or ov.get("sweep_impl") == "shift"
+                or ov.get("cell_cap", default_cap) < default_cap)
+
+    cands = bench.AUTOTUNE_CANDIDATES
+    assert any(degrading(ov) for _, ov in cands), \
+        "expected diagnostic candidates present"
+    for sel, ov in cands:
+        if degrading(ov):
+            assert not sel, f"fidelity-degrading candidate selectable: {ov}"
