@@ -357,6 +357,7 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
         ban_boot=gc.ban_boot_entity or mh_rank > 0,
         restore=restoring,
         checkpoint_interval=gc.checkpoint_interval,
+        gc_freeze_on_boot=gc.gc_freeze,
     )
     svc = server.setup_services()
     _apply_registrations(world, svc=svc, services_only=True)

@@ -67,6 +67,17 @@ class GameConfig:
     # off-thread on single-controller games; synchronous at a
     # tick-count cadence on multihost groups (leader writes the file).
     checkpoint_interval: float = 0.0
+    # freeze boot-time objects out of the cyclic GC when the logic loop
+    # starts (gen-2 collections otherwise walk the whole entity
+    # population — ~100 ms at a 131K-entity shard vs the 16 ms frame);
+    # post-boot churn stays tracked and collectable. CAVEAT: frozen
+    # objects are reclaimed by refcounting only. The engine severs the
+    # cycles it owns on destroy (attr trees, timer callbacks —
+    # manager.destroy_entity / attrs.sever_tree), but USER-held cycles
+    # among boot entities (e.g. two NPCs storing references to each
+    # other) will leak after destroy — break such references in
+    # OnDestroy, or set gc_freeze = false
+    gc_freeze: bool = True
     extent_x: float = 1000.0
     extent_z: float = 1000.0
     mesh_devices: int = 0  # 0 = single-device vmap path (GLOBAL count

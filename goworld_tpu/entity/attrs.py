@@ -17,9 +17,8 @@ batching shape as the device's hot-attr delta array,
 
 from __future__ import annotations
 
-import dataclasses
 import numbers
-from typing import Any, Callable, Iterator
+from typing import Any, Callable, Iterator, NamedTuple
 
 # journal ops
 OP_SET = "set"
@@ -29,9 +28,13 @@ OP_POP = "pop"
 OP_INSERT = "insert"
 
 
-@dataclasses.dataclass
-class AttrDelta:
-    """One attribute mutation, addressed by path from the entity root."""
+class AttrDelta(NamedTuple):
+    """One attribute mutation, addressed by path from the entity root.
+
+    A NamedTuple (not a dataclass): deltas are constructed per mutation
+    on the per-tick host path — device hot-attr decode journals one per
+    record at attr_sync_cap volumes — and tuple construction is ~2x a
+    dataclass ``__init__``."""
 
     path: tuple  # (key, key-or-index, ...) root-first
     op: str
@@ -286,6 +289,26 @@ def make_root(cb: Callable[[AttrDelta], None]) -> MapAttr:
     root = MapAttr()
     root._root_cb = cb
     return root
+
+
+def sever_tree(node: Any) -> None:
+    """Clear every back-reference in an attr tree (child ``parent``
+    pointers and the root's journal callback, whose closure holds the
+    entity). A discarded tree then frees by plain refcounting — required
+    for entities in the GC's permanent generation (the game logic
+    loop's default ``gc.freeze`` boot discipline, ``net/game.py``),
+    which the cyclic collector never revisits. Reads on a severed tree
+    still work; mutations no longer journal."""
+    if isinstance(node, MapAttr):
+        children = node._d.values()
+    elif isinstance(node, ListAttr):
+        children = node._l
+    else:
+        return
+    node._root_cb = None
+    node.parent = None
+    for v in children:
+        sever_tree(v)
 
 
 def load_into(root: MapAttr, data: dict) -> None:

@@ -29,7 +29,14 @@ import numpy as np
 
 from goworld_tpu.core.state import SpaceState, WorldConfig
 from goworld_tpu.core.step import TickInputs, tick_body
-from goworld_tpu.entity.attrs import AttrDelta, load_into, make_root
+from goworld_tpu.entity.attrs import (
+    AttrDelta,
+    ListAttr,
+    MapAttr,
+    load_into,
+    make_root,
+    sever_tree,
+)
 from goworld_tpu.entity.entity import Entity, GameClient
 from goworld_tpu.entity.registry import (
     RF_OTHER_CLIENT,
@@ -769,6 +776,17 @@ class World:
         # referencing its slot have been processed (_process_outputs), or
         # until _process_arrivals drops its in-flight row (destroyed
         # mid-migration)
+        #
+        # Break the entity's reference cycles (e -> attrs ->
+        # _root_cb-closure -> e, and every attr child's parent
+        # pointer): with the logic loop's default gc.freeze-on-boot
+        # (net/game.py), boot-time entities live in the GC's permanent
+        # generation and ONLY plain refcounting can reclaim them — a
+        # destroyed entity left cyclic would leak for the process
+        # lifetime. Post-destroy attr mutations no longer journal,
+        # which is correct: the entity is gone to every client.
+        if e.attrs is not None:
+            sever_tree(e.attrs)
         if self.on_entity_destroyed is not None:
             self.on_entity_destroyed(e)
 
@@ -906,39 +924,73 @@ class World:
                 and not isinstance(d.value, bool):
             self.stage_hot(e, col, float(d.value))
 
-    def _apply_device_attr(self, e: Entity, name: str, v: float) -> None:
-        """Write a kernel-mutated hot attr into the host tree WITHOUT
-        echoing it back to the device (it already holds the value), while
-        still journaling the change for client fan-out."""
-        cb = e.attrs._root_cb
-        e.attrs._root_cb = None
-        try:
-            e.attrs[name] = v
-        finally:
-            e.attrs._root_cb = cb
-        self._dirty_attr_entities.setdefault(e.id, []).append(
-            AttrDelta((name,), "set", v)
+    def _journal_wanted(self, e: Entity, aud: str | None) -> bool:
+        """Whether a device-attr delta has any recipient: the drain
+        fans out to the own client ("client" audience) and/or watching
+        clients ("all_clients"); journaling anything else is per-record
+        work thrown away at drain (the dominant host cost at
+        attr_sync_cap volume — tools/probe_fanout.py)."""
+        return aud is not None and (
+            e.client is not None
+            or (aud == "all_clients" and bool(e.interested_by))
         )
+
+    def _apply_device_attr(self, e: Entity, name: str, v: float,
+                           aud: str | None) -> None:
+        """Write a kernel-mutated hot attr into the host tree WITHOUT
+        echoing it back to the device (it already holds the value),
+        journaling the change for client fan-out when ``aud`` (the
+        attr's audience, see ``_journal_wanted``) gives it a recipient.
+
+        Runs per record at attr_sync_cap volumes on the per-tick host
+        path (profiled: the full MapAttr.set machinery was ~45% of the
+        attr decode at cap volume), so plain-scalar overwrites — the
+        only shape a hot attr ever has — take a direct dict write:
+        orphan/adopt are no-ops for non-node values and the suppressed
+        root callback means set() would emit nothing anyway."""
+        attrs = e.attrs
+        old = attrs._d.get(name)
+        if isinstance(old, (MapAttr, ListAttr)):
+            cb = attrs._root_cb
+            attrs._root_cb = None
+            try:
+                attrs[name] = v
+            finally:
+                attrs._root_cb = cb
+        else:
+            attrs._d[name] = v
+        if self._journal_wanted(e, aud):
+            self._dirty_attr_entities.setdefault(e.id, []).append(
+                AttrDelta((name,), "set", v)
+            )
 
     def _drain_attr_journals(self) -> None:
         for eid, deltas in self._dirty_attr_entities.items():
             e = self.entities.get(eid)
             if e is None or e.destroyed:
                 continue
+            has_own = e.client is not None
+            has_watchers = bool(e.interested_by)
+            if not has_own and not has_watchers:
+                # nobody to tell — don't build recs that are dropped
+                # (this loop runs at attr_sync_cap volumes per tick)
+                continue
             desc = e._type_desc
             own: list = []
             others: list = []
             for d in deltas:
                 aud = desc.audience_of(d.path[0]) if d.path else None
+                if aud is None:
+                    continue
                 rec = {"path": list(d.path), "op": d.op, "value": d.value}
                 if aud == "all_clients":
                     own.append(rec)
                     others.append(rec)
-                elif aud == "client":
+                else:
                     own.append(rec)
-            if own and e.client is not None:
+            if own and has_own:
                 e.client.send({"type": "attrs", "eid": eid, "deltas": own})
-            if others and e.interested_by:
+            if others and has_watchers:
                 for wid in e.interested_by:
                     w = self.entities.get(wid)
                     if w is not None and w.client is not None:
@@ -1535,6 +1587,19 @@ class World:
         # X) on the destination tile for a subject X visible from both —
         # both slots resolve to the same host entity, so enters must be
         # applied last for the final interest set to be correct.
+        # The pair-decode loops below run at event-cap volumes every
+        # tick (the host half of the 16 ms frame budget — see
+        # tools/probe_fanout.py): owner resolution is inlined (two
+        # dict gets, no helper-call overhead; dict.get(None) is safely
+        # None) and the AOI hook call + its exception containment is
+        # skipped for types that don't override the no-op hook. The
+        # override test is cached per CLASS per decode (so post-
+        # registration class patching is honored) with a per-pair
+        # instance-__dict__ check for per-object hook assignment.
+        mega = self.mega is not None
+        entities = self.entities
+        leave_hooked: dict[type, bool] = {}
+        enter_hooked: dict[type, bool] = {}
         for shard in self.local_shards:
             ln = int(base.leave_n[shard])
             if ln > cfg.leave_cap:
@@ -1542,6 +1607,7 @@ class World:
                     "shard %d leave overflow: %d > %d", shard, ln,
                     cfg.leave_cap,
                 )
+            slot_eid = self._slot_owner[shard].get
             # .tolist() upfront: plain-int pairs beat per-element numpy
             # scalar conversions across tens of thousands of events
             for w, j in zip(
@@ -1550,16 +1616,23 @@ class World:
                 np.asarray(base.leave_j[shard])[: min(ln, cfg.leave_cap)]
                 .tolist(),
             ):
-                we = self._owner_entity(shard, w)
-                je = self._owner_subject(shard, j)
+                we = entities.get(slot_eid(w))
+                je = (self._owner_subject(shard, j) if mega
+                      else entities.get(slot_eid(j)))
                 if we is None or je is None:
                     continue
                 we.interested_in.discard(je.id)
                 je.interested_by.discard(we.id)
-                try:
-                    we.OnLeaveAOI(je)
-                except Exception:
-                    logger.exception("OnLeaveAOI failed")
+                wcls = we.__class__
+                hooked = leave_hooked.get(wcls)
+                if hooked is None:
+                    hooked = leave_hooked[wcls] = (
+                        wcls.OnLeaveAOI is not Entity.OnLeaveAOI)
+                if hooked or "OnLeaveAOI" in we.__dict__:
+                    try:
+                        we.OnLeaveAOI(je)
+                    except Exception:
+                        logger.exception("OnLeaveAOI failed")
                 if we.client is not None and not we.destroyed:
                     we.client.send({
                         "type": "destroy_entity", "eid": je.id,
@@ -1597,22 +1670,30 @@ class World:
             # sends; a user OnEnterAOI hook mutating the subject MID-
             # DECODE would journal attr deltas to clients anyway.
             payloads: dict[str, tuple] = {}
+            slot_eid = self._slot_owner[shard].get
             for w, j in zip(
                 np.asarray(base.enter_w[shard])[: min(en, cfg.enter_cap)]
                 .tolist(),
                 np.asarray(base.enter_j[shard])[: min(en, cfg.enter_cap)]
                 .tolist(),
             ):
-                we = self._owner_entity(shard, w)
-                je = self._owner_subject(shard, j)
+                we = entities.get(slot_eid(w))
+                je = (self._owner_subject(shard, j) if mega
+                      else entities.get(slot_eid(j)))
                 if we is None or je is None:
                     continue
                 we.interested_in.add(je.id)
                 je.interested_by.add(we.id)
-                try:
-                    we.OnEnterAOI(je)
-                except Exception:
-                    logger.exception("OnEnterAOI failed")
+                wcls = we.__class__
+                hooked = enter_hooked.get(wcls)
+                if hooked is None:
+                    hooked = enter_hooked[wcls] = (
+                        wcls.OnEnterAOI is not Entity.OnEnterAOI)
+                if hooked or "OnEnterAOI" in we.__dict__:
+                    try:
+                        we.OnEnterAOI(je)
+                    except Exception:
+                        logger.exception("OnEnterAOI failed")
                 if we.client is not None and not je.destroyed:
                     pc = payloads.get(je.id)
                     if pc is None:
@@ -1678,14 +1759,36 @@ class World:
                 es = np.asarray(base.attr_e[shard])[:an]
                 cs = np.asarray(base.attr_i[shard])[:an]
                 vs = np.asarray(base.attr_v[shard])[:an]
-                for slot, col, v in zip(es, cs, vs):
-                    e = self._owner_entity(shard, int(slot))
+                slot_eid = self._slot_owner[shard].get
+                dirty = self._dirty_attr_entities
+                for slot, col, v in zip(es.tolist(), cs.tolist(),
+                                        vs.tolist()):
+                    e = entities.get(slot_eid(slot))
                     if e is None:
                         continue
-                    for name, c in e._type_desc.hot_attrs.items():
-                        if c == int(col):
-                            self._apply_device_attr(e, name, float(v))
-                            break
+                    info = e._type_desc.hot_attr_by_col.get(col)
+                    if info is None:
+                        continue
+                    name, aud = info
+                    attrs = e.attrs
+                    if isinstance(attrs._d.get(name),
+                                  (MapAttr, ListAttr)):
+                        # a hot attr shadowed by a tree node — take the
+                        # orphaning slow path (same journal policy)
+                        self._apply_device_attr(e, name, v, aud)
+                        continue
+                    attrs._d[name] = v
+                    # inline _journal_wanted + _apply_device_attr's
+                    # fast path (this loop runs at attr_sync_cap
+                    # volumes; the call overhead alone was measured by
+                    # tools/probe_fanout.py): journal ONLY deltas
+                    # someone will receive
+                    if aud is not None and (
+                        e.client is not None
+                        or (aud == "all_clients" and e.interested_by)
+                    ):
+                        dirty.setdefault(e.id, []).append(
+                            AttrDelta((name,), "set", v))
 
         if self.mesh is not None and self.mega is None:
             self._process_arrivals(outs)

@@ -63,6 +63,11 @@ class EntityTypeDesc:
     persistent_attrs: frozenset = frozenset()
     # attr name -> SoA hot_attrs column index (device-visible scalars)
     hot_attrs: dict = dataclasses.field(default_factory=dict)
+    # the reverse (column -> (attr name, audience)), precomputed once:
+    # the device hot-attr delta decode runs per record on the per-tick
+    # host path and must not scan hot_attrs.items() or re-derive
+    # audience_of each time
+    hot_attr_by_col: dict = dataclasses.field(default_factory=dict)
     rpc_descs: dict = dataclasses.field(default_factory=dict)
     type_id: int = 0  # device type_id column value (registration order)
 
@@ -156,6 +161,11 @@ class Registry:
             all_client_attrs=frozenset(all_clients),
             persistent_attrs=frozenset(persist),
             hot_attrs=hot,
+            hot_attr_by_col={
+                c: (a, "all_clients" if a in all_clients
+                    else "client" if a in client else None)
+                for a, c in hot.items()
+            },
             rpc_descs=_visit_rpc_methods(cls),
             type_id=len(self._types),
         )

@@ -104,6 +104,13 @@ class TimerQueue:
         if t is None:
             return False
         t.cancelled = True
+        # the dead heap entry sits until its fire_at (lazy deletion);
+        # drop the callback closure NOW — it typically holds the owning
+        # entity (e.g. the 300 s save timer), which must be refcount-
+        # reclaimable the moment it's destroyed (the gc.freeze boot
+        # discipline exempts boot objects from cycle collection)
+        t.cb = None
+        t.args = ()
         return True
 
     def tick(self, fire: Callable[[_Timer], None]) -> int:
