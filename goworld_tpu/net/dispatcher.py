@@ -238,6 +238,9 @@ class DispatcherService:
             proto.MT_NOTIFY_CLIENT_DISCONNECTED: self._h_client_disconnected,
             proto.MT_SYNC_POSITION_YAW_FROM_CLIENT: self._h_sync_upstream,
             proto.MT_SYNC_POSITION_YAW_ON_CLIENTS: self._h_sync_downstream,
+            # per-tick client event bundle: forward to its gate whole
+            # (the gate unbundles) — same leg as the sync batch
+            proto.MT_CLIENT_EVENTS_BATCH: self._h_to_gate,
             proto.MT_SET_CLIENT_FILTER_PROP: self._h_to_gate,
             proto.MT_CALL_FILTERED_CLIENTS: self._h_filtered_broadcast,
             proto.MT_KVREG_REGISTER: self._h_kvreg,

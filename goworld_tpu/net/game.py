@@ -130,6 +130,12 @@ class GameServer:
         self._migrating_out: dict[str, tuple[Entity, str, tuple]] = {}
         # per-gate downstream sync batches for the current tick
         self._sync_out: dict[int, list] = {}
+        # per-gate ordered (inner_msgtype, body) client messages staged
+        # this tick; flushed as ONE MT_CLIENT_EVENTS_BATCH packet per
+        # gate (before syncs, so a create precedes its entity's first
+        # position sync). Emission order per gate is preserved, so the
+        # per-client message order matches the per-message path.
+        self._events_out: dict[int, list] = {}
         self.on_deployment_ready: Callable[[], None] | None = None
         # multihost World-mutation log (see _MH_WORLD_MSGTYPES)
         self._mh_pending: list[tuple[int, bytes]] = []
@@ -240,6 +246,10 @@ class GameServer:
 
         w = self.world
         w.post_q.tick()
+        # the deferred work just drained may have staged client
+        # messages; the tick loop will never flush again, so do it now
+        # (pre-batching they were sent immediately)
+        self._flush_sync_out()
         # an in-flight ASYNC checkpoint must finish before the freeze
         # file is written: its atomic rename landing afterwards would
         # give an OLDER-state checkpoint a NEWER mtime, and the
@@ -265,6 +275,9 @@ class GameServer:
         if not self._mh_follower():
             _freeze.write_freeze_file(path, data)
             logger.info("game%d: frozen to %s", self.game_id, path)
+        # OnFreeze hooks may have emitted client messages after the
+        # first flush — put them on the wire before exiting
+        self._flush_sync_out()
         self.run_state = "frozen"
         self.stop()
 
@@ -532,9 +545,13 @@ class GameServer:
                 tuple(msg["args"]),
             )
         elif t == "filter_prop":
+            # gate-service message (mutates the gate's FilterIndex, no
+            # client relay) — not part of the per-client event stream
             p = proto.pack_set_client_filter_prop(
                 gate_id, client_id, msg["key"], msg["val"]
             )
+            self._send(self.cluster.select_by_gate_id(gate_id), p)
+            return
         elif t == "sync":
             self._sync_out.setdefault(gate_id, []).append(
                 (client_id, msg["eid"],
@@ -545,13 +562,59 @@ class GameServer:
             logger.warning("game%d: unknown client msg type %r",
                            self.game_id, t)
             return
-        self._send(self.cluster.select_by_gate_id(gate_id), p)
+        # Stage into the per-gate per-tick bundle instead of sending a
+        # dispatcher packet per message: a churn-heavy AOI tick emits
+        # thousands of create/destroy/attr messages and per-message
+        # framing through two hops dominated the gate leg. The record
+        # body is the packed message minus its [u16 msgtype][u16
+        # gate_id] prefix — byte-identical to what the gate's relay
+        # forwards to the client. (buf layout: new_packet wrote the
+        # u16 msgtype first, the pack_* helper the u16 gate_id next.)
+        mt = int.from_bytes(bytes(p.buf[0:2]), "little")
+        self._events_out.setdefault(gate_id, []).append(
+            (mt, bytes(memoryview(p.buf)[4:]))
+        )
+        # the packed message was copied into the record — return the
+        # pooled packet (the per-message path's _send released it)
+        p.release()
 
     def _sync_sink(self, gate_id: int, cids: list, eids: list,
                    vals: np.ndarray) -> None:
         self._sync_out.setdefault(gate_id, []).append((cids, eids, vals))
 
+    _EVENT_BATCH_BYTES = 4 * 1024 * 1024  # chunk bound, well under the
+                                          # 32M packet cap
+
+    def _flush_events_out(self) -> None:
+        """Put the staged per-gate client event bundles on the wire.
+        Called from the per-tick flush, and EAGERLY by any send whose
+        gate-side handling depends on the staged events having been
+        applied (e.g. a filtered broadcast resolving cp.owner_eid set
+        by a staged create_entity)."""
+        for gate_id, recs in self._events_out.items():
+            if not recs:
+                continue
+            conn = self.cluster.select_by_gate_id(gate_id)
+            chunk: list = []
+            size = 0
+            for rec in recs:
+                chunk.append(rec)
+                size += 6 + len(rec[1])
+                if size >= self._EVENT_BATCH_BYTES:
+                    self._send(conn,
+                               proto.pack_client_events_batch(
+                                   gate_id, chunk))
+                    chunk, size = [], 0
+            if chunk:
+                self._send(conn,
+                           proto.pack_client_events_batch(gate_id, chunk))
+        self._events_out.clear()
+
     def _flush_sync_out(self) -> None:
+        # client event bundles FIRST: a create_entity staged this tick
+        # must reach the client before the same entity's first position
+        # sync record (flushed below)
+        self._flush_events_out()
         for gate_id, chunks in self._sync_out.items():
             # per-chunk ARRAYS concatenated once — never element-wise
             # Python appends (the world's mirror path hands us S16
@@ -600,6 +663,11 @@ class GameServer:
                        args: tuple) -> None:
         if self._mh_follower():
             return
+        # a filtered RPC is addressed on the gate via cp.owner_eid,
+        # which a create_entity staged THIS tick may set — flush the
+        # event bundles first so the broadcast observes them in order
+        # (the per-message path sent everything in emission order)
+        self._flush_events_out()
         p = proto.pack_call_filtered_clients(key, op, val, "", method, args)
         self._send(self.cluster.conns[0], p)
 

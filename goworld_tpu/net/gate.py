@@ -295,22 +295,20 @@ class GateService:
         if proto.MT_REDIRECT_TO_GATEPROXY_MSG_TYPE_START <= msgtype <= \
                 proto.MT_REDIRECT_TO_GATEPROXY_MSG_TYPE_STOP:
             pkt.read_u16()  # gate_id (ours)
-            client_id = pkt.read_entity_id()
-            cp = self.clients.get(client_id)
-            if cp is None:
-                return
-            if msgtype == proto.MT_CREATE_ENTITY_ON_CLIENT:
-                # peek is_player to learn the owner entity
-                # (reference GateService.go:266-297)
-                save = pkt.rpos
-                eid = pkt.read_entity_id()
-                pkt.read_var_str()
-                if pkt.read_bool():
-                    cp.owner_eid = eid
-                pkt.rpos = save
-            out = new_packet(msgtype)
-            out.append_bytes(bytes(memoryview(pkt.buf)[pkt.rpos:]))
-            cp.send(out)
+            self._relay_to_client(msgtype, pkt)
+            return
+        if msgtype == proto.MT_CLIENT_EVENTS_BATCH:
+            # one per-tick bundle from a game: unbundle and relay each
+            # record exactly like the per-message redirect path above
+            # (same bytes on the client wire, in the same order)
+            pkt.read_u16()  # gate_id (ours)
+            n = pkt.read_u32()
+            for _ in range(n):
+                mt = pkt.read_u16()
+                ln = pkt.read_u32()
+                # read_bytes underrun-checks a corrupt length field
+                rec = Packet(pkt.read_bytes(ln))
+                self._relay_to_client(mt, rec)
             return
         if msgtype == proto.MT_SYNC_POSITION_YAW_ON_CLIENTS:
             pkt.read_u16()  # gate_id routing prefix (ours)
@@ -345,6 +343,27 @@ class GateService:
             return
         logger.warning("gate%d: dispatcher sent unhandled msgtype %d",
                        self.gate_id, msgtype)
+
+    def _relay_to_client(self, msgtype: int, pkt: Packet) -> None:
+        """Relay one redirect-range message to its client proxy; ``pkt``
+        is positioned at the 16-byte client id (reference
+        ``GateService.go:258-306``)."""
+        client_id = pkt.read_entity_id()
+        cp = self.clients.get(client_id)
+        if cp is None:
+            return
+        if msgtype == proto.MT_CREATE_ENTITY_ON_CLIENT:
+            # peek is_player to learn the owner entity
+            # (reference GateService.go:266-297)
+            save = pkt.rpos
+            eid = pkt.read_entity_id()
+            pkt.read_var_str()
+            if pkt.read_bool():
+                cp.owner_eid = eid
+            pkt.rpos = save
+        out = new_packet(msgtype)
+        out.append_bytes(bytes(memoryview(pkt.buf)[pkt.rpos:]))
+        cp.send(out)
 
     def _handle_sync_on_clients(self, pkt: Packet) -> None:
         """Regroup 48B (cid+eid+pos) records per client and send each its

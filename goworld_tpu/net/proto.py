@@ -71,6 +71,16 @@ MT_GATE_SERVICE_MSG_TYPE_START = 1500
 MT_SET_CLIENT_FILTER_PROP = 1501
 MT_CALL_FILTERED_CLIENTS = 1502
 MT_SYNC_POSITION_YAW_ON_CLIENTS = 1503  # batched [16B cid + 32B record]
+# ordered per-tick bundle of redirect-range client messages, one packet
+# per gate per tick (the batched shape of the reference's per-message
+# gate relay, GateService.go:258-306): the game coalesces every
+# create/destroy/attr/rpc client message it would have sent as its own
+# dispatcher packet; the gate unbundles and relays each record to its
+# client EXACTLY as the per-message path does, so the client wire is
+# unchanged. Cuts game->dispatcher->gate framing from
+# O(client messages) to O(gates) per tick (churn-heavy AOI ticks emit
+# thousands — docs/R5_MEASUREMENTS.md).
+MT_CLIENT_EVENTS_BATCH = 1504
 MT_GATE_SERVICE_MSG_TYPE_STOP = 1999
 
 # --- client-direct (2000+) ----------------------------------------------
@@ -186,6 +196,24 @@ def pack_destroy_entity_on_client(gate_id: int, client_id: str,
     p.append_entity_id(client_id)
     p.append_entity_id(eid)
     p.append_bool(is_player)
+    return p
+
+
+def pack_client_events_batch(gate_id: int,
+                             records: list[tuple[int, bytes]]) -> Packet:
+    """One per-gate bundle of redirect-range client messages:
+    ``[u16 gate_id][u32 n]`` then n x ``[u16 inner_msgtype][u32 len]
+    [len bytes]`` where the bytes are the inner message's payload
+    starting at the 16-byte client id (i.e. the per-message packet
+    minus its msgtype and gate_id prefix — byte-identical to what the
+    gate's per-message relay reads)."""
+    p = new_packet(MT_CLIENT_EVENTS_BATCH)
+    p.append_u16(gate_id)
+    p.append_u32(len(records))
+    for mt, body in records:
+        p.append_u16(mt)
+        p.append_u32(len(body))
+        p.append_bytes(body)
     return p
 
 

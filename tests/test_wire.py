@@ -170,3 +170,43 @@ def test_create_load_anywhere_carry_routing_gameid():
     assert p.read_u16() == 0
     assert p.read_var_str() == "Avatar"
     assert p.read_entity_id() == "abcdefghabcdefgh"
+
+
+def test_client_events_batch_roundtrip_and_order():
+    """MT_CLIENT_EVENTS_BATCH bundles redirect-range client messages
+    per gate per tick; the gate must recover each record's msgtype and
+    a body byte-identical to the per-message packet minus its
+    [u16 msgtype][u16 gate_id] prefix, in emission order."""
+    cid = "c" * ids.ENTITYID_LENGTH
+    eid = "e" * ids.ENTITYID_LENGTH
+    singles = [
+        proto.pack_create_entity_on_client(
+            3, cid, eid, "Avatar", True, {"hp": 7}, (1.0, 2.0, 3.0), 0.5),
+        proto.pack_notify_attr_change_on_client(
+            3, cid, eid, [{"path": ["hp"], "op": "set", "value": 8}]),
+        proto.pack_destroy_entity_on_client(3, cid, eid, False),
+        proto.pack_call_entity_method_on_client(
+            3, cid, eid, "Ping_Client", (1, "x")),
+    ]
+    recs = []
+    for p in singles:
+        mt = int.from_bytes(bytes(p.buf[0:2]), "little")
+        recs.append((mt, bytes(memoryview(p.buf)[4:])))
+
+    batch = proto.pack_client_events_batch(3, recs)
+    pkt = Packet(bytes(batch.buf))
+    assert pkt.read_u16() == proto.MT_CLIENT_EVENTS_BATCH
+    assert pkt.read_u16() == 3
+    assert pkt.read_u32() == len(recs)
+    for want_mt, want_body in recs:
+        mt = pkt.read_u16()
+        ln = pkt.read_u32()
+        body = bytes(memoryview(pkt.buf)[pkt.rpos:pkt.rpos + ln])
+        pkt.rpos += ln
+        assert mt == want_mt
+        assert body == want_body
+    assert pkt.remaining() == 0
+    # each body starts at the 16B client id, as _relay_to_client reads
+    rec = Packet(recs[0][1])
+    assert rec.read_entity_id() == cid
+    assert rec.read_entity_id() == eid
