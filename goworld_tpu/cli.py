@@ -626,6 +626,7 @@ def cmd_run_gate(gateid: int, configfile: str | None,
             heartbeat_timeout=gc.heartbeat_timeout,
             position_sync_interval_ms=gc.position_sync_interval_ms,
             compress=gc.compress,
+            compress_codec=gc.compress_codec,
             ssl_context=ssl_ctx,
         )
         task = asyncio.ensure_future(svc.serve())

@@ -114,6 +114,12 @@ class GateConfig:
     # flags; ClientProxy.go:38-53). encrypt=TLS on the TCP listener; the
     # cert/key are generated self-signed on first use when paths are empty.
     compress: bool = False
+    # stream codec for compressed client connections: "snappy" (the
+    # reference's codec — from-scratch framing-format implementation,
+    # net/snappy.py) or "zlib" (one zlib-1 stream per direction; its
+    # shared dictionary wins on tiny packets at more CPU per byte).
+    # Both ends must agree, like the compress flag itself.
+    compress_codec: str = "snappy"
     encrypt: bool = False
     tls_cert: str = ""
     tls_key: str = ""
@@ -321,7 +327,8 @@ heartbeat_timeout = 60
 port = 15000
 # ws_port = 15100    # websocket listener
 # kcp_port = 15200   # KCP (reliable-UDP) listener
-# compress = true    # zlib stream compression (both ends must agree)
+# compress = true    # stream compression (both ends must agree)
+# compress_codec = snappy   # snappy (default, the reference codec) | zlib
 # encrypt = true     # TLS on the TCP listener (self-signed on first use)
 
 [storage]

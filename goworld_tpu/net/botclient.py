@@ -205,7 +205,8 @@ class BotClient:
                  strict: bool = False, move_interval: float = 0.1,
                  speed: float = 5.0, seed: int | None = None,
                  ws: bool = False, kcp: bool = False,
-                 compress: bool = False, tls: bool = False,
+                 compress: bool = False, compress_codec: str = "snappy",
+                 tls: bool = False,
                  nosync: bool = False,
                  profiler: BotProfiler | None = None):
         self.host = host
@@ -213,6 +214,7 @@ class BotClient:
         self.ws = ws
         self.kcp = kcp
         self.compress = compress
+        self.compress_codec = compress_codec
         self.tls = tls
         # reference test_client -nosync: connect and mirror but never
         # send position syncs (isolates the downstream pipeline)
@@ -248,8 +250,9 @@ class BotClient:
             reader, writer = await open_kcp_connection(
                 self.host, self.port
             )
-            self.conn = PacketConnection(reader, writer,
-                                         compress=self.compress)
+            self.conn = PacketConnection(
+                reader, writer, compress=self.compress,
+                compress_codec=self.compress_codec)
             return
         ssl_ctx = None
         if self.tls:
@@ -259,8 +262,9 @@ class BotClient:
         reader, writer = await asyncio.open_connection(
             self.host, self.port, ssl=ssl_ctx
         )
-        self.conn = PacketConnection(reader, writer,
-                                     compress=self.compress)
+        self.conn = PacketConnection(
+            reader, writer, compress=self.compress,
+            compress_codec=self.compress_codec)
 
     async def run(self, duration: float = 5.0) -> None:
         """Connect and play for ``duration`` seconds."""

@@ -105,6 +105,7 @@ class GateService:
         heartbeat_timeout: float = 0.0,
         position_sync_interval_ms: int = 100,
         compress: bool = False,
+        compress_codec: str = "snappy",
         ssl_context=None,
         exit_on_dispatcher_loss: bool = True,
     ):
@@ -126,6 +127,7 @@ class GateService:
         # KCP deviation note). Compression/TLS apply to the TCP listener;
         # WebSocket clients get compression from the WS layer itself.
         self.compress = compress
+        self.compress_codec = compress_codec
         self.ssl_context = ssl_context
         self.heartbeat_timeout = heartbeat_timeout
         self.sync_interval = position_sync_interval_ms / 1000.0
@@ -225,7 +227,8 @@ class GateService:
 
     # -- client side -----------------------------------------------------
     async def _handle_client(self, reader, writer) -> None:
-        conn = PacketConnection(reader, writer, compress=self.compress)
+        conn = PacketConnection(reader, writer, compress=self.compress,
+                                compress_codec=self.compress_codec)
         cp = ClientProxy(conn)
         cp.last_heartbeat = asyncio.get_event_loop().time()
         self.clients[cp.client_id] = cp

@@ -179,14 +179,23 @@ class PacketConnection:
     ``PacketConnection.go``). Writes are buffered by the transport; reads
     return (msgtype, Packet-positioned-after-msgtype).
 
-    ``compress=True`` runs one zlib stream per direction over the
-    connection (level 1, ``Z_SYNC_FLUSH`` at packet boundaries) — the
-    cheap-stream-compression role snappy plays in the reference's client
-    edge (``ClientProxy.go:38-53``; python-snappy is not in this
-    environment). A shared per-connection dictionary keeps the dominant
-    small packets (heartbeats, 34-byte sync records) from inflating the
-    way per-packet compression would. Both ends must agree, exactly like
-    the reference's ini flag."""
+    ``compress=True`` runs one compression stream per direction over
+    the connection — the cheap-stream-compression role snappy plays in
+    the reference's client edge (``ClientProxy.go:38-53``).
+    ``compress_codec`` picks the stream codec:
+
+    * ``"snappy"`` (default) — the reference's codec, via the
+      from-scratch framing-format implementation in
+      :mod:`goworld_tpu.net.snappy` (each packet is one or more framed
+      chunks; the stream identifier leads the first send).
+    * ``"zlib"`` — one zlib-1 stream with ``Z_SYNC_FLUSH`` at packet
+      boundaries; its shared per-connection dictionary compresses the
+      dominant small packets (heartbeats, 34-byte sync records)
+      better than snappy's per-chunk framing, at more CPU per byte.
+
+    Both ends must agree on flag AND codec, exactly like the
+    reference's ini flag; a codec the environment cannot provide
+    raises at construction (silent fallback would desync the peer)."""
 
     def __init__(
         self,
@@ -194,13 +203,32 @@ class PacketConnection:
         writer: asyncio.StreamWriter,
         *,
         compress: bool = False,
+        compress_codec: str = "snappy",
     ):
         self.reader = reader
         self.writer = writer
         self.compress = compress
         if compress:
-            self._comp = zlib.compressobj(1)
-            self._decomp = zlib.decompressobj()
+            if compress_codec == "snappy":
+                from goworld_tpu.net import snappy as _snappy
+
+                if not _snappy.available():
+                    raise RuntimeError(
+                        "snappy codec unavailable (native build failed);"
+                        " set compress_codec = zlib on BOTH ends"
+                    )
+                self._comp = _snappy.StreamCompressor()
+                self._decomp = _snappy.StreamDecompressor()
+                self._snappy = True
+            elif compress_codec == "zlib":
+                self._comp = zlib.compressobj(1)
+                self._decomp = zlib.decompressobj()
+                self._snappy = False
+            else:
+                raise ValueError(
+                    f"compress_codec must be snappy|zlib, "
+                    f"got {compress_codec!r}"
+                )
         self._closed = False
 
     def send(self, p: Packet, release: bool = True) -> None:
@@ -208,8 +236,11 @@ class PacketConnection:
             return
         try:
             if self.compress:
-                payload = self._comp.compress(bytes(p.buf)) \
-                    + self._comp.flush(zlib.Z_SYNC_FLUSH)
+                if self._snappy:
+                    payload = self._comp.compress(bytes(p.buf))
+                else:
+                    payload = self._comp.compress(bytes(p.buf)) \
+                        + self._comp.flush(zlib.Z_SYNC_FLUSH)
                 self.writer.write(_SIZE_FMT.pack(len(payload)) + payload)
             else:
                 self.writer.write(frame(p))
@@ -232,18 +263,30 @@ class PacketConnection:
             raise ConnectionError(f"bad packet size {size}")
         body: bytes | bytearray = await self.reader.readexactly(size)
         if self.compress:
-            try:
-                # max_length caps output BEFORE allocation: a crafted
-                # high-ratio stream (decompression bomb) hits the limit
-                # and leaves unconsumed input instead of eating RAM
-                body = self._decomp.decompress(
-                    bytes(body), MAX_PAYLOAD_LENGTH + 1
-                )
-            except zlib.error as exc:
-                raise ConnectionError(f"bad compressed packet: {exc}")
-            if self._decomp.unconsumed_tail \
-                    or len(body) > MAX_PAYLOAD_LENGTH:
-                raise ConnectionError("decompressed packet too large")
+            if self._snappy:
+                try:
+                    # the bound is checked chunk-by-chunk during
+                    # decode, so a bomb stream fails before allocation
+                    body = self._decomp.decompress(
+                        bytes(body), max_out=MAX_PAYLOAD_LENGTH
+                    )
+                except ValueError as exc:
+                    raise ConnectionError(
+                        f"bad compressed packet: {exc}")
+            else:
+                try:
+                    # max_length caps output BEFORE allocation: a
+                    # crafted high-ratio stream (decompression bomb)
+                    # hits the limit and leaves unconsumed input
+                    # instead of eating RAM
+                    body = self._decomp.decompress(
+                        bytes(body), MAX_PAYLOAD_LENGTH + 1
+                    )
+                except zlib.error as exc:
+                    raise ConnectionError(f"bad compressed packet: {exc}")
+                if self._decomp.unconsumed_tail \
+                        or len(body) > MAX_PAYLOAD_LENGTH:
+                    raise ConnectionError("decompressed packet too large")
             if len(body) < 2:
                 raise ConnectionError("short decompressed packet")
         p = Packet(body)

@@ -3,10 +3,12 @@
 Reference parity: the gate optionally wraps every client connection in TLS
 (``components/gate/ClientProxy.go:38-53``; cert/key shipped as ``rsa.crt``
 / ``rsa.key`` at the repo root, ini flags ``encrypt_connection``) and
-snappy compression. Here TLS rides stdlib ``ssl`` over asyncio; the
-compression codec is zlib level 1 per packet (:mod:`goworld_tpu.net.packet`
-— python-snappy is not available in this environment; zlib-1 fills the
-same cheap-stream-compression role).
+snappy compression. Here TLS rides stdlib ``ssl`` over asyncio; stream
+compression defaults to SNAPPY — the reference's codec, implemented from
+scratch (block + framing formats, :mod:`goworld_tpu.net.snappy`, C++
+core in ``native/snappy_core.cpp``) — with zlib-1 selectable per ini
+(``compress_codec``) for deployments that prefer its shared-dictionary
+ratio on tiny packets.
 
 The third client transport, KCP (reliable-UDP tuned for latency,
 ``GateService.go:129-161``), is implemented from scratch in

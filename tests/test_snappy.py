@@ -1,0 +1,135 @@
+"""From-scratch snappy codec (net/snappy.py + native/snappy_core.cpp).
+
+The reference compresses gate<->client streams with snappy
+(``ClientProxy.go:38-53``); round 5 replaces the documented zlib
+deviation with a real implementation of the public block and framing
+formats. No reference snappy library exists in this environment, so
+correctness rests on: spec-derived known vectors (hand-encoded from
+format_description.txt), the standard CRC32C test vector, format
+property checks on the emitted bytes, and adversarial decoder inputs —
+plus roundtrips at many shapes and split points.
+"""
+
+import os
+import random
+
+import pytest
+
+from goworld_tpu.net import snappy
+
+
+pytestmark = pytest.mark.skipif(
+    not snappy.available(), reason="native snappy core failed to build")
+
+
+CASES = [
+    b"",
+    b"a",
+    b"ab" * 3,
+    b"abcabcabcabcabcabc" * 100,       # short-period matches
+    b"x" * 70000,                      # long run, >64KB literal span
+    os.urandom(4096),                  # incompressible
+    bytes(random.Random(7).choices(b"abcd", k=300000)),
+]
+
+
+@pytest.mark.parametrize("data", CASES, ids=[f"n{len(c)}" for c in CASES])
+def test_block_roundtrip(data):
+    blk = snappy.compress(data)
+    assert snappy.uncompress(blk, max(len(data) + 16, 32)) == data
+
+
+def test_block_known_vectors():
+    # spec: varint(len) + literal tag ((len-1)<<2) + bytes
+    assert snappy.compress(b"abc") == bytes([3, (3 - 1) << 2]) + b"abc"
+    assert snappy.compress(b"") == b"\x00"
+    # decode a hand-built stream using a copy element the encoder
+    # wouldn't produce the same way: "abcd" + copy(offset=4, len=4)
+    # copy1 tag: 01 | (len-4)<<2 | (offset>>8)<<5, then offset low byte
+    src = bytes([8,                      # ulen = 8
+                 (4 - 1) << 2]) + b"abcd" + bytes([
+                 0b001 | ((4 - 4) << 2), 4])
+    assert snappy.uncompress(src, 16) == b"abcdabcd"
+    # copy2 form of the same
+    src2 = bytes([8, (4 - 1) << 2]) + b"abcd" + bytes([
+        0b010 | ((4 - 1) << 2), 4, 0])
+    assert snappy.uncompress(src2, 16) == b"abcdabcd"
+
+
+def test_overlapping_copy_replicates():
+    # offset < len: snappy's RLE idiom — "a" then copy(offset=1, len=7)
+    src = bytes([8, 0]) + b"a" + bytes([0b010 | ((7 - 1) << 2), 1, 0])
+    assert snappy.uncompress(src, 16) == b"a" * 8
+
+
+def test_malformed_blocks_rejected():
+    for bad in (
+        b"\x05\x00",                    # ulen 5 but one literal byte
+        bytes([4, (4 - 1) << 2]) + b"ab",   # literal overruns input
+        bytes([8, 0]) + b"a" + bytes([0b001, 9]),  # offset > written
+        bytes([2, 0]) + b"a" + bytes([0b001, 0]),  # offset 0
+        b"\xff\xff\xff\xff\xff",        # varint runs past end
+    ):
+        with pytest.raises(ValueError):
+            snappy.uncompress(bad, 64)
+
+
+def test_crc32c_standard_vector():
+    assert snappy.crc32c(b"123456789") == 0xE3069283
+    assert snappy.crc32c(b"") == 0
+
+
+def test_stream_roundtrip_any_split():
+    enc = snappy.StreamCompressor()
+    dec = snappy.StreamDecompressor()
+    wire = b"".join(enc.compress(c) for c in CASES)
+    want = b"".join(CASES)
+    got = b""
+    rng = random.Random(3)
+    i = 0
+    while i < len(wire):
+        j = min(len(wire), i + rng.randint(1, 1000))
+        got += dec.decompress(wire[i:j])
+        i = j
+    assert got == want
+
+
+def test_stream_layout_per_spec():
+    enc = snappy.StreamCompressor()
+    w = enc.compress(b"hello" * 100)
+    # first chunk: stream identifier ff 06 00 00 "sNaPpY"
+    assert w[:10] == b"\xff\x06\x00\x00sNaPpY"
+    # next chunk: compressed (0x00) with 3-byte length then masked crc
+    assert w[10] == 0x00
+    body_len = w[11] | (w[12] << 8) | (w[13] << 16)
+    assert len(w) == 10 + 4 + body_len
+    # second call must NOT repeat the stream id
+    w2 = enc.compress(b"hello")
+    assert w2[0] in (0x00, 0x01)
+
+
+def test_stream_corruption_detected():
+    enc = snappy.StreamCompressor()
+    w = bytearray(enc.compress(b"payload" * 50))
+    w[-1] ^= 0xFF  # flip a data byte -> CRC mismatch
+    with pytest.raises(ValueError):
+        snappy.StreamDecompressor().decompress(bytes(w))
+
+
+def test_stream_bomb_bound():
+    # a 64KB zero block compresses to a few bytes; feed many chunks and
+    # require the decoder to stop at max_out instead of allocating all
+    enc = snappy.StreamCompressor()
+    wire = enc.compress(b"\x00" * 65536 * 8)
+    dec = snappy.StreamDecompressor()
+    with pytest.raises(ValueError):
+        dec.decompress(wire, max_out=100000)
+
+
+def test_skippable_and_reserved_chunks():
+    dec = snappy.StreamDecompressor()
+    # skippable padding chunk (0xfe) is ignored
+    assert dec.decompress(b"\xfe\x02\x00\x00ab") == b""
+    # unskippable reserved chunk (0x02) is an error
+    with pytest.raises(ValueError):
+        snappy.StreamDecompressor().decompress(b"\x02\x01\x00\x00a")

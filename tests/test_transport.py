@@ -17,18 +17,28 @@ from goworld_tpu.net.botclient import BotClient
 from goworld_tpu.net.game import GameServer
 from goworld_tpu.net.packet import PacketConnection, new_packet
 from goworld_tpu.net.standalone import ClusterHarness
+from goworld_tpu.net import snappy as _snappy
 from goworld_tpu.ops.aoi import GridSpec
+
+# snappy is the DEFAULT codec for compress=True, so every compressed
+# test needs the native core; skip (not error) where it can't build,
+# like tests/test_snappy.py does
+requires_snappy = pytest.mark.skipif(
+    not _snappy.available(), reason="native snappy core failed to build")
+_snappy_param = pytest.param("snappy", marks=requires_snappy)
 
 
 # =======================================================================
 # packet-level compression
 # =======================================================================
-def test_compressed_packet_roundtrip():
+@pytest.mark.parametrize("codec", [_snappy_param, "zlib"])
+def test_compressed_packet_roundtrip(codec):
     async def main():
         got = []
 
         async def handle(reader, writer):
-            conn = PacketConnection(reader, writer, compress=True)
+            conn = PacketConnection(reader, writer, compress=True,
+                                    compress_codec=codec)
             mt, p = await conn.recv()
             got.append((mt, p.read_var_str(), p.read_data()))
             reply = new_packet(77)
@@ -40,7 +50,8 @@ def test_compressed_packet_roundtrip():
         server = await asyncio.start_server(handle, "127.0.0.1", 0)
         port = server.sockets[0].getsockname()[1]
         reader, writer = await asyncio.open_connection("127.0.0.1", port)
-        conn = PacketConnection(reader, writer, compress=True)
+        conn = PacketConnection(reader, writer, compress=True,
+                                compress_codec=codec)
         p = new_packet(42)
         p.append_var_str("hello" * 200)  # compressible payload
         p.append_data({"k": [1, 2, 3]})
@@ -56,6 +67,7 @@ def test_compressed_packet_roundtrip():
     asyncio.run(main())
 
 
+@requires_snappy
 def test_compression_mismatch_detected():
     """An uncompressed sender against a compressed receiver must fail
     loudly (bad zlib header), not feed garbage into the packet codec."""
@@ -105,6 +117,7 @@ class _CaptureWriter:
         pass
 
 
+@requires_snappy
 def test_stream_compression_beats_plain_on_hot_path():
     """Per-connection streaming compression must SHRINK a realistic
     client-edge stream (repeated small sync records); per-packet zlib
@@ -126,23 +139,33 @@ def test_stream_compression_beats_plain_on_hot_path():
     )
 
 
-def test_decompression_bomb_rejected():
+@pytest.mark.parametrize("codec", [_snappy_param, "zlib"])
+def test_decompression_bomb_rejected(codec):
     """A crafted high-ratio stream must be rejected by the output cap,
     not materialized (gate OOM)."""
-    import zlib as _z
+    import struct
 
     async def main():
-        comp = _z.compressobj(1)
-        payload = comp.compress(b"\0" * (64 * 1024 * 1024))
-        payload += comp.flush(_z.Z_SYNC_FLUSH)
+        if codec == "zlib":
+            import zlib as _z
+
+            comp = _z.compressobj(1)
+            payload = comp.compress(b"\0" * (64 * 1024 * 1024))
+            payload += comp.flush(_z.Z_SYNC_FLUSH)
+            match = "too large"
+        else:
+            from goworld_tpu.net import snappy as _snappy
+
+            payload = _snappy.StreamCompressor().compress(
+                b"\0" * (64 * 1024 * 1024))
+            match = "size bound"
         assert len(payload) < 32 * 1024 * 1024  # passes the wire check
         reader = asyncio.StreamReader()
-        import struct
-
         reader.feed_data(struct.pack("<I", len(payload)) + payload)
         reader.feed_eof()
-        conn = PacketConnection(reader, _CaptureWriter(), compress=True)
-        with pytest.raises(ConnectionError, match="too large"):
+        conn = PacketConnection(reader, _CaptureWriter(), compress=True,
+                                compress_codec=codec)
+        with pytest.raises(ConnectionError, match=match):
             await conn.recv()
 
     asyncio.run(main())
@@ -247,6 +270,7 @@ async def _login_and_walk(bot: BotClient):
         await bot.conn.close()
 
 
+@requires_snappy
 def test_bot_over_compressed_tls(secure_cluster):
     harness, world, gs = secure_cluster
     host, port = harness.gate_addrs[0]
