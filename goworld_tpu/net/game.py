@@ -136,6 +136,7 @@ class GameServer:
         # position sync). Emission order per gate is preserved, so the
         # per-client message order matches the per-message path.
         self._events_out: dict[int, list] = {}
+        self._event_recs_flushed = 0  # per-tick gauge accumulator
         self.on_deployment_ready: Callable[[], None] | None = None
         # multihost World-mutation log (see _MH_WORLD_MSGTYPES)
         self._mh_pending: list[tuple[int, bytes]] = []
@@ -594,6 +595,9 @@ class GameServer:
         for gate_id, recs in self._events_out.items():
             if not recs:
                 continue
+            # accumulated across eager mid-tick flushes; exposed (and
+            # zeroed) once per tick by _flush_sync_out
+            self._event_recs_flushed += len(recs)
             conn = self.cluster.select_by_gate_id(gate_id)
             chunk: list = []
             size = 0
@@ -615,6 +619,11 @@ class GameServer:
         # must reach the client before the same entity's first position
         # sync record (flushed below)
         self._flush_events_out()
+        # per-tick total (incl. eager mid-tick flushes), exposed
+        # unconditionally so idle ticks read 0, like the mh_* gauges
+        opmon.expose("client_event_batch_records",
+                     self._event_recs_flushed)
+        self._event_recs_flushed = 0
         for gate_id, chunks in self._sync_out.items():
             # per-chunk ARRAYS concatenated once — never element-wise
             # Python appends (the world's mirror path hands us S16

@@ -341,3 +341,23 @@ def test_bot_swarm_over_kcp(kcp_cluster):
     accounts = [e for e in world.entities.values()
                 if e.type_name == "Account" and not e.destroyed]
     assert len(accounts) == n
+
+
+@pytest.fixture()
+def kcp_compressed_cluster():
+    yield from _cluster(with_kcp=True, compress=True)
+
+
+@requires_snappy
+def test_bot_over_kcp_with_snappy(kcp_compressed_cluster):
+    """Compression composes with the reliable-UDP edge: the gate's KCP
+    sessions reuse the TCP client handler, so the snappy stream codec
+    must run unchanged over (reader, writer) adapters backed by KCP."""
+    harness, world, gs = kcp_compressed_cluster
+    host, port = harness.gate_kcp_addrs[0]
+    bot = BotClient(host, port, strict=True, kcp=True, compress=True)
+    harness.submit(_login_and_walk(bot)).result(timeout=40)
+    assert not bot.errors, bot.errors
+    avatars = [e for e in world.entities.values()
+               if e.type_name == "Avatar" and not e.destroyed]
+    assert len(avatars) == 1 and avatars[0].client is not None
