@@ -81,11 +81,50 @@ class _Handler(socketserver.BaseRequestHandler):
         for it in items:
             if isinstance(it, (list, tuple)):
                 self._array(it)
+            elif isinstance(it, int):
+                self._int(it)  # CLUSTER SLOTS carries slot numbers/ports
             else:
                 self._bulk(it)
 
     def _err(self, msg: str) -> None:
         self._send(f"-ERR {msg}\r\n".encode())
+
+    def _redirect(self, kind: str, slot: int, addr: str) -> None:
+        # cluster redirect replies are errors WITHOUT the ERR prefix:
+        # "-MOVED 3999 127.0.0.1:6381" / "-ASK 3999 127.0.0.1:6381"
+        self._send(f"-{kind} {slot} {addr}\r\n".encode())
+
+    def _cluster_check(self, srv: "MiniRedis", keys: list[bytes]) -> bool:
+        """Cluster-mode ownership check; True = an error/redirect was
+        sent and the command must not execute. Validates EVERY key like
+        real cluster redis: a multi-key command spanning slots gets
+        -CROSSSLOT even when all slots are locally owned. The ASKING
+        flag (set by the previous command on THIS connection) admits
+        one command for a slot being imported, per the cluster spec."""
+        if srv.cluster_slots is None or not keys:
+            return False
+        asking, self._asking = getattr(self, "_asking", False), False
+        from goworld_tpu.ext.db.resp import key_slot
+
+        slots = {key_slot(k) for k in keys}
+        if len(slots) > 1:
+            self._send(b"-CROSSSLOT Keys in request don't hash to the "
+                       b"same slot\r\n")
+            return True
+        slot = slots.pop()
+        ask_to = srv.ask.get(slot)
+        if ask_to is not None and not asking:
+            self._redirect("ASK", slot, ask_to)
+            return True
+        lo, hi = srv.cluster_slots
+        if lo <= slot <= hi or asking:
+            return False
+        for addr, (plo, phi) in srv.peers.items():
+            if plo <= slot <= phi:
+                self._redirect("MOVED", slot, addr)
+                return True
+        self._redirect("MOVED", slot, srv.addr)  # stale map fallback
+        return True
 
     # -- commands -------------------------------------------------------
     def _dispatch(self, args: list[bytes]) -> None:
@@ -94,6 +133,16 @@ class _Handler(socketserver.BaseRequestHandler):
         a = args[1:]
         with srv.lock:
             d = srv.dbs.setdefault(self.db, {})
+            if srv.cluster_slots is not None:
+                if cmd in ("MGET", "DEL", "EXISTS"):
+                    ck = a                      # every arg is a key
+                elif cmd in ("SET", "SETNX", "GET", "HSET", "HGET",
+                             "HGETALL", "HDEL", "EXPIRE"):
+                    ck = a[:1]                  # first arg is THE key
+                else:
+                    ck = []
+                if ck and self._cluster_check(srv, ck):
+                    return
             if cmd == "PING":
                 self._ok("PONG")
             elif cmd == "SELECT":
@@ -173,6 +222,27 @@ class _Handler(socketserver.BaseRequestHandler):
                 self._int(n)
             elif cmd == "EXPIRE":
                 self._int(1 if a[0] in d else 0)
+            elif cmd == "ASKING":
+                # admit the NEXT command on this connection for a slot
+                # this node is importing (cluster spec)
+                self._asking = True
+                self._ok()
+            elif cmd == "CLUSTER":
+                sub = a[0].upper() if a else b""
+                if srv.cluster_slots is None:
+                    self._err("This instance has cluster support disabled")
+                elif sub == b"SLOTS":
+                    def node(addr: str):
+                        h, _, p = addr.rpartition(":")
+                        return [h.encode(), int(p)]
+
+                    entries = [[srv.cluster_slots[0],
+                                srv.cluster_slots[1], node(srv.addr)]]
+                    for addr, (lo, hi) in srv.peers.items():
+                        entries.append([lo, hi, node(addr)])
+                    self._array(entries)
+                else:
+                    self._err(f"unknown CLUSTER subcommand {sub!r}")
             else:
                 self._err(f"unknown command '{cmd}'")
 
@@ -185,13 +255,22 @@ class _Server(socketserver.ThreadingTCPServer):
 class MiniRedis:
     """``srv = MiniRedis(); srv.start()`` -> ``srv.port``."""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 cluster_slots: tuple[int, int] | None = None):
         self.host = host
         self.port = port
         self.dbs: dict[int, dict[bytes, object]] = {}
         self.lock = threading.Lock()
         self._server: _Server | None = None
         self._thread: threading.Thread | None = None
+        # cluster mode (None = plain redis): this node owns the
+        # inclusive slot range; `peers` maps other nodes' addr -> range
+        # (drives CLUSTER SLOTS and -MOVED); `ask` maps slot -> addr
+        # for migration-in-progress -ASK redirects. Tests mutate these
+        # live to simulate resharding.
+        self.cluster_slots = cluster_slots
+        self.peers: dict[str, tuple[int, int]] = {}
+        self.ask: dict[int, str] = {}
 
     def start(self) -> "MiniRedis":
         self._server = _Server((self.host, self.port), _Handler)

@@ -38,6 +38,35 @@ def parse_addr(addr: str) -> tuple[str, int, int]:
     return host or "127.0.0.1", int(port_s or 6379), db
 
 
+# --- cluster-mode key hashing (shared by the kvdb cluster client and
+# --- miniredis's cluster mode) ------------------------------------------
+
+NUM_SLOTS = 16384
+
+
+def crc16(data: bytes) -> int:
+    """CRC16-CCITT (XModem) — redis cluster's key-slot hash."""
+    crc = 0
+    for b in data:
+        crc ^= b << 8
+        for _ in range(8):
+            crc = ((crc << 1) ^ 0x1021) if crc & 0x8000 else (crc << 1)
+            crc &= 0xFFFF
+    return crc
+
+
+def key_slot(key: bytes) -> int:
+    """Redis cluster slot of a key, honoring ``{hashtag}`` semantics:
+    if the key contains ``{...}`` with a NON-EMPTY tag, only the tag
+    bytes hash (so ``{user1}.a`` and ``{user1}.b`` co-locate)."""
+    lb = key.find(b"{")
+    if lb != -1:
+        rb = key.find(b"}", lb + 1)
+        if rb != -1 and rb > lb + 1:
+            key = key[lb + 1:rb]
+    return crc16(key) % NUM_SLOTS
+
+
 class RespClient:
     def __init__(self, host: str = "127.0.0.1", port: int = 6379,
                  db: int = 0, timeout: float = 10.0):

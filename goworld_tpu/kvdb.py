@@ -133,56 +133,181 @@ def _range_on(client, begin: str, end: str) -> list[tuple[str, str]]:
     return [(k, v.decode()) for k, v in zip(sel, vals) if v is not None]
 
 
-def _crc16(data: bytes) -> int:
-    """CRC16-CCITT (XModem) — redis cluster's key-slot hash function."""
-    crc = 0
-    for b in data:
-        crc ^= b << 8
-        for _ in range(8):
-            crc = ((crc << 1) ^ 0x1021) if crc & 0x8000 else (crc << 1)
-            crc &= 0xFFFF
-    return crc
-
-
 class RedisClusterKVDB(KVDBBackend):
-    """Client-side sharding over N INDEPENDENT redis endpoints (the
-    architecture of the reference's ``kvdb/backend/kvdbrediscluster``
-    role: horizontal kvdb capacity). Keys route by CRC16 (redis
-    cluster's slot hash function) modulo the node count; range queries
-    fan out to every node and merge.
+    """Redis CLUSTER-MODE client (the reference's
+    ``kvdb/backend/kvdbrediscluster`` role: horizontal kvdb capacity),
+    from scratch over the RESP client:
 
-    DEVIATION: this is NOT the redis cluster-mode protocol — there is
-    no 16384-slot map, hashtag parsing, or MOVED-redirect handling, so
-    point it at plain redis instances (or miniredis), not at the nodes
-    of an actual cluster-mode deployment."""
+    * On connect it asks any reachable node ``CLUSTER SLOTS`` and
+      builds the 16384-entry slot map; keys route by
+      ``CRC16(key) % 16384`` with ``{hashtag}`` semantics — hashing
+      the FULL key as sent (prefix included), so routing agrees with
+      the server's own hash.
+    * ``-MOVED slot host:port`` repairs the slot map and retries at
+      the new owner (new nodes are dialed on demand, so the client
+      follows resharding it was never told about); ``-ASK`` sends
+      ``ASKING`` then retries once at the target WITHOUT a map update,
+      per the migration protocol. Redirect chains are bounded.
+    * Nodes that have cluster support disabled (plain redis/miniredis)
+      fall back to LEGACY client-side sharding:
+      ``CRC16 % len(nodes)`` over the configured endpoints — the
+      pre-round-5 behavior, kept so independent-node deployments work
+      unchanged.
+
+    Range queries fan out to every known node and merge (same
+    architecture as the reference's scan-across-shards)."""
+
+    _MAX_REDIRECTS = 5
 
     def __init__(self, addrs: list[str]):
-        from goworld_tpu.ext.db.resp import RespClient
+        from goworld_tpu.ext.db import resp
 
         if not addrs:
             raise ValueError("redis-cluster needs at least one node")
-        self._nodes = [RespClient.from_addr(a) for a in addrs]
+        self._resp = resp
+        self._clients: dict[str, resp.RespClient] = {
+            a: resp.RespClient.from_addr(a) for a in addrs
+        }
+        self._seed_addrs = list(addrs)
+        # slot -> addr; None = legacy (cluster support disabled)
+        self._slot_map: list[str] | None = None
+        self._refresh_slot_map()
 
-    def _node(self, key: str):
-        return self._nodes[_crc16(key.encode()) % len(self._nodes)]
+    # -- topology ------------------------------------------------------
+    def _refresh_slot_map(self) -> None:
+        from goworld_tpu.ext.db.resp import NUM_SLOTS, RespError
 
+        transient: Exception | None = None
+        for addr in list(self._clients):
+            try:
+                entries = self._clients[addr].command(
+                    b"CLUSTER", b"SLOTS")
+            except RespError as e:
+                msg = str(e).lower()
+                if "cluster support disabled" in msg \
+                        or "unknown command" in msg:
+                    # definitively a NON-cluster node -> legacy
+                    # client-side sharding over the seed endpoints
+                    self._slot_map = None
+                    return
+                # transient (-LOADING, permissions, ...): a cluster
+                # node that cannot answer RIGHT NOW must not silently
+                # demote the client to legacy routing — try the next
+                # node, fail loud if none answers
+                transient = e
+                continue
+            except ConnectionError as e:
+                transient = e
+                continue
+            m: list[str | None] = [None] * NUM_SLOTS
+            for lo, hi, node, *_ in entries:
+                host = node[0].decode()
+                naddr = f"{host}:{int(node[1])}"
+                for s in range(int(lo), int(hi) + 1):
+                    m[s] = naddr
+            # unassigned slots route to the seed we asked (they will
+            # MOVED-correct themselves)
+            self._slot_map = [s or addr for s in m]
+            return
+        raise ConnectionError(
+            f"no redis-cluster node could serve CLUSTER SLOTS "
+            f"(last error: {transient})"
+        )
+
+    def _client_for(self, addr: str):
+        c = self._clients.get(addr)
+        if c is None:
+            c = self._clients[addr] = self._resp.RespClient.from_addr(addr)
+        return c
+
+    def _route(self, full_key: bytes, bare_key: bytes):
+        from goworld_tpu.ext.db.resp import crc16, key_slot
+
+        if self._slot_map is None:
+            # legacy mode hashes the BARE key, exactly like the
+            # pre-cluster-protocol client — an existing independent-
+            # node deployment keeps finding its data on the same nodes
+            nodes = [self._clients[a] for a in self._seed_addrs]
+            return nodes[crc16(bare_key) % len(nodes)]
+        return self._client_for(self._slot_map[key_slot(full_key)])
+
+    def _command(self, full_key: bytes, bare_key: bytes, *args):
+        """Run one keyed command with MOVED/ASK redirect handling."""
+        from goworld_tpu.ext.db.resp import RespError, key_slot
+
+        client = self._route(full_key, bare_key)
+        asking = False
+        for _ in range(self._MAX_REDIRECTS):
+            try:
+                if asking:
+                    client.command(b"ASKING")
+                    asking = False
+                return client.command(*args)
+            except RespError as e:
+                words = str(e).split()
+                if len(words) == 3 and words[0] in ("MOVED", "ASK"):
+                    slot, addr = int(words[1]), words[2]
+                    client = self._client_for(addr)
+                    if words[0] == "MOVED" and self._slot_map is not None:
+                        self._slot_map[slot] = addr
+                    asking = words[0] == "ASK"
+                    continue
+                raise
+        raise ConnectionError(
+            f"redis-cluster redirect chain exceeded "
+            f"{self._MAX_REDIRECTS} for slot {key_slot(full_key)}"
+        )
+
+    # -- KVDB backend --------------------------------------------------
     def get(self, key):
-        raw = self._node(key).get(RedisKVDB.PREFIX + key)
+        bk = key.encode()
+        fk = RedisKVDB.PREFIX.encode() + bk
+        raw = self._command(fk, bk, b"GET", fk)
         return None if raw is None else raw.decode()
 
     def put(self, key, val):
-        self._node(key).set(RedisKVDB.PREFIX + key, val)
+        bk = key.encode()
+        fk = RedisKVDB.PREFIX.encode() + bk
+        self._command(fk, bk, b"SET", fk,
+                      val.encode() if isinstance(val, str) else val)
 
     def get_range(self, begin, end):
+        from goworld_tpu.ext.db.resp import key_slot
+
         out: list[tuple[str, str]] = []
-        for node in self._nodes:
-            out.extend(_range_on(node, begin, end))
+        if self._slot_map is None:
+            for addr in self._seed_addrs:
+                out.extend(_range_on(self._clients[addr], begin, end))
+            out.sort()
+            return out
+        # cluster mode: SCAN is node-local (allowed), but MGET must be
+        # SAME-SLOT only (real cluster redis rejects cross-slot MGET
+        # with -CROSSSLOT) — group each node's matches by slot and
+        # fetch per group through the redirect-capable path, so a
+        # group mid-migration follows its MOVED/ASK
+        pre = RedisKVDB.PREFIX
+        lo_b, hi_b = begin.encode(), end.encode()
+        for addr in sorted(set(self._slot_map)):
+            node = self._client_for(addr)
+            keys = [k[len(pre):] for k in node.scan_keys(pre + "*")]
+            sel = sorted(k for k in keys if lo_b <= k < hi_b)
+            groups: dict[int, list[bytes]] = {}
+            for k in sel:
+                fk = pre.encode() + k
+                groups.setdefault(key_slot(fk), []).append(k)
+            for ks in groups.values():
+                fks = [pre.encode() + k for k in ks]
+                vals = self._command(fks[0], ks[0], b"MGET", *fks)
+                out.extend(
+                    (k.decode(), v.decode())
+                    for k, v in zip(ks, vals) if v is not None
+                )
         out.sort()
         return out
 
     def close(self):
-        for n in self._nodes:
-            n.close()
+        for c in self._clients.values():
+            c.close()
 
 
 def open_kvdb_backend(kind: str, location: str = "") -> KVDBBackend:
