@@ -1,0 +1,86 @@
+"""Gate-leg wire cost: per-message packets vs the per-tick batch.
+
+Quantifies what MT_CLIENT_EVENTS_BATCH buys at churn volume: the old
+path sent ONE dispatcher packet per client message (pack + 4-byte
+frame + asyncio send x 2 hops); the new path coalesces a tick's
+messages into one bundle per gate. This probe measures, for a
+4096-create/4096-destroy churn tick (the library event caps):
+
+  * packets on the game->dispatcher leg (framing/send-call count)
+  * total bytes (framing + routing-prefix overhead delta)
+  * host CPU to pack both shapes
+
+Run: python -u tools/probe_wire.py   (no jax, no sockets)
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from goworld_tpu.net import proto
+from goworld_tpu.net.packet import frame
+
+N = 4096
+CID = "c" * 16
+EID = "e" * 16
+ATTRS = {"name": "walker-1234", "level": 42}
+POS = (123.0, 0.0, 456.0)
+
+
+def per_message():
+    t0 = time.perf_counter()
+    n_pkts = 0
+    n_bytes = 0
+    for i in range(N):
+        p = proto.pack_create_entity_on_client(
+            1, CID, EID, "Walker", False, ATTRS, POS, 1.5)
+        n_bytes += len(frame(p))
+        n_pkts += 1
+        p.release()  # production _send releases too — keep the pool
+                     # comparison symmetric with batched()
+    for i in range(N):
+        p = proto.pack_destroy_entity_on_client(1, CID, EID, False)
+        n_bytes += len(frame(p))
+        n_pkts += 1
+        p.release()
+    dt = time.perf_counter() - t0
+    return n_pkts, n_bytes, dt
+
+
+def batched():
+    t0 = time.perf_counter()
+    recs = []
+    for i in range(N):
+        p = proto.pack_create_entity_on_client(
+            1, CID, EID, "Walker", False, ATTRS, POS, 1.5)
+        recs.append((proto.MT_CREATE_ENTITY_ON_CLIENT,
+                     bytes(memoryview(p.buf)[4:])))
+        p.release()
+    for i in range(N):
+        p = proto.pack_destroy_entity_on_client(1, CID, EID, False)
+        recs.append((proto.MT_DESTROY_ENTITY_ON_CLIENT,
+                     bytes(memoryview(p.buf)[4:])))
+        p.release()
+    wire = frame(proto.pack_client_events_batch(1, recs))
+    dt = time.perf_counter() - t0
+    return 1, len(wire), dt
+
+
+def main():
+    # warm allocators/pools
+    per_message()
+    batched()
+    op_, ob, ot = min((per_message() for _ in range(5)),
+                      key=lambda r: r[2])
+    np_, nb, nt = min((batched() for _ in range(5)), key=lambda r: r[2])
+    print(f"per-message: {op_} packets  {ob} bytes  {1000*ot:.2f} ms")
+    print(f"batched:     {np_} packets  {nb} bytes  {1000*nt:.2f} ms")
+    print(f"=> {op_ / np_:.0f}x fewer dispatcher packets, "
+          f"{100 * (1 - nb / ob):.1f}% fewer bytes, "
+          f"{ot / nt:.2f}x pack-side CPU "
+          f"for a {N}+{N} churn tick on one gate")
+
+
+if __name__ == "__main__":
+    main()
