@@ -131,14 +131,16 @@ class GateConfig:
 
 @dataclasses.dataclass
 class StorageConfig:
-    kind: str = "filesystem"   # filesystem | memory
-    directory: str = "entity_storage"
+    kind: str = "filesystem"   # filesystem | memory | redis | mongodb
+    directory: str = "entity_storage"  # path, or host:port[/db] for
+                                       # the networked kinds
 
 
 @dataclasses.dataclass
 class KVDBConfig:
-    kind: str = "filesystem"   # filesystem | memory
-    path: str = "kvdb_data"
+    kind: str = "filesystem"   # filesystem | memory | redis |
+                               # redis_cluster | mongodb
+    path: str = "kvdb_data"    # path, addr[,addr...] or host:port[/db]
 
 
 @dataclasses.dataclass

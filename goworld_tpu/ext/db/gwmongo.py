@@ -4,13 +4,15 @@ Reference being rebuilt: ``ext/db/gwmongo.go:31-355`` — an mgo session
 owned by one async group exposing ``InsertOne/FindOne/UpdateId/Count/...``
 per (db, collection), every reply posted back to the logic thread.
 
-DEVIATION NOTE: this environment bakes in neither a MongoDB server nor a
-driver, so the document API is implemented over a pluggable
-:class:`DocStore`. The default store keeps msgpack documents in any
-redis-compatible endpoint (including the in-process miniredis) under
-``doc:<db>:<collection>:<id>`` keys; a MongoDB-driver store can slot in
-behind the same two-method interface where one exists. The ASYNC API —
-what user code actually programs against — matches the reference's shape.
+The document API rides a pluggable :class:`DocStore`.
+:class:`MongoDocStore` (``connect_mongodb``) is the reference shape:
+native BSON documents per (db, collection) over the from-scratch
+OP_MSG wire client (:mod:`goworld_tpu.ext.db.mongowire`) — a real
+mongod or the in-process :mod:`goworld_tpu.ext.db.minimongo` both
+speak it. :class:`RedisDocStore` (``connect_redis``) keeps msgpack
+documents in any redis-compatible endpoint under
+``doc:<db>:<collection>:<id>`` keys. The ASYNC API — what user code
+actually programs against — matches the reference's shape either way.
 """
 
 from __future__ import annotations
@@ -41,6 +43,14 @@ class DocStore:
     def keys(self, prefix: str) -> list[str]:
         raise NotImplementedError
 
+    def query(self, db: str, col: str, flt: dict,
+              limit: int = 0) -> "list[dict] | None":
+        """OPTIONAL server-side filtered find. None = unsupported (the
+        caller falls back to a keys()+get() scan); stores with a real
+        query engine (MongoDocStore) answer in ONE round trip instead
+        of 1 + N."""
+        return None
+
     def close(self) -> None: ...
 
 
@@ -64,6 +74,62 @@ class RedisDocStore(DocStore):
         self._c.close()
 
 
+class MongoDocStore(DocStore):
+    """The REAL thing: documents live as native BSON in their
+    ``(db, collection)`` with ``_id``, via the from-scratch OP_MSG wire
+    client — readable by any mongo tooling, no msgpack envelope. The
+    DocStore key convention (``doc:<db>:<col>:<id>``) is parsed back
+    into its parts; blobs are msgpack only at the interface seam (the
+    GWMongo layer packs them) and are unpacked to store natively."""
+
+    def __init__(self, addr: str):
+        from goworld_tpu.ext.db.mongowire import MongoClient
+
+        self._c = MongoClient.from_addr(addr)
+
+    @staticmethod
+    def _parse(key: str) -> tuple[str, str, str]:
+        _, db, col, doc_id = key.split(":", 3)
+        return db, col, doc_id
+
+    def _coll(self, db: str, col: str) -> str:
+        # one client bound to one wire-level $db; namespace by prefixing
+        # the db part into the collection when it differs
+        return col if db == self._c.db else f"{db}.{col}"
+
+    def put(self, key, blob):
+        db, col, doc_id = self._parse(key)
+        doc = msgpack.unpackb(blob, raw=False)
+        self._c.upsert_id(self._coll(db, col), doc_id, doc)
+
+    def get(self, key):
+        db, col, doc_id = self._parse(key)
+        doc = self._c.find_id(self._coll(db, col), doc_id)
+        if doc is None:
+            return None
+        return msgpack.packb(doc, use_bin_type=True)
+
+    def delete(self, key):
+        db, col, doc_id = self._parse(key)
+        return self._c.delete(self._coll(db, col), {"_id": doc_id}) > 0
+
+    def keys(self, prefix):
+        # prefix is always "doc:<db>:<col>:" (the GWMongo key scheme)
+        db, col, _ = self._parse(prefix + "\x00")
+        docs = self._c.find(self._coll(db, col), {},
+                            projection={"_id": 1})
+        return sorted(f"doc:{db}:{col}:{d['_id']}" for d in docs)
+
+    def query(self, db, col, flt, limit=0):
+        # server-side filter: one round trip instead of a 1 + N
+        # key-scan (the flat-equality filters GWMongo supports are
+        # valid mongo filters verbatim)
+        return self._c.find(self._coll(db, col), flt, limit=limit)
+
+    def close(self):
+        self._c.close()
+
+
 def _matches(doc: dict, query: dict) -> bool:
     """Flat equality filter (the subset the reference's examples use)."""
     return all(doc.get(k) == v for k, v in query.items())
@@ -80,6 +146,13 @@ class GWMongo:
     @classmethod
     def connect_redis(cls, addr: str, workers: AsyncWorkers) -> "GWMongo":
         return cls(RedisDocStore(addr), workers)
+
+    @classmethod
+    def connect_mongodb(cls, addr: str,
+                        workers: AsyncWorkers) -> "GWMongo":
+        """The reference shape: a real MongoDB endpoint (or the
+        in-process minimongo) over the from-scratch wire client."""
+        return cls(MongoDocStore(addr), workers)
 
     @staticmethod
     def _key(db: str, col: str, doc_id: str) -> str:
@@ -116,6 +189,9 @@ class GWMongo:
     def find_one(self, db: str, col: str, query: dict,
                  cb: Callable) -> None:
         def job():
+            native = self._store.query(db, col, query, limit=1)
+            if native is not None:
+                return native[0] if native else None
             for key in self._store.keys(f"doc:{db}:{col}:"):
                 raw = self._store.get(key)
                 if raw is None:
@@ -130,6 +206,9 @@ class GWMongo:
     def find_all(self, db: str, col: str, query: dict,
                  cb: Callable) -> None:
         def job():
+            native = self._store.query(db, col, query)
+            if native is not None:
+                return native
             out = []
             for key in self._store.keys(f"doc:{db}:{col}:"):
                 raw = self._store.get(key)

@@ -310,6 +310,41 @@ class RedisClusterKVDB(KVDBBackend):
             c.close()
 
 
+class MongoKVDB(KVDBBackend):
+    """The reference's mongo kvdb engine
+    (``kvdb/backend/kvdb_mongodb/mongodb.go``), same document layout:
+    one collection, ``_id`` = key, value under ``"_"`` (its
+    ``_VAL_KEY``); Put = UpsertId, Get = FindId, Find = range query
+    ``{"_id": {"$gte": begin, "$lt": end}}``. Collection ``__kv__``
+    (the name the reference's own backend test uses). Rides the
+    from-scratch BSON/OP_MSG wire client — works against a real
+    mongod or the in-process minimongo."""
+
+    COLLECTION = "__kv__"
+
+    def __init__(self, addr: str):
+        from goworld_tpu.ext.db.mongowire import MongoClient
+
+        self._c = MongoClient.from_addr(addr)
+
+    def get(self, key):
+        doc = self._c.find_id(self.COLLECTION, key)
+        return None if doc is None else doc.get("_")
+
+    def put(self, key, val):
+        self._c.upsert_id(self.COLLECTION, key, {"_": val})
+
+    def get_range(self, begin, end):
+        docs = self._c.find(
+            self.COLLECTION, {"_id": {"$gte": begin, "$lt": end}},
+            sort={"_id": 1},
+        )
+        return [(d["_id"], d["_"]) for d in docs]
+
+    def close(self):
+        self._c.close()
+
+
 def open_kvdb_backend(kind: str, location: str = "") -> KVDBBackend:
     if kind == "memory":
         return MemoryKVDB()
@@ -321,6 +356,8 @@ def open_kvdb_backend(kind: str, location: str = "") -> KVDBBackend:
         return RedisClusterKVDB(
             [a.strip() for a in location.split(",") if a.strip()]
         )
+    if kind == "mongodb":
+        return MongoKVDB(location or "127.0.0.1:27017/goworld")
     raise ValueError(f"unknown kvdb backend {kind!r}")
 
 

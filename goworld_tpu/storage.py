@@ -6,15 +6,14 @@ worker consumes a queue of save/load/exists/list requests against an
 (entity data must not be lost), callbacks are posted back to the logic
 thread, and a queue-length monitor warns on backlog (``:102-110``).
 
-Backends here: ``redis`` (networked, RESP wire protocol — the key scheme
-mirrors the reference's one-Mongo-collection-per-type layout,
-``backend/mongodb/mongodb.go:27-136``; works against any redis-compatible
-endpoint including the in-process test server
-:mod:`goworld_tpu.ext.db.miniredis`), ``filesystem`` (one directory per
-entity type, one msgpack file per entity), and ``memory`` (tests).
-MongoDB itself is not available in this environment; the backend
-interface matches so a driver-backed one can slot in without touching
-this module.
+Backends here: ``mongodb`` (the reference's primary backend,
+``backend/mongodb/mongodb.go:27-136`` — re-implemented over a
+from-scratch BSON + OP_MSG wire client, one collection per entity type
+with ``_id`` = EntityID and the attrs under ``data``; works against a
+real mongod or the in-process :mod:`goworld_tpu.ext.db.minimongo`),
+``redis`` (networked, RESP wire protocol; key scheme ``gw:<type>:<eid>``),
+``filesystem`` (one directory per entity type, one msgpack file per
+entity), and ``memory`` (tests).
 """
 
 from __future__ import annotations
@@ -145,6 +144,41 @@ class RedisStorage(EntityStorageBackend):
         self._c.close()
 
 
+class MongoDBStorage(EntityStorageBackend):
+    """The reference's primary backend
+    (``backend/mongodb/mongodb.go:27-136``), byte-compatible layout:
+    one collection per entity type, ``_id`` = EntityID, attrs under
+    ``data`` (``col.UpsertId(entityID, bson.M{"data": data})``). Rides
+    the from-scratch BSON/OP_MSG client
+    (:mod:`goworld_tpu.ext.db.mongowire`) — no driver needed; any
+    mongod or the in-process minimongo speaks the wire."""
+
+    def __init__(self, addr: str):
+        from goworld_tpu.ext.db.mongowire import MongoClient
+
+        self._c = MongoClient.from_addr(addr)
+
+    def write(self, type_name, eid, data):
+        self._c.upsert_id(type_name, eid, {"data": data})
+
+    def read(self, type_name, eid):
+        doc = self._c.find_id(type_name, eid)
+        return None if doc is None else doc.get("data")
+
+    def exists(self, type_name, eid):
+        return bool(self._c.find(type_name, {"_id": eid},
+                                 projection={"_id": 1}, limit=1))
+
+    def list_entity_ids(self, type_name):
+        return sorted(
+            d["_id"] for d in self._c.find(
+                type_name, {}, projection={"_id": 1})
+        )
+
+    def close(self):
+        self._c.close()
+
+
 def open_backend(kind: str, location: str = "") -> EntityStorageBackend:
     if kind == "memory":
         return MemoryStorage()
@@ -152,6 +186,8 @@ def open_backend(kind: str, location: str = "") -> EntityStorageBackend:
         return FilesystemStorage(location or "entity_storage")
     if kind == "redis":
         return RedisStorage(location or "127.0.0.1:6379")
+    if kind == "mongodb":
+        return MongoDBStorage(location or "127.0.0.1:27017/goworld")
     raise ValueError(f"unknown storage backend {kind!r}")
 
 
