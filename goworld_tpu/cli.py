@@ -8,7 +8,8 @@ then games, then gates — ``start.go:17-114``), stops it in reverse order
 
 Differences from the reference, by design:
 
-* no ``build`` step — games are Python scripts (the reference compiles Go);
+* ``build`` compiles the native C++ cores + bytecode instead of Go
+  binaries (games are Python scripts; ``cmd_build``);
 * liveness is tracked with pid files under ``<dir>/run/`` instead of
   scanning the process table (same observable behavior, simpler and safer);
 * readiness still uses the supervisor tag printed to each process's log
@@ -548,8 +549,56 @@ def cmd_watchdog(server_dir: str, interval: float = 2.0,
 
 
 # =======================================================================
-# status (reference status.go)
+# build (reference build.go)
 # =======================================================================
+def cmd_build(server_dir: str | None = None) -> int:
+    """Reference ``goworld build <server>`` (``cmd/goworld/build.go:9-38``
+    go-compiles the server, dispatcher and gate). Python has no link
+    step, but the framework DOES have build products: the native C++
+    cores (the batch sync codec, the KCP ARQ core, the snappy codec)
+    and .pyc bytecode. Building them at deploy time moves first-boot
+    latency and the lazy in-process g++ builds (which need a compiler
+    on the production host) to the build box — the role the reference's
+    command plays."""
+    import compileall
+
+    pkg_root = os.path.dirname(os.path.abspath(__file__))
+    if server_dir and not os.path.isdir(server_dir):
+        # a typo'd path must not print "build ok" (compileall treats a
+        # missing dir as trivially successful)
+        print(f"server directory not found: {server_dir}")
+        return 1
+    native = os.path.join(pkg_root, "native")
+    print("building native cores ...")
+    try:
+        r = subprocess.run(["make", "-C", native, "all"],
+                           capture_output=True, text=True, timeout=600)
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        print(f"native build FAILED ({e}); runtime falls back to "
+              f"pure-python cores where available")
+        return 1
+    if r.returncode != 0:
+        print(r.stdout[-2000:] + r.stderr[-2000:])
+        print("native build FAILED (runtime falls back to pure-python "
+              "cores where available)")
+        return 1
+    for so in sorted(f for f in os.listdir(native)
+                     if f.endswith(".so")):
+        print(f"  {so}: ok")
+    print("byte-compiling framework ...")
+    # quiet=1: listings off, per-file ERRORS still shown (the operator
+    # needs to know WHICH file failed)
+    ok = compileall.compile_dir(pkg_root, quiet=1)
+    if server_dir:
+        print(f"byte-compiling server {server_dir} ...")
+        ok = compileall.compile_dir(server_dir, quiet=1) and ok
+    if not ok:
+        print("byte-compile reported errors")
+        return 1
+    print("build ok")
+    return 0
+
+
 def cmd_status(server_dir: str) -> int:
     cfg = config_mod.load(_find_config(server_dir))
     rows = (
@@ -668,6 +717,8 @@ def main(argv: list[str] | None = None) -> int:
     for name in ("start", "stop", "kill", "reload", "status"):
         p = sub.add_parser(name)
         p.add_argument("server_dir")
+    pb = sub.add_parser("build")
+    pb.add_argument("server_dir", nargs="?", default=None)
     pw = sub.add_parser("watchdog")
     pw.add_argument("server_dir")
     pw.add_argument("--interval", type=float, default=2.0)
@@ -703,6 +754,8 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_reload(args.server_dir)
     if args.cmd == "status":
         return cmd_status(args.server_dir)
+    if args.cmd == "build":
+        return cmd_build(args.server_dir)
     if args.cmd == "watchdog":
         return cmd_watchdog(args.server_dir, interval=args.interval,
                             once=args.once)

@@ -507,3 +507,25 @@ def test_watchdog_recovers_killed_multihost_rank(tmp_path):
             [sys.executable, "-m", "goworld_tpu", "stop", dst],
             env=env, cwd=dst, capture_output=True, text=True, timeout=120,
         )
+
+
+def test_cli_build(tmp_path):
+    """`build` prebuilds the native C++ cores and byte-compiles the
+    framework + server dir (the reference's `goworld build` role,
+    cmd/goworld/build.go:9-38, adapted: no Go link step)."""
+    sdir = tmp_path / "srv"
+    sdir.mkdir()
+    (sdir / "server.py").write_text("import goworld_tpu\n")
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["PYTHONPATH"] = REPO
+    r = subprocess.run(
+        [sys.executable, "-m", "goworld_tpu", "build", str(sdir)],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "build ok" in r.stdout
+    native = os.path.join(REPO, "goworld_tpu", "native")
+    for so in ("_packet_codec.so", "_kcp_core_v2.so", "_snappy_core.so"):
+        assert os.path.exists(os.path.join(native, so))
+    assert (sdir / "__pycache__").exists()
