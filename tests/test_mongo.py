@@ -221,3 +221,36 @@ def test_gwmongo_over_real_wire(server):
         cb()
     fdoc, ferr = results["fo"]
     assert ferr is None and fdoc["_id"] == did
+
+
+def test_bson_decoder_never_crashes_on_garbage():
+    """Adversarial robustness for the from-scratch BSON decoder:
+    random bytes and mutated valid documents must raise a bounded,
+    expected error — never hang, never allocate from an
+    attacker-controlled length (MemoryError is a FAILURE here: it
+    means a bit-flipped int32 drove a huge allocation), and a decode
+    that SUCCEEDS must have stayed inside the declared document
+    bounds."""
+    import random
+    import struct
+
+    rng = random.Random(13)
+    ok_errors = (ValueError, IndexError, OverflowError,
+                 UnicodeDecodeError, struct.error)
+
+    def probe(blob: bytes) -> None:
+        try:
+            _, end = bson.decode_with_end(blob)
+        except ok_errors:
+            return
+        assert end <= len(blob), "decoder read past the input"
+
+    for _ in range(500):
+        probe(bytes(rng.randrange(256)
+                    for _ in range(rng.randrange(4, 64))))
+    valid = bson.encode({"a": [1, {"b": "cc"}], "d": 2.5, "e": b"xy"})
+    for _ in range(400):
+        m = bytearray(valid)
+        for _ in range(rng.randrange(1, 3)):
+            m[rng.randrange(len(m))] ^= 1 << rng.randrange(8)
+        probe(bytes(m))

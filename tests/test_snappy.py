@@ -133,3 +133,36 @@ def test_skippable_and_reserved_chunks():
     # unskippable reserved chunk (0x02) is an error
     with pytest.raises(ValueError):
         snappy.StreamDecompressor().decompress(b"\x02\x01\x00\x00a")
+
+
+def test_decoder_never_crashes_on_garbage():
+    """Adversarial robustness: random bytes and mutated valid streams
+    must produce ValueError (or clean output) — never an unhandled
+    crash, hang, or out-of-bounds read."""
+    rng = random.Random(11)
+    # pure garbage blocks
+    for _ in range(500):
+        blob = bytes(rng.randrange(256)
+                     for _ in range(rng.randrange(1, 80)))
+        try:
+            snappy.uncompress(blob, 1 << 16)
+        except ValueError:
+            pass
+    # bit-flipped valid blocks
+    valid = snappy.compress(bytes(rng.choices(b"abcdef", k=5000)))
+    for _ in range(300):
+        m = bytearray(valid)
+        for _ in range(rng.randrange(1, 4)):
+            m[rng.randrange(len(m))] ^= 1 << rng.randrange(8)
+        try:
+            snappy.uncompress(bytes(m), 1 << 16)
+        except ValueError:
+            pass
+    # garbage framed streams
+    for _ in range(300):
+        blob = bytes(rng.randrange(256)
+                     for _ in range(rng.randrange(1, 120)))
+        try:
+            snappy.StreamDecompressor().decompress(blob, max_out=1 << 20)
+        except ValueError:
+            pass
