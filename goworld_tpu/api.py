@@ -245,6 +245,8 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
         mesh=mesh, game_id=gid,
         megaspace=gc.megaspace, mega_shape=mega_shape,
         halo_cap=gc.halo_cap, migrate_cap=gc.migrate_cap,
+        pipeline_decode=gc.pipeline_decode and mesh is None
+        and not gc.megaspace,
     )
     # periodic persistence cadence (reference [gameN] save_interval,
     # goworld.ini.sample:45; Entity.go:164-177)

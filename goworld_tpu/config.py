@@ -78,6 +78,13 @@ class GameConfig:
     # other) will leak after destroy — break such references in
     # OnDestroy, or set gc_freeze = false
     gc_freeze: bool = True
+    # pipeline the host decode one tick behind the device step
+    # (single-controller non-mesh games only; silently ignored
+    # elsewhere): tick N's device execution overlaps tick N-1's host
+    # event decode, so the frame pays max(device, host) instead of
+    # their sum. Cost: client-visible events lag one tick (~one
+    # position-sync interval).
+    pipeline_decode: bool = False
     extent_x: float = 1000.0
     extent_z: float = 1000.0
     mesh_devices: int = 0  # 0 = single-device vmap path (GLOBAL count

@@ -135,6 +135,7 @@ class World:
         megaspace: bool = False,
         halo_cap: int = 1024,
         mega_shape: tuple[int, int] | None = None,
+        pipeline_decode: bool = False,
     ):
         self.cfg = cfg
         self.n_spaces = n_spaces
@@ -149,6 +150,18 @@ class World:
 
             self.policy = init_policy(jax.random.PRNGKey(seed))
         self.mega = None    # MegaConfig when megaspace=True
+        # pipelined host decode (see tick()): only the single-
+        # controller, non-mesh shape qualifies — reject loudly instead
+        # of silently decoding a tick late where same-tick couplings
+        # (staged-migration tags, mega arrivals, SPMD collectives)
+        # would corrupt state
+        if pipeline_decode and (mesh is not None or megaspace):
+            raise ValueError(
+                "pipeline_decode requires a single-device, "
+                "non-megaspace World"
+            )
+        self.pipeline_decode = pipeline_decode
+        self._pending_outs = None
         if mesh is not None and mesh.devices.size != n_spaces:
             raise ValueError(
                 f"mesh has {mesh.devices.size} devices but "
@@ -1223,23 +1236,63 @@ class World:
         self._pos_cache = self._yaw_cache = None
         t0 = time.perf_counter()
         self.state, outs = self._step(self.state, inputs, self.policy)
-        outs = self._dget(outs)
-        if self._multihost:
-            # EAGER pos/yaw refresh: every controller executes these two
-            # collectives at the same point every tick. Lazy fetching
-            # would deadlock — read_pos is a process_allgather under
-            # multihost, and the owner-local decode below reaches it on
-            # ONE controller only (e.g. je.position while building a
-            # client enter message, or a user OnEnterAOI hook)
-            self._pos_cache = self._dget(self.state.pos)
-            self._yaw_cache = self._dget(self.state.yaw)
+        if self.pipeline_decode:
+            # PIPELINED decode (opt-in; single-controller non-mesh
+            # worlds only — mesh/mega decode has same-tick couplings
+            # like the staged-migration tag map): tick N is dispatched
+            # ASYNC above, then tick N-1's outputs — already
+            # materialized on device — are fetched and decoded WHILE
+            # the device computes N. The frame pays
+            # max(device, host decode) instead of their sum (on TPU
+            # the host half was ~5-7 ms of a 16 ms frame —
+            # docs/R5_MEASUREMENTS.md). Costs: host-visible events and
+            # client sends lag one tick, and the slot-release
+            # quarantine is skewed one call to match (_flush_staging
+            # routes despawn releases via _release_next). Freeze /
+            # checkpoint paths call flush_pending_outputs() first.
+            # outs is None on the first tick (nothing to decode yet).
+            outs, self._pending_outs = self._pending_outs, outs
+        if outs is not None:
+            outs = self._dget(outs)
+            if self._multihost:
+                # EAGER pos/yaw refresh: every controller executes
+                # these two collectives at the same point every tick.
+                # Lazy fetching would deadlock — read_pos is a
+                # process_allgather under multihost, and the
+                # owner-local decode below reaches it on ONE controller
+                # only (e.g. je.position while building a client enter
+                # message, or a user OnEnterAOI hook)
+                self._pos_cache = self._dget(self.state.pos)
+                self._yaw_cache = self._dget(self.state.yaw)
+        # under pipelining this measures dispatch + the blocking fetch
+        # of the PREVIOUS tick's outputs — i.e. how long this frame
+        # actually waited on the device, the number the 16 ms budget
+        # cares about (the true per-step device time is not
+        # host-observable without a sync)
         self.op_stats["device_step_s"] = time.perf_counter() - t0
-        self.last_outputs = outs  # observability (tests, opmon, dryrun)
-        self._process_outputs(outs)
-        self._drain_attr_journals()
+        if outs is not None:
+            self._decode_outputs(outs)
         self.post_q.tick()
         self.tick_count += 1
         opmon.monitor.record("world.tick", time.perf_counter() - t_start)
+
+    def _decode_outputs(self, outs) -> None:
+        """The host half of a tick: record + decode fetched outputs.
+        Shared by tick() and flush_pending_outputs() so the sequence
+        cannot drift between the pipelined and eager paths."""
+        self.last_outputs = outs  # observability (tests, opmon, dryrun)
+        self._process_outputs(outs)
+        self._drain_attr_journals()
+
+    def flush_pending_outputs(self) -> None:
+        """Drain the pipelined decode (no-op when pipelining is off or
+        nothing is pending). Freeze, checkpoint and shutdown paths must
+        not snapshot with a tick's outputs undecoded — client sends and
+        interest-set updates would be lost with the process."""
+        pending, self._pending_outs = self._pending_outs, None
+        if pending is None:
+            return
+        self._decode_outputs(self._dget(pending))
 
     # -- staging flush --------------------------------------------------
     def _spmd_guard(self) -> None:
@@ -1416,7 +1469,15 @@ class World:
                 npc_moving=st.npc_moving.at[ix].set(False, mode="drop"),
                 dirty=st.dirty.at[ix].set(False, mode="drop"),
             )
-            self._release_now.extend(
+            # release AFTER this tick's leave events decode: that is
+            # the end of THIS tick's _process_outputs normally, but one
+            # call LATER under pipelined decode (this tick's outputs
+            # decode next tick — releasing now would free the slot a
+            # call early, letting a reused slot capture the old
+            # entity's pending leave events)
+            rel = (self._release_next if self.pipeline_decode
+                   else self._release_now)
+            rel.extend(
                 (sh_, sl_, self._slot_owner[sh_].get(sl_))
                 for sh_, sl_ in self._staged_despawn
             )

@@ -106,6 +106,9 @@ def freeze_world(world: World, *, _snap=None, run_hooks: bool = True
     hook contract doesn't apply)."""
     if world.nil_space is None:
         raise RuntimeError("cannot freeze: no nil space")
+    # a pipelined world may hold one tick's outputs undecoded — the
+    # snapshot must not lose their client sends / interest updates
+    world.flush_pending_outputs()
     snap = _snap if _snap is not None else _device_snapshot(world)
 
     if run_hooks:

@@ -1,0 +1,178 @@
+"""Pipelined host decode (World(pipeline_decode=True)): tick N's device
+step overlaps tick N-1's host event decode. The device trajectory is
+UNCHANGED (decode never feeds back into the step); host-visible events
+arrive one tick late but none are lost — after a final
+flush_pending_outputs(), interest sets, client mirrors, and event
+totals must match a non-pipelined world run over the same seed."""
+
+import numpy as np
+import pytest
+
+from goworld_tpu.core.state import WorldConfig
+from goworld_tpu.entity.entity import Entity, GameClient
+from goworld_tpu.entity.manager import World
+from goworld_tpu.entity.space import Space
+from goworld_tpu.ops.aoi import GridSpec
+
+
+class Npc(Entity):
+    ATTRS = {"name": "allclients"}
+
+
+class Arena(Space):
+    pass
+
+
+def _world(pipeline: bool, n=96):
+    cfg = WorldConfig(
+        capacity=n,
+        grid=GridSpec(radius=12.0, extent_x=200.0, extent_z=200.0,
+                      k=16, cell_cap=32, row_block=n),
+        npc_speed=20.0, turn_prob=0.3,
+        enter_cap=2048, leave_cap=2048, sync_cap=2048,
+        attr_sync_cap=64, input_cap=n, delta_rows_cap=n,
+    )
+    world = World(cfg, n_spaces=1, seed=5, pipeline_decode=pipeline)
+    sent = []
+    world.client_sink = lambda g, c, m: sent.append((c, m["type"],
+                                                     m.get("eid")))
+    world.register_space("Arena", Arena)
+    world.register_entity("Npc", Npc)
+    world.create_nil_space()
+    arena = world.create_space("Arena")
+    rng = np.random.default_rng(4)
+    pts = rng.uniform(20, 180, size=(n - 16, 2))
+    ents = []
+    for i in range(n - 16):
+        client = GameClient(1, f"CL{i:010d}", world) if i % 9 == 0 \
+            else None
+        ents.append(world.create_entity(
+            "Npc", space=arena, pos=(pts[i, 0], 0.0, pts[i, 1]),
+            moving=True, client=client,
+        ))
+    return world, ents, sent
+
+
+def _interest_maps(ents):
+    return {e.id: (frozenset(e.interested_in),
+                   frozenset(e.interested_by)) for e in ents}
+
+
+def test_pipelined_equals_eager_after_drain():
+    wa, ea, sa = _world(False)
+    wb, eb, sb = _world(True)
+    for _ in range(12):
+        wa.tick()
+        wb.tick()
+    wb.flush_pending_outputs()
+    # identical device trajectory -> identical final interest relation
+    ma, mb = _interest_maps(ea), _interest_maps(eb)
+    # entity ids differ between worlds; compare by creation order
+    for a, b in zip(ea, eb):
+        ia, _ = ma[a.id]
+        ib, _ = mb[b.id]
+        # map a-world ids to creation indices for comparison
+        idx_a = {e.id: i for i, e in enumerate(ea)}
+        idx_b = {e.id: i for i, e in enumerate(eb)}
+        assert {idx_a[x] for x in ia} == {idx_b[x] for x in ib}, \
+            f"interest mismatch for entity #{idx_a[a.id]}"
+    # same client message multiset (order may shift by one tick)
+    def norm(sent, idx):
+        out = []
+        for cid, t, eid in sent:
+            out.append((cid, t, idx.get(eid, eid)))
+        return sorted(out)
+
+    assert norm(sa, {e.id: i for i, e in enumerate(ea)}) \
+        == norm(sb, {e.id: i for i, e in enumerate(eb)})
+
+
+def test_pipeline_lags_exactly_one_tick():
+    wb, eb, _ = _world(True)
+    wb.tick()
+    # first tick's outputs are pending, nothing decoded yet
+    assert wb._pending_outs is not None
+    assert all(not e.interested_in for e in eb)
+    wb.tick()
+    # now tick 1's spawn-wave enters have decoded
+    assert any(e.interested_in for e in eb)
+
+
+def test_pipeline_rejected_on_mesh_and_mega():
+    cfg = WorldConfig(
+        capacity=32,
+        grid=GridSpec(radius=10.0, extent_x=100.0, extent_z=100.0,
+                      k=8, cell_cap=16, row_block=32),
+    )
+    from goworld_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="pipeline_decode"):
+        World(cfg, n_spaces=8, mesh=make_mesh(8), pipeline_decode=True)
+
+
+def test_freeze_drains_pending():
+    from goworld_tpu import freeze as freeze_mod
+
+    wb, eb, _ = _world(True)
+    for _ in range(3):
+        wb.tick()
+    assert wb._pending_outs is not None
+    data = freeze_mod.freeze_world(wb)
+    assert wb._pending_outs is None          # drained before snapshot
+    assert data is not None
+    # the snapshot's host interest state includes the last tick's events
+    assert any(e.interested_in for e in eb)
+
+
+def test_pipelined_churn_with_slot_reuse_matches_eager():
+    """The quarantine skew: a destroyed entity's slot must not free
+    before its leave events decode, even though pipelined decode runs
+    one tick behind — otherwise a reused slot captures the old
+    entity's leaves (spurious client destroys, stuck interest). Drive
+    identical create/destroy churn through both modes on a SMALL
+    capacity (forcing reuse) and require identical final state."""
+    def run(pipeline: bool):
+        world, ents, sent = _world(pipeline, n=48)
+        rng = np.random.default_rng(9)
+        alive = list(ents)
+        created = list(ents)
+        for t in range(16):
+            if len(alive) > 8:
+                victim = alive.pop(int(rng.integers(len(alive))))
+                world.destroy_entity(victim)
+            e = world.create_entity(
+                "Npc", space=alive[0].space,
+                pos=(float(rng.uniform(20, 180)), 0.0,
+                     float(rng.uniform(20, 180))),
+                moving=True,
+            )
+            alive.append(e)
+            created.append(e)
+            world.tick()
+        world.flush_pending_outputs()
+        idx = {e.id: i for i, e in enumerate(created)}
+        state = sorted(
+            (idx[e.id], frozenset(idx[x] for x in e.interested_in
+                                  if x in idx))
+            for e in alive if not e.destroyed
+        )
+        msgs = sorted((c, ty, idx.get(eid, eid)) for c, ty, eid in sent)
+        return state, msgs
+
+    sa, ma = run(False)
+    sb, mb = run(True)
+    assert sa == sb
+    assert ma == mb
+
+
+def test_pipeline_rejected_on_megaspace():
+    cfg = WorldConfig(
+        capacity=64,
+        grid=GridSpec(radius=10.0, extent_x=120.0, extent_z=100.0,
+                      k=8, cell_cap=16, row_block=64),
+    )
+    from goworld_tpu.parallel.mesh import make_mesh
+
+    with pytest.raises(ValueError, match="pipeline_decode"):
+        World(cfg, n_spaces=8, mesh=make_mesh(8), megaspace=True,
+              halo_cap=64, migrate_cap=32, pipeline_decode=True)
