@@ -643,6 +643,21 @@ def _apply_registrations(world: World, svc=None,
             kw = dict(kw)
             shards = kw.pop("shard_count", 1)
             svc.register(name, c, shard_count=shards, **kw)
+    if svc is None and not services_only:
+        # pre-register service ENTITY TYPES (second loop, so a
+        # same-name entity/space registration wins regardless of
+        # declaration order — exactly what ServiceManager.register's
+        # name-in-registry skip used to give): a -restore replays the
+        # snapshot during GameServer construction — BEFORE the
+        # kvreg-backed ServiceManager exists — and the snapshot
+        # contains service entities (services are ordinary entities,
+        # reference service.go:65).
+        for kind, name, c, kw in _registrations:
+            if kind == "service" and name not in world.registry:
+                world.register_entity(
+                    name, c,
+                    **{k: v for k, v in kw.items()
+                       if k != "shard_count"})
 
 
 def _reset_for_tests() -> None:

@@ -132,6 +132,25 @@ class ServiceManager:
                     continue
                 if (name, idx) in self._local_shards:
                     continue
+                # ADOPT before creating: after a hot reload the
+                # -restore snapshot already recreated this shard's
+                # entity and the kvreg (dispatcher survives the game
+                # restart; local worlds restore the mirror) still maps
+                # the shard to its eid — creating a fresh entity here
+                # would orphan-duplicate every service shard per
+                # reload (reference checkServices re-links the
+                # registered eid the same way, service.go:106-238)
+                eid = self._kv_get(_ENTITY_KEY.format(name=name, idx=idx))
+                if eid is not None:
+                    e = self.world.entities.get(eid)
+                    if e is not None and not e.destroyed:
+                        e.service_name = name
+                        e.shard_index = idx
+                        self._local_shards[(name, idx)] = eid
+                        logger.info(
+                            "adopted restored service shard %s#%d -> %s",
+                            name, idx, eid)
+                        continue
                 e = self.world.create_entity(name)
                 e.service_name = name
                 e.shard_index = idx

@@ -26,19 +26,31 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-@pytest.fixture()
-def server_dir(tmp_path):
-    src = os.path.join(REPO, "examples", "nil_game")
-    dst = str(tmp_path / "nil_game")
+def _copy_example(name: str, tmp_path, dport_old: int,
+                  gport_old: int) -> tuple[str, int]:
+    """Copy an example server dir and rebind its dispatcher/gate ports
+    to free ones; asserts the rewrites actually happened (a changed ini
+    default would otherwise silently bind the stock port and collide
+    with parallel runs)."""
+    src = os.path.join(REPO, "examples", name)
+    dst = str(tmp_path / name)
     shutil.copytree(src, dst)
     dport, gport = _free_port(), _free_port()
     ini = os.path.join(dst, "goworld_tpu.ini")
     with open(ini) as f:
         text = f.read()
-    text = text.replace("port = 14300", f"port = {dport}")
-    text = text.replace("port = 15300", f"port = {gport}")
+    for old, new in ((f"port = {dport_old}", f"port = {dport}"),
+                     (f"port = {gport_old}", f"port = {gport}")):
+        assert old in text, f"{name} ini default moved: {old!r} missing"
+        text = text.replace(old, new)
     with open(ini, "w") as f:
         f.write(text)
+    return dst, gport
+
+
+@pytest.fixture()
+def server_dir(tmp_path):
+    dst, gport = _copy_example("nil_game", tmp_path, 14300, 15300)
     yield dst, gport
     cli.cmd_stop(dst)
 
@@ -529,3 +541,20 @@ def test_cli_build(tmp_path):
     for so in ("_packet_codec.so", "_kcp_core_v2.so", "_snappy_core.so"):
         assert os.path.exists(os.path.join(native, so))
     assert (sdir / "__pycache__").exists()
+
+
+def test_cli_reload_with_services(tmp_path):
+    """Hot reload of a game WITH service entities (examples/test_game:
+    OnlineService etc.): the -restore boot replays a snapshot that
+    CONTAINS service entities, so their types must be registered before
+    the restore (regression: restore ran during GameServer construction
+    while service types registered only afterwards — the restart died
+    with 'entity type not registered' and reload reported RESTORE
+    FAILED)."""
+    dst, gport = _copy_example("test_game", tmp_path, 14400, 15400)
+    try:
+        assert cli.cmd_start(dst) == 0, _logs(dst)
+        assert cli.cmd_reload(dst) == 0, _logs(dst)
+        assert cli.cmd_status(dst) == 0, _logs(dst)
+    finally:
+        cli.cmd_stop(dst)

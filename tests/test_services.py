@@ -215,3 +215,43 @@ def test_kvdb_ops():
     assert out["gop_new"] is None   # fresh write
     assert out["rng"] == [("k1", "v1")]
     assert next_larger_key("abc") == "abc\x00"
+
+
+def test_restored_service_shards_are_adopted_not_duplicated():
+    """Hot-reload semantics: the -restore snapshot recreates service
+    entities and the kvreg (surviving on the dispatcher, or restored
+    with the world's mirror) still maps each shard to its eid —
+    check_services must ADOPT those entities instead of creating a
+    duplicate orphan per shard per reload (reference checkServices
+    re-links the registered eid, service.go:106-238)."""
+    from goworld_tpu import freeze as freeze_mod
+
+    shared_kv: dict[str, str] = {}
+
+    def kv_write(k, v):
+        shared_kv.setdefault(k, v)
+
+    w1 = make_world()
+    sm1 = ServiceManager(w1, game_id=1, kv_write=kv_write,
+                         kv_get=shared_kv.get)
+    sm1.register("CounterService", CounterService, shard_count=3)
+    sm1.check_services()
+    assert len(sm1._local_shards) == 3
+    n_before = sum(1 for e in w1.entities.values()
+                   if e.type_name == "CounterService")
+    w1.tick()
+    snap = freeze_mod.freeze_world(w1)
+
+    # the reloaded process: fresh World + ServiceManager, SAME kvreg
+    w2 = make_world()
+    w2.register_entity("CounterService", CounterService)
+    freeze_mod.restore_world(w2, snap)
+    sm2 = ServiceManager(w2, game_id=1, kv_write=kv_write,
+                         kv_get=shared_kv.get)
+    sm2._services["CounterService"] = 3
+    sm2.check_services()
+    n_after = sum(1 for e in w2.entities.values()
+                  if e.type_name == "CounterService")
+    assert n_after == n_before, "reload duplicated service shards"
+    # the adopted shards are the RESTORED entities (same eids)
+    assert sm2._local_shards == sm1._local_shards
