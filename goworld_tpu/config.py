@@ -324,6 +324,12 @@ aoi_radius = 50.0
 extent_x = 1000.0
 extent_z = 1000.0
 # behavior = btree   # fused NPC kernel: random_walk | mlp | btree
+# pipeline_decode = true   # overlap host event decode with the device
+#                          # step (single-controller non-mesh games;
+#                          # client events lag one tick)
+# gc_freeze = false        # keep boot objects in the cyclic GC (the
+#                          # default freezes them out: gen-2 passes
+#                          # cost ~100 ms at a 131K-entity shard)
 
 [game1]
 
@@ -343,6 +349,11 @@ port = 15000
 [storage]
 kind = filesystem
 directory = entity_storage
+# kind = mongodb           # the reference's primary backend (BSON +
+# directory = 127.0.0.1:27017/goworld   # OP_MSG wire; mongod or the
+#                          # in-process minimongo)
+# kind = redis
+# directory = 127.0.0.1:6379
 
 [kvdb]
 kind = filesystem
