@@ -375,6 +375,20 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
 
     server.on_deployment_ready = _fire_ready
 
+    # observability endpoint (reference binutil.go:17-75 serves pprof +
+    # expvar on every process): /metrics, /trace, /vars, /ops, /healthz.
+    # Multihost ranks offset the port so every controller is scrapeable.
+    if gc.http_port:
+        from goworld_tpu.utils import debug_http
+
+        try:
+            debug_http.start(gc.http_port + (mh_rank if mh_procs > 1
+                                             else 0),
+                             process_name=f"game{gid}")
+        except OSError:
+            logger.exception("game%d: debug http on port %d failed; "
+                             "continuing without it", gid, gc.http_port)
+
     # signal handling (reference game.go:137-196): TERM = clean stop,
     # HUP = freeze for hot reload
     if block:

@@ -599,6 +599,28 @@ def cmd_build(server_dir: str | None = None) -> int:
     return 0
 
 
+def _load_scrape_tool():
+    """Load tools/scrape_metrics.py (the shared cluster scraper) when
+    the repo checkout ships it; a bare package install degrades to the
+    pidfile-only status."""
+    import importlib.util
+
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools", "scrape_metrics.py",
+    )
+    if not os.path.exists(path):
+        return None
+    spec = importlib.util.spec_from_file_location("gw_scrape_metrics",
+                                                  path)
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)  # type: ignore[union-attr]
+    except Exception:
+        return None
+    return mod
+
+
 def cmd_status(server_dir: str) -> int:
     cfg = config_mod.load(_find_config(server_dir))
     rows = (
@@ -613,12 +635,40 @@ def cmd_status(server_dir: str) -> int:
         all_up &= up
         state = f"running (pid {pid})" if up else "stopped"
         print(f"{role}{idx}: {state}")
+    # live telemetry (reference status.go only checks the process table;
+    # with /metrics on every process, status can show the cluster's
+    # actual health: tick latency, AOI overflow, backlogs, drops)
+    scraper = _load_scrape_tool()
+    if scraper is not None:
+        targets = scraper.targets_from_config(cfg)
+        if targets:
+            results, errors = scraper.scrape_all(targets)
+            if results:
+                print()
+                print(scraper.merged_table(results))
+            for e in errors:
+                print(f"metrics: {e}", file=sys.stderr)
     return 0 if all_up else 1
 
 
 # =======================================================================
 # in-process runners (the spawned dispatcher/gate processes)
 # =======================================================================
+def _start_debug_http(port: int, process_name: str,
+                      host: str = "127.0.0.1") -> None:
+    """Observability endpoint for a spawned process (reference
+    binutil.go:17-75 serves pprof + expvar on every process kind).
+    Binds the process's configured host so the scraper's URLs (built
+    from the same config) actually reach it."""
+    if not port:
+        return
+    from goworld_tpu.utils import debug_http
+
+    try:
+        debug_http.start(port, host=host, process_name=process_name)
+    except OSError as e:
+        print(f"{process_name}: debug http on port {port} failed ({e}); "
+              "continuing without it", file=sys.stderr)
 def cmd_run_dispatcher(dispid: int, configfile: str | None,
                        logfile: str = "") -> int:
     from goworld_tpu.net.dispatcher import DispatcherService
@@ -627,6 +677,7 @@ def cmd_run_dispatcher(dispid: int, configfile: str | None,
         log.setup(f"dispatcher{dispid}", logfile=logfile)
     cfg = config_mod.load(configfile)
     dc = cfg.dispatchers.get(dispid) or config_mod.DispatcherConfig()
+    _start_debug_http(dc.http_port, f"dispatcher{dispid}", host=dc.host)
 
     async def main() -> None:
         svc = DispatcherService(
@@ -656,6 +707,7 @@ def cmd_run_gate(gateid: int, configfile: str | None,
         log.setup(f"gate{gateid}", logfile=logfile)
     cfg = config_mod.load(configfile)
     gc = cfg.gates.get(gateid) or config_mod.GateConfig()
+    _start_debug_http(gc.http_port, f"gate{gateid}", host=gc.host)
 
     ssl_ctx = None
     if gc.encrypt:

@@ -132,6 +132,9 @@ class GateConfig:
     tls_key: str = ""
     heartbeat_timeout: float = 0.0  # 0 = disabled
     position_sync_interval_ms: int = 100
+    http_port: int = 0        # debug/metrics endpoint (0 = off); every
+                              # process kind serves the same /metrics +
+                              # /trace map (docs/OBSERVABILITY.md)
     log_file: str = ""
     log_level: str = "info"
 
@@ -288,6 +291,34 @@ def load(path: str | None = None) -> ClusterConfig:
                             "distinct port"
                         )
                     seen[key] = idx
+    # debug-http collisions including GAME rank spans: a multihost game
+    # binds http_port .. http_port + mesh_processes - 1 (one endpoint
+    # per controller, api.run), which the dispatcher/gate-only check
+    # above cannot see — and a wrong-port scrape silently attributes
+    # one process's health to another
+    seen_http: dict[tuple, str] = {}
+    for role, store in (("dispatcher", cfg.dispatchers),
+                        ("gate", cfg.gates)):
+        for idx, dc in sorted(store.items()):
+            p = getattr(dc, "http_port", 0)
+            if p > 0:
+                seen_http[(dc.host, p)] = f"{role}{idx}"
+    for idx, gdc in sorted(cfg.games.items()):
+        if gdc.http_port <= 0:
+            continue
+        span = max(1, getattr(gdc, "mesh_processes", 1))
+        for rank in range(span):
+            key = ("127.0.0.1", gdc.http_port + rank)  # games bind lo
+            if key in seen_http:
+                raise ValueError(
+                    f"game{idx} http_port {key[1]}"
+                    + (f" (rank {rank})" if span > 1 else "")
+                    + f" collides with {seen_http[key]} — give each "
+                    "debug endpoint a distinct port"
+                )
+            seen_http[key] = f"game{idx}" + (f"c{rank}" if span > 1
+                                             else "")
+
     if cp.has_section("storage"):
         _fill(cfg.storage, cp["storage"])
     if cp.has_section("kvdb"):
@@ -312,6 +343,8 @@ def dumps_sample() -> str:
 [dispatcher1]
 host = 127.0.0.1
 port = 14000
+# http_port = 14100  # debug/metrics endpoint: /metrics (Prometheus),
+#                    # /trace (Chrome JSON), /vars, /ops, /healthz
 
 [game_common]
 boot_entity = Account
@@ -327,6 +360,8 @@ extent_z = 1000.0
 # pipeline_decode = true   # overlap host event decode with the device
 #                          # step (single-controller non-mesh games;
 #                          # client events lag one tick)
+# http_port = 16000        # debug/metrics endpoint (multihost ranks
+#                          # bind http_port + rank)
 # gc_freeze = false        # keep boot objects in the cyclic GC (the
 #                          # default freezes them out: gen-2 passes
 #                          # cost ~100 ms at a 131K-entity shard)

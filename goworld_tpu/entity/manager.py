@@ -46,7 +46,7 @@ from goworld_tpu.entity.registry import (
 from goworld_tpu.entity.space import Space
 from goworld_tpu.entity.timer import Crontab, PostQueue, TimerQueue
 from goworld_tpu.parallel.mesh import create_multi_state
-from goworld_tpu.utils import consts, ids, log, opmon
+from goworld_tpu.utils import consts, ids, log, metrics, opmon
 
 logger = log.get("world")
 
@@ -325,6 +325,15 @@ class World:
         self.on_entity_destroyed: Callable[[Entity], None] | None = None
         self.op_stats: dict[str, float] = defaultdict(float)
         self._aoi_alarm_tick = -(1 << 30)  # last AOI-overflow alarm tick
+        # scrapeable AOI saturation series (debug_http /metrics): the
+        # counter accumulates truncated rows/cells; the gauges mirror
+        # the per-tick op_stats so a scraper never needs /vars
+        self._m_aoi_overflow = metrics.counter(
+            "aoi_overflow_total",
+            help="AOI rows truncated to nearest-k + cells past cell_cap",
+        )
+        self._m_aoi_demand = metrics.gauge("aoi_demand_max")
+        self._m_aoi_cell = metrics.gauge("aoi_cell_max")
 
     # ==================================================================
     # registration / creation
@@ -1219,23 +1228,42 @@ class World:
     # the tick
     # ==================================================================
     def tick(self) -> None:
+        # per-tick phase timeline (debug_http /trace): the GameServer's
+        # serve loop opens the tick record (so pump/fan-out spans land in
+        # it too); a standalone World opens its own and must close it
+        # even when a phase raises, or the process-global recorder wedges
+        tl = metrics.timeline
+        self_opened = not tl.is_open
+        if self_opened:
+            tl.begin_tick()
+        try:
+            self._tick_phases(tl)
+        finally:
+            if self_opened:
+                tl.end_tick()
+
+    def _tick_phases(self, tl) -> None:
         t_start = time.perf_counter()
-        if self._multihost and self.service_mgr is not None \
-                and self.mh_group_ready \
-                and self.tick_count % self.service_mgr.MH_CHECK_TICKS == 0:
-            # tick-cadence service reconcile (wall timers would fire at
-            # different instants per controller and desync the
-            # deterministic eid sequence; tick_count is SPMD-consistent,
-            # and mh_group_ready comes from the GameServer's per-tick
-            # allgather — True by construction for standalone worlds)
-            self.service_mgr.check_services()
-        self.timers.tick(self._fire_timer)
-        self.crontab.tick()
-        self.post_q.tick()
-        inputs = self._flush_staging()
+        with tl.span("flush_staging"):
+            if self._multihost and self.service_mgr is not None \
+                    and self.mh_group_ready \
+                    and self.tick_count % self.service_mgr.MH_CHECK_TICKS \
+                    == 0:
+                # tick-cadence service reconcile (wall timers would fire
+                # at different instants per controller and desync the
+                # deterministic eid sequence; tick_count is
+                # SPMD-consistent, and mh_group_ready comes from the
+                # GameServer's per-tick allgather — True by construction
+                # for standalone worlds)
+                self.service_mgr.check_services()
+            self.timers.tick(self._fire_timer)
+            self.crontab.tick()
+            self.post_q.tick()
+            inputs = self._flush_staging()
         self._pos_cache = self._yaw_cache = None
         t0 = time.perf_counter()
-        self.state, outs = self._step(self.state, inputs, self.policy)
+        with tl.span("device_step"):
+            self.state, outs = self._step(self.state, inputs, self.policy)
         if self.pipeline_decode:
             # PIPELINED decode (opt-in; single-controller non-mesh
             # worlds only — mesh/mega decode has same-tick couplings
@@ -1252,27 +1280,32 @@ class World:
             # checkpoint paths call flush_pending_outputs() first.
             # outs is None on the first tick (nothing to decode yet).
             outs, self._pending_outs = self._pending_outs, outs
-        if outs is not None:
-            outs = self._dget(outs)
-            if self._multihost:
-                # EAGER pos/yaw refresh: every controller executes
-                # these two collectives at the same point every tick.
-                # Lazy fetching would deadlock — read_pos is a
-                # process_allgather under multihost, and the
-                # owner-local decode below reaches it on ONE controller
-                # only (e.g. je.position while building a client enter
-                # message, or a user OnEnterAOI hook)
-                self._pos_cache = self._dget(self.state.pos)
-                self._yaw_cache = self._dget(self.state.yaw)
+        with tl.span("fetch_outputs"):
+            if outs is not None:
+                outs = self._dget(outs)
+                if self._multihost:
+                    # EAGER pos/yaw refresh: every controller executes
+                    # these two collectives at the same point every tick.
+                    # Lazy fetching would deadlock — read_pos is a
+                    # process_allgather under multihost, and the
+                    # owner-local decode below reaches it on ONE
+                    # controller only (e.g. je.position while building a
+                    # client enter message, or a user OnEnterAOI hook)
+                    self._pos_cache = self._dget(self.state.pos)
+                    self._yaw_cache = self._dget(self.state.yaw)
         # under pipelining this measures dispatch + the blocking fetch
         # of the PREVIOUS tick's outputs — i.e. how long this frame
         # actually waited on the device, the number the 16 ms budget
         # cares about (the true per-step device time is not
         # host-observable without a sync)
-        self.op_stats["device_step_s"] = time.perf_counter() - t0
-        if outs is not None:
-            self._decode_outputs(outs)
-        self.post_q.tick()
+        dt = time.perf_counter() - t0
+        self.op_stats["device_step_s"] = dt
+        tl.set_tick_args(device_step_ms=round(dt * 1e3, 3),
+                         tick=self.tick_count)
+        with tl.span("decode_fanout"):
+            if outs is not None:
+                self._decode_outputs(outs)
+            self.post_q.tick()
         self.tick_count += 1
         opmon.monitor.record("world.tick", time.perf_counter() - t_start)
 
@@ -1870,6 +1903,10 @@ class World:
         self.op_stats["aoi_over_k_rows"] = over_k
         self.op_stats["aoi_cell_max"] = cell_max
         self.op_stats["aoi_over_cap_cells"] = over_cap
+        self._m_aoi_demand.set(dem_max)
+        self._m_aoi_cell.set(cell_max)
+        if over_k or over_cap:
+            self._m_aoi_overflow.inc(over_k + over_cap)
         if (over_k or over_cap) and \
                 self.tick_count - self._aoi_alarm_tick >= 64:
             self._aoi_alarm_tick = self.tick_count

@@ -78,7 +78,15 @@ def _read_cstring(buf: memoryview, at: int) -> tuple[str, int]:
 
 
 def _decode_doc(buf: memoryview, at: int) -> tuple[dict, int]:
+    # wire int32 lengths are attacker-controlled (minimongo feeds raw
+    # socket bytes here): validate every one BEFORE advancing, or a
+    # negative length walks the cursor backwards and loops the handler
+    # thread forever (ADVICE.md)
+    if len(buf) - at < 5:
+        raise ValueError("BSON document truncated")
     (total,) = _I32.unpack_from(buf, at)
+    if total < 5 or total > len(buf) - at:
+        raise ValueError(f"BSON document length {total} out of range")
     end = at + total
     if buf[end - 1] != 0:
         raise ValueError("BSON document missing terminator")
@@ -94,6 +102,8 @@ def _decode_doc(buf: memoryview, at: int) -> tuple[dict, int]:
         elif t == 0x02:
             (n,) = _I32.unpack_from(buf, p)
             p += 4
+            if n < 1 or p + n > end:
+                raise ValueError(f"BSON string length {n} out of range")
             doc[name] = bytes(buf[p:p + n - 1]).decode("utf-8")
             p += n
         elif t == 0x03:
@@ -104,6 +114,8 @@ def _decode_doc(buf: memoryview, at: int) -> tuple[dict, int]:
         elif t == 0x05:
             (n,) = _I32.unpack_from(buf, p)
             p += 5  # length + subtype byte
+            if n < 0 or p + n > end:
+                raise ValueError(f"BSON binary length {n} out of range")
             doc[name] = bytes(buf[p:p + n])
             p += n
         elif t == 0x08:

@@ -24,6 +24,10 @@ from goworld_tpu.ext.db import bson
 
 _HDR = struct.Struct("<iiii")
 OP_MSG = 2013
+# real mongod caps messages at 48 MB (maxMessageSizeBytes); anything
+# outside [16, cap] means the framing cannot be trusted — drop the
+# connection instead of letting _recv_exact chew on garbage (ADVICE.md)
+MAX_MESSAGE_BYTES = 48 * 1024 * 1024
 
 
 def _match(doc: dict, q: dict) -> bool:
@@ -62,12 +66,17 @@ class _Handler(socketserver.BaseRequestHandler):
                 if hdr is None:
                     return
                 length, rid, _resp, opcode = _HDR.unpack(hdr)
+                if length < 16 or length > MAX_MESSAGE_BYTES:
+                    return  # untrustworthy framing: drop connection
                 body = self._recv_exact(length - 16)
                 if body is None:
                     return
-                if opcode != OP_MSG or body[4] != 0:
+                if opcode != OP_MSG or len(body) < 5 or body[4] != 0:
                     return  # unsupported legacy opcode: drop connection
-                cmd = bson.decode(body, 5)
+                try:
+                    cmd = bson.decode(body, 5)
+                except ValueError:
+                    return  # malformed BSON: drop connection
                 reply = self._dispatch(cmd)
                 rb = bson.encode(reply)
                 payload = struct.pack("<I", 0) + b"\x00" + rb
@@ -90,6 +99,10 @@ class _Handler(socketserver.BaseRequestHandler):
     # -- commands -------------------------------------------------------
     def _dispatch(self, cmd: dict) -> dict:
         srv: MiniMongo = self.server.owner  # type: ignore[attr-defined]
+        if not cmd:
+            # next(iter({})) would raise StopIteration and kill the
+            # handler thread; answer like mongod answers nonsense
+            return {"ok": 0.0, "errmsg": "empty command", "code": 59}
         name = next(iter(cmd))
         db = cmd.get("$db", "goworld")
         with srv.lock:

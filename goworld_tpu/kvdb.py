@@ -15,11 +15,12 @@ from __future__ import annotations
 import bisect
 import os
 import threading
+import time
 from typing import Callable
 
 import msgpack
 
-from goworld_tpu.utils import log
+from goworld_tpu.utils import log, metrics, opmon
 from goworld_tpu.utils.asyncwork import AsyncWorkers
 
 logger = log.get("kvdb")
@@ -284,9 +285,13 @@ class RedisClusterKVDB(KVDBBackend):
         # SAME-SLOT only (real cluster redis rejects cross-slot MGET
         # with -CROSSSLOT) — group each node's matches by slot and
         # fetch per group through the redirect-capable path, so a
-        # group mid-migration follows its MOVED/ASK
+        # group mid-migration follows its MOVED/ASK. Merge through a
+        # dict keyed by k: during a live slot migration the source and
+        # target node can BOTH report the same key, and the reader must
+        # not see it twice (ADVICE.md)
         pre = RedisKVDB.PREFIX
         lo_b, hi_b = begin.encode(), end.encode()
+        merged: dict[str, str] = {}
         for addr in sorted(set(self._slot_map)):
             node = self._client_for(addr)
             keys = [k[len(pre):] for k in node.scan_keys(pre + "*")]
@@ -298,12 +303,11 @@ class RedisClusterKVDB(KVDBBackend):
             for ks in groups.values():
                 fks = [pre.encode() + k for k in ks]
                 vals = self._command(fks[0], ks[0], b"MGET", *fks)
-                out.extend(
+                merged.update(
                     (k.decode(), v.decode())
                     for k, v in zip(ks, vals) if v is not None
                 )
-        out.sort()
-        return out
+        return sorted(merged.items())
 
     def close(self):
         for c in self._clients.values():
@@ -370,20 +374,47 @@ def next_larger_key(key: str) -> str:
 
 class KVDB:
     """Async facade (``world.kvdb = KVDB(backend, workers)``); callbacks
-    run on the logic thread via the worlds's post queue."""
+    run on the logic thread via the worlds's post queue. Every op runs
+    through a timing shim that feeds both the metrics registry
+    (``kvdb_op_ms{op=...}`` histogram on ``/metrics``) and the existing
+    :data:`opmon.monitor` table (``kvdb.<op>`` rows on ``/ops``)."""
 
     def __init__(self, backend: KVDBBackend, workers: AsyncWorkers):
         self.backend = backend
         self.workers = workers
+        self._hists = {
+            op: metrics.histogram("kvdb_op_ms", op=op,
+                                  help="kvdb backend op latency")
+            for op in ("get", "put", "get_or_put", "get_range")
+        }
+
+    def _timed(self, op: str, fn: Callable):
+        hist = self._hists[op]
+
+        def job():
+            t0 = time.perf_counter()
+            try:
+                return fn()
+            finally:
+                dt = time.perf_counter() - t0
+                hist.observe(dt * 1e3)
+                opmon.monitor.record(f"kvdb.{op}", dt)
+
+        return job
 
     def get(self, key: str,
             cb: Callable[[str | None, Exception | None], None]) -> None:
-        self.workers.submit(_GROUP, lambda: self.backend.get(key), cb)
+        self.workers.submit(
+            _GROUP, self._timed("get", lambda: self.backend.get(key)), cb
+        )
 
     def put(self, key: str, val: str,
             cb: Callable[[None, Exception | None], None] | None = None,
             ) -> None:
-        self.workers.submit(_GROUP, lambda: self.backend.put(key, val), cb)
+        self.workers.submit(
+            _GROUP,
+            self._timed("put", lambda: self.backend.put(key, val)), cb,
+        )
 
     def get_or_put(self, key: str, val: str,
                    cb: Callable[[str | None, Exception | None], None],
@@ -398,10 +429,12 @@ class KVDB:
                 self.backend.put(key, val)
             return old
 
-        self.workers.submit(_GROUP, job, cb)
+        self.workers.submit(_GROUP, self._timed("get_or_put", job), cb)
 
     def get_range(self, begin: str, end: str,
                   cb: Callable[[list, Exception | None], None]) -> None:
         self.workers.submit(
-            _GROUP, lambda: self.backend.get_range(begin, end), cb
+            _GROUP,
+            self._timed("get_range",
+                        lambda: self.backend.get_range(begin, end)), cb,
         )

@@ -25,7 +25,7 @@ import numpy as np
 
 from goworld_tpu.net import proto
 from goworld_tpu.net.packet import Packet, PacketConnection, new_packet
-from goworld_tpu.utils import consts, ids, log
+from goworld_tpu.utils import consts, ids, log, metrics
 
 logger = log.get("dispatcher")
 
@@ -141,6 +141,10 @@ class DispatcherService:
         self._blocked_until: dict[bytes, float] = {}
         self.open_conns: set[PacketConnection] = set()
         self.started = asyncio.Event()
+        # per-msgtype route counters (debug_http /metrics): children of
+        # one ``dispatcher_route_total`` family, cached by msgtype so
+        # the hot path is one dict hit + one locked increment
+        self._route_counters: dict[int, metrics.Counter] = {}
 
     # ------------------------------------------------------------------
     async def serve(self) -> None:
@@ -209,6 +213,14 @@ class DispatcherService:
 
     # ------------------------------------------------------------------
     def _handle_packet(self, conn, role, msgtype: int, pkt: Packet):
+        c = self._route_counters.get(msgtype)
+        if c is None:
+            c = self._route_counters[msgtype] = metrics.counter(
+                "dispatcher_route_total",
+                help="packets routed, by wire msgtype",
+                msgtype=str(msgtype),
+            )
+        c.inc()
         if msgtype == proto.MT_SET_GAME_ID:
             return self._handle_set_game_id(conn, pkt)
         if msgtype == proto.MT_SET_GATE_ID:

@@ -31,9 +31,14 @@ from goworld_tpu.entity.manager import World
 from goworld_tpu.net import codec, proto
 from goworld_tpu.net.cluster import DispatcherCluster, DispatcherConn
 from goworld_tpu.net.packet import Packet, new_packet
-from goworld_tpu.utils import consts, log, opmon
+from goworld_tpu.utils import consts, log, metrics, opmon
 
 logger = log.get("game")
+
+# module-level like the opmon.expose twins (one game per process; tests
+# drive _mh_drain_pending on stubs that bypass __init__)
+_m_mh_backlog_pkts = metrics.gauge("mh_mutation_backlog_packets")
+_m_mh_backlog_bytes = metrics.gauge("mh_mutation_backlog_bytes")
 
 # Dispatcher packets that MUTATE the World. Under a multi-controller
 # (multihost) World these land on ONE controller's dispatcher connection
@@ -147,6 +152,23 @@ class GameServer:
         self._mh_freeze_requested = False  # leader sets; exchange spreads
         self._mh_ckpt_due = False          # leader's wall-clock verdict
 
+        # scrapeable serve-loop series (debug_http /metrics): tick
+        # latency distribution, fell-behind backlog, queue depths and
+        # drop counters — every silent saturation signal gets a name
+        self._m_tick_hist = metrics.histogram(
+            "tick_latency_ms", help="serve-loop tick wall time")
+        self._m_backlog = metrics.gauge(
+            "backlog_ticks",
+            help="ticks the serve loop is behind its cadence")
+        self._m_queue_depth = metrics.gauge(
+            "input_queue_depth", help="pending dispatcher packets")
+        self._m_pkt_drop = metrics.counter(
+            "packet_queue_drop_total",
+            help="dispatcher packets dropped on a full input queue")
+        self._m_event_records = metrics.counter(
+            "client_event_records_total",
+            help="client event records flushed downstream")
+
         # wire the world's pluggable edges to the cluster
         w = world
         w.client_sink = self._client_sink
@@ -202,14 +224,24 @@ class GameServer:
                 self.game_id, _gc.get_freeze_count(),
             )
         next_tick = time.monotonic()
+        tl = metrics.timeline
         while not self._stop.is_set():
-            self.pump()
+            # the serve loop owns the tick record: the pump and fan-out
+            # spans land in the same trace row as the World's phases
+            tl.begin_tick()
+            self._m_queue_depth.set(self._packet_q.qsize())
+            with tl.span("drain_inputs"):
+                self.pump()
             self.tick()
+            dur = tl.end_tick()
+            if dur is not None:
+                self._m_tick_hist.observe(dur * 1e3)
             if self.run_state == "freezing":
                 self._do_freeze()
                 return
             next_tick += self.tick_interval
             delay = next_tick - time.monotonic()
+            self._m_backlog.set(max(0.0, -delay / self.tick_interval))
             if delay > 0:
                 time.sleep(delay)
             else:
@@ -300,13 +332,16 @@ class GameServer:
             n += 1
 
     def tick(self) -> None:
+        tl = metrics.timeline
         if self.world._multihost:
             # the exchange also publishes world.mh_group_ready, which
             # gates the World's own tick-cadence service reconcile
-            self._mh_exchange_mutations()
+            with tl.span("mh_exchange"):
+                self._mh_exchange_mutations()
         self.world.tick()
-        self._flush_sync_out()
-        self._maybe_checkpoint()
+        with tl.span("fan_out"):
+            self._flush_sync_out()
+            self._maybe_checkpoint()
 
     def _maybe_checkpoint(self) -> None:
         """Periodic crash-recovery snapshot (``checkpoint_interval`` ini
@@ -385,6 +420,8 @@ class GameServer:
         backlog_b = sum(6 + len(p) for _, p in self._mh_pending)
         opmon.expose("mh_mutation_backlog_packets", len(self._mh_pending))
         opmon.expose("mh_mutation_backlog_bytes", backlog_b)
+        _m_mh_backlog_pkts.set(len(self._mh_pending))
+        _m_mh_backlog_bytes.set(backlog_b)
         self.world.op_stats["mh_mutation_backlog_bytes"] = backlog_b
         if self._mh_pending:
             self._mh_backlog_ticks += 1
@@ -506,6 +543,7 @@ class GameServer:
         try:
             self._packet_q.put_nowait((didx, msgtype, pkt))
         except queue.Full:
+            self._m_pkt_drop.inc()
             logger.error("game%d: packet queue full; dropping %d",
                          self.game_id, msgtype)
 
@@ -623,6 +661,8 @@ class GameServer:
         # unconditionally so idle ticks read 0, like the mh_* gauges
         opmon.expose("client_event_batch_records",
                      self._event_recs_flushed)
+        if self._event_recs_flushed:
+            self._m_event_records.inc(self._event_recs_flushed)
         self._event_recs_flushed = 0
         for gate_id, chunks in self._sync_out.items():
             # per-chunk ARRAYS concatenated once — never element-wise

@@ -15,6 +15,7 @@ before forwarding the rest to the client socket verbatim.
 from __future__ import annotations
 
 import asyncio
+import time
 from collections import defaultdict
 
 import numpy as np
@@ -28,7 +29,7 @@ from goworld_tpu.net.packet import (
     frame,
     new_packet,
 )
-from goworld_tpu.utils import ids, log, opmon
+from goworld_tpu.utils import ids, log, metrics, opmon
 
 logger = log.get("gate")
 
@@ -153,6 +154,17 @@ class GateService:
         self._kcp_server = None
         self.started = asyncio.Event()
         self.ws_started = asyncio.Event()
+        # scrapeable gate series (debug_http /metrics): client packet
+        # handle latency and downstream batch sizes (the reference wraps
+        # handling in opmon, GateService.go:435-442 — same signal, now
+        # as a histogram a scraper can take percentiles from)
+        self._m_handle_ms = metrics.histogram(
+            "gate_packet_handle_ms",
+            help="client packet handle latency")
+        self._m_down_batch = metrics.histogram(
+            "gate_downstream_batch_records",
+            buckets=metrics.DEFAULT_SIZE_BUCKETS,
+            help="records per downstream batch from games")
 
     # ------------------------------------------------------------------
     async def _handshake(self, conn: DispatcherConn) -> None:
@@ -245,8 +257,11 @@ class GateService:
                 msgtype, pkt = await conn.recv()
                 # reference wraps gate packet handling in opmon
                 # (GateService.go:435-442)
+                t0 = time.perf_counter()
                 with opmon.monitor.op("gate.handleClientPacket"):
                     self._handle_client_packet(cp, msgtype, pkt)
+                self._m_handle_ms.observe(
+                    (time.perf_counter() - t0) * 1e3)
         except (asyncio.IncompleteReadError, ConnectionError, OSError):
             pass
         finally:
@@ -306,6 +321,7 @@ class GateService:
             # (same bytes on the client wire, in the same order)
             pkt.read_u16()  # gate_id (ours)
             n = pkt.read_u32()
+            self._m_down_batch.observe(n)
             for _ in range(n):
                 mt = pkt.read_u16()
                 ln = pkt.read_u32()
@@ -376,6 +392,7 @@ class GateService:
         buf = memoryview(pkt.buf)[pkt.rpos:]
         cids, eids, vals = codec.decode_client_sync_batch(buf)
         n = len(cids)
+        self._m_down_batch.observe(n)
         if n == 0:
             return
         keys = np.ascontiguousarray(cids).view("V16").ravel()

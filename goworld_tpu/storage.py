@@ -25,7 +25,7 @@ from typing import Any, Callable
 
 import msgpack
 
-from goworld_tpu.utils import log, opmon
+from goworld_tpu.utils import log, metrics, opmon
 
 logger = log.get("storage")
 
@@ -203,6 +203,15 @@ class Storage:
         self._cv = threading.Condition()
         self._closed = False
         self.op_count = 0
+        # /metrics shim beside the opmon rows: latency histogram per op
+        # kind + a queue-depth gauge a scraper can alarm on
+        self._hists = {
+            op: metrics.histogram("storage_op_ms", op=op,
+                                  help="storage backend op latency")
+            for op in ("save", "load", "exists", "list")
+        }
+        self._m_queue = metrics.gauge(
+            "storage_queue_depth", help="pending storage ops")
         self._thread = threading.Thread(
             target=self._run, name="storage", daemon=True
         )
@@ -251,6 +260,7 @@ class Storage:
                 logger.error("storage closed; dropping %s", op[0])
                 return
             self._q.append(op)
+            self._m_queue.set(len(self._q))
             if len(self._q) > WARN_QUEUE_LEN:
                 logger.warning("storage queue backlog: %d", len(self._q))
             self._cv.notify()
@@ -295,7 +305,10 @@ class Storage:
                 res = None
                 break
         self.op_count += 1
-        opmon.monitor.record(f"storage.{kind}", time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        opmon.monitor.record(f"storage.{kind}", dt)
+        self._hists[kind].observe(dt * 1e3)
+        self._m_queue.set(self.queue_len())
         if cb is not None:
             if kind == "save":
                 self._post(cb)

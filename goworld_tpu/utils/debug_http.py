@@ -6,6 +6,10 @@ TPU-native analog exposes:
 
 * ``/vars``   — gwvar-style exposed variables (:mod:`opmon` ``expose``)
 * ``/ops``    — opmon op stats (count / avg / max per named op)
+* ``/metrics``— Prometheus text exposition of the :mod:`metrics` registry
+  (the expvar/opmon role, scrapeable: counters, gauges, histograms)
+* ``/trace``  — Chrome ``chrome://tracing`` / Perfetto JSON of the
+  per-tick phase timeline ring buffer (:data:`metrics.timeline`)
 * ``/healthz``— liveness probe
 * ``/profile``— a jax.profiler trace capture hint (profiling is driven by
   ``jax.profiler.start_server`` when available; see ``start``'s docstring)
@@ -19,22 +23,27 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from goworld_tpu.utils import log, opmon
+from goworld_tpu.utils import log, metrics, opmon
 
 logger = log.get("debug_http")
+
+_ENDPOINTS = ["/healthz", "/vars", "/ops", "/metrics", "/trace"]
 
 
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):  # keep request noise out of server logs
         pass
 
-    def _json(self, obj, code: int = 200) -> None:
-        body = json.dumps(obj, indent=2, default=str).encode()
+    def _body(self, body: bytes, ctype: str, code: int = 200) -> None:
         self.send_response(code)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _json(self, obj, code: int = 200) -> None:
+        self._body(json.dumps(obj, indent=2, default=str).encode(),
+                   "application/json", code)
 
     def do_GET(self):  # noqa: N802 (stdlib api)
         if self.path == "/healthz":
@@ -43,19 +52,32 @@ class _Handler(BaseHTTPRequestHandler):
             self._json(opmon.vars())
         elif self.path == "/ops":
             self._json(opmon.monitor.snapshot())
+        elif self.path == "/metrics":
+            self._body(metrics.REGISTRY.expose_text().encode(),
+                       "text/plain; version=0.0.4; charset=utf-8")
+        elif self.path == "/trace":
+            self._body(
+                metrics.timeline.chrome_trace_json(
+                    getattr(self.server, "process_name", "goworld_tpu")
+                ).encode(),
+                "application/json",
+            )
         else:
             self._json({"error": "not found",
-                        "endpoints": ["/healthz", "/vars", "/ops"]}, 404)
+                        "endpoints": _ENDPOINTS}, 404)
 
 
-def start(port: int, host: str = "127.0.0.1") -> ThreadingHTTPServer:
+def start(port: int, host: str = "127.0.0.1",
+          process_name: str = "goworld_tpu") -> ThreadingHTTPServer:
     """Serve debug endpoints on a daemon thread; returns the server (its
     bound port is ``server.server_address[1]`` when ``port=0``).
+    ``process_name`` labels the ``/trace`` export (e.g. ``game1``).
 
     For on-device profiling, pair with ``jax.profiler.start_server(
     profiler_port)`` and capture traces via TensorBoard — the reference's
     pprof role (``binutil.go:26-47``)."""
     srv = ThreadingHTTPServer((host, port), _Handler)
+    srv.process_name = process_name  # type: ignore[attr-defined]
     t = threading.Thread(target=srv.serve_forever,
                          name=f"debug-http-{port}", daemon=True)
     t.start()

@@ -1,0 +1,155 @@
+"""Regression tests for the wire-hardening fixes (ADVICE.md, ISSUE 1
+satellites): BSON int32 length validation, minimongo message-size caps
+and empty-command guard, kvdb cluster-mode get_range dedup."""
+
+import socket
+import struct
+
+import pytest
+
+from goworld_tpu.ext.db import bson
+from goworld_tpu.ext.db.minimongo import OP_MSG, MiniMongo
+
+
+# =======================================================================
+# bson: unvalidated int32 lengths
+# =======================================================================
+def _raw_doc(body: bytes) -> bytes:
+    return struct.pack("<i", len(body) + 5) + body + b"\x00"
+
+
+def test_bson_roundtrip_still_works():
+    doc = {"a": 1, "s": "x", "b": b"\x00\x01", "n": None, "f": 1.5,
+           "l": [1, 2], "d": {"k": "v"}, "t": True, "big": 1 << 40}
+    assert bson.decode(bson.encode(doc)) == doc
+
+
+def test_bson_negative_string_length_raises():
+    # pre-fix, n = -1 walked the cursor BACKWARDS and the minimongo
+    # handler thread looped forever on the same element
+    body = b"\x02a\x00" + struct.pack("<i", -1) + b"\x00"
+    with pytest.raises(ValueError):
+        bson.decode(_raw_doc(body))
+
+
+def test_bson_oversized_string_length_raises():
+    body = b"\x02a\x00" + struct.pack("<i", 1 << 20) + b"x\x00"
+    with pytest.raises(ValueError):
+        bson.decode(_raw_doc(body))
+
+
+def test_bson_negative_binary_length_raises():
+    body = b"\x05a\x00" + struct.pack("<i", -5) + b"\x00"
+    with pytest.raises(ValueError):
+        bson.decode(_raw_doc(body))
+
+
+def test_bson_document_length_out_of_range():
+    with pytest.raises(ValueError):
+        bson.decode(struct.pack("<i", 4) + b"\x00")        # total < 5
+    with pytest.raises(ValueError):
+        bson.decode(struct.pack("<i", 64) + b"\x00" * 16)  # total > buf
+    with pytest.raises(ValueError):
+        bson.decode(b"\x01\x02")                           # truncated
+
+
+# =======================================================================
+# minimongo: wire message caps + empty command
+# =======================================================================
+_HDR = struct.Struct("<iiii")
+
+
+def _op_msg(cmd: dict, rid: int = 1) -> bytes:
+    body = struct.pack("<I", 0) + b"\x00" + bson.encode(cmd)
+    return _HDR.pack(16 + len(body), rid, 0, OP_MSG) + body
+
+
+def _recv_exact(s: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = s.recv(n)
+        if not b:
+            return b"".join(chunks)
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _roundtrip(s: socket.socket, msg: bytes) -> dict:
+    s.sendall(msg)
+    hdr = _recv_exact(s, 16)
+    length = _HDR.unpack(hdr)[0]
+    body = _recv_exact(s, length - 16)
+    return bson.decode(body, 5)  # skip flags u32 + section kind byte
+
+
+def test_minimongo_rejects_undersized_length():
+    with MiniMongo() as srv:
+        s = socket.create_connection((srv.host, srv.port), timeout=5)
+        s.sendall(_HDR.pack(8, 1, 0, OP_MSG))  # length < 16
+        assert s.recv(1) == b""  # connection dropped
+        s.close()
+
+
+def test_minimongo_rejects_oversized_length():
+    with MiniMongo() as srv:
+        s = socket.create_connection((srv.host, srv.port), timeout=5)
+        s.sendall(_HDR.pack(49 * 1024 * 1024, 1, 0, OP_MSG))
+        assert s.recv(1) == b""
+        s.close()
+
+
+def test_minimongo_empty_command_answers_and_survives():
+    with MiniMongo() as srv:
+        s = socket.create_connection((srv.host, srv.port), timeout=5)
+        reply = _roundtrip(s, _op_msg({}))
+        assert reply["ok"] == 0.0
+        assert reply["code"] == 59
+        # the handler thread is still alive: the same connection serves
+        reply = _roundtrip(s, _op_msg({"ping": 1, "$db": "goworld"}))
+        assert reply["ok"] == 1.0
+        s.close()
+
+
+def test_minimongo_malformed_bson_drops_connection():
+    with MiniMongo() as srv:
+        s = socket.create_connection((srv.host, srv.port), timeout=5)
+        # valid framing, negative string length inside the command doc
+        body = struct.pack("<I", 0) + b"\x00" + _raw_doc(
+            b"\x02a\x00" + struct.pack("<i", -1) + b"\x00"
+        )
+        s.sendall(_HDR.pack(16 + len(body), 1, 0, OP_MSG) + body)
+        assert s.recv(1) == b""
+        s.close()
+
+
+# =======================================================================
+# kvdb: cluster-mode get_range dedup across a live slot migration
+# =======================================================================
+def test_kvdb_cluster_get_range_dedupes_keys():
+    from goworld_tpu.ext.db import resp
+    from goworld_tpu.kvdb import RedisClusterKVDB
+
+    store = {b"kv:a": b"1", b"kv:b": b"2"}
+
+    class _FakeNode:
+        def __init__(self, keys):
+            self._keys = keys
+
+        def scan_keys(self, pattern):
+            return list(self._keys)
+
+        def command(self, *args):
+            assert args[0] == b"MGET"
+            return [store.get(k) for k in args[1:]]
+
+    kv = RedisClusterKVDB.__new__(RedisClusterKVDB)
+    kv._resp = resp
+    # mid-migration: BOTH nodes report key "a" from their SCAN sweep
+    kv._clients = {"n1": _FakeNode([b"kv:a", b"kv:b"]),
+                   "n2": _FakeNode([b"kv:a"])}
+    kv._seed_addrs = ["n1", "n2"]
+    kv._slot_map = ["n1" if s % 2 == 0 else "n2" for s in range(16384)]
+
+    out = kv.get_range("a", "z")
+    assert out == [("a", "1"), ("b", "2")]  # no duplicate "a"

@@ -1,0 +1,292 @@
+"""Unified telemetry tests: registry semantics, Prometheus exposition,
+tick-timeline ring buffer + Chrome trace export, and the debug-http
+``/metrics`` + ``/trace`` endpoints (ISSUE 1 tentpole)."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from goworld_tpu.utils import debug_http, metrics
+
+
+# =======================================================================
+# counters / gauges / histograms
+# =======================================================================
+def test_counter_semantics():
+    r = metrics.Registry()
+    c = r.counter("reqs_total")
+    c.inc()
+    c.inc(2)
+    assert c.value == 3
+    # same name + labels returns the same child
+    assert r.counter("reqs_total") is c
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    # a name registers one kind only
+    with pytest.raises(ValueError):
+        r.gauge("reqs_total")
+
+
+def test_gauge_semantics():
+    r = metrics.Registry()
+    g = r.gauge("depth")
+    g.set(7)
+    assert g.value == 7
+    g.inc()
+    g.dec(3)
+    assert g.value == 5
+
+
+def test_histogram_buckets_and_exposition():
+    r = metrics.Registry()
+    h = r.histogram("lat_ms", buckets=(1, 10, 100))
+    for v in (0.5, 5.0, 50.0, 5000.0, 10.0):  # 10.0 lands in le="10"
+        h.observe(v)
+    assert h.count == 5
+    assert h.sum == pytest.approx(5065.5)
+    text = r.expose_text()
+    assert "# TYPE lat_ms histogram" in text
+    assert 'lat_ms_bucket{le="1"} 1' in text
+    assert 'lat_ms_bucket{le="10"} 3' in text  # cumulative, le inclusive
+    assert 'lat_ms_bucket{le="100"} 4' in text
+    assert 'lat_ms_bucket{le="+Inf"} 5' in text
+    assert "lat_ms_count 5" in text
+
+
+def test_labels_render_as_name_suffix():
+    r = metrics.Registry()
+    r.counter("route_total", msgtype="12").inc()
+    r.counter("route_total", msgtype="30").inc(4)
+    text = r.expose_text()
+    assert 'route_total{msgtype="12"} 1' in text
+    assert 'route_total{msgtype="30"} 4' in text
+    # one TYPE line per family, not per child
+    assert text.count("# TYPE route_total counter") == 1
+
+
+def test_exposition_parses_back():
+    r = metrics.Registry()
+    r.counter("a_total").inc(2)
+    r.gauge("b", role="gate").set(1.5)
+    parsed = metrics.parse_prometheus_text(r.expose_text())
+    assert parsed["a_total"] == 2
+    assert parsed['b{role="gate"}'] == 1.5
+
+
+# =======================================================================
+# tick timeline
+# =======================================================================
+def test_timeline_ring_buffer_bounds():
+    tl = metrics.TickTimeline(capacity=8)
+    for _ in range(20):
+        tl.begin_tick()
+        with tl.span("a"):
+            pass
+        tl.end_tick()
+    assert len(tl.records()) == 8
+
+
+def test_timeline_span_is_noop_without_open_tick():
+    tl = metrics.TickTimeline()
+    with tl.span("orphan"):
+        pass
+    assert tl.records() == []
+    assert tl.end_tick() is None
+
+
+def test_timeline_chrome_trace_and_coverage():
+    tl = metrics.TickTimeline(capacity=4)
+    tl.begin_tick()
+    with tl.span("phase1"):
+        time.sleep(0.005)
+    with tl.span("phase2", rows=3):
+        time.sleep(0.005)
+    tl.set_tick_args(device_step_ms=1.25)
+    dur = tl.end_tick()
+    assert dur is not None and dur >= 0.01
+    # contiguous spans cover (nearly) the whole tick — the /trace
+    # acceptance bar is >= 95% of tick wall time
+    assert tl.coverage() >= 0.95
+    trace = tl.chrome_trace("game1")
+    json.dumps(trace)  # must be valid JSON
+    events = trace["traceEvents"]
+    by_name = {e["name"]: e for e in events}
+    assert by_name["tick"]["args"]["device_step_ms"] == 1.25
+    assert by_name["phase2"]["args"] == {"rows": 3}
+    tick_ev, p1 = by_name["tick"], by_name["phase1"]
+    assert tick_ev["ph"] == "X" and p1["ph"] == "X"
+    # spans nest inside their tick on the same track
+    assert tick_ev["ts"] <= p1["ts"]
+    assert p1["ts"] + p1["dur"] <= tick_ev["ts"] + tick_ev["dur"] + 1.0
+
+
+def test_timeline_overhead_under_one_percent_of_frame():
+    """The recorder is always on: a full game tick (begin + 6 spans +
+    end) must cost well under 1% of the 16 ms roofline frame."""
+    tl = metrics.TickTimeline(capacity=16)
+    n = 2000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        tl.begin_tick()
+        for name in ("a", "b", "c", "d", "e", "f"):
+            with tl.span(name):
+                pass
+        tl.end_tick()
+    per_tick = (time.perf_counter() - t0) / n
+    assert per_tick < 160e-6, f"{per_tick * 1e6:.1f}us per tick"
+
+
+# =======================================================================
+# World.tick integration (the live phases the bench only had offline)
+# =======================================================================
+def test_world_tick_records_phases_and_aoi_series():
+    from goworld_tpu.core import WorldConfig
+    from goworld_tpu.entity import World
+    from goworld_tpu.ops.aoi import GridSpec
+
+    w = World(WorldConfig(capacity=32, grid=GridSpec(
+        radius=10.0, extent_x=40.0, extent_z=40.0)), n_spaces=1)
+    w.create_nil_space()
+    metrics.timeline.clear()
+    w.tick()
+    w.tick()
+    recs = metrics.timeline.records()
+    assert len(recs) == 2
+    names = [s[0] for s in recs[-1][2]]
+    assert names == ["flush_staging", "device_step", "fetch_outputs",
+                     "decode_fanout"]
+    assert "device_step_ms" in recs[-1][3]
+    assert metrics.timeline.coverage() >= 0.95
+    # AOI saturation series exist (0 on a healthy world) and are scrapeable
+    text = metrics.REGISTRY.expose_text()
+    assert "aoi_overflow_total" in text
+    assert "aoi_demand_max" in text
+
+
+# =======================================================================
+# live game acceptance: serve loop + /metrics + /trace end to end
+# =======================================================================
+def test_running_game_exposes_tick_series_and_trace():
+    """ISSUE 1 acceptance: curl /metrics on a RUNNING game returns the
+    tick_latency_ms histogram buckets, aoi_overflow_total and
+    backlog_ticks; /trace returns Chrome JSON whose spans cover >= 95%
+    of a tick's wall time."""
+    import threading
+
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.entity.manager import World
+    from goworld_tpu.net.game import GameServer
+    from goworld_tpu.net.standalone import ClusterHarness
+    from goworld_tpu.ops.aoi import GridSpec
+
+    harness = ClusterHarness(n_dispatchers=1, n_gates=1,
+                             desired_games=1)
+    harness.start()
+    world = World(
+        WorldConfig(capacity=64, grid=GridSpec(
+            radius=10.0, extent_x=40.0, extent_z=40.0)),
+        n_spaces=1,
+    )
+    world.create_nil_space()
+    gs = GameServer(1, world, list(harness.dispatcher_addrs),
+                    tick_interval=0.02, gc_freeze_on_boot=False)
+    gs.start_network()
+    metrics.timeline.clear()
+    t = threading.Thread(target=gs.serve_forever, daemon=True)
+    t.start()
+    srv = debug_http.start(0, process_name="game1")
+    try:
+        deadline = time.monotonic() + 10
+        while gs._m_tick_hist.count < 5 \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert gs._m_tick_hist.count >= 5, "serve loop never ticked"
+
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as resp:
+            body = resp.read().decode()
+        assert 'tick_latency_ms_bucket{le="+Inf"}' in body
+        assert "tick_latency_ms_count" in body
+        assert "aoi_overflow_total" in body
+        assert "backlog_ticks" in body
+        assert "input_queue_depth" in body
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/trace") as resp:
+            trace = json.loads(resp.read().decode())
+        span_names = {e["name"] for e in trace["traceEvents"]}
+        assert {"tick", "drain_inputs", "device_step",
+                "fan_out"} <= span_names
+        # per-tick span coverage of the live loop
+        assert metrics.timeline.coverage() >= 0.95
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        gs.stop()
+        t.join(timeout=5)
+        harness.stop()
+
+
+def test_config_rejects_game_http_rank_collision(tmp_path):
+    """A multihost game binds http_port..+mesh_processes-1; a sibling
+    landing inside that span would get silently mis-attributed by the
+    scraper — the config loader must reject it."""
+    from goworld_tpu import config as config_mod
+
+    ini = tmp_path / "goworld_tpu.ini"
+    ini.write_text(
+        "[dispatcher1]\nport = 14000\n"
+        "[game1]\nhttp_port = 16000\nmesh_processes = 2\n"
+        "[game2]\nhttp_port = 16001\n"
+        "[gate1]\nport = 15000\n"
+    )
+    with pytest.raises(ValueError, match="http_port"):
+        config_mod.load(str(ini))
+
+
+# =======================================================================
+# /metrics + /trace endpoints
+# =======================================================================
+def test_debug_http_metrics_and_trace():
+    metrics.counter("endpoint_probe_total").inc(3)
+    tl = metrics.timeline
+    tl.begin_tick()
+    with tl.span("probe_phase"):
+        pass
+    tl.end_tick()
+
+    srv = debug_http.start(0, process_name="game-test")
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics") as resp:
+            ctype = resp.headers.get("Content-Type", "")
+            body = resp.read().decode()
+        assert ctype.startswith("text/plain")
+        assert "endpoint_probe_total 3" in body
+        assert metrics.parse_prometheus_text(body)[
+            "endpoint_probe_total"] == 3
+
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/trace") as resp:
+            trace = json.loads(resp.read().decode())
+        names = [e["name"] for e in trace["traceEvents"]]
+        assert "probe_phase" in names
+        meta = [e for e in trace["traceEvents"]
+                if e["name"] == "process_name"]
+        assert meta and meta[0]["args"]["name"] == "game-test"
+
+        # discovery: 404 advertises the new endpoints
+        req = urllib.request.Request(f"http://127.0.0.1:{port}/nope")
+        try:
+            urllib.request.urlopen(req)
+        except urllib.error.HTTPError as e:
+            listing = json.loads(e.read().decode())["endpoints"]
+            assert "/metrics" in listing and "/trace" in listing
+    finally:
+        srv.shutdown()
+        srv.server_close()

@@ -1,0 +1,164 @@
+#!/usr/bin/env python
+"""One-shot /metrics scraper across a whole cluster.
+
+Hits every process's debug-http ``/metrics`` endpoint (dispatchers,
+games, gates — ports from the server dir's ini ``http_port`` keys) and
+prints one merged table: rows are metric series, one value column per
+process. Used by ``goworld_tpu.cli status`` and usable directly in CI
+smoke runs::
+
+    python tools/scrape_metrics.py <server_dir>          # whole cluster
+    python tools/scrape_metrics.py --url http://127.0.0.1:16000/metrics
+    python tools/scrape_metrics.py <server_dir> --buckets  # + histogram
+                                                           # bucket rows
+
+Exit status: 0 if every target answered, 1 otherwise (a process with a
+configured http_port that cannot be scraped is a finding, not noise).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import urllib.error
+import urllib.request
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+from goworld_tpu import config as config_mod  # noqa: E402
+from goworld_tpu.utils.metrics import parse_prometheus_text  # noqa: E402
+
+
+def targets_from_config(cfg, host_fallback: str = "127.0.0.1",
+                        ) -> list[tuple[str, str]]:
+    """(label, /metrics url) for every process with an http_port.
+    Multihost games expose one endpoint per rank (http_port + rank)."""
+    targets: list[tuple[str, str]] = []
+    for did, dc in sorted(cfg.dispatchers.items()):
+        if dc.http_port:
+            targets.append((
+                f"dispatcher{did}",
+                f"http://{dc.host}:{dc.http_port}/metrics",
+            ))
+    for gid, gc in sorted(cfg.games.items()):
+        if not gc.http_port:
+            continue
+        procs = max(1, getattr(gc, "mesh_processes", 1))
+        for rank in range(procs):
+            label = f"game{gid}" if procs == 1 else f"game{gid}c{rank}"
+            targets.append((
+                label,
+                f"http://{host_fallback}:{gc.http_port + rank}/metrics",
+            ))
+    for gid, gc in sorted(cfg.gates.items()):
+        if gc.http_port:
+            targets.append((
+                f"gate{gid}",
+                f"http://{gc.host}:{gc.http_port}/metrics",
+            ))
+    return targets
+
+
+def scrape(url: str, timeout: float = 2.0) -> dict[str, float]:
+    """Fetch one /metrics endpoint into {series: value}; raises on
+    network errors (callers decide whether that is fatal)."""
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return parse_prometheus_text(
+            resp.read().decode("utf-8", "replace")
+        )
+
+
+def scrape_all(targets: list[tuple[str, str]], timeout: float = 2.0,
+               ) -> tuple[dict[str, dict[str, float]], list[str]]:
+    """Scrape every target; returns ({label: series map}, [errors])."""
+    results: dict[str, dict[str, float]] = {}
+    errors: list[str] = []
+    for label, url in targets:
+        try:
+            results[label] = scrape(url, timeout=timeout)
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            errors.append(f"{label}: {url} unreachable ({e})")
+    return results, errors
+
+
+def merged_table(results: dict[str, dict[str, float]],
+                 include_buckets: bool = False) -> str:
+    """One row per series, one column per process; histogram bucket
+    rows are dropped by default (they swamp the table — use /metrics
+    directly or --buckets when they matter)."""
+    if not results:
+        return "(no metrics scraped)"
+    labels = list(results)
+    series: set[str] = set()
+    for m in results.values():
+        series.update(m)
+    if not include_buckets:
+        series = {s for s in series if "_bucket{" not in s}
+    rows = sorted(series)
+    name_w = max([len(r) for r in rows] + [len("series")])
+    col_ws = [
+        max(len(lb), *(len(_cell(results[lb].get(r))) for r in rows))
+        if rows else len(lb)
+        for lb in labels
+    ]
+    lines = [
+        "  ".join(["series".ljust(name_w)]
+                  + [lb.rjust(w) for lb, w in zip(labels, col_ws)])
+    ]
+    for r in rows:
+        lines.append("  ".join(
+            [r.ljust(name_w)]
+            + [_cell(results[lb].get(r)).rjust(w)
+               for lb, w in zip(labels, col_ws)]
+        ))
+    return "\n".join(lines)
+
+
+def _cell(v: float | None) -> str:
+    if v is None:
+        return "-"
+    return str(int(v)) if float(v).is_integer() else f"{v:.3f}"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="scrape /metrics from every cluster process")
+    ap.add_argument("server_dir", nargs="?", default=None,
+                    help="server directory with the cluster ini")
+    ap.add_argument("--url", action="append", default=[],
+                    help="scrape this /metrics url directly (repeatable)")
+    ap.add_argument("--buckets", action="store_true",
+                    help="include histogram bucket rows")
+    ap.add_argument("--timeout", type=float, default=2.0)
+    args = ap.parse_args(argv)
+
+    targets: list[tuple[str, str]] = [
+        (u.split("//", 1)[-1].split("/", 1)[0], u) for u in args.url
+    ]
+    if args.server_dir:
+        for name in config_mod.DEFAULT_CONFIG_PATHS:
+            p = os.path.join(args.server_dir, name)
+            if os.path.exists(p):
+                targets += targets_from_config(config_mod.load(p))
+                break
+        else:
+            print(f"no cluster ini under {args.server_dir}",
+                  file=sys.stderr)
+            return 1
+    if not targets:
+        print("nothing to scrape: pass a server dir with http_port "
+              "configured, or --url", file=sys.stderr)
+        return 1
+
+    results, errors = scrape_all(targets, timeout=args.timeout)
+    print(merged_table(results, include_buckets=args.buckets))
+    for e in errors:
+        print(e, file=sys.stderr)
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
