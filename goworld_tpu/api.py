@@ -388,6 +388,12 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
         except OSError:
             logger.exception("game%d: debug http on port %d failed; "
                              "continuing without it", gid, gc.http_port)
+    if getattr(gc, "trace_sample_rate", 0.0) > 0:
+        # self-rooted traces (outbound migrations); inbound traced
+        # packets are recorded regardless of the local rate
+        from goworld_tpu.utils import tracing
+
+        tracing.set_sample_rate(gc.trace_sample_rate)
 
     # signal handling (reference game.go:137-196): TERM = clean stop,
     # HUP = freeze for hot reload

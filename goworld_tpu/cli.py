@@ -599,26 +599,29 @@ def cmd_build(server_dir: str | None = None) -> int:
     return 0
 
 
-def _load_scrape_tool():
-    """Load tools/scrape_metrics.py (the shared cluster scraper) when
-    the repo checkout ships it; a bare package install degrades to the
-    pidfile-only status."""
+def _load_tool(name: str):
+    """Load a script from the repo's ``tools/`` directory when the
+    checkout ships it; a bare package install degrades gracefully."""
     import importlib.util
 
     path = os.path.join(
         os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "tools", "scrape_metrics.py",
+        "tools", f"{name}.py",
     )
     if not os.path.exists(path):
         return None
-    spec = importlib.util.spec_from_file_location("gw_scrape_metrics",
-                                                  path)
+    spec = importlib.util.spec_from_file_location(f"gw_{name}", path)
     mod = importlib.util.module_from_spec(spec)
     try:
         spec.loader.exec_module(mod)  # type: ignore[union-attr]
     except Exception:
         return None
     return mod
+
+
+def _load_scrape_tool():
+    """tools/scrape_metrics.py (the shared cluster scraper)."""
+    return _load_tool("scrape_metrics")
 
 
 def cmd_status(server_dir: str) -> int:
@@ -649,6 +652,90 @@ def cmd_status(server_dir: str) -> int:
             for e in errors:
                 print(f"metrics: {e}", file=sys.stderr)
     return 0 if all_up else 1
+
+
+# =======================================================================
+# trace (distributed tracing capture across the live cluster)
+# =======================================================================
+def cmd_trace(server_dir: str, rate: float, seconds: float,
+              out: str) -> int:
+    """Capture a cluster-wide distributed trace: arm sampling at
+    ``rate`` on every process's ``/tracing`` endpoint, let traffic run
+    for ``seconds``, disarm, then scrape + clock-align + merge every
+    ``/trace`` export into one Perfetto JSON (tools/merge_traces.py)."""
+    cfg = config_mod.load(_find_config(server_dir))
+    merger = _load_tool("merge_traces")
+    if merger is None:
+        print("tools/merge_traces.py not available in this install",
+              file=sys.stderr)
+        return 1
+    targets = merger.base_targets_from_config(cfg)
+    if not targets:
+        print("no process has an http_port configured — tracing needs "
+              "the debug-http endpoints", file=sys.stderr)
+        return 1
+
+    def _get(url: str):
+        """One debug-http GET via the merge tool's fetch_json (ONE
+        copy of the scrape plumbing); None on any failure."""
+        try:
+            return merger.fetch_json(url, timeout=3.0)
+        except (OSError, ValueError):
+            return None
+    # remember each process's steady-state rate (e.g. an ini
+    # trace_sample_rate) so the capture restores it instead of
+    # force-disarming the whole cluster; when the pre-arm state read
+    # fails, fall back to the INI-CONFIGURED rate rather than 0 so a
+    # flaky read can never clobber an operator's always-on sampling
+    prior: dict[str, float] = {}
+    for gid, gc in cfg.games.items():
+        r0 = float(getattr(gc, "trace_sample_rate", 0.0))
+        prior[f"game{gid}"] = r0
+        for rank in range(max(1, getattr(gc, "mesh_processes", 1))):
+            prior[f"game{gid}c{rank}"] = r0
+    for gid, gc in cfg.gates.items():
+        prior[f"gate{gid}"] = float(
+            getattr(gc, "trace_sample_rate", 0.0))
+    armed = 0
+    for label, base in targets:
+        state = _get(f"{base}/tracing")
+        if state is not None:
+            prior[label] = float(state.get("rate", 0.0))
+        if _get(f"{base}/tracing?rate={rate}&clear=1") is not None:
+            armed += 1
+        else:
+            print(f"{label}: {base} unreachable (skipping)",
+                  file=sys.stderr)
+    if armed == 0:
+        print("no process reachable; is the cluster running?",
+              file=sys.stderr)
+        return 1
+    print(f"sampling at rate {rate} on {armed}/{len(targets)} "
+          f"processes for {seconds:g}s ...")
+    time.sleep(seconds)
+    # restoring MUST be loud: a process left sampling at the capture
+    # rate keeps paying trailer bytes + span recording until restarted
+    def _restore(label: str, base: str) -> bool:
+        return _get(
+            f"{base}/tracing?rate={prior.get(label, 0.0)}"
+        ) is not None
+
+    still_armed = [
+        (label, base) for label, base in targets
+        if not _restore(label, base)
+    ]
+    for label, base in list(still_armed):  # one retry after a breather
+        time.sleep(1.0)
+        if _restore(label, base):
+            still_armed.remove((label, base))
+    for label, base in still_armed:
+        print(f"WARNING: {label}: could not restore sample rate at "
+              f"{base} — it keeps tracing at {rate} until restarted or "
+              f"`curl '{base}/tracing?rate={prior.get(label, 0.0)}'` "
+              "succeeds", file=sys.stderr)
+    merged, errors = merger.collect(targets)
+    rc = merger.write_and_report(merged, errors, out)
+    return 1 if still_armed else rc
 
 
 # =======================================================================
@@ -708,6 +795,10 @@ def cmd_run_gate(gateid: int, configfile: str | None,
     cfg = config_mod.load(configfile)
     gc = cfg.gates.get(gateid) or config_mod.GateConfig()
     _start_debug_http(gc.http_port, f"gate{gateid}", host=gc.host)
+    if getattr(gc, "trace_sample_rate", 0.0) > 0:
+        from goworld_tpu.utils import tracing
+
+        tracing.set_sample_rate(gc.trace_sample_rate)
 
     ssl_ctx = None
     if gc.encrypt:
@@ -769,6 +860,16 @@ def main(argv: list[str] | None = None) -> int:
     for name in ("start", "stop", "kill", "reload", "status"):
         p = sub.add_parser(name)
         p.add_argument("server_dir")
+    pt = sub.add_parser(
+        "trace",
+        help="capture a cluster-wide distributed trace (Perfetto JSON)",
+    )
+    pt.add_argument("server_dir")
+    pt.add_argument("--rate", type=float, default=1.0,
+                    help="sampling probability per client packet")
+    pt.add_argument("--seconds", type=float, default=5.0,
+                    help="capture window")
+    pt.add_argument("--out", default="cluster_trace.json")
     pb = sub.add_parser("build")
     pb.add_argument("server_dir", nargs="?", default=None)
     pw = sub.add_parser("watchdog")
@@ -806,6 +907,9 @@ def main(argv: list[str] | None = None) -> int:
         return cmd_reload(args.server_dir)
     if args.cmd == "status":
         return cmd_status(args.server_dir)
+    if args.cmd == "trace":
+        return cmd_trace(args.server_dir, rate=args.rate,
+                         seconds=args.seconds, out=args.out)
     if args.cmd == "build":
         return cmd_build(args.server_dir)
     if args.cmd == "watchdog":

@@ -35,6 +35,10 @@ class GameConfig:
     position_sync_interval_ms: int = 100
     ban_boot_entity: bool = False
     http_port: int = 0
+    # distributed tracing: sampling rate for traces the GAME roots
+    # itself (outbound migrations); inbound traced packets are always
+    # recorded regardless (the gate made the sampling decision)
+    trace_sample_rate: float = 0.0
     log_file: str = ""
     log_level: str = "info"
     # TPU execution knobs
@@ -135,6 +139,10 @@ class GateConfig:
     http_port: int = 0        # debug/metrics endpoint (0 = off); every
                               # process kind serves the same /metrics +
                               # /trace map (docs/OBSERVABILITY.md)
+    # distributed tracing: probability that a client packet entering
+    # this gate roots a sampled trace (0 = off; also settable live via
+    # debug-http /tracing?rate= and `goworld_tpu trace`)
+    trace_sample_rate: float = 0.0
     log_file: str = ""
     log_level: str = "info"
 

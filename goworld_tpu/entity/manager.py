@@ -46,7 +46,7 @@ from goworld_tpu.entity.registry import (
 from goworld_tpu.entity.space import Space
 from goworld_tpu.entity.timer import Crontab, PostQueue, TimerQueue
 from goworld_tpu.parallel.mesh import create_multi_state
-from goworld_tpu.utils import consts, ids, log, metrics, opmon
+from goworld_tpu.utils import consts, ids, log, metrics, opmon, tracing
 
 logger = log.get("world")
 
@@ -1045,6 +1045,20 @@ class World:
 
     def _invoke(self, e: Entity, method: str, args: tuple,
                 from_client: str | None) -> None:
+        if tracing.active:
+            ctx = tracing.current()
+            if ctx is not None and ctx.sampled:
+                # traced RPC: the method execution gets its own span
+                # under the transport handle span, so the merged trace
+                # separates routing time from entity-logic time
+                with tracing.hop("invoke", f"game{self.game_id}", ctx,
+                                 method=method, eid=e.id):
+                    return self._invoke_body(e, method, args,
+                                             from_client)
+        return self._invoke_body(e, method, args, from_client)
+
+    def _invoke_body(self, e: Entity, method: str, args: tuple,
+                     from_client: str | None) -> None:
         if e.destroyed:
             return
         desc = e._type_desc.rpc_descs.get(method)

@@ -17,7 +17,7 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable
 
-from goworld_tpu.net.packet import Packet, PacketConnection
+from goworld_tpu.net.packet import Packet, PacketConnection, wire_payload
 from goworld_tpu.utils import log
 
 logger = log.get("cluster")
@@ -105,7 +105,10 @@ class DispatcherConn:
         if self.conn is not None and not self.conn.closed:
             self.conn.send(p, release=release)
         else:
-            self._pending.append(bytes(p.buf))
+            # wire_payload keeps a trace trailer through the reconnect
+            # queue (byte-identical to p.buf when untraced); the flush
+            # sends the stored bytes verbatim
+            self._pending.append(wire_payload(p))
             if release:
                 p.release()
 

@@ -24,8 +24,13 @@ from collections import deque
 import numpy as np
 
 from goworld_tpu.net import proto
-from goworld_tpu.net.packet import Packet, PacketConnection, new_packet
-from goworld_tpu.utils import consts, ids, log, metrics
+from goworld_tpu.net.packet import (
+    Packet,
+    PacketConnection,
+    new_packet,
+    wire_payload,
+)
+from goworld_tpu.utils import consts, ids, log, metrics, tracing
 
 logger = log.get("dispatcher")
 
@@ -101,7 +106,11 @@ class _GameInfo:
             self.conn.send(p, release=release)
         else:
             if len(self.pending) < consts.MAX_PENDING_PACKETS_PER_GAME:
-                self.pending.append(bytes(p.buf))
+                # wire_payload keeps a trace trailer through the queue
+                # (identical to bytes(p.buf) when untraced); the flush
+                # sends the stored bytes verbatim and the receiver's
+                # decode_wire strips the trailer as usual
+                self.pending.append(wire_payload(p))
             if release:
                 p.release()
 
@@ -213,6 +222,19 @@ class DispatcherService:
 
     # ------------------------------------------------------------------
     def _handle_packet(self, conn, role, msgtype: int, pkt: Packet):
+        ctx = pkt.trace
+        if ctx is not None and ctx.sampled:
+            # one route span per traced packet; the forwarded packet is
+            # re-stamped with OUR span so the next hop parents to it,
+            # and acks built inside (new_packet under the installed
+            # context) carry it back to the caller automatically
+            with tracing.hop("route", f"dispatcher{self.id}", ctx,
+                             msgtype=msgtype) as my:
+                pkt.trace = my
+                return self._route_packet(conn, role, msgtype, pkt)
+        return self._route_packet(conn, role, msgtype, pkt)
+
+    def _route_packet(self, conn, role, msgtype: int, pkt: Packet):
         c = self._route_counters.get(msgtype)
         if c is None:
             c = self._route_counters[msgtype] = metrics.counter(
@@ -372,7 +394,13 @@ class DispatcherService:
             return
         if info.blocked:
             if len(info.pending) < consts.MAX_PENDING_PACKETS_PER_ENTITY:
-                info.pending.append(Packet(bytes(pkt.buf)))
+                q = Packet(bytes(pkt.buf))
+                # carry the trace across the migration-block queue: the
+                # queueing delay is exactly the hop a p99 investigation
+                # needs attributed, and the post-unblock forward must
+                # still reach the game traced
+                q.trace = pkt.trace
+                info.pending.append(q)
             return
         gi = self.games.get(info.game_id)
         if gi is not None:

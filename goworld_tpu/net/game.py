@@ -31,7 +31,7 @@ from goworld_tpu.entity.manager import World
 from goworld_tpu.net import codec, proto
 from goworld_tpu.net.cluster import DispatcherCluster, DispatcherConn
 from goworld_tpu.net.packet import Packet, new_packet
-from goworld_tpu.utils import consts, log, metrics, opmon
+from goworld_tpu.utils import consts, log, metrics, opmon, tracing
 
 logger = log.get("game")
 
@@ -142,6 +142,11 @@ class GameServer:
         # per-client message order matches the per-message path.
         self._events_out: dict[int, list] = {}
         self._event_recs_flushed = 0  # per-tick gauge accumulator
+        # trace context staged per gate for the current tick's client
+        # event bundle: set by _client_sink when a traced handler emits
+        # client messages, applied to the bundle packet at flush so the
+        # gate's egress span stays linked to the inbound RPC's trace
+        self._events_trace: dict[int, tracing.TraceContext] = {}
         self.on_deployment_ready: Callable[[], None] | None = None
         # multihost World-mutation log (see _MH_WORLD_MSGTYPES)
         self._mh_pending: list[tuple[int, bytes]] = []
@@ -613,6 +618,12 @@ class GameServer:
         self._events_out.setdefault(gate_id, []).append(
             (mt, bytes(memoryview(p.buf)[4:]))
         )
+        if tracing.active:
+            # remember the emitting span so the flushed bundle carries
+            # it (records are raw bytes; last traced emitter wins)
+            ctx = tracing.current()
+            if ctx is not None:
+                self._events_trace[gate_id] = ctx
         # the packed message was copied into the record — return the
         # pooled packet (the per-message path's _send released it)
         p.release()
@@ -637,19 +648,21 @@ class GameServer:
             # zeroed) once per tick by _flush_sync_out
             self._event_recs_flushed += len(recs)
             conn = self.cluster.select_by_gate_id(gate_id)
+            trace_ctx = self._events_trace.pop(gate_id, None)
             chunk: list = []
             size = 0
             for rec in recs:
                 chunk.append(rec)
                 size += 6 + len(rec[1])
                 if size >= self._EVENT_BATCH_BYTES:
-                    self._send(conn,
-                               proto.pack_client_events_batch(
-                                   gate_id, chunk))
+                    p = proto.pack_client_events_batch(gate_id, chunk)
+                    p.trace = trace_ctx
+                    self._send(conn, p)
                     chunk, size = [], 0
             if chunk:
-                self._send(conn,
-                           proto.pack_client_events_batch(gate_id, chunk))
+                p = proto.pack_client_events_batch(gate_id, chunk)
+                p.trace = trace_ctx
+                self._send(conn, p)
         self._events_out.clear()
 
     def _flush_sync_out(self) -> None:
@@ -810,6 +823,21 @@ class GameServer:
     def _remote_enter_space(self, e: Entity, space_id: str,
                             pos: tuple) -> None:
         self._migrating_out[e.id] = (e, space_id, pos)
+        if tracing.active and tracing.current() is None:
+            # migration not already under a traced RPC: root its own
+            # trace (sampled at the same rate) so the whole protocol —
+            # QUERY_SPACE_GAMEID -> MIGRATE_REQUEST -> REAL_MIGRATE,
+            # acks included — appears as ONE causally-linked trace; the
+            # chain continues automatically because every ack comes
+            # back traced and re-enters the handle/route hops
+            root = tracing.maybe_sample()
+            if root is not None:
+                with tracing.root("migrate_out", f"game{self.game_id}",
+                                  root, eid=e.id, space=space_id):
+                    p = proto.pack_query_space_gameid(space_id, e.id)
+                    self._send(
+                        self.cluster.select_by_entity_id(space_id), p)
+                return
         p = proto.pack_query_space_gameid(space_id, e.id)
         self._send(self.cluster.select_by_entity_id(space_id), p)
 
@@ -817,6 +845,20 @@ class GameServer:
     # cluster -> world packet handlers (logic thread)
     # ==================================================================
     def _handle_packet(self, didx: int, msgtype: int, pkt: Packet) -> None:
+        ctx = pkt.trace
+        if ctx is not None and ctx.sampled:
+            # one handle span per traced inbound packet, parented to the
+            # sender's span; installing it as current makes every
+            # outbound packet the handler creates (entity RPC forwards,
+            # migration acks, staged client events) carry OUR span
+            with tracing.hop("handle", f"game{self.game_id}", ctx,
+                             msgtype=msgtype) as my:
+                pkt.trace = my
+                return self._handle_packet_body(didx, msgtype, pkt)
+        return self._handle_packet_body(didx, msgtype, pkt)
+
+    def _handle_packet_body(self, didx: int, msgtype: int,
+                            pkt: Packet) -> None:
         w = self.world
         if w._multihost and not self._mh_replaying \
                 and msgtype in _MH_WORLD_MSGTYPES:

@@ -26,10 +26,11 @@ from goworld_tpu.net.packet import (
     HEADER_SIZE,
     Packet,
     PacketConnection,
+    decode_wire,
     frame,
     new_packet,
 )
-from goworld_tpu.utils import ids, log, metrics, opmon
+from goworld_tpu.utils import ids, log, metrics, opmon, tracing
 
 logger = log.get("gate")
 
@@ -281,6 +282,31 @@ class GateService:
 
     def _handle_client_packet(self, cp: ClientProxy, msgtype: int,
                               pkt: Packet) -> None:
+        """Trace ingress: the gate is where a client request enters the
+        cluster, so the sampling decision is made HERE and only here —
+        a context a client ships itself is untrusted and discarded
+        (honoring it would let any client bypass the sampling rate and
+        get trailer bytes echoed onto the client wire). Heartbeats are
+        never sampled. The root span's context is installed as current,
+        so the packets forwarded below carry it and the dispatcher's
+        route span parents to ``gate_ingress``."""
+        pkt.trace = None  # client-supplied contexts are not trusted
+        if msgtype not in (proto.MT_HEARTBEAT,
+                           proto.MT_CLIENT_SYNC_POSITION_YAW):
+            # heartbeats are noise; sync records are staged into a
+            # batch and flushed OUTSIDE any handler context, so
+            # sampling them would only mint orphan single-span traces
+            # at 10 Hz per client — flooding the span ring
+            root = tracing.maybe_sample()
+            if root is not None:
+                with tracing.root("gate_ingress", f"gate{self.gate_id}",
+                                  root, msgtype=msgtype):
+                    self._handle_client_packet_body(cp, msgtype, pkt)
+                return
+        self._handle_client_packet_body(cp, msgtype, pkt)
+
+    def _handle_client_packet_body(self, cp: ClientProxy, msgtype: int,
+                                   pkt: Packet) -> None:
         """Reference ``handleClientProxyPacket`` (``:236-256``): stamp the
         client id onto entity RPCs; batch sync records per dispatcher."""
         cp.last_heartbeat = asyncio.get_event_loop().time()
@@ -310,6 +336,21 @@ class GateService:
     # -- dispatcher side --------------------------------------------------
     def _on_dispatcher_packet(self, didx: int, msgtype: int,
                               pkt: Packet) -> None:
+        ctx = pkt.trace
+        if ctx is not None and ctx.sampled:
+            # egress leaf: record the client-delivery span but do NOT
+            # install a current context — the relayed client-bound
+            # packets must stay unstamped (client wire unchanged)
+            my = ctx.child()
+            with tracing.recorder.span(
+                    "gate_egress", f"gate{self.gate_id}", my,
+                    ctx.span_hex, msgtype=msgtype):
+                self._on_dispatcher_packet_body(didx, msgtype, pkt)
+            return
+        self._on_dispatcher_packet_body(didx, msgtype, pkt)
+
+    def _on_dispatcher_packet_body(self, didx: int, msgtype: int,
+                                   pkt: Packet) -> None:
         if proto.MT_REDIRECT_TO_GATEPROXY_MSG_TYPE_START <= msgtype <= \
                 proto.MT_REDIRECT_TO_GATEPROXY_MSG_TYPE_STOP:
             pkt.read_u16()  # gate_id (ours)
@@ -476,8 +517,10 @@ class GateService:
                 async for msg in ws:
                     if not isinstance(msg, (bytes, bytearray)):
                         continue
-                    p = Packet(msg[HEADER_SIZE:])  # strip size prefix
-                    self._handle_client_packet(cp, p.read_u16(), p)
+                    # strip size prefix; decode_wire also strips any
+                    # trace trailer like the TCP recv path
+                    mt, p = decode_wire(msg[HEADER_SIZE:])
+                    self._handle_client_packet(cp, mt, p)
             except Exception:
                 pass
             finally:

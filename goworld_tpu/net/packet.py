@@ -23,6 +23,7 @@ from typing import Any
 
 import msgpack
 
+from goworld_tpu.utils import tracing
 from goworld_tpu.utils.ids import ENTITYID_LENGTH
 
 MAX_PAYLOAD_LENGTH = 32 * 1024 * 1024  # defensive cap (reference 16M-ish)
@@ -32,6 +33,16 @@ _U32 = struct.Struct("<I")
 _U16 = struct.Struct("<H")
 _F32 = struct.Struct("<f")
 HEADER_SIZE = 4  # the u32 size prefix; msgtype counts into payload_size
+
+# bit 15 of the u16 msgtype field marks a trace-context trailer: the
+# last CTX_WIRE_SIZE bytes of the payload are a packed
+# tracing.TraceContext, stripped before the handler sees the packet.
+# Every real msgtype lives in the documented 0..2047 routing ranges
+# (net/proto.py; guarded by tests/test_proto_invariants.py), so the bit
+# can never collide — and untraced packets pay zero bytes (the framed
+# stream is byte-identical to the pre-tracing wire).
+TRACE_FLAG = 0x8000
+MSGTYPE_MASK = 0x7FFF
 
 _pool: list["Packet"] = []
 _POOL_MAX = 256
@@ -45,11 +56,15 @@ class Packet:
     hot paths; plain construction also works.
     """
 
-    __slots__ = ("buf", "rpos")
+    __slots__ = ("buf", "rpos", "trace")
 
     def __init__(self, data: bytes | bytearray | None = None):
         self.buf = bytearray(data) if data is not None else bytearray()
         self.rpos = 0
+        # attached tracing.TraceContext (or None): set by decode_wire on
+        # traced inbound packets and by hops/new_packet on outbound ones;
+        # applied to the wire as a flagged trailer by wire_payload
+        self.trace = None
 
     # -- lifecycle -------------------------------------------------------
     @staticmethod
@@ -62,6 +77,7 @@ class Packet:
             return Packet()
 
     def release(self) -> None:
+        self.trace = None  # never leak a context into a pooled reuse
         if len(_pool) < _POOL_MAX:
             self.buf.clear()
             self.rpos = 0
@@ -166,12 +182,54 @@ class Packet:
 def new_packet(msgtype: int) -> Packet:
     p = Packet.alloc()
     p.append_u16(msgtype)
+    if tracing.active:
+        # inside a traced hop (tracing.use/hop): outbound packets carry
+        # the emitting span's context so the next hop parents to it
+        ctx = tracing.current()
+        if ctx is not None:
+            p.trace = ctx
     return p
+
+
+def wire_payload(p: Packet) -> bytes:
+    """Payload bytes as they go on the wire: verbatim when untraced;
+    with TRACE_FLAG set on the msgtype and the packed 25B context
+    appended as a trailer when a trace context is attached."""
+    if p.trace is None:
+        return bytes(p.buf)
+    buf = bytearray(p.buf)
+    buf[1] |= 0x80  # little-endian u16 msgtype: bit 15 lives in byte 1
+    buf += p.trace.pack()
+    return bytes(buf)
+
+
+def decode_wire(body: bytes | bytearray) -> tuple[int, Packet]:
+    """Inverse of :func:`wire_payload` + the msgtype read: returns the
+    masked msgtype and a Packet positioned after it, with any trace
+    trailer stripped into ``packet.trace`` (handlers see byte-identical
+    payloads either way)."""
+    p = Packet(body)
+    msgtype = p.read_u16()
+    if msgtype & TRACE_FLAG:
+        msgtype &= MSGTYPE_MASK
+        if len(p.buf) < 2 + tracing.CTX_WIRE_SIZE:
+            raise ConnectionError("traced packet too short for trailer")
+        p.trace = tracing.TraceContext.unpack(
+            bytes(p.buf[-tracing.CTX_WIRE_SIZE:])
+        )
+        del p.buf[-tracing.CTX_WIRE_SIZE:]
+        # clear the flag in the stored bytes too: handlers that forward
+        # or copy the raw buffer (queue-while-blocked, broadcasts) must
+        # see payload bytes identical to an untraced packet's — the
+        # flag is re-applied by wire_payload iff a context is attached
+        p.buf[1] &= 0x7F
+    return msgtype, p
 
 
 def frame(p: Packet) -> bytes:
     """Wrap a packet's payload with the u32 size prefix for the wire."""
-    return _SIZE_FMT.pack(len(p.buf)) + bytes(p.buf)
+    payload = wire_payload(p)
+    return _SIZE_FMT.pack(len(payload)) + payload
 
 
 class PacketConnection:
@@ -236,10 +294,11 @@ class PacketConnection:
             return
         try:
             if self.compress:
+                raw = wire_payload(p)
                 if self._snappy:
-                    payload = self._comp.compress(bytes(p.buf))
+                    payload = self._comp.compress(raw)
                 else:
-                    payload = self._comp.compress(bytes(p.buf)) \
+                    payload = self._comp.compress(raw) \
                         + self._comp.flush(zlib.Z_SYNC_FLUSH)
                 self.writer.write(_SIZE_FMT.pack(len(payload)) + payload)
             else:
@@ -289,9 +348,7 @@ class PacketConnection:
                     raise ConnectionError("decompressed packet too large")
             if len(body) < 2:
                 raise ConnectionError("short decompressed packet")
-        p = Packet(body)
-        msgtype = p.read_u16()
-        return msgtype, p
+        return decode_wire(body)
 
     async def close(self) -> None:
         if self._closed:

@@ -8,35 +8,105 @@ TPU-native analog exposes:
 * ``/ops``    — opmon op stats (count / avg / max per named op)
 * ``/metrics``— Prometheus text exposition of the :mod:`metrics` registry
   (the expvar/opmon role, scrapeable: counters, gauges, histograms)
-* ``/trace``  — Chrome ``chrome://tracing`` / Perfetto JSON of the
-  per-tick phase timeline ring buffer (:data:`metrics.timeline`)
+* ``/trace``  — Chrome ``chrome://tracing`` / Perfetto JSON: the per-tick
+  phase timeline ring buffer (:data:`metrics.timeline`) merged with the
+  distributed-tracing span ring (:data:`tracing.recorder`); gzipped when
+  the client sends ``Accept-Encoding: gzip`` (merged cluster traces at
+  1M entities are large)
+* ``/tracing``— distributed-tracing control: ``?rate=R`` sets the
+  process's sample rate, ``?clear=1`` drops recorded spans; always
+  returns the current state (driven by ``goworld_tpu trace``)
+* ``/clock``  — paired monotonic/wall anchors for cross-process clock
+  alignment (``tools/merge_traces.py``)
 * ``/healthz``— liveness probe
-* ``/profile``— a jax.profiler trace capture hint (profiling is driven by
-  ``jax.profiler.start_server`` when available; see ``start``'s docstring)
+* ``/profile``— jax.profiler capture trigger: GET starts a device trace
+  (``?logdir=`` overrides the output dir), ``?stop=1`` stops it; a
+  clear JSON error when jax.profiler is unavailable
 
 Stdlib-only (http.server on a daemon thread), one call to :func:`start`.
 """
 
 from __future__ import annotations
 
+import gzip as _gzip
 import json
+import os
 import threading
+import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from goworld_tpu.utils import log, metrics, opmon
+from goworld_tpu.utils import log, metrics, opmon, tracing
 
 logger = log.get("debug_http")
 
-_ENDPOINTS = ["/healthz", "/vars", "/ops", "/metrics", "/trace"]
+_ENDPOINTS = ["/healthz", "/vars", "/ops", "/metrics", "/trace",
+              "/tracing", "/clock", "/profile"]
+
+# jax.profiler capture state (one capture at a time per process)
+_profile_lock = threading.Lock()
+_profile_dir: str | None = None
+
+
+def merged_trace(process_name: str) -> dict:
+    """The tick timeline's Chrome trace with the span recorder's events
+    (RPC/migration hop spans, one named track per service) appended —
+    one JSON object per process, merged cluster-wide by
+    ``tools/merge_traces.py``."""
+    obj = metrics.timeline.chrome_trace(process_name)
+    obj["traceEvents"].extend(
+        tracing.recorder.chrome_events(os.getpid())
+    )
+    return obj
+
+
+def _profile_action(query: dict) -> tuple[dict, int]:
+    """Start/stop a jax.profiler trace capture; (json body, status)."""
+    global _profile_dir
+    try:
+        from jax import profiler as jax_profiler
+    except Exception:
+        return ({"error": "jax.profiler unavailable in this process"},
+                501)
+    # presence of the key counts (`?stop` and `?stop=1` both stop)
+    stop = "stop" in query and query["stop"][0] not in ("0", "false")
+    with _profile_lock:
+        if stop:
+            if _profile_dir is None:
+                return ({"error": "no capture in progress"}, 409)
+            try:
+                jax_profiler.stop_trace()
+            except Exception as exc:
+                _profile_dir = None
+                return ({"error": f"stop_trace failed: {exc}"}, 500)
+            d, _profile_dir = _profile_dir, None
+            return ({"ok": True, "stopped": True, "logdir": d}, 200)
+        if _profile_dir is not None:
+            return ({"error": "capture already in progress",
+                     "logdir": _profile_dir}, 409)
+        logdir = query.get("logdir", [""])[0] or os.path.join(
+            os.getcwd(), "jax_profile"
+        )
+        try:
+            jax_profiler.start_trace(logdir)
+        except Exception as exc:
+            return ({"error": f"start_trace failed: {exc}"}, 500)
+        _profile_dir = logdir
+        return ({"ok": True, "started": True, "logdir": logdir}, 200)
 
 
 class _Handler(BaseHTTPRequestHandler):
     def log_message(self, *a):  # keep request noise out of server logs
         pass
 
-    def _body(self, body: bytes, ctype: str, code: int = 200) -> None:
+    def _body(self, body: bytes, ctype: str, code: int = 200,
+              gzip_ok: bool = False) -> None:
         self.send_response(code)
         self.send_header("Content-Type", ctype)
+        if gzip_ok and "gzip" in \
+                self.headers.get("Accept-Encoding", ""):
+            body = _gzip.compress(body)
+            self.send_header("Content-Encoding", "gzip")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -46,22 +116,52 @@ class _Handler(BaseHTTPRequestHandler):
                    "application/json", code)
 
     def do_GET(self):  # noqa: N802 (stdlib api)
-        if self.path == "/healthz":
+        path, _, qs = self.path.partition("?")
+        # keep_blank_values: `?stop` / `?clear` (no value) must count
+        query = urllib.parse.parse_qs(qs, keep_blank_values=True)
+        if path == "/healthz":
             self._json({"ok": True})
-        elif self.path == "/vars":
+        elif path == "/vars":
             self._json(opmon.vars())
-        elif self.path == "/ops":
+        elif path == "/ops":
             self._json(opmon.monitor.snapshot())
-        elif self.path == "/metrics":
+        elif path == "/metrics":
             self._body(metrics.REGISTRY.expose_text().encode(),
                        "text/plain; version=0.0.4; charset=utf-8")
-        elif self.path == "/trace":
+        elif path == "/trace":
             self._body(
-                metrics.timeline.chrome_trace_json(
+                json.dumps(merged_trace(
                     getattr(self.server, "process_name", "goworld_tpu")
-                ).encode(),
+                )).encode(),
                 "application/json",
+                gzip_ok=True,
             )
+        elif path == "/tracing":
+            if "rate" in query:
+                try:
+                    tracing.set_sample_rate(float(query["rate"][0]))
+                except ValueError:
+                    self._json({"error": "rate must be a float"}, 400)
+                    return
+            if "clear" in query \
+                    and query["clear"][0] not in ("0", "false"):
+                tracing.recorder.clear()
+            self._json({"rate": tracing.sample_rate(),
+                        "spans": len(tracing.recorder)})
+        elif path == "/clock":
+            # both clocks sampled back to back: the merge tool pairs
+            # them with its own request midpoint to estimate this
+            # process's wall-clock offset
+            self._json({
+                "wall_us": time.time() * 1e6,
+                "mono_us": time.monotonic() * 1e6,
+                "pid": os.getpid(),
+                "process_name": getattr(self.server, "process_name",
+                                        "goworld_tpu"),
+            })
+        elif path == "/profile":
+            body, code = _profile_action(query)
+            self._json(body, code)
         else:
             self._json({"error": "not found",
                         "endpoints": _ENDPOINTS}, 404)
@@ -73,9 +173,10 @@ def start(port: int, host: str = "127.0.0.1",
     bound port is ``server.server_address[1]`` when ``port=0``).
     ``process_name`` labels the ``/trace`` export (e.g. ``game1``).
 
-    For on-device profiling, pair with ``jax.profiler.start_server(
-    profiler_port)`` and capture traces via TensorBoard — the reference's
-    pprof role (``binutil.go:26-47``)."""
+    For on-device profiling beyond the ``/profile`` start/stop trigger,
+    pair with ``jax.profiler.start_server(profiler_port)`` and capture
+    traces via TensorBoard — the reference's pprof role
+    (``binutil.go:26-47``)."""
     srv = ThreadingHTTPServer((host, port), _Handler)
     srv.process_name = process_name  # type: ignore[attr-defined]
     t = threading.Thread(target=srv.serve_forever,

@@ -4,6 +4,10 @@ Reference parity: ``engine/gwlog`` (zap-based; level from config/flag,
 stderr + file, per-process source tag like ``game1``, ``TraceError`` dumps a
 stack — ``gwlog.go:47-120``, ``binutil.go:50-66``). Here: thin wrappers over
 :mod:`logging` so the rest of the framework has one import point.
+
+When distributed tracing is sampling (:mod:`goworld_tpu.utils.tracing`),
+every line emitted inside a traced hop carries ``trace=<trace_id>`` so
+log lines correlate with the spans in a merged cluster trace.
 """
 
 from __future__ import annotations
@@ -12,8 +16,27 @@ import logging
 import sys
 import traceback
 
+# stdlib-only module, imports nothing back from log — no cycle
+from goworld_tpu.utils import tracing
+
 _root = logging.getLogger("goworld_tpu")
 _source = "?"
+
+
+class _TraceIdFilter(logging.Filter):
+    """Stamp ``record.trace`` with the current trace id (empty when no
+    traced hop is active — the common case costs one module-bool load)."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        record.trace = ""
+        if tracing.active:
+            ctx = tracing.current()
+            if ctx is not None:
+                record.trace = f" trace={ctx.trace_hex}"
+        return True
+
+
+_trace_filter = _TraceIdFilter()
 
 
 def setup(source: str, level: str = "info", logfile: str | None = None) -> None:
@@ -23,14 +46,17 @@ def setup(source: str, level: str = "info", logfile: str | None = None) -> None:
     _root.setLevel(getattr(logging, level.upper(), logging.INFO))
     _root.handlers.clear()
     fmt = logging.Formatter(
-        f"%(asctime)s %(levelname).1s {source} %(name)s: %(message)s"
+        f"%(asctime)s %(levelname).1s {source} %(name)s:"
+        f"%(trace)s %(message)s"
     )
     h: logging.Handler = logging.StreamHandler(sys.stderr)
     h.setFormatter(fmt)
+    h.addFilter(_trace_filter)
     _root.addHandler(h)
     if logfile:
         fh = logging.FileHandler(logfile)
         fh.setFormatter(fmt)
+        fh.addFilter(_trace_filter)
         _root.addHandler(fh)
     _root.propagate = False
 
@@ -40,7 +66,16 @@ def get(name: str) -> logging.Logger:
 
 
 def trace_error(msg: str, *args) -> None:
-    """Log an error with a stack trace (reference ``gwlog.TraceError``)."""
+    """Log an error with the most useful stack available (reference
+    ``gwlog.TraceError``): inside an ``except`` block that is the ACTIVE
+    EXCEPTION's traceback (``exc_info``), not the call site's stack —
+    the previous ``format_stack()`` showed where ``trace_error`` was
+    called from and lost the actual failure. Outside an except block it
+    falls back to the call-site stack. The current trace id (when
+    sampling) rides the normal log format via :class:`_TraceIdFilter`."""
+    if sys.exc_info()[1] is not None:
+        _root.error(msg, *args, exc_info=True)
+        return
     _root.error(msg, *args)
     _root.error("stack:\n%s", "".join(traceback.format_stack()[:-1]))
 

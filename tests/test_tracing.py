@@ -1,0 +1,552 @@
+"""Distributed tracing (ISSUE 2): context wire format + zero-cost
+untraced framing, span recorder linkage, debug-http trace endpoints,
+cluster merge with flow synthesis, and end-to-end propagation of one
+sampled client RPC across gate -> dispatcher -> game in a standalone
+cluster over real sockets."""
+
+import json
+import struct
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from goworld_tpu.net import proto
+from goworld_tpu.net.packet import (
+    MSGTYPE_MASK,
+    TRACE_FLAG,
+    Packet,
+    decode_wire,
+    frame,
+    new_packet,
+    wire_payload,
+)
+from goworld_tpu.utils import debug_http, tracing
+
+
+@pytest.fixture(autouse=True)
+def _reset_tracing():
+    """Every test starts with sampling off and an empty span ring."""
+    tracing.set_sample_rate(0.0)
+    tracing.recorder.clear()
+    yield
+    tracing.set_sample_rate(0.0)
+    tracing.recorder.clear()
+
+
+# =======================================================================
+# context + sampling
+# =======================================================================
+def test_context_pack_unpack_roundtrip():
+    ctx = tracing.new_trace()
+    b = ctx.pack()
+    assert len(b) == tracing.CTX_WIRE_SIZE == 25
+    back = tracing.TraceContext.unpack(b)
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled
+    with pytest.raises(ValueError):
+        tracing.TraceContext.unpack(b[:-1])
+
+
+def test_child_keeps_trace_id_fresh_span_id():
+    ctx = tracing.new_trace()
+    kid = ctx.child()
+    assert kid.trace_id == ctx.trace_id
+    assert kid.span_id != ctx.span_id
+    assert kid.sampled == ctx.sampled
+
+
+def test_sampling_rates():
+    tracing.set_sample_rate(0.0)
+    assert all(tracing.maybe_sample() is None for _ in range(50))
+    tracing.set_sample_rate(1.0)
+    roots = [tracing.maybe_sample() for _ in range(10)]
+    assert all(r is not None and r.sampled for r in roots)
+    # distinct trace ids per root
+    assert len({r.trace_id for r in roots}) == 10
+
+
+def test_disarm_resets_fast_path_flag():
+    tracing.set_sample_rate(0.5)
+    assert tracing.active
+    tracing.set_sample_rate(0.0)
+    assert not tracing.active  # untraced processes pay one global load
+    # an inbound traced hop re-raises it so propagation still stamps
+    with tracing.use(tracing.new_trace()):
+        assert tracing.active
+
+
+def test_current_context_nests():
+    assert tracing.current() is None
+    a, b = tracing.new_trace(), tracing.new_trace()
+    with tracing.use(a):
+        assert tracing.current() is a
+        with tracing.use(b):
+            assert tracing.current() is b
+        assert tracing.current() is a
+    assert tracing.current() is None
+
+
+# =======================================================================
+# wire format: zero bytes when untraced, trailer strip when traced
+# =======================================================================
+def test_untraced_frame_is_byte_identical_to_pre_tracing_wire():
+    """ISSUE 2 acceptance: with sampling disabled, packet bytes on the
+    wire are unchanged — golden-framed against the documented
+    [u32 size][u16 msgtype][payload] layout."""
+    p = new_packet(proto.MT_CALL_ENTITY_METHOD)
+    p.append_entity_id("e" * 16)
+    p.append_var_str("Ping")
+    p.append_args(("x", 1))
+    payload = bytes(p.buf)
+    golden = struct.pack("<I", len(payload)) + payload
+    assert frame(p) == golden
+    assert wire_payload(p) == payload
+    # msgtype field carries no flag bit
+    assert struct.unpack_from("<H", payload)[0] == \
+        proto.MT_CALL_ENTITY_METHOD
+    mt, q = decode_wire(payload)
+    assert mt == proto.MT_CALL_ENTITY_METHOD and q.trace is None
+
+
+def test_traced_frame_trailer_and_strip():
+    p = new_packet(proto.MT_CALL_ENTITY_METHOD)
+    p.append_var_str("hello")
+    plain = bytes(p.buf)
+    p.trace = tracing.new_trace()
+    wire = wire_payload(p)
+    # flag bit set, 25B trailer appended
+    assert len(wire) == len(plain) + tracing.CTX_WIRE_SIZE
+    assert struct.unpack_from("<H", wire)[0] == \
+        proto.MT_CALL_ENTITY_METHOD | TRACE_FLAG
+    mt, q = decode_wire(wire)
+    assert mt == proto.MT_CALL_ENTITY_METHOD
+    assert bytes(q.buf) == plain  # handler sees identical payload
+    assert q.trace is not None
+    assert q.trace.trace_id == p.trace.trace_id
+    assert q.trace.span_id == p.trace.span_id
+
+
+def test_truncated_trace_trailer_rejected():
+    p = new_packet(proto.MT_HEARTBEAT)
+    p.trace = tracing.new_trace()
+    wire = wire_payload(p)[:10]  # flagged but trailer cut off
+    with pytest.raises(ConnectionError):
+        decode_wire(wire)
+
+
+def test_release_clears_trace_context():
+    p = new_packet(proto.MT_HEARTBEAT)
+    p.trace = tracing.new_trace()
+    p.release()
+    q = Packet.alloc()
+    assert q.trace is None
+
+
+def test_new_packet_autostamps_under_current_context():
+    ctx = tracing.new_trace()
+    with tracing.use(ctx):
+        p = new_packet(proto.MT_CALL_ENTITY_METHOD)
+    assert p.trace is ctx
+    q = new_packet(proto.MT_CALL_ENTITY_METHOD)
+    assert q.trace is None
+
+
+def test_pending_queues_preserve_trace_context():
+    """Packets queued while a peer is away (game reconnecting, entity
+    blocked mid-migration) must come out of the queue still traced —
+    the queueing delay is exactly the hop a p99 investigation needs."""
+    from goworld_tpu.net.cluster import DispatcherConn
+    from goworld_tpu.net.dispatcher import _GameInfo
+
+    ctx = tracing.new_trace()
+    gi = _GameInfo(1)  # conn is None: send() queues
+    p = new_packet(proto.MT_CALL_ENTITY_METHOD)
+    p.append_var_str("x")
+    p.trace = ctx
+    gi.send(p, release=False)
+    mt, q = decode_wire(gi.pending[0])
+    assert mt == proto.MT_CALL_ENTITY_METHOD
+    assert q.trace is not None and q.trace.trace_id == ctx.trace_id
+    assert q.read_var_str() == "x"
+
+    conn = DispatcherConn(0, ("127.0.0.1", 1), lambda *a: None, None)
+    p2 = new_packet(proto.MT_CALL_ENTITY_METHOD)
+    p2.trace = ctx
+    conn.send(p2, release=False)
+    mt2, q2 = decode_wire(conn._pending[0])
+    assert mt2 == proto.MT_CALL_ENTITY_METHOD
+    assert q2.trace is not None and q2.trace.span_id == ctx.span_id
+
+
+# =======================================================================
+# span recorder
+# =======================================================================
+def test_recorder_span_linkage_and_chrome_events():
+    root = tracing.new_trace()
+    with tracing.hop("route", "dispatcher1", root, msgtype=8) as my:
+        time.sleep(0.002)
+        with tracing.hop("handle", "game1", my, msgtype=8):
+            pass
+    recs = tracing.recorder.records()
+    assert [r[0] for r in recs] == ["handle", "route"]  # inner closes first
+    handle, route = recs[0], recs[1]
+    assert route[2] == handle[2] == root.trace_hex
+    assert route[4] == root.span_hex          # route parents to the root
+    assert handle[4] == route[3]              # handle parents to route
+    assert route[6] >= 2000                   # >= 2ms in us
+
+    events = tracing.recorder.chrome_events(pid=42)
+    tracks = {e["args"]["name"] for e in events
+              if e["name"] == "thread_name"}
+    assert tracks == {"dispatcher1", "game1"}
+    spans = [e for e in events if e["ph"] == "X"]
+    assert {s["name"] for s in spans} == {"route", "handle"}
+    by_name = {s["name"]: s for s in spans}
+    assert by_name["handle"]["args"]["parent_id"] == \
+        by_name["route"]["args"]["span_id"]
+    assert by_name["route"]["args"]["msgtype"] == 8
+    json.dumps(events)  # valid JSON
+
+
+def test_recorder_ring_bounds():
+    rec = tracing.SpanRecorder(capacity=16)
+    ctx = tracing.new_trace()
+    for i in range(50):
+        rec.record("s", "t", ctx, None, 0.0, 1.0)
+    assert len(rec) == 16
+
+
+# =======================================================================
+# debug-http: /clock, /tracing, gzip /trace, /profile
+# =======================================================================
+def _get(url: str, headers: dict | None = None, timeout: float = 5):
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+@pytest.fixture()
+def http_srv():
+    srv = debug_http.start(0, process_name="tracetest")
+    yield srv, f"http://127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+    srv.server_close()
+
+
+def test_clock_endpoint(http_srv):
+    _, base = http_srv
+    t0 = time.time() * 1e6
+    code, _, body = _get(base + "/clock")
+    t1 = time.time() * 1e6
+    assert code == 200
+    clock = json.loads(body)
+    assert t0 <= clock["wall_us"] <= t1
+    assert clock["mono_us"] > 0
+    assert clock["process_name"] == "tracetest"
+
+
+def test_tracing_control_endpoint(http_srv):
+    _, base = http_srv
+    code, _, body = _get(base + "/tracing?rate=0.25")
+    assert code == 200
+    assert json.loads(body)["rate"] == 0.25
+    assert tracing.sample_rate() == 0.25
+    ctx = tracing.new_trace()
+    with tracing.recorder.span("s", "t", ctx, None):
+        pass
+    code, _, body = _get(base + "/tracing")
+    assert json.loads(body)["spans"] == 1
+    code, _, body = _get(base + "/tracing?rate=0&clear=1")
+    out = json.loads(body)
+    assert out["rate"] == 0 and out["spans"] == 0
+    # value-less form counts too (`curl .../tracing?clear`)
+    with tracing.recorder.span("s2", "t", ctx, None):
+        pass
+    code, _, body = _get(base + "/tracing?clear")
+    assert json.loads(body)["spans"] == 0
+
+
+def test_trace_endpoint_merges_spans_and_gzips(http_srv):
+    _, base = http_srv
+    from goworld_tpu.utils import metrics
+
+    metrics.timeline.begin_tick()
+    with metrics.timeline.span("tick_phase"):
+        pass
+    metrics.timeline.end_tick()
+    ctx = tracing.new_trace()
+    with tracing.recorder.span("rpc_span", "gate1", ctx, None):
+        pass
+
+    code, headers, body = _get(base + "/trace")
+    assert code == 200 and headers.get("Content-Encoding") is None
+    names = {e["name"] for e in json.loads(body)["traceEvents"]}
+    assert {"tick_phase", "rpc_span"} <= names
+
+    import gzip as _gz
+
+    code, headers, zbody = _get(base + "/trace",
+                                {"Accept-Encoding": "gzip"})
+    assert code == 200 and headers.get("Content-Encoding") == "gzip"
+    assert json.loads(_gz.decompress(zbody)) == json.loads(body)
+
+
+def test_profile_endpoint_start_stop(http_srv, tmp_path):
+    _, base = http_srv
+    # first start_trace in a process initializes the profiler (~10s on
+    # a cold jax); give the request room
+    code, _, body = _get(
+        base + f"/profile?logdir={tmp_path}/prof", timeout=90)
+    out = json.loads(body)
+    if code == 501:
+        assert "unavailable" in out["error"]
+        return  # environment without jax.profiler: clear JSON error
+    assert code == 200 and out["started"]
+    # double start is a clear conflict, not a crash
+    code2, _, body2 = _get(base + f"/profile?logdir={tmp_path}/p2")
+    assert code2 == 409
+    code3, _, body3 = _get(base + "/profile?stop=1", timeout=90)
+    assert code3 == 200 and json.loads(body3)["stopped"]
+    # stop without a capture
+    code4, _, _ = _get(base + "/profile?stop=1")
+    assert code4 == 409
+
+
+# =======================================================================
+# end-to-end: one sampled client RPC across a standalone cluster
+# =======================================================================
+from goworld_tpu.core.state import WorldConfig  # noqa: E402
+from goworld_tpu.entity.entity import Entity  # noqa: E402
+from goworld_tpu.entity.manager import World  # noqa: E402
+from goworld_tpu.net.botclient import BotClient  # noqa: E402
+from goworld_tpu.net.game import GameServer  # noqa: E402
+from goworld_tpu.net.standalone import ClusterHarness  # noqa: E402
+from goworld_tpu.ops.aoi import GridSpec  # noqa: E402
+
+
+class TracedAccount(Entity):
+    ATTRS = {"status": "client"}
+
+    def Ping_Client(self, text):
+        # a client RPC emitted INSIDE the handler stages a client event
+        # under the active trace -> exercises the game -> dispatcher ->
+        # gate egress leg (attr fan-out happens later in the tick,
+        # outside any handler context, and is deliberately untraced)
+        self.call_client("OnPing", text)
+
+
+@pytest.fixture()
+def traced_cluster():
+    harness = ClusterHarness(n_dispatchers=1, n_gates=1,
+                             desired_games=1)
+    harness.start()
+    world = World(
+        WorldConfig(capacity=64, grid=GridSpec(
+            radius=10.0, extent_x=40.0, extent_z=40.0)),
+        n_spaces=1,
+    )
+    world.register_entity("TracedAccount", TracedAccount)
+    world.create_nil_space()
+    gs = GameServer(1, world, list(harness.dispatcher_addrs),
+                    boot_entity="TracedAccount",
+                    gc_freeze_on_boot=False)
+    gs.start_network()
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            gs.pump()
+            gs.tick()
+            time.sleep(0.01)
+
+    t = threading.Thread(target=loop, daemon=True)
+    t.start()
+    assert gs.ready_event.wait(20), "deployment never became ready"
+    tracing.recorder.clear()
+    tracing.set_sample_rate(1.0)
+    yield harness, world, gs
+    stop.set()
+    t.join(timeout=5)
+    gs.stop()
+    harness.stop()
+
+
+async def _ping_script(bot: BotClient):
+    import asyncio
+
+    await bot.connect()
+    asyncio.ensure_future(bot._recv_loop())
+    await asyncio.wait_for(bot.player_ready.wait(), 10)
+    bot.call_server("Ping_Client", "pong")
+    for _ in range(100):
+        if any(m == "OnPing" for _, m, _a in bot.rpc_log):
+            return
+        await asyncio.sleep(0.05)
+    raise AssertionError("Ping RPC round trip never completed")
+
+
+def _spans_by_name(name):
+    return [r for r in tracing.recorder.records() if r[0] == name]
+
+
+def test_e2e_client_rpc_spans_link_across_services(traced_cluster):
+    """ISSUE 2 acceptance: a single traced client RPC appears as
+    causally-linked spans on gate, dispatcher and game tracks sharing
+    one trace_id with correct parentage."""
+    harness, world, gs = traced_cluster
+    host, port = harness.gate_addrs[0]
+    bot = BotClient(host, port)
+    harness.submit(_ping_script(bot)).result(timeout=30)
+
+    # the RPC leg (client -> game) completes before the script returns;
+    # the response leg (events batch -> gate) lands within a tick
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and not _spans_by_name("gate_egress"):
+        time.sleep(0.05)
+
+    rpc_mt = proto.MT_CALL_ENTITY_METHOD_FROM_CLIENT
+    ingress = [r for r in _spans_by_name("gate_ingress")
+               if (r[7] or {}).get("msgtype") == rpc_mt]
+    assert ingress, "no gate_ingress span for the client RPC"
+    gate_span = ingress[-1]
+    trace_id = gate_span[2]
+    assert gate_span[4] is None  # rooted at the gate edge
+
+    routes = [r for r in _spans_by_name("route")
+              if r[2] == trace_id and (r[7] or {}).get("msgtype") == rpc_mt]
+    assert routes, "dispatcher recorded no route span for the trace"
+    assert routes[0][1] == "dispatcher1"
+    assert routes[0][4] == gate_span[3]  # parented to gate_ingress
+
+    handles = [r for r in _spans_by_name("handle")
+               if r[2] == trace_id
+               and (r[7] or {}).get("msgtype") == rpc_mt]
+    assert handles, "game recorded no handle span for the trace"
+    assert handles[0][1] == "game1"
+    assert handles[0][4] == routes[0][3]  # parented to the route span
+
+    invokes = [r for r in _spans_by_name("invoke") if r[2] == trace_id]
+    assert invokes and invokes[0][4] == handles[0][3]
+    assert invokes[0][7]["method"] == "Ping_Client"
+
+    # response leg: the client-events batch rode the SAME trace through
+    # dispatcher (msgtype 1504) to the gate's egress span
+    batch_routes = [r for r in _spans_by_name("route")
+                    if r[2] == trace_id and (r[7] or {}).get("msgtype")
+                    == proto.MT_CLIENT_EVENTS_BATCH]
+    assert batch_routes, "events batch lost the trace at the dispatcher"
+    egress = [r for r in _spans_by_name("gate_egress")
+              if r[2] == trace_id]
+    assert egress, "gate recorded no egress span for the response"
+    assert egress[0][4] == batch_routes[0][3]
+
+
+def test_e2e_merged_cluster_trace_is_perfetto_loadable(traced_cluster):
+    """ISSUE 2 acceptance: the merge tool produces ONE Perfetto JSON
+    from the live cluster with flow arrows linking the hop spans."""
+    import importlib.util
+    import os as _os
+
+    harness, world, gs = traced_cluster
+    host, port = harness.gate_addrs[0]
+    bot = BotClient(host, port)
+    harness.submit(_ping_script(bot)).result(timeout=30)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline \
+            and not _spans_by_name("gate_egress"):
+        time.sleep(0.05)
+
+    spec = importlib.util.spec_from_file_location(
+        "merge_traces",
+        _os.path.join(_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), "tools", "merge_traces.py"),
+    )
+    merger = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(merger)
+
+    srv = debug_http.start(0, process_name="standalone")
+    try:
+        base = f"http://127.0.0.1:{srv.server_address[1]}"
+        merged, errors = merger.collect([("standalone", base)])
+        assert not errors
+        json.dumps(merged)  # loadable JSON
+        events = merged["traceEvents"]
+        tracks = {e["args"]["name"] for e in events
+                  if e["name"] == "thread_name"}
+        assert {"gate1", "dispatcher1", "game1"} <= tracks
+        spans = [e for e in events if e.get("ph") == "X"
+                 and "span_id" in (e.get("args") or {})]
+        names = {e["name"] for e in spans}
+        assert {"gate_ingress", "route", "handle"} <= names
+        # flow arrows were synthesized from the parent/child linkage
+        flow_starts = [e for e in events if e.get("ph") == "s"]
+        flow_ends = [e for e in events if e.get("ph") == "f"]
+        assert flow_starts and len(flow_starts) == len(flow_ends)
+        # every flow id pairs a start with an end
+        assert {e["id"] for e in flow_starts} == \
+            {e["id"] for e in flow_ends}
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_client_supplied_context_is_discarded(traced_cluster):
+    """A client shipping its own (flagged) trace context must not have
+    it honored: no span parents to it, and NOTHING the gate sends back
+    to the client carries the flag bit — the client wire stays clean
+    and the sampling rate cannot be bypassed from outside."""
+    import asyncio
+
+    harness, world, gs = traced_cluster
+    tracing.set_sample_rate(0.0)  # only a honored context could trace
+    tracing.recorder.clear()
+    host, port = harness.gate_addrs[0]
+    rogue = tracing.new_trace()
+
+    async def rogue_heartbeat():
+        reader, writer = await asyncio.open_connection(host, port)
+        p = new_packet(proto.MT_HEARTBEAT)
+        p.trace = rogue
+        writer.write(frame(p))
+        await writer.drain()
+        # read raw frames until the heartbeat echo; every client-bound
+        # frame must have bit 15 clear (boot-flow packets may precede)
+        for _ in range(20):
+            hdr = await asyncio.wait_for(reader.readexactly(4), 10)
+            (size,) = struct.unpack("<I", hdr)
+            body = await asyncio.wait_for(reader.readexactly(size), 10)
+            mt = struct.unpack_from("<H", body)[0]
+            assert mt & TRACE_FLAG == 0, \
+                f"client wire carries trace flag on msgtype {mt}"
+            if mt == proto.MT_HEARTBEAT:
+                break
+        else:
+            raise AssertionError("no heartbeat echo")
+        writer.close()
+
+    harness.submit(rogue_heartbeat()).result(timeout=30)
+    # the rogue context never rooted anything
+    assert all(r[2] != rogue.trace_hex
+               for r in tracing.recorder.records())
+
+
+def test_untraced_cluster_pays_zero_wire_bytes(traced_cluster):
+    """With sampling off mid-run, the gate forwards packets with no
+    flag bit and no trailer (spot-checked at the framing layer by
+    test_untraced_frame_is_byte_identical_to_pre_tracing_wire; here we
+    assert no spans are recorded for unsampled traffic)."""
+    harness, world, gs = traced_cluster
+    tracing.set_sample_rate(0.0)
+    tracing.recorder.clear()
+    host, port = harness.gate_addrs[0]
+    bot = BotClient(host, port)
+    harness.submit(_ping_script(bot)).result(timeout=30)
+    time.sleep(0.3)  # let any (wrongly) traced response leg land
+    assert tracing.recorder.records() == []
