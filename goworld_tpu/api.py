@@ -288,6 +288,17 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
     # process_count() > 1 and runs in multihost mode).
     mh_procs = int(os.environ.get("GOWORLD_MH_PROCS", "1"))
     mh_rank = int(os.environ.get("GOWORLD_MH_PROC_ID", "0"))
+    # deterministic fault injection (ini [deployment] faults/faults_seed,
+    # env GOWORLD_FAULTS/GOWORLD_FAULTS_SEED override; utils/faults.py).
+    # Installed before the world build so timed kill rules cover boot;
+    # multihost ranks get per-rank labels so a kill can target one rank.
+    from goworld_tpu.utils import faults as faults_mod
+
+    faults_mod.install(
+        f"game{gid}" + (f"c{mh_rank}" if mh_procs > 1 else ""),
+        spec=getattr(cfg, "faults", ""),
+        seed=getattr(cfg, "faults_seed", 0),
+    )
     if gid >= consts.MH_FOLLOWER_GAME_ID_BASE:
         raise SystemExit(
             f"game id {gid} collides with the multihost follower id "
@@ -331,15 +342,6 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
     # and replay restore_world SPMD-identically before the network;
     # a crash-recovery checkpoint counts as a snapshot too (watchdog
     # restarts pass -restore after a crash with no fresh freeze file)
-    restoring = args.restore and \
-        freeze_mod.latest_snapshot_path(gid) is not None
-    if not restoring:
-        world.create_nil_space()
-        for cb in _boot_callbacks:
-            try:
-                cb(world)
-            except Exception:
-                logger.exception("on_boot callback failed")
     # follower controllers need their OWN dispatcher identity (the
     # dispatcher keys connections by game id; a duplicate id would be
     # treated as a reconnect and replace the leader's connection) —
@@ -349,18 +351,47 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
         gid if mh_rank == 0
         else consts.MH_FOLLOWER_GAME_ID_BASE + gid * 64 + mh_rank
     )
-    server = GameServer(
-        server_gid, world, cfg.dispatcher_addrs(),
-        boot_entity=gc.boot_entity,
-        # followers never take boot entities directly: the leader alone
-        # represents the group in the dispatcher's boot round-robin, or
-        # the logical game would be weighted once per controller (the
-        # boot itself still replicates group-wide via the mutation log)
-        ban_boot=gc.ban_boot_entity or mh_rank > 0,
-        restore=restoring,
-        checkpoint_interval=gc.checkpoint_interval,
-        gc_freeze_on_boot=gc.gc_freeze,
-    )
+
+    def _mk_server(restore: bool) -> "GameServer":
+        return GameServer(
+            server_gid, world, cfg.dispatcher_addrs(),
+            boot_entity=gc.boot_entity,
+            # followers never take boot entities directly: the leader
+            # alone represents the group in the dispatcher's boot
+            # round-robin, or the logical game would be weighted once
+            # per controller (the boot itself still replicates
+            # group-wide via the mutation log)
+            ban_boot=gc.ban_boot_entity or mh_rank > 0,
+            restore=restore,
+            checkpoint_interval=gc.checkpoint_interval,
+            gc_freeze_on_boot=gc.gc_freeze,
+            pend_max_packets=gc.pend_max_packets,
+            pend_max_bytes=gc.pend_max_bytes,
+        )
+
+    restoring = args.restore and \
+        bool(freeze_mod.snapshot_candidates(gid))
+    server = None
+    if restoring:
+        try:
+            server = _mk_server(True)
+        except freeze_mod.CorruptSnapshotError:
+            # every candidate rejected (restore_from_file reads fully
+            # BEFORE applying, so the world is untouched): degrade to a
+            # loud cold boot instead of a supervisor crash loop
+            logger.exception(
+                "game%d: no snapshot survived corruption checks; "
+                "COLD-BOOTING without restore", gid,
+            )
+            restoring = False
+    if not restoring:
+        world.create_nil_space()
+        for cb in _boot_callbacks:
+            try:
+                cb(world)
+            except Exception:
+                logger.exception("on_boot callback failed")
+        server = _mk_server(False)
     svc = server.setup_services()
     _apply_registrations(world, svc=svc, services_only=True)
 

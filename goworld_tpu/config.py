@@ -17,6 +17,10 @@ import dataclasses
 import os
 
 from goworld_tpu.utils import consts
+from goworld_tpu.utils.consts import (
+    MAX_RECONNECT_PEND_BYTES,
+    MAX_RECONNECT_PEND_PACKETS,
+)
 
 DEFAULT_CONFIG_PATHS = ("goworld_tpu.ini", "goworld.ini")
 
@@ -97,6 +101,11 @@ class GameConfig:
                            # game: the CLI spawns one per rank with a
                            # shared jax.distributed coordinator; ONE
                            # logical game spans them (multihost)
+    # reconnect pend queue budget (net/cluster.py): packets queued while
+    # a dispatcher link is down; beyond either bound the OLDEST drop
+    # (cluster_pend_dropped_total counts them)
+    pend_max_packets: int = MAX_RECONNECT_PEND_PACKETS
+    pend_max_bytes: int = MAX_RECONNECT_PEND_BYTES
     npc_speed: float = 5.0
     behavior: str = "random_walk"  # random_walk | mlp | btree (the fused
                                    # NPC kernels, BASELINE config 5)
@@ -134,8 +143,14 @@ class GateConfig:
     encrypt: bool = False
     tls_cert: str = ""
     tls_key: str = ""
-    heartbeat_timeout: float = 0.0  # 0 = disabled
+    # default ON (a vanished TCP peer — cable pull, NAT expiry — is
+    # reaped without opt-in; the reference ships 60 in its sample ini);
+    # 0 stays the explicit off switch
+    heartbeat_timeout: float = 30.0
     position_sync_interval_ms: int = 100
+    # reconnect pend queue budget (net/cluster.py; drop-oldest beyond)
+    pend_max_packets: int = MAX_RECONNECT_PEND_PACKETS
+    pend_max_bytes: int = MAX_RECONNECT_PEND_BYTES
     http_port: int = 0        # debug/metrics endpoint (0 = off); every
                               # process kind serves the same /metrics +
                               # /trace map (docs/OBSERVABILITY.md)
@@ -164,6 +179,10 @@ class KVDBConfig:
 @dataclasses.dataclass
 class ClusterConfig:
     entry: str = "server.py"   # game script ([deployment] entry = ...)
+    # deterministic fault injection ([deployment] faults / faults_seed;
+    # grammar in docs/ROBUSTNESS.md; env GOWORLD_FAULTS[_SEED] override)
+    faults: str = ""
+    faults_seed: int = 0
     dispatchers: dict[int, DispatcherConfig] = dataclasses.field(
         default_factory=dict)
     games: dict[int, GameConfig] = dataclasses.field(default_factory=dict)
@@ -244,6 +263,9 @@ def load(path: str | None = None) -> ClusterConfig:
         dep = cp["deployment"]
         if "entry" in dep:
             cfg.entry = dep["entry"]
+        cfg.faults = dep.get("faults", cfg.faults)
+        if "faults_seed" in dep:
+            cfg.faults_seed = int(dep["faults_seed"])
         # reference semantics: [deployment] declares DESIRED COUNTS
         # (read_config.go:40-118): counts beyond the explicit numbered
         # sections auto-create defaults from the *_common section, and
@@ -348,6 +370,12 @@ def dumps_sample() -> str:
 # Every process reads this same file; numbered sections declare the
 # deployment (their count is the readiness barrier).
 
+# [deployment]
+# faults = drop:gate->dispatcher:0.05,kill:game1@t+10s
+#                    # seeded fault-injection schedule (chaos testing;
+# faults_seed = 42   # grammar in docs/ROBUSTNESS.md; env
+#                    # GOWORLD_FAULTS / GOWORLD_FAULTS_SEED override)
+
 [dispatcher1]
 host = 127.0.0.1
 port = 14000
@@ -379,6 +407,7 @@ extent_z = 1000.0
 [gate_common]
 host = 127.0.0.1
 compress = false
+# heartbeat reaping defaults to 30 when omitted; 0 = explicit off
 heartbeat_timeout = 60
 
 [gate1]

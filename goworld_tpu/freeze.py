@@ -28,11 +28,19 @@ import numpy as np
 from goworld_tpu.entity.entity import Entity, GameClient
 from goworld_tpu.entity.manager import World
 from goworld_tpu.entity.space import Space
-from goworld_tpu.utils import log
+from goworld_tpu.utils import faults, log
 
 logger = log.get("freeze")
 
 FREEZE_FORMAT_VERSION = 1
+
+
+class CorruptSnapshotError(RuntimeError):
+    """A freeze/checkpoint file exists but cannot be parsed (truncated
+    write, disk fault, crash before the atomic rename of a pre-1 format
+    writer). The restore path REJECTS such a file whole — half-loading a
+    world is worse than falling back to an older snapshot or a cold
+    boot."""
 
 
 def freeze_filename(game_id: int) -> str:
@@ -257,13 +265,29 @@ def write_freeze_file(path: str, data: dict) -> None:
         f.write(blob)
         f.flush()
         os.fsync(f.fileno())
+    # chaos crashpoint (`crash:freeze.write:...`): dying HERE — after
+    # the tmp write, before the rename — models the worst mid-freeze
+    # crash; the invariant under test is that only the .tmp is left and
+    # the -restore boot falls back instead of half-loading
+    faults.maybe_crash("freeze.write")
     os.replace(tmp, path)
     logger.info("froze %d bytes -> %s", len(blob), path)
 
 
 def read_freeze_file(path: str) -> dict:
     with open(path, "rb") as f:
-        return msgpack.unpackb(f.read(), raw=False, strict_map_key=False)
+        raw = f.read()
+    try:
+        data = msgpack.unpackb(raw, raw=False, strict_map_key=False)
+    except Exception as exc:
+        raise CorruptSnapshotError(
+            f"snapshot {path!r} is corrupt ({len(raw)} bytes): {exc}"
+        ) from exc
+    if not isinstance(data, dict) or "version" not in data:
+        raise CorruptSnapshotError(
+            f"snapshot {path!r} parsed but is not a freeze record"
+        )
+    return data
 
 
 def freeze_to_file(world: World, directory: str = ".") -> str:
@@ -272,41 +296,70 @@ def freeze_to_file(world: World, directory: str = ".") -> str:
     return path
 
 
-def latest_snapshot_path(game_id: int, directory: str = ".") -> str | None:
-    """The freshest restorable snapshot for a game: the NEWER (by mtime)
-    of the freeze file (intentional reload) and the periodic crash-
-    recovery checkpoint. Mtime decides because either can be stale —
-    a freeze file left over from an old reload must not shadow hours of
-    newer checkpoints after a crash, and vice versa."""
-    cands = [
-        os.path.join(directory, freeze_filename(game_id)),
-        os.path.join(directory, checkpoint_filename(game_id)),
-    ]
-    best, best_m = None, -1.0
-    for p in cands:
+def snapshot_candidates(game_id: int, directory: str = ".") -> list[str]:
+    """Existing snapshot files for a game, freshest (by mtime) first:
+    the freeze file (intentional reload) and the periodic crash-recovery
+    checkpoint. Mtime orders because either can be stale — a freeze file
+    left over from an old reload must not shadow hours of newer
+    checkpoints after a crash, and vice versa."""
+    cands = []
+    for p in (os.path.join(directory, freeze_filename(game_id)),
+              os.path.join(directory, checkpoint_filename(game_id))):
         try:
-            m = os.path.getmtime(p)
+            cands.append((os.path.getmtime(p), p))
         except OSError:
             continue
-        if m > best_m:
-            best, best_m = p, m
-    return best
+    return [p for _, p in sorted(cands, reverse=True)]
+
+
+def latest_snapshot_path(game_id: int, directory: str = ".") -> str | None:
+    cands = snapshot_candidates(game_id, directory)
+    return cands[0] if cands else None
+
+
+def has_restorable_snapshot(game_id: int, directory: str = ".") -> bool:
+    """True when at least one snapshot candidate PARSES. The boot path
+    decides restore-vs-cold on this, so an all-corrupt snapshot set
+    degrades to a loud cold boot instead of a supervisor crash loop."""
+    for path in snapshot_candidates(game_id, directory):
+        try:
+            read_freeze_file(path)
+            return True
+        except CorruptSnapshotError as exc:
+            logger.error("ignoring unrestorable snapshot: %s", exc)
+    return False
 
 
 def restore_from_file(world: World, directory: str = ".") -> None:
-    """Restore for a ``-restore`` boot from the freshest snapshot
-    (:func:`latest_snapshot_path`): a freeze file written by a reload,
-    or a crash-recovery checkpoint written by the periodic cadence —
-    the capability the reference lacks (a crashed, unfrozen game there
-    loses everything since the last persistence save; SURVEY.md §5.3)."""
-    path = latest_snapshot_path(world.game_id, directory)
-    if path is None:
+    """Restore for a ``-restore`` boot from the freshest PARSEABLE
+    snapshot (:func:`snapshot_candidates`): a freeze file written by a
+    reload, or a crash-recovery checkpoint written by the periodic
+    cadence — the capability the reference lacks (a crashed, unfrozen
+    game there loses everything since the last persistence save;
+    SURVEY.md §5.3). A corrupt candidate (truncated write, disk fault)
+    is rejected WHOLE and the next-freshest tried — recovery invariant:
+    a damaged snapshot may cost freshness, never a half-loaded world or
+    a supervisor crash loop."""
+    cands = snapshot_candidates(world.game_id, directory)
+    if not cands:
         raise FileNotFoundError(
             f"no freeze or checkpoint snapshot for game{world.game_id} "
             f"in {directory!r}"
         )
+    data = None
+    for path in cands:
+        try:
+            data = read_freeze_file(path)
+            break
+        except CorruptSnapshotError as exc:
+            logger.error("rejecting snapshot: %s", exc)
+    if data is None:
+        raise CorruptSnapshotError(
+            f"every snapshot candidate for game{world.game_id} is "
+            f"corrupt: {cands}"
+        )
     logger.info("restoring game%d from %s", world.game_id, path)
-    restore_world(world, read_freeze_file(path))
+    restore_world(world, data)
 
 
 # =======================================================================

@@ -3,11 +3,17 @@
 Reference being rebuilt: ``engine/kvdb`` (``kvdb.go:42-200``): a cluster-
 global KV store with pluggable backends, all ops running on a dedicated
 async group (``_kvdb``) with callbacks posted to the logic thread:
-``Get/Put/GetOrPut/GetRange/NextLargerKey``. Backends here: ``redis``
-(networked RESP, reference ``kvdb/backend/kvdbredis``), ``filesystem``
-(single msgpack file with ordered keys) and ``memory``; the interface
-matches the reference's backend iface (``kvdb/types/kvdb_types.go``) so
-a mongo/redis-cluster backend can slot in where a driver exists.
+``Get/Put/GetOrPut/GetRange/NextLargerKey``. Backends here (matching the
+reference's backend iface, ``kvdb/types/kvdb_types.go``): ``memory``,
+``filesystem`` (single msgpack file with ordered keys), ``redis``
+(networked RESP, reference ``kvdb/backend/kvdbredis``),
+``redis_cluster`` (slot-map + MOVED/ASK redirect client, the
+``kvdbrediscluster`` role) and ``mongodb`` (BSON/OP_MSG wire, the
+``kvdb_mongodb`` layout) — the networked ones ride the from-scratch wire
+clients in :mod:`goworld_tpu.ext.db`, no drivers required. Transient
+backend errors are retried with capped exponential backoff before the
+error reaches the caller's callback (``kvdb_retry_total`` counts
+retries; see docs/ROBUSTNESS.md).
 """
 
 from __future__ import annotations
@@ -20,12 +26,21 @@ from typing import Callable
 
 import msgpack
 
-from goworld_tpu.utils import log, metrics, opmon
+from goworld_tpu.utils import faults, log, metrics, opmon
 from goworld_tpu.utils.asyncwork import AsyncWorkers
 
 logger = log.get("kvdb")
 
 _GROUP = "_kvdb"  # dedicated worker group (reference kvdb.go:42)
+
+# transient-error retry policy for backend ops: bounded attempts under a
+# wall-clock budget with exponential backoff — a blip on the redis/mongo
+# link must not surface as an op error, but a dead backend must fail the
+# callback instead of wedging the single _kvdb worker forever
+RETRY_ATTEMPTS = 3
+RETRY_BASE_DELAY = 0.05
+RETRY_DEADLINE = 5.0
+_TRANSIENT = (ConnectionError, TimeoutError, OSError)
 
 
 class KVDBBackend:
@@ -387,14 +402,52 @@ class KVDB:
                                   help="kvdb backend op latency")
             for op in ("get", "put", "get_or_put", "get_range")
         }
+        self._m_retry = {
+            op: metrics.counter("kvdb_retry_total", op=op,
+                                help="kvdb ops retried after a "
+                                     "transient backend error")
+            for op in ("get", "put", "get_or_put", "get_range")
+        }
+        self._m_err = metrics.counter(
+            "kvdb_op_errors_total",
+            help="kvdb ops that exhausted retries")
 
     def _timed(self, op: str, fn: Callable):
+        """Timing + bounded-retry shim around one backend op. Transient
+        errors (ConnectionError/TimeoutError/OSError — including
+        injected ``err:kvdb.*`` faults) retry with exponential backoff
+        until RETRY_ATTEMPTS or the RETRY_DEADLINE budget runs out, then
+        surface through the callback like any other op error."""
         hist = self._hists[op]
+        retry = self._m_retry[op]
 
         def job():
+            deadline = time.perf_counter() + RETRY_DEADLINE
+            # the histogram records PER-ATTEMPT backend latency (the
+            # last attempt's, success or final failure) — folding the
+            # backoff sleeps in would make kvdb_op_ms report injected
+            # wait, not backend behavior
             t0 = time.perf_counter()
             try:
-                return fn()
+                for attempt in range(RETRY_ATTEMPTS):
+                    t0 = time.perf_counter()
+                    try:
+                        faults.maybe_op_fault("kvdb", op)
+                        return fn()
+                    except _TRANSIENT as exc:
+                        delay = RETRY_BASE_DELAY * (2 ** attempt)
+                        if attempt + 1 >= RETRY_ATTEMPTS \
+                                or time.perf_counter() + delay > deadline:
+                            self._m_err.inc()
+                            logger.error(
+                                "kvdb %s failed after %d attempts: %s",
+                                op, attempt + 1, exc,
+                            )
+                            raise
+                        retry.inc()
+                        logger.warning("kvdb %s transient error (%s); "
+                                       "retry %d", op, exc, attempt + 1)
+                        time.sleep(delay)
             finally:
                 dt = time.perf_counter() - t0
                 hist.observe(dt * 1e3)

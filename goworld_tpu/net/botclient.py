@@ -190,6 +190,13 @@ class WSPacketConnection:
         except Exception:
             pass
 
+    @property
+    def closed(self) -> bool:
+        # mirror PacketConnection.closed (the bot's heartbeat loop
+        # keys its liveness check on it); the underlying websocket may
+        # also be closed by the peer without close() ever being called
+        return self._closed or not getattr(self.ws, "open", True)
+
 
 class BotClient:
     """One bot: connects, waits for its player entity, random-walks.
@@ -233,9 +240,32 @@ class BotClient:
         self.errors: list[str] = []
         self.profiler = profiler
         self._stop = False
+        self._hb_task: asyncio.Task | None = None
+
+    # periodic client heartbeat (reference ClientBot sends heartbeats on
+    # a timer): keeps a quiet bot alive under the gate's default
+    # heartbeat_timeout (30 s reap; docs/ROBUSTNESS.md). Well under half
+    # the timeout so one lost heartbeat never kicks the session.
+    HEARTBEAT_INTERVAL = 10.0
+
+    async def _heartbeat_loop(self) -> None:
+        try:
+            while not self._stop and self.conn is not None \
+                    and not getattr(self.conn, "closed", False):
+                try:
+                    self.send_heartbeat()
+                except Exception:
+                    return  # transport gone (e.g. closed websocket)
+                await asyncio.sleep(self.HEARTBEAT_INTERVAL)
+        except asyncio.CancelledError:
+            pass
 
     # ------------------------------------------------------------------
     async def connect(self) -> None:
+        await self._connect_transport()
+        self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+
+    async def _connect_transport(self) -> None:
         if self.ws:
             import websockets
 
@@ -277,6 +307,8 @@ class BotClient:
             self._stop = True
             move.cancel()
             recv.cancel()
+            if self._hb_task is not None:
+                self._hb_task.cancel()
             await self.conn.close()
 
     async def _recv_loop(self) -> None:

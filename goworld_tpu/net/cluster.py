@@ -15,10 +15,11 @@ sharded star consistent.
 from __future__ import annotations
 
 import asyncio
+from collections import deque
 from typing import Awaitable, Callable
 
 from goworld_tpu.net.packet import Packet, PacketConnection, wire_payload
-from goworld_tpu.utils import log
+from goworld_tpu.utils import consts, log, metrics
 
 logger = log.get("cluster")
 
@@ -53,14 +54,33 @@ class DispatcherConn:
         on_packet: Callable[[int, int, Packet], None],
         handshake: Callable[["DispatcherConn"], Awaitable[None]],
         reconnect_delay: float = 1.0,
+        edge: str = "",
+        pend_max_packets: int = consts.MAX_RECONNECT_PEND_PACKETS,
+        pend_max_bytes: int = consts.MAX_RECONNECT_PEND_BYTES,
     ):
         self.index = index
         self.addr = addr
         self.on_packet = on_packet
         self.handshake = handshake
         self.reconnect_delay = reconnect_delay
+        self.edge = edge  # fault-injection label (utils/faults.py)
         self.conn: PacketConnection | None = None
-        self._pending: list[bytes] = []
+        # reconnect pend queue, BOUNDED by a packet + byte budget with a
+        # drop-oldest policy: a long dispatcher outage must degrade to
+        # bounded message loss (counted below), never to unbounded
+        # process growth. Oldest-first because queued cluster messages
+        # age badly — the census re-handshake on reconnect re-asserts
+        # current state anyway.
+        self._pending: deque[bytes] = deque()
+        self._pending_bytes = 0
+        self.pend_max_packets = pend_max_packets
+        self.pend_max_bytes = pend_max_bytes
+        self._m_pend_dropped = metrics.counter(
+            "cluster_pend_dropped_total",
+            help="queued-while-disconnected packets dropped on overflow",
+            dispatcher=str(index),
+        )
+        self._pend_warned = False
         self.connected = asyncio.Event()
         self._stopped = False
         # fired on every connection loss (before the reconnect sleep);
@@ -76,17 +96,22 @@ class DispatcherConn:
             except OSError:
                 await asyncio.sleep(self.reconnect_delay)
                 continue
-            self.conn = PacketConnection(reader, writer)
+            self.conn = PacketConnection(reader, writer, edge=self.edge)
             try:
                 await self.handshake(self)
-                for raw in self._pending:
-                    self.conn.send(Packet(raw), release=False)
-                self._pending.clear()
+                while self._pending:
+                    self.conn.send(Packet(self._pending.popleft()),
+                                   release=False)
+                self._pending_bytes = 0
+                self._pend_warned = False
                 self.connected.set()
                 while True:
                     msgtype, pkt = await self.conn.recv()
                     self.on_packet(self.index, msgtype, pkt)
-            except (asyncio.IncompleteReadError, ConnectionError, OSError):
+            except (EOFError, ConnectionError, OSError):
+                # EOFError also covers a malformed/truncated packet
+                # whose decode underran (IncompleteReadError is an
+                # EOFError subclass): sever + reconnect, never wedge
                 pass
             finally:
                 self.connected.clear()
@@ -108,7 +133,23 @@ class DispatcherConn:
             # wire_payload keeps a trace trailer through the reconnect
             # queue (byte-identical to p.buf when untraced); the flush
             # sends the stored bytes verbatim
-            self._pending.append(wire_payload(p))
+            raw = wire_payload(p)
+            self._pending.append(raw)
+            self._pending_bytes += len(raw)
+            while self._pending and (
+                len(self._pending) > self.pend_max_packets
+                or self._pending_bytes > self.pend_max_bytes
+            ):
+                self._pending_bytes -= len(self._pending.popleft())
+                self._m_pend_dropped.inc()
+                if not self._pend_warned:
+                    self._pend_warned = True
+                    logger.warning(
+                        "dispatcher%d reconnect queue over budget "
+                        "(%d pkts / %d B): dropping oldest (counted in "
+                        "cluster_pend_dropped_total)", self.index,
+                        self.pend_max_packets, self.pend_max_bytes,
+                    )
             if release:
                 p.release()
 
@@ -124,9 +165,14 @@ class DispatcherCluster:
         addrs: list[tuple[str, int]],
         on_packet: Callable[[int, int, Packet], None],
         handshake: Callable[[DispatcherConn], Awaitable[None]],
+        edge: str = "",
+        pend_max_packets: int = consts.MAX_RECONNECT_PEND_PACKETS,
+        pend_max_bytes: int = consts.MAX_RECONNECT_PEND_BYTES,
     ):
         self.conns = [
-            DispatcherConn(i, a, on_packet, handshake)
+            DispatcherConn(i, a, on_packet, handshake, edge=edge,
+                           pend_max_packets=pend_max_packets,
+                           pend_max_bytes=pend_max_bytes)
             for i, a in enumerate(addrs)
         ]
         self._tasks: list[asyncio.Task] = []

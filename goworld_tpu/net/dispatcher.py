@@ -149,6 +149,20 @@ class DispatcherService:
         # touching per-entity Python
         self._blocked_until: dict[bytes, float] = {}
         self.open_conns: set[PacketConnection] = set()
+        # boot requests that arrived while NO game was live (mid-crash /
+        # mid-restart window): a silently dropped boot leaves the client
+        # hanging forever, so queue bounded (with a TTL — a client that
+        # gave up and disconnected during a long outage must not mint an
+        # orphan entity when a game finally returns) and flush on the
+        # next game handshake (chaos finding: a client connecting in the
+        # ~200 ms between game death and supervised restart never got a
+        # world). Entries carry the client id so a disconnect CANCELS
+        # the parked boot (a client that gave up must not mint an
+        # orphan entity when a game returns seconds later).
+        self._boot_pending: deque[tuple[float, str, Packet]] = deque()
+        self._m_boot_queued = metrics.counter(
+            "dispatcher_boot_queued_total",
+            help="boot requests queued while no game was live")
         self.started = asyncio.Event()
         # per-msgtype route counters (debug_http /metrics): children of
         # one ``dispatcher_route_total`` family, cached by msgtype so
@@ -203,7 +217,11 @@ class DispatcherService:
                 msgtype, pkt = await conn.recv()
                 role = self._handle_packet(conn, role, msgtype, pkt)
                 await conn.drain()
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        except (EOFError, ConnectionError, OSError):
+            # EOFError (superset of IncompleteReadError) also covers a
+            # truncated/corrupt packet underrunning its handler: drop
+            # the connection (the peer reconnects + re-handshakes)
+            # instead of killing the serve task
             pass
         finally:
             self.open_conns.discard(conn)
@@ -248,6 +266,7 @@ class DispatcherService:
         if msgtype == proto.MT_SET_GATE_ID:
             gate_id = pkt.read_u16()
             self.gates[gate_id] = conn
+            conn.edge = "dispatcher->gate"  # fault-injection label
             logger.info("dispatcher%d: gate%d connected", self.id, gate_id)
             self._check_deployment_ready()
             return ("gate", gate_id)
@@ -304,6 +323,7 @@ class DispatcherService:
         if gi is None:
             gi = self.games[game_id] = _GameInfo(game_id)
         gi.conn = conn
+        conn.edge = "dispatcher->game"  # fault-injection label
         gi.ban_boot = ban_boot
         gi.blocked_until = 0.0
 
@@ -328,6 +348,7 @@ class DispatcherService:
         ))
         conn.send(ack)
         gi.flush_pending()
+        self._flush_boot_pending()
         logger.info(
             "dispatcher%d: game%d connected (reconnect=%s restore=%s, "
             "%d entities)", self.id, game_id, is_reconnect, is_restore,
@@ -488,19 +509,77 @@ class DispatcherService:
         pkt.rpos = 2
         gi.send(pkt, release=False)
 
+    BOOT_PENDING_MAX = 1024
+    BOOT_PENDING_TTL = 30.0  # s; past this the client has long given up
+
     def _h_client_connected(self, conn, role, msgtype, pkt: Packet) -> None:
         boot_eid = pkt.read_entity_id()
         gi = self._choose_game(boot=True)
         if gi is None:
-            logger.error("dispatcher%d: no game for boot entity", self.id)
+            if len(self._boot_pending) < self.BOOT_PENDING_MAX:
+                client_id = pkt.read_entity_id()
+                q = Packet(bytes(pkt.buf))
+                q.trace = pkt.trace
+                self._boot_pending.append(
+                    (time.monotonic(), client_id, q))
+                self._m_boot_queued.inc()
+                logger.warning(
+                    "dispatcher%d: no game for boot entity; queued "
+                    "(%d pending)", self.id, len(self._boot_pending),
+                )
+            else:
+                logger.error(
+                    "dispatcher%d: no game for boot entity and queue "
+                    "full; dropped", self.id,
+                )
             return
         self._entity_info(boot_eid).game_id = gi.game_id
         pkt.rpos = 2
         gi.send(pkt, release=False)
 
+    def _flush_boot_pending(self) -> None:
+        """Re-route boot requests parked during a zero-game outage (a
+        game just handshaked, so re-choosing usually finds one).
+        Entries older than the TTL are expired instead: their clients
+        disconnected long ago and would only become orphan entities
+        with dead client bindings. A still-unroutable entry (the new
+        game has ban_boot) is RE-PARKED with its original timestamp so
+        the TTL keeps counting and the queued metric stays one-per-
+        arrival."""
+        if not self._boot_pending:
+            return
+        pending, self._boot_pending = list(self._boot_pending), deque()
+        now = time.monotonic()
+        routed = expired = 0
+        for t, cid, q in pending:
+            if now - t > self.BOOT_PENDING_TTL:
+                expired += 1
+                continue
+            gi = self._choose_game(boot=True)
+            if gi is None:
+                self._boot_pending.append((t, cid, q))
+                continue
+            q.rpos = 2
+            boot_eid = q.read_entity_id()
+            self._entity_info(boot_eid).game_id = gi.game_id
+            q.rpos = 2
+            gi.send(q, release=False)
+            routed += 1
+        logger.info(
+            "dispatcher%d: routed %d queued boot requests "
+            "(%d expired, %d re-parked)",
+            self.id, routed, expired, len(self._boot_pending),
+        )
+
     def _h_client_disconnected(self, conn, role, msgtype, pkt: Packet) -> None:
-        pkt.read_entity_id()  # client id
+        client_id = pkt.read_entity_id()
         owner = pkt.read_var_str()
+        if self._boot_pending:
+            # cancel any parked boot for this client: it gave up during
+            # the zero-game window and must not mint an orphan entity
+            self._boot_pending = deque(
+                e for e in self._boot_pending if e[1] != client_id
+            )
         pkt.rpos = 2
         if owner and owner in self.entities:
             self._dispatch_to_entity(owner, pkt)

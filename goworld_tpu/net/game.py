@@ -31,7 +31,7 @@ from goworld_tpu.entity.manager import World
 from goworld_tpu.net import codec, proto
 from goworld_tpu.net.cluster import DispatcherCluster, DispatcherConn
 from goworld_tpu.net.packet import Packet, new_packet
-from goworld_tpu.utils import consts, log, metrics, opmon, tracing
+from goworld_tpu.utils import consts, faults, log, metrics, opmon, tracing
 
 logger = log.get("game")
 
@@ -94,6 +94,8 @@ class GameServer:
         restore: bool = False,
         checkpoint_interval: float = 0.0,
         gc_freeze_on_boot: bool = True,
+        pend_max_packets: int = consts.MAX_RECONNECT_PEND_PACKETS,
+        pend_max_bytes: int = consts.MAX_RECONNECT_PEND_BYTES,
     ):
         self.game_id = game_id
         self.world = world
@@ -118,7 +120,10 @@ class GameServer:
         self._packet_q: "queue.Queue[tuple[int, int, Packet]]" = \
             queue.Queue(maxsize=consts.MAX_PENDING_PACKETS_PER_GAME)
         self.cluster = DispatcherCluster(
-            dispatcher_addrs, self._on_packet_netthread, self._handshake
+            dispatcher_addrs, self._on_packet_netthread, self._handshake,
+            edge="game->dispatcher",
+            pend_max_packets=pend_max_packets,
+            pend_max_bytes=pend_max_bytes,
         )
         self._loop: asyncio.AbstractEventLoop | None = None
         self._net_thread: threading.Thread | None = None
@@ -231,6 +236,11 @@ class GameServer:
         next_tick = time.monotonic()
         tl = metrics.timeline
         while not self._stop.is_set():
+            if faults.active:
+                # chaos crashpoint: `crash:game.tick@n=N` dies at the
+                # Nth serve-loop iteration (deterministic, unlike a
+                # wall-clock kill racing the boot compile)
+                faults.maybe_crash("game.tick")
             # the serve loop owns the tick record: the pump and fan-out
             # spans land in the same trace row as the World's phases
             tl.begin_tick()

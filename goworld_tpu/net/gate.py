@@ -30,7 +30,8 @@ from goworld_tpu.net.packet import (
     frame,
     new_packet,
 )
-from goworld_tpu.utils import ids, log, metrics, opmon, tracing
+from goworld_tpu.utils import consts, faults, ids, log, metrics, opmon, \
+    tracing
 
 logger = log.get("gate")
 
@@ -110,6 +111,8 @@ class GateService:
         compress_codec: str = "snappy",
         ssl_context=None,
         exit_on_dispatcher_loss: bool = True,
+        pend_max_packets: int = consts.MAX_RECONNECT_PEND_PACKETS,
+        pend_max_bytes: int = consts.MAX_RECONNECT_PEND_BYTES,
     ):
         self.gate_id = gate_id
         self.host = host
@@ -136,7 +139,10 @@ class GateService:
         self.clients: dict[str, ClientProxy] = {}
         self.filter_index = FilterIndex()
         self.cluster = DispatcherCluster(
-            dispatcher_addrs, self._on_dispatcher_packet, self._handshake
+            dispatcher_addrs, self._on_dispatcher_packet, self._handshake,
+            edge="gate->dispatcher",
+            pend_max_packets=pend_max_packets,
+            pend_max_bytes=pend_max_bytes,
         )
         # a gate that lost a dispatcher is routing into a black hole:
         # the reference kills itself and lets the supervisor restart it
@@ -197,6 +203,10 @@ class GateService:
                 self._handle_client, self.host,
                 max(self.kcp_port, 0),
                 idle_timeout=self.kcp_idle_timeout,
+                # datagram-level fault injection (drop rules on the
+                # gate->client edge exercise the KCP ARQ/retransmit
+                # path; utils/faults.py)
+                loss_hook=faults.kcp_loss_hook("gate->client"),
             )
         self.started.set()
         logger.info("gate%d listening on %s:%d", self.gate_id, self.host,
@@ -241,7 +251,8 @@ class GateService:
     # -- client side -----------------------------------------------------
     async def _handle_client(self, reader, writer) -> None:
         conn = PacketConnection(reader, writer, compress=self.compress,
-                                compress_codec=self.compress_codec)
+                                compress_codec=self.compress_codec,
+                                edge="gate->client")
         cp = ClientProxy(conn)
         cp.last_heartbeat = asyncio.get_event_loop().time()
         self.clients[cp.client_id] = cp
@@ -263,7 +274,10 @@ class GateService:
                     self._handle_client_packet(cp, msgtype, pkt)
                 self._m_handle_ms.observe(
                     (time.perf_counter() - t0) * 1e3)
-        except (asyncio.IncompleteReadError, ConnectionError, OSError):
+        except (EOFError, ConnectionError, OSError):
+            # EOFError (superset of IncompleteReadError) also covers a
+            # malformed client packet underrunning its handler: kick
+            # the client instead of killing the serve task
             pass
         finally:
             await conn.close()

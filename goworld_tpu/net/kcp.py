@@ -523,7 +523,10 @@ class _Session:
     def __init__(self, conv: int, transport, addr, loss_hook=None):
         def output(datagram: bytes) -> None:
             if loss_hook is not None and loss_hook(datagram):
-                return                       # test-injected packet loss
+                # injected packet loss: unit tests pass ad-hoc hooks;
+                # the gate wires faults.kcp_loss_hook so a seeded chaos
+                # schedule (drop:gate->client:p) exercises the ARQ path
+                return
             try:
                 transport.sendto(datagram, addr)
             except OSError:

@@ -23,7 +23,7 @@ from typing import Any
 
 import msgpack
 
-from goworld_tpu.utils import tracing
+from goworld_tpu.utils import faults, tracing
 from goworld_tpu.utils.ids import ENTITYID_LENGTH
 
 MAX_PAYLOAD_LENGTH = 32 * 1024 * 1024  # defensive cap (reference 16M-ish)
@@ -262,9 +262,14 @@ class PacketConnection:
         *,
         compress: bool = False,
         compress_codec: str = "snappy",
+        edge: str = "",
     ):
         self.reader = reader
         self.writer = writer
+        # fault-injection edge label ("game->dispatcher", ...): owners
+        # set it so the seeded fault plane (utils/faults.py) can match
+        # wire rules against this connection; "" = never injected
+        self.edge = edge
         self.compress = compress
         if compress:
             if compress_codec == "snappy":
@@ -301,12 +306,66 @@ class PacketConnection:
                     payload = self._comp.compress(raw) \
                         + self._comp.flush(zlib.Z_SYNC_FLUSH)
                 self.writer.write(_SIZE_FMT.pack(len(payload)) + payload)
+            elif faults.active and self.edge \
+                    and self._faulted_send(p):
+                pass  # the fault consumed (or rewrote) the packet
             else:
                 self.writer.write(frame(p))
         except (ConnectionError, RuntimeError):
             self._closed = True
         if release:
             p.release()
+
+    def _faulted_send(self, p: Packet) -> bool:
+        """Apply a seeded wire fault to this send, if one fires.
+        Returns True when the fault handled the packet (the normal
+        write must be skipped). Only the uncompressed path is injected:
+        stream compression shares codec state with the peer, so
+        byte-level tampering there models a codec bug, not a network
+        fault."""
+        mt = ((p.buf[0] | (p.buf[1] << 8)) & MSGTYPE_MASK
+              if len(p.buf) >= 2 else 0)
+        rule = faults.plane.wire_fault(self.edge, mt, trace_ctx=p.trace)
+        if rule is None:
+            return False
+        if rule.kind == "drop":
+            return True
+        payload = wire_payload(p)
+        data = _SIZE_FMT.pack(len(payload)) + payload
+        if rule.kind == "dup":
+            self.writer.write(data)
+            self.writer.write(data)
+            return True
+        if rule.kind == "truncate":
+            # a consistently-framed but cut-short payload: the peer's
+            # decoder sees a malformed packet (size < 2 or a handler
+            # underrun) and severs the connection — the corruption
+            # recovery path, not a stream desync
+            cut = payload[: len(payload) // 2]
+            self.writer.write(_SIZE_FMT.pack(len(cut)) + cut)
+            return True
+        if rule.kind == "disconnect":
+            self._closed = True
+            try:
+                self.writer.transport.abort()
+            except (AttributeError, RuntimeError):
+                self.writer.close()
+            return True
+        if rule.kind == "delay":
+            try:
+                loop = asyncio.get_running_loop()
+            except RuntimeError:
+                return False  # no loop (unit context): send normally
+
+            def _late_write(w=self.writer, d=data):
+                try:
+                    w.write(d)
+                except (ConnectionError, RuntimeError):
+                    pass
+
+            loop.call_later(rule.delay_s, _late_write)
+            return True
+        return False
 
     async def drain(self) -> None:
         if not self._closed:

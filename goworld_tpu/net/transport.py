@@ -15,6 +15,13 @@ The third client transport, KCP (reliable-UDP tuned for latency,
 :mod:`goworld_tpu.net.kcp` — same wire protocol as the reference's
 kcp-go dependency, adapted to the (reader, writer) seam so
 PacketConnection runs unchanged over it.
+
+Fault injection (:mod:`goworld_tpu.utils.faults`, docs/ROBUSTNESS.md)
+wraps these boundaries one layer up: wire faults apply at
+``PacketConnection.send`` above the TLS/compression stream (tampering
+inside a negotiated stream would model a codec bug, not a network
+fault), and the KCP edge drops whole datagrams through
+``KcpServer``'s ``loss_hook`` so the ARQ path is what gets exercised.
 """
 
 from __future__ import annotations

@@ -25,12 +25,18 @@ from typing import Any, Callable
 
 import msgpack
 
-from goworld_tpu.utils import log, metrics, opmon
+from goworld_tpu.utils import faults, log, metrics, opmon
 
 logger = log.get("storage")
 
-SAVE_RETRY_DELAY = 1.0
+SAVE_RETRY_DELAY = 1.0    # backoff base: saves retry FOREVER (entity
+SAVE_RETRY_MAX = 30.0     # data must not be lost), but with capped
+                          # exponential backoff so a dead backend is not
+                          # hammered once per second for hours
+READ_RETRY_ATTEMPTS = 3   # loads/exists/lists retry transient errors a
+READ_RETRY_DELAY = 0.05   # bounded number of times before reporting None
 WARN_QUEUE_LEN = 100  # reference storage.go:102-110
+_TRANSIENT = (ConnectionError, TimeoutError, OSError)
 
 
 class EntityStorageBackend:
@@ -212,6 +218,12 @@ class Storage:
         }
         self._m_queue = metrics.gauge(
             "storage_queue_depth", help="pending storage ops")
+        self._m_retry = metrics.counter(
+            "storage_retry_total",
+            help="storage ops retried after a backend error")
+        self._m_err = metrics.counter(
+            "storage_op_errors_total",
+            help="non-save storage ops that exhausted retries")
         self._thread = threading.Thread(
             target=self._run, name="storage", daemon=True
         )
@@ -278,9 +290,14 @@ class Storage:
 
     def _execute(self, op: tuple) -> None:
         kind, type_name, eid, data, cb = op
-        t0 = time.perf_counter()
+        attempt = 0
         while True:
+            # per-ATTEMPT timing (like the kvdb shim): folding the
+            # retry backoff sleeps into storage_op_ms would report
+            # injected wait, not backend latency
+            t0 = time.perf_counter()
             try:
+                faults.maybe_op_fault("storage", kind)
                 if kind == "save":
                     self.backend.write(type_name, eid, data)
                     res: Any = None
@@ -291,15 +308,36 @@ class Storage:
                 else:
                     res = self.backend.list_entity_ids(type_name)
                 break
-            except Exception:
+            except Exception as exc:
+                attempt += 1
                 if kind == "save":
                     # saves retry forever: losing entity data is worse
                     # than blocking the queue (reference storageRoutine)
+                    # — but back off exponentially (capped) so a dead
+                    # backend isn't hammered at a fixed cadence
+                    self._m_retry.inc()
+                    delay = min(SAVE_RETRY_MAX,
+                                SAVE_RETRY_DELAY * 2 ** (attempt - 1))
                     logger.exception(
-                        "save %s.%s failed; retrying", type_name, eid
+                        "save %s.%s failed (attempt %d); retrying in "
+                        "%.1fs", type_name, eid, attempt, delay,
                     )
-                    time.sleep(SAVE_RETRY_DELAY)
+                    time.sleep(delay)
                     continue
+                # reads: a TRANSIENT blip gets a bounded number of
+                # quick retries before the op reports failure — a load
+                # that fails on one dropped TCP segment would otherwise
+                # boot the player with a fresh entity
+                if isinstance(exc, _TRANSIENT) \
+                        and attempt < READ_RETRY_ATTEMPTS:
+                    self._m_retry.inc()
+                    logger.warning(
+                        "storage %s %s.%s transient error (%s); "
+                        "retry %d", kind, type_name, eid, exc, attempt,
+                    )
+                    time.sleep(READ_RETRY_DELAY * 2 ** (attempt - 1))
+                    continue
+                self._m_err.inc()
                 logger.exception("storage %s %s.%s failed",
                                  kind, type_name, eid)
                 res = None

@@ -36,6 +36,12 @@ DEFAULT_TOPK_IMPL = "sort"
 # --- queues / backpressure (reference consts.go:26-28) -----------------
 MAX_PENDING_PACKETS_PER_GAME = 1_000_000
 MAX_PENDING_PACKETS_PER_ENTITY = 1_000
+# reconnect pend queue budget (net/cluster.py DispatcherConn._pending):
+# packets queued while a dispatcher link is down, drop-OLDEST beyond
+# either bound (counted in cluster_pend_dropped_total). Overridable per
+# game/gate via the ini pend_max_packets / pend_max_bytes keys.
+MAX_RECONNECT_PEND_PACKETS = 65_536
+MAX_RECONNECT_PEND_BYTES = 32 << 20
 
 # --- timeouts (reference consts.go:58-64) ------------------------------
 MIGRATE_TIMEOUT = 60.0

@@ -22,6 +22,9 @@ TPU-native analog exposes:
 * ``/profile``— jax.profiler capture trigger: GET starts a device trace
   (``?logdir=`` overrides the output dir), ``?stop=1`` stops it; a
   clear JSON error when jax.profiler is unavailable
+* ``/faults`` — fault-injection plane state (:mod:`goworld_tpu.utils.
+  faults`): seed, per-rule trial counts and the deterministic fired
+  log; ``{"active": false}`` when no schedule is installed
 
 Stdlib-only (http.server on a daemon thread), one call to :func:`start`.
 """
@@ -41,7 +44,7 @@ from goworld_tpu.utils import log, metrics, opmon, tracing
 logger = log.get("debug_http")
 
 _ENDPOINTS = ["/healthz", "/vars", "/ops", "/metrics", "/trace",
-              "/tracing", "/clock", "/profile"]
+              "/tracing", "/clock", "/profile", "/faults"]
 
 # jax.profiler capture state (one capture at a time per process)
 _profile_lock = threading.Lock()
@@ -162,6 +165,13 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/profile":
             body, code = _profile_action(query)
             self._json(body, code)
+        elif path == "/faults":
+            # fault-injection plane state: per-rule trial counts + the
+            # deterministic fired-trial log (utils/faults.py; chaos
+            # runs scrape this to verify seeded replay)
+            from goworld_tpu.utils import faults
+
+            self._json(faults.snapshot())
         else:
             self._json({"error": "not found",
                         "endpoints": _ENDPOINTS}, 404)

@@ -39,6 +39,11 @@ def pytest_configure(config):
         "soak_full: the reference CI's 200-bot/300s profile "
         "(RUN_SOAK_FULL=1 to enable; ~7 min)",
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: seeded fault-injection tests (tests/test_chaos.py); the "
+        "fast smoke runs in tier-1, the full soak is also marked slow",
+    )
 
 
 def spawn_on(states, dev, slot, **kw):

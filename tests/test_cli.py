@@ -109,6 +109,19 @@ def test_sample_config_prints(capsys):
     assert "[dispatcher1]" in capsys.readouterr().out
 
 
+def test_sample_config_loads(tmp_path):
+    """The emitted sample must round-trip through the real loader —
+    ConfigParser has no inline-comment support, so a trailing `# ...`
+    on a value line would crash every process at boot."""
+    from goworld_tpu import config as config_mod
+
+    ini = tmp_path / "goworld_tpu.ini"
+    ini.write_text(config_mod.dumps_sample())
+    cfg = config_mod.load(str(ini))
+    assert cfg.gates[1].heartbeat_timeout == 60.0
+    assert cfg.games[1].capacity == 16384
+
+
 def test_watchdog_single_process_crash_and_deliberate_stop(server_dir):
     """Fast watchdog semantics on a 1-proc-per-role cluster: a healthy
     scan is a no-op; a SIGKILLed game (crash = dead process with its

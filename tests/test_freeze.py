@@ -297,3 +297,96 @@ class TestAsyncCheckpoint:
         assert all("_slot" not in rec for rec in data["entities"])
         pos = data["entities"][0]["pos"]
         assert abs(pos[0] - 50.0) < 1e-3 and abs(pos[2] - 50.0) < 1e-3
+
+
+class TestSnapshotCorruption:
+    """A partial/corrupt snapshot must be REJECTED whole — restore falls
+    back to the next-freshest candidate or fails loudly, never
+    half-loads (ISSUE 3 recovery invariant)."""
+
+    def _frozen(self):
+        w = _make_world()
+        arena = w.create_space("Arena")
+        e = w.create_entity("Npc", space=arena, pos=(5.0, 0.0, 5.0))
+        e.attrs["hp"] = 3
+        return e, freeze.freeze_world(w)
+
+    def test_truncated_freeze_falls_back_to_checkpoint(self, tmp_path):
+        import msgpack
+
+        e, data = self._frozen()
+        # older but VALID checkpoint...
+        freeze.write_freeze_file(
+            str(tmp_path / freeze.checkpoint_filename(1)), data)
+        # ...shadowed by a newer TRUNCATED freeze file (simulated crash
+        # of a non-atomic writer / disk fault)
+        blob = msgpack.packb(data, use_bin_type=True)
+        fz = tmp_path / freeze.freeze_filename(1)
+        fz.write_bytes(blob[: len(blob) // 2])
+        later = time.time() + 5
+        import os
+        os.utime(str(fz), (later, later))
+
+        assert freeze.latest_snapshot_path(1, str(tmp_path)) \
+            == str(fz)                      # mtime says the corrupt one
+        w2 = _make_world()
+        freeze.restore_from_file(w2, str(tmp_path))   # ...but it falls back
+        assert e.id in w2.entities
+        assert w2.entities[e.id].attrs.get("hp") == 3
+        assert freeze.has_restorable_snapshot(1, str(tmp_path))
+
+    def test_all_corrupt_rejected_not_half_loaded(self, tmp_path):
+        import msgpack
+
+        _e, data = self._frozen()
+        blob = msgpack.packb(data, use_bin_type=True)
+        (tmp_path / freeze.freeze_filename(1)).write_bytes(blob[:40])
+        assert not freeze.has_restorable_snapshot(1, str(tmp_path))
+        w2 = _make_world()
+        with pytest.raises(freeze.CorruptSnapshotError):
+            freeze.restore_from_file(w2, str(tmp_path))
+        # nothing was half-loaded: the world still holds only nil space
+        assert list(w2.entities) == [w2.nil_space.id]
+
+    def test_parseable_but_wrong_shape_rejected(self, tmp_path):
+        import msgpack
+
+        (tmp_path / freeze.freeze_filename(1)).write_bytes(
+            msgpack.packb(["not", "a", "freeze"], use_bin_type=True))
+        with pytest.raises(freeze.CorruptSnapshotError):
+            freeze.read_freeze_file(
+                str(tmp_path / freeze.freeze_filename(1)))
+
+    def test_crash_mid_freeze_leaves_only_tmp(self, tmp_path):
+        """Injected crash between the tmp write and the atomic rename
+        (`crash:freeze.write`): the snapshot path must hold only the
+        .tmp — a later -restore boot sees no (partial) freeze file at
+        all, exactly the no-half-load guarantee."""
+        import os
+        import subprocess
+        import sys
+
+        from goworld_tpu.utils import faults as faults_mod
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        target = str(tmp_path / freeze.freeze_filename(1))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo
+        env["JAX_PLATFORMS"] = "cpu"
+        env["GOWORLD_FAULTS"] = "crash:freeze.write:1.0"
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "from goworld_tpu.utils import faults; "
+             "faults.install('freezer'); "
+             "from goworld_tpu import freeze; "
+             f"freeze.write_freeze_file({target!r}, "
+             "{'version': 1, 'entities': []})"],
+            env=env, capture_output=True, text=True, timeout=240,
+        )
+        assert r.returncode == faults_mod.KILL_EXIT_CODE, \
+            r.stdout + r.stderr
+        assert not os.path.exists(target)          # no partial snapshot
+        assert os.path.exists(target + ".tmp")     # the crash artifact
+        w2 = _make_world()
+        with pytest.raises(FileNotFoundError):
+            freeze.restore_from_file(w2, str(tmp_path))

@@ -1,0 +1,292 @@
+#!/usr/bin/env python
+"""Chaos soak: run a standalone gate->dispatcher->game cluster under a
+seeded fault schedule and report convergence + the deterministic fault
+log.
+
+One invocation = one full chaos scenario against a throwaway server dir:
+
+1. build a 1-dispatcher/1-game/1-gate cluster (persistent Vault entity,
+   1 s crash-recovery checkpoints, gate /faults endpoint),
+2. start it with ``GOWORLD_FAULTS`` armed (wire faults on the
+   gate->dispatcher edge + a deterministic ``crash:game.tick@n=...``
+   game kill),
+3. drive deposits through a bot, wait for a post-deposit checkpoint,
+4. let the kill fire, supervise the cluster back to health
+   (``cli.cmd_supervise`` machinery), audit the Vault from a fresh
+   client,
+5. scrape the gate's ``/faults`` log and write a JSON report.
+
+Running the soak TWICE with the same ``--seed`` must produce
+byte-identical ``fault_log`` entries — the seeded-replay guarantee
+(tests/test_chaos.py::test_chaos_soak_same_seed_replays_identical_log
+automates the double run behind ``-m slow``).
+
+Usage::
+
+    python tools/chaos_soak.py --dir /tmp/chaos --seed 77 \
+        --deposits 25 --out chaos_report.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import socket
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from goworld_tpu.net import proto  # noqa: E402 (after sys.path insert)
+
+SERVER_PY = '''\
+import goworld_tpu as gw
+
+VAULT_EID = "Vault00000000001"
+
+
+@gw.register_entity("Vault")
+class Vault(gw.Entity):
+    ATTRS = {"gold": "persistent"}
+
+
+@gw.register_entity("Account")
+class Account(gw.Entity):
+    ATTRS = {"status": "client", "audit": "client"}
+
+    def OnClientConnected(self):
+        self.attrs["status"] = "online"
+
+    def Deposit_Client(self, amount):
+        v = gw.get_entity(VAULT_EID)
+        if v is None:
+            v = gw.create_entity("Vault", eid=VAULT_EID)
+        v.attrs["gold"] = v.attrs.get("gold", 0) + amount
+        v.save()
+        self.attrs["audit"] = v.attrs["gold"]
+
+    def Audit_Client(self):
+        v = gw.get_entity(VAULT_EID)
+        self.attrs["audit"] = -1 if v is None else v.attrs.get("gold", 0)
+
+
+if __name__ == "__main__":
+    gw.run()
+'''
+
+RPC_MT = proto.MT_CALL_ENTITY_METHOD_FROM_CLIENT
+KILL_TICK = 900   # ~15 s of serve loop at 60 Hz: past the deposit
+                  # phase, deterministic regardless of boot-compile time
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def build_server_dir(path: str) -> tuple[str, int, int]:
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "server.py"), "w") as f:
+        f.write(SERVER_PY)
+    dport, gport, hport = _free_port(), _free_port(), _free_port()
+    with open(os.path.join(path, "goworld_tpu.ini"), "w") as f:
+        f.write(
+            f"[dispatcher1]\nhost = 127.0.0.1\nport = {dport}\n"
+            "[game_common]\nboot_entity = Account\ncapacity = 256\n"
+            "n_spaces = 1\ncheckpoint_interval = 1\n"
+            "[game1]\n"
+            f"[gate1]\nhost = 127.0.0.1\nport = {gport}\n"
+            f"http_port = {hport}\n"
+            "[storage]\nkind = filesystem\ndirectory = entity_storage\n"
+            "[kvdb]\nkind = memory\n"
+        )
+    return path, gport, hport
+
+
+def spec_for(kill_tick: int = KILL_TICK) -> str:
+    return (
+        f"drop:gate->dispatcher:mt={RPC_MT}:0.25,"
+        f"dup:gate->dispatcher:mt={RPC_MT}:0.25,"
+        f"delay:gate->dispatcher:mt={RPC_MT}:0.5:5ms,"
+        f"crash:game.tick@n={kill_tick}"
+    )
+
+
+async def _session(gport: int, actions):
+    from goworld_tpu.net.botclient import BotClient
+
+    bot = BotClient("127.0.0.1", gport)
+    await bot.connect()
+    recv = asyncio.ensure_future(bot._recv_loop())
+    try:
+        await asyncio.wait_for(bot.player_ready.wait(), 90)
+        for _ in range(200):
+            if bot.player.attrs.get("status") == "online":
+                break
+            await asyncio.sleep(0.05)
+        return await actions(bot)
+    finally:
+        recv.cancel()
+        await bot.conn.close()
+
+
+def run_soak(server_dir: str, seed: int, deposits: int,
+             kill_tick: int = KILL_TICK) -> dict:
+    from goworld_tpu import cli
+    from goworld_tpu.utils import faults as faults_mod
+
+    spec = spec_for(kill_tick)
+    report: dict = {"seed": seed, "spec": spec, "converged": False}
+    os.environ["GOWORLD_FAULTS"] = spec
+    os.environ["GOWORLD_FAULTS_SEED"] = str(seed)
+    stop = threading.Event()
+    sup = None
+    try:
+        if cli.cmd_start(server_dir) != 0:
+            report["error"] = "initial start failed"
+            return report
+        os.environ.pop("GOWORLD_FAULTS")
+        os.environ.pop("GOWORLD_FAULTS_SEED")
+        _, gport, hport = (
+            server_dir,
+            _ini_port(server_dir, "gate1", "port"),
+            _ini_port(server_dir, "gate1", "http_port"),
+        )
+        game_pid = cli._read_pid(server_dir, "game", 1)
+
+        async def deposit(bot):
+            for _ in range(deposits):
+                bot.call_server("Deposit_Client", 1)
+                await asyncio.sleep(0.02)
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                a = bot.player.attrs.get("audit")
+                if a is not None:
+                    await asyncio.sleep(1.0)
+                    return bot.player.attrs.get("audit")
+                await asyncio.sleep(0.1)
+            return None
+
+        gold = asyncio.run(asyncio.wait_for(_session(gport, deposit),
+                                            180))
+        t_gold = time.time()
+        report["gold"] = gold
+        if not gold:
+            report["error"] = "no deposit survived"
+            return report
+
+        # poll until every deposit passed the gate's decision point
+        # (ordered client stream: the first rule's trial count grows to
+        # exactly the RPC count)
+        def _scrape():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{hport}/faults", timeout=5
+            ) as r:
+                return json.loads(r.read())
+
+        snap = _scrape()
+        deadline = time.time() + 30
+        while time.time() < deadline \
+                and snap["rules"][0]["trials"] < deposits:
+            time.sleep(0.2)
+            snap = _scrape()
+        report["fault_log"] = snap["log"]
+        report["injected_total"] = snap["injected_total"]
+        # sanity: the live log IS the seeded pure function
+        expected = faults_mod.FaultPlane(
+            faults_mod.parse_schedule(spec), seed)
+        for _ in range(deposits):
+            expected.wire_fault("gate->dispatcher", RPC_MT)
+        report["replay_matches"] = snap["log"] == expected.log_lines()
+
+        ckpt = os.path.join(server_dir, "game1_checkpoint.dat")
+        deadline = time.time() + 60
+        while time.time() < deadline and (
+            not os.path.exists(ckpt)
+            or os.path.getmtime(ckpt) < t_gold + 0.5
+        ):
+            time.sleep(0.2)
+
+        deadline = time.time() + 120
+        while time.time() < deadline and cli._alive(game_pid):
+            time.sleep(0.2)
+        if cli._alive(game_pid):
+            report["error"] = "kill never fired"
+            return report
+        report["killed"] = True
+
+        sup = threading.Thread(
+            target=cli.cmd_supervise, args=(server_dir,),
+            kwargs=dict(interval=0.5, stop=stop), daemon=True,
+        )
+        sup.start()
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            pid = cli._read_pid(server_dir, "game", 1)
+            if pid != game_pid and cli._alive(pid):
+                break
+            time.sleep(0.3)
+        else:
+            report["error"] = "supervisor never recovered the game"
+            return report
+        report["restarted"] = True
+
+        async def audit(bot):
+            bot.call_server("Audit_Client")
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                a = bot.player.attrs.get("audit")
+                if a is not None:
+                    return a
+                await asyncio.sleep(0.1)
+            return None
+
+        seen = asyncio.run(asyncio.wait_for(_session(gport, audit), 240))
+        report["audited"] = seen
+        report["converged"] = bool(
+            seen == gold and report.get("replay_matches")
+        )
+        return report
+    finally:
+        stop.set()
+        if sup is not None:
+            sup.join(timeout=60)
+        from goworld_tpu import cli as _cli
+
+        _cli.cmd_stop(server_dir)
+
+
+def _ini_port(server_dir: str, section: str, key: str) -> int:
+    import configparser
+
+    cp = configparser.ConfigParser()
+    cp.read(os.path.join(server_dir, "goworld_tpu.ini"))
+    return int(cp[section][key])
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--dir", required=True,
+                    help="throwaway server dir (created)")
+    ap.add_argument("--seed", type=int, default=77)
+    ap.add_argument("--deposits", type=int, default=25)
+    ap.add_argument("--kill-tick", type=int, default=KILL_TICK)
+    ap.add_argument("--out", default="chaos_report.json")
+    args = ap.parse_args()
+    server_dir, _, _ = build_server_dir(args.dir)
+    report = run_soak(server_dir, args.seed, args.deposits,
+                      kill_tick=args.kill_tick)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report, indent=2))
+    return 0 if report.get("converged") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
