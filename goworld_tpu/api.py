@@ -364,9 +364,16 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
             ban_boot=gc.ban_boot_entity or mh_rank > 0,
             restore=restore,
             checkpoint_interval=gc.checkpoint_interval,
+            tick_interval=1.0 / max(1e-3, gc.tick_hz),
             gc_freeze_on_boot=gc.gc_freeze,
             pend_max_packets=gc.pend_max_packets,
             pend_max_bytes=gc.pend_max_bytes,
+            overload_enabled=gc.overload,
+            overload_up_ticks=gc.overload_up_ticks,
+            overload_down_ticks=gc.overload_down_ticks,
+            overload_latency_ratio=gc.overload_latency_ratio,
+            degraded_sync_stride=gc.degraded_sync_stride,
+            degraded_event_coalesce=gc.degraded_event_coalesce,
         )
 
     restoring = args.restore and \

@@ -998,6 +998,11 @@ def cmd_run_gate(gateid: int, configfile: str | None,
             ssl_context=ssl_ctx,
             pend_max_packets=gc.pend_max_packets,
             pend_max_bytes=gc.pend_max_bytes,
+            max_clients=gc.max_clients,
+            rate_limit_pps=gc.rate_limit_pps,
+            rate_limit_bps=gc.rate_limit_bps,
+            downstream_max_bytes=gc.downstream_max_bytes,
+            downstream_kick_secs=gc.downstream_kick_secs,
         )
         task = asyncio.ensure_future(svc.serve())
         await svc.started.wait()

@@ -86,6 +86,26 @@ class GameConfig:
     # other) will leak after destroy — break such references in
     # OnDestroy, or set gc_freeze = false
     gc_freeze: bool = True
+    # serve-loop tick rate (Hz): the deadline the overload governor
+    # measures against. The 60 Hz default is the device-tick target;
+    # hosts that cannot hold it should lower this rather than run
+    # permanently DEGRADED (the ladder compares wall time per tick
+    # against 1/tick_hz)
+    tick_hz: float = float(consts.TICK_HZ)
+    # overload-protection ladder (utils/overload.py; docs/ROBUSTNESS.md
+    # "Overload & degradation"): NORMAL -> DEGRADED -> SHEDDING ->
+    # REJECTING driven by tick latency / backlog / queue depths with
+    # hysteresis. overload = false keeps the prioritized ingress queues
+    # but never escalates past NORMAL.
+    overload: bool = True
+    overload_up_ticks: int = consts.OVERLOAD_UP_TICKS
+    overload_down_ticks: int = consts.OVERLOAD_DOWN_TICKS
+    overload_latency_ratio: float = consts.OVERLOAD_LATENCY_RATIO
+    # DEGRADED fan-out degradation: position/attr sync serves each
+    # entity cohort every Nth tick; client event/sync bundles flush
+    # every Nth tick (bigger batches, fewer packets)
+    degraded_sync_stride: int = consts.DEGRADED_SYNC_STRIDE
+    degraded_event_coalesce: int = consts.DEGRADED_EVENT_COALESCE_TICKS
     # pipeline the host decode one tick behind the device step
     # (single-controller non-mesh games only; silently ignored
     # elsewhere): tick N's device execution overlaps tick N-1's host
@@ -147,6 +167,17 @@ class GateConfig:
     # reaped without opt-in; the reference ships 60 in its sample ini);
     # 0 stays the explicit off switch
     heartbeat_timeout: float = 30.0
+    # admission control (utils/overload.py): connection cap (0 =
+    # unlimited; new handshakes past the cap — or while the gate's
+    # overload ladder is REJECTING — are refused), per-client
+    # token-bucket rate limits on inbound packets/s and bytes/s (0 =
+    # off), and the per-client downstream buffer budget with the
+    # stalled-consumer kick window
+    max_clients: int = 0
+    rate_limit_pps: float = 0.0
+    rate_limit_bps: float = 0.0
+    downstream_max_bytes: int = consts.GATE_DOWNSTREAM_MAX_BYTES
+    downstream_kick_secs: float = consts.GATE_DOWNSTREAM_KICK_SECS
     position_sync_interval_ms: int = 100
     # reconnect pend queue budget (net/cluster.py; drop-oldest beyond)
     pend_max_packets: int = MAX_RECONNECT_PEND_PACKETS
@@ -401,6 +432,13 @@ extent_z = 1000.0
 # gc_freeze = false        # keep boot objects in the cyclic GC (the
 #                          # default freezes them out: gen-2 passes
 #                          # cost ~100 ms at a 131K-entity shard)
+# overload = true          # overload ladder NORMAL->DEGRADED->SHEDDING
+#                          # ->REJECTING (docs/ROBUSTNESS.md); knobs:
+# overload_up_ticks = 8    # pressured ticks to climb one rung
+# overload_down_ticks = 120  # calm ticks to descend one rung
+# overload_latency_ratio = 1.5  # tick wall / interval that = pressure
+# degraded_sync_stride = 4 # DEGRADED: sync each entity cohort every Nth
+# degraded_event_coalesce = 2  # DEGRADED: flush bundles every Nth tick
 
 [game1]
 
@@ -417,6 +455,12 @@ port = 15000
 # compress = true    # stream compression (both ends must agree)
 # compress_codec = snappy   # snappy (default, the reference codec) | zlib
 # encrypt = true     # TLS on the TCP listener (self-signed on first use)
+# max_clients = 10000       # connection cap (0 = unlimited); REJECTING
+#                           # state refuses new handshakes regardless
+# rate_limit_pps = 200      # per-client inbound packets/s (0 = off)
+# rate_limit_bps = 262144   # per-client inbound bytes/s (0 = off)
+# downstream_max_bytes = 4194304  # per-client downstream buffer budget
+# downstream_kick_secs = 10 # disconnect a client whose buffer stays full
 
 [storage]
 kind = filesystem

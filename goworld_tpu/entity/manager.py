@@ -324,6 +324,11 @@ class World:
         self.on_entity_created: Callable[[Entity], None] | None = None
         self.on_entity_destroyed: Callable[[Entity], None] | None = None
         self.op_stats: dict[str, float] = defaultdict(float)
+        # overload degradation (utils/overload.py): when > 1 the
+        # position-sync fan-out serves each entity cohort every Nth
+        # tick (cohort = subject slot % stride) — the GameServer's
+        # governor sets it in DEGRADED and restores 1 on recovery
+        self.sync_stride = 1
         self._aoi_alarm_tick = -(1 << 30)  # last AOI-overflow alarm tick
         # scrapeable AOI saturation series (debug_http /metrics): the
         # counter accumulates truncated rows/cells; the gauges mirror
@@ -1823,7 +1828,27 @@ class World:
                 ws = np.asarray(base.sync_w[shard])[:sn]
                 js = np.asarray(base.sync_j[shard])[:sn]
                 vs = np.asarray(base.sync_vals[shard])[:sn]
-                if self.sync_sink is not None:
+                if self.sync_stride > 1:
+                    # DEGRADED fan-out: serve one entity cohort per
+                    # tick (subject slot mod stride) — each entity
+                    # still syncs every `stride` ticks with its LATEST
+                    # position, so nothing is lost, only thinned.
+                    # Vectorized mask; skipped records counted so every
+                    # shed record has a name (shed_total{sync,stride}).
+                    from goworld_tpu.utils import overload as _ov
+
+                    keep = (js % self.sync_stride) == (
+                        self.tick_count % self.sync_stride
+                    )
+                    dropped = int(sn - int(keep.sum()))
+                    if dropped:
+                        _ov.shed_counter(
+                            _ov.CLASS_SYNC, "stride").inc(dropped)
+                    ws, js, vs = ws[keep], js[keep], vs[keep]
+                    sn = len(js)
+                if not sn:
+                    pass
+                elif self.sync_sink is not None:
                     # batched path: one (cids, eids, vals) bundle per
                     # gate per tick, feeding
                     # MT_SYNC_POSITION_YAW_ON_CLIENTS — resolved through

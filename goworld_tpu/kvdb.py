@@ -26,7 +26,8 @@ from typing import Callable
 
 import msgpack
 
-from goworld_tpu.utils import faults, log, metrics, opmon
+from goworld_tpu.utils import consts, faults, log, metrics, opmon, \
+    overload
 from goworld_tpu.utils.asyncwork import AsyncWorkers
 
 logger = log.get("kvdb")
@@ -411,6 +412,20 @@ class KVDB:
         self._m_err = metrics.counter(
             "kvdb_op_errors_total",
             help="kvdb ops that exhausted retries")
+        # circuit breaker around the backend (docs/ROBUSTNESS.md
+        # "Overload & degradation"): after the failure budget the
+        # breaker opens and every op fails FAST through the callback —
+        # a dead backend degrades kvdb service instead of stacking
+        # retry sleeps on the single _kvdb worker; a half-open probe
+        # per reset window closes it again when the backend recovers
+        self.breaker = overload.register_breaker(overload.CircuitBreaker(
+            "kvdb",
+            failure_threshold=consts.CIRCUIT_FAILURE_THRESHOLD,
+            reset_timeout=consts.CIRCUIT_RESET_TIMEOUT,
+        ))
+        self._m_circuit_rejected = metrics.counter(
+            "kvdb_circuit_rejected_total",
+            help="kvdb ops failed fast while the circuit was open")
 
     def _timed(self, op: str, fn: Callable):
         """Timing + bounded-retry shim around one backend op. Transient
@@ -422,6 +437,13 @@ class KVDB:
         retry = self._m_retry[op]
 
         def job():
+            if not self.breaker.allow():
+                # open circuit: fail fast WITHOUT touching the backend
+                # or burning retry sleeps on the single _kvdb worker
+                self._m_circuit_rejected.inc()
+                raise overload.CircuitOpenError(
+                    f"kvdb circuit open; {op} rejected fast"
+                )
             deadline = time.perf_counter() + RETRY_DEADLINE
             # the histogram records PER-ATTEMPT backend latency (the
             # last attempt's, success or final failure) — folding the
@@ -433,11 +455,16 @@ class KVDB:
                     t0 = time.perf_counter()
                     try:
                         faults.maybe_op_fault("kvdb", op)
-                        return fn()
+                        res = fn()
+                        self.breaker.record_success()
+                        return res
                     except _TRANSIENT as exc:
+                        self.breaker.record_failure()
                         delay = RETRY_BASE_DELAY * (2 ** attempt)
                         if attempt + 1 >= RETRY_ATTEMPTS \
-                                or time.perf_counter() + delay > deadline:
+                                or time.perf_counter() + delay > deadline \
+                                or self.breaker.state \
+                                == overload.CircuitBreaker.OPEN:
                             self._m_err.inc()
                             logger.error(
                                 "kvdb %s failed after %d attempts: %s",
@@ -448,6 +475,14 @@ class KVDB:
                         logger.warning("kvdb %s transient error (%s); "
                                        "retry %d", op, exc, attempt + 1)
                         time.sleep(delay)
+                    except Exception:
+                        # NON-transient failure (protocol garbage, a
+                        # bug): still settle the breaker's half-open
+                        # probe — leaving it unrecorded would pin the
+                        # breaker HALF_OPEN with its one probe slot
+                        # consumed, failing every later op forever
+                        self.breaker.record_failure()
+                        raise
             finally:
                 dt = time.perf_counter() - t0
                 hist.observe(dt * 1e3)

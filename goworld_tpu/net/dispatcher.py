@@ -25,12 +25,14 @@ import numpy as np
 
 from goworld_tpu.net import proto
 from goworld_tpu.net.packet import (
+    MSGTYPE_MASK,
     Packet,
     PacketConnection,
     new_packet,
     wire_payload,
 )
-from goworld_tpu.utils import consts, ids, log, metrics, tracing
+from goworld_tpu.utils import consts, ids, log, metrics, overload, \
+    tracing
 
 logger = log.get("dispatcher")
 
@@ -84,16 +86,28 @@ class _EntityDispatchInfo:
 
 
 class _GameInfo:
-    """Per-game connection state (reference ``gameDispatchInfo``)."""
+    """Per-game connection state (reference ``gameDispatchInfo``).
+
+    The queue-while-blocked/disconnected buffer is CLASS-PRIORITIZED
+    (utils/overload.py): one deque per traffic class, flushed
+    highest-priority first, and bounded by a packet AND byte budget
+    whose overflow evicts the *cheapest* queued class first — a
+    position-sync flood during a game's freeze window can therefore
+    never push out a migration leg or an RPC, and eviction is counted
+    per class in ``shed_total{class,stage="dispatcher_pend"}``."""
 
     __slots__ = ("game_id", "conn", "blocked_until", "pending", "load",
-                 "ban_boot")
+                 "ban_boot", "pending_count", "pending_bytes")
 
     def __init__(self, game_id: int):
         self.game_id = game_id
         self.conn: PacketConnection | None = None
         self.blocked_until = 0.0
-        self.pending: deque[bytes] = deque()
+        self.pending: tuple[deque[bytes], ...] = tuple(
+            deque() for _ in range(overload.N_CLASSES)
+        )
+        self.pending_count = 0
+        self.pending_bytes = 0
         self.load = 0.0   # CPU% analog reported via MT_GAME_LBC_INFO
         self.ban_boot = False
 
@@ -105,18 +119,44 @@ class _GameInfo:
         if self.conn is not None and not self.blocked:
             self.conn.send(p, release=release)
         else:
-            if len(self.pending) < consts.MAX_PENDING_PACKETS_PER_GAME:
-                # wire_payload keeps a trace trailer through the queue
-                # (identical to bytes(p.buf) when untraced); the flush
-                # sends the stored bytes verbatim and the receiver's
-                # decode_wire strips the trailer as usual
-                self.pending.append(wire_payload(p))
+            # wire_payload keeps a trace trailer through the queue
+            # (identical to bytes(p.buf) when untraced); the flush
+            # sends the stored bytes verbatim and the receiver's
+            # decode_wire strips the trailer as usual
+            raw = wire_payload(p)
+            cls = overload.classify(
+                (raw[0] | (raw[1] << 8)) & MSGTYPE_MASK
+                if len(raw) >= 2 else 0
+            )
+            self.pending[cls].append(raw)
+            self.pending_count += 1
+            self.pending_bytes += len(raw)
+            self._evict_over_budget()
             if release:
                 p.release()
 
+    def _evict_over_budget(self) -> None:
+        """Drop-oldest from the cheapest non-empty class until both
+        budgets hold; each eviction counted per class."""
+        while (self.pending_count > consts.MAX_PENDING_PACKETS_PER_GAME
+               or self.pending_bytes > consts.MAX_PENDING_BYTES_PER_GAME):
+            for cls in range(overload.N_CLASSES - 1, -1, -1):
+                q = self.pending[cls]
+                if q:
+                    self.pending_bytes -= len(q.popleft())
+                    self.pending_count -= 1
+                    overload.shed_counter(cls, "dispatcher_pend").inc()
+                    break
+            else:
+                return  # all empty (budgets misconfigured tiny)
+
     def flush_pending(self) -> None:
-        while self.pending and self.conn is not None:
-            self.conn.send(Packet(self.pending.popleft()), release=False)
+        for q in self.pending:
+            while q and self.conn is not None:
+                raw = q.popleft()
+                self.pending_count -= 1
+                self.pending_bytes -= len(raw)
+                self.conn.send(Packet(raw), release=False)
 
 
 class DispatcherService:

@@ -19,7 +19,6 @@ from __future__ import annotations
 import asyncio
 import gc as _gc
 import os
-import queue
 import threading
 import time
 from typing import Callable
@@ -31,7 +30,8 @@ from goworld_tpu.entity.manager import World
 from goworld_tpu.net import codec, proto
 from goworld_tpu.net.cluster import DispatcherCluster, DispatcherConn
 from goworld_tpu.net.packet import Packet, new_packet
-from goworld_tpu.utils import consts, faults, log, metrics, opmon, tracing
+from goworld_tpu.utils import consts, faults, log, metrics, opmon, \
+    overload, tracing
 
 logger = log.get("game")
 
@@ -96,6 +96,12 @@ class GameServer:
         gc_freeze_on_boot: bool = True,
         pend_max_packets: int = consts.MAX_RECONNECT_PEND_PACKETS,
         pend_max_bytes: int = consts.MAX_RECONNECT_PEND_BYTES,
+        overload_enabled: bool = True,
+        overload_up_ticks: int = consts.OVERLOAD_UP_TICKS,
+        overload_down_ticks: int = consts.OVERLOAD_DOWN_TICKS,
+        overload_latency_ratio: float = consts.OVERLOAD_LATENCY_RATIO,
+        degraded_sync_stride: int = consts.DEGRADED_SYNC_STRIDE,
+        degraded_event_coalesce: int = consts.DEGRADED_EVENT_COALESCE_TICKS,
     ):
         self.game_id = game_id
         self.world = world
@@ -117,8 +123,26 @@ class GameServer:
             _freeze.restore_from_file(world, freeze_dir)
             self._is_restore = True
 
-        self._packet_q: "queue.Queue[tuple[int, int, Packet]]" = \
-            queue.Queue(maxsize=consts.MAX_PENDING_PACKETS_PER_GAME)
+        # prioritized ingress: bounded per-class queues drained
+        # highest-priority first, so a sync/event flood can neither
+        # evict nor delay-behind-it the migration/RPC control plane
+        # (utils/overload.py; replaces the old single FIFO queue)
+        self._packet_q = overload.ClassQueues(stage="game_queue")
+        # overload ladder: observed once per serve-loop tick; NORMAL
+        # when disabled (observe() is simply never called)
+        self.overload = overload.register(overload.OverloadGovernor(
+            f"game{game_id}",
+            up_ticks=overload_up_ticks,
+            down_ticks=overload_down_ticks,
+            latency_ratio=overload_latency_ratio,
+        ))
+        self.overload_enabled = overload_enabled
+        self.degraded_sync_stride = max(1, int(degraded_sync_stride))
+        self.degraded_event_coalesce = max(1, int(degraded_event_coalesce))
+        self._fanout_tick = 0  # coalesce phase counter (DEGRADED+)
+        # shed counters captured at the last sustained-backlog alarm so
+        # the alarm can report what was shed SINCE the previous interval
+        self._shed_at_alarm: dict[str, float] = {}
         self.cluster = DispatcherCluster(
             dispatcher_addrs, self._on_packet_netthread, self._handshake,
             edge="game->dispatcher",
@@ -246,7 +270,13 @@ class GameServer:
             tl.begin_tick()
             self._m_queue_depth.set(self._packet_q.qsize())
             with tl.span("drain_inputs"):
-                self.pump()
+                # 1.5 frames of handler work per tick keeps the loop
+                # observing (and the p99 near 2x the interval) under a
+                # flood; the surplus waits in the class queues
+                self.pump(
+                    budget=1.5 * self.tick_interval
+                    if self.overload_enabled else None
+                )
             self.tick()
             dur = tl.end_tick()
             if dur is not None:
@@ -256,11 +286,37 @@ class GameServer:
                 return
             next_tick += self.tick_interval
             delay = next_tick - time.monotonic()
-            self._m_backlog.set(max(0.0, -delay / self.tick_interval))
+            backlog = max(0.0, -delay / self.tick_interval)
+            self._m_backlog.set(backlog)
+            if self.overload_enabled:
+                self._observe_overload(dur, backlog)
             if delay > 0:
                 time.sleep(delay)
             else:
                 next_tick = time.monotonic()  # fell behind; don't spiral
+
+    def _observe_overload(self, dur: float | None,
+                          backlog: float) -> None:
+        """Feed this tick's measured signals to the overload governor
+        and push the resulting degradation knobs into the fan-out."""
+        pend_frac = 0.0
+        for c in self.cluster.conns:
+            if c.pend_max_bytes > 0:
+                pend_frac = max(
+                    pend_frac, c._pending_bytes / c.pend_max_bytes
+                )
+        st = self.overload.observe(
+            (dur / self.tick_interval) if dur else 0.0,
+            backlog,
+            self._packet_q.depth_frac(),
+            pend_frac,
+        )
+        # DEGRADED+: AOI/attr-sync fan-out strides entity cohorts
+        # (entity/manager.py applies the mask vectorized); back to 1 the
+        # tick the ladder returns to NORMAL
+        self.world.sync_stride = (
+            self.degraded_sync_stride if st >= overload.DEGRADED else 1
+        )
 
     # ==================================================================
     # freeze (hot reload; reference GameService.go:220-313, SURVEY.md#3.6)
@@ -297,7 +353,7 @@ class GameServer:
         # the deferred work just drained may have staged client
         # messages; the tick loop will never flush again, so do it now
         # (pre-batching they were sent immediately)
-        self._flush_sync_out()
+        self._flush_sync_out(force=True)
         # an in-flight ASYNC checkpoint must finish before the freeze
         # file is written: its atomic rename landing afterwards would
         # give an OLDER-state checkpoint a NEWER mtime, and the
@@ -325,17 +381,32 @@ class GameServer:
             logger.info("game%d: frozen to %s", self.game_id, path)
         # OnFreeze hooks may have emitted client messages after the
         # first flush — put them on the wire before exiting
-        self._flush_sync_out()
+        self._flush_sync_out(force=True)
         self.run_state = "frozen"
         self.stop()
 
-    def pump(self) -> int:
-        """Drain and handle every queued dispatcher packet (logic thread)."""
+    def pump(self, budget: float | None = None) -> int:
+        """Drain and handle queued dispatcher packets (logic thread),
+        highest traffic class first — under backlog the migration/RPC
+        control plane is applied before sync/event noise.
+
+        ``budget`` (seconds) TIME-BOXES the drain: without it, an
+        arrival rate above the service rate turns one "tick" into a
+        minutes-long grind — the tick deadline is obliterated AND the
+        overload governor starves (one observation per mega-tick, so
+        the ladder can never climb). With a budget the loop returns
+        mid-queue once the box is spent; the remainder stays queued
+        (bounded per class) for the next tick, the serve loop keeps
+        its cadence, and sustained pressure becomes a SIGNAL instead
+        of a stall."""
         n = 0
+        deadline = (
+            time.monotonic() + budget if budget is not None else None
+        )
         while True:
             try:
-                didx, msgtype, pkt = self._packet_q.get_nowait()
-            except queue.Empty:
+                didx, msgtype, pkt = self._packet_q.pop()
+            except IndexError:
                 return n
             try:
                 self._handle_packet(didx, msgtype, pkt)
@@ -345,6 +416,8 @@ class GameServer:
                     self.game_id, msgtype,
                 )
             n += 1
+            if deadline is not None and time.monotonic() > deadline:
+                return n
 
     def tick(self) -> None:
         tl = metrics.timeline
@@ -442,14 +515,28 @@ class GameServer:
             self._mh_backlog_ticks += 1
             if self._mh_backlog_ticks >= 8 \
                     and self._mh_backlog_ticks % 64 == 8:
+                # the alarm reports what the overload plane is actually
+                # DOING about it (state + per-class sheds since the
+                # last alarm interval) instead of advising "shed load"
+                # with no mechanism behind the words
+                shed_now = overload.shed_snapshot()
+                delta = {
+                    k: v - self._shed_at_alarm.get(k, 0.0)
+                    for k, v in shed_now.items()
+                    if v > self._shed_at_alarm.get(k, 0.0)
+                }
+                self._shed_at_alarm = shed_now
                 logger.warning(
                     "game%d: multihost mutation backlog sustained for "
                     "%d ticks (%d packets / %d bytes queued): the "
                     "cluster plane outruns MH_LOG_BYTES_PER_TICK "
-                    "(%d B/tick) — shed load or raise the cap",
+                    "(%d B/tick) — overload state %s; shed last "
+                    "interval: %s",
                     self.game_id, self._mh_backlog_ticks,
                     len(self._mh_pending), backlog_b,
                     self.MH_LOG_BYTES_PER_TICK,
+                    self.overload.state_name,
+                    delta or "nothing (raise the cap or add controllers)",
                 )
         else:
             self._mh_backlog_ticks = 0
@@ -555,12 +642,22 @@ class GameServer:
 
     def _on_packet_netthread(self, didx: int, msgtype: int,
                              pkt: Packet) -> None:
-        try:
-            self._packet_q.put_nowait((didx, msgtype, pkt))
-        except queue.Full:
+        cls = overload.classify(msgtype)
+        if self.overload_enabled and self.overload.should_shed(cls):
+            # SHEDDING/REJECTING: the cheapest classes are dropped at
+            # ingress, before any logic-thread work; every drop counted
+            overload.shed_counter(cls, "game_ingress").inc()
+            return
+        if not self._packet_q.offer(cls, (didx, msgtype, pkt)):
+            # class queue full (offer counted the shed); the old
+            # aggregate drop counter keeps its series alive
             self._m_pkt_drop.inc()
-            logger.error("game%d: packet queue full; dropping %d",
-                         self.game_id, msgtype)
+            if int(self._m_pkt_drop.value) % 1024 == 1:
+                logger.error(
+                    "game%d: %s input queue full; dropping msgtype %d "
+                    "(counted in shed_total)", self.game_id,
+                    overload.CLASS_NAMES[cls], msgtype,
+                )
 
     def _send(self, conn: DispatcherConn, p: Packet) -> None:
         """Thread-safe send from the logic thread."""
@@ -675,7 +772,19 @@ class GameServer:
                 self._send(conn, p)
         self._events_out.clear()
 
-    def _flush_sync_out(self) -> None:
+    def _flush_sync_out(self, force: bool = False) -> None:
+        self._fanout_tick += 1
+        if (not force and self.overload_enabled
+                and self.overload.state >= overload.DEGRADED
+                and self.degraded_event_coalesce > 1
+                and self._fanout_tick % self.degraded_event_coalesce):
+            # DEGRADED batch coalescing: hold this tick's staged events
+            # AND syncs (held together so a staged create still
+            # precedes its entity's first sync) and flush them with the
+            # next tick's — half the downstream packets at twice the
+            # batch size. Eager mid-tick event flushes (filtered
+            # broadcasts) still happen; freeze passes force=True.
+            return
         # client event bundles FIRST: a create_entity staged this tick
         # must reach the client before the same entity's first position
         # sync record (flushed below)

@@ -24,6 +24,7 @@ from goworld_tpu.net import codec, proto
 from goworld_tpu.net.cluster import DispatcherCluster, DispatcherConn
 from goworld_tpu.net.packet import (
     HEADER_SIZE,
+    MSGTYPE_MASK,
     Packet,
     PacketConnection,
     decode_wire,
@@ -31,7 +32,7 @@ from goworld_tpu.net.packet import (
     new_packet,
 )
 from goworld_tpu.utils import consts, faults, ids, log, metrics, opmon, \
-    tracing
+    overload, tracing
 
 logger = log.get("gate")
 
@@ -40,7 +41,8 @@ class ClientProxy:
     """One connected game client (reference ``ClientProxy.go:29-53``)."""
 
     __slots__ = ("client_id", "conn", "owner_eid", "filter_props",
-                 "last_heartbeat")
+                 "last_heartbeat", "bucket", "byte_bucket",
+                 "down_full_since")
 
     def __init__(self, conn: PacketConnection):
         self.client_id = ids.gen_entity_id()
@@ -48,6 +50,21 @@ class ClientProxy:
         self.owner_eid = ""      # set when the game binds a player entity
         self.filter_props: dict[str, str] = {}
         self.last_heartbeat = 0.0
+        # admission control (set by the gate when rate limits are on)
+        self.bucket: overload.TokenBucket | None = None
+        self.byte_bucket: overload.TokenBucket | None = None
+        # monotonic instant the downstream buffer FIRST refused a send
+        # (None = healthy); a client stuck past the kick window is
+        # disconnected rather than wedging memory
+        self.down_full_since: float | None = None
+
+    def downstream_buffered(self) -> int:
+        """Bytes sitting unsent in this client's socket buffer (0 when
+        the transport cannot say — e.g. the WS adapter)."""
+        try:
+            return self.conn.writer.transport.get_write_buffer_size()
+        except (AttributeError, RuntimeError):
+            return 0
 
     def send(self, p: Packet, release: bool = True) -> None:
         self.conn.send(p, release=release)
@@ -113,6 +130,11 @@ class GateService:
         exit_on_dispatcher_loss: bool = True,
         pend_max_packets: int = consts.MAX_RECONNECT_PEND_PACKETS,
         pend_max_bytes: int = consts.MAX_RECONNECT_PEND_BYTES,
+        max_clients: int = 0,
+        rate_limit_pps: float = 0.0,
+        rate_limit_bps: float = 0.0,
+        downstream_max_bytes: int = consts.GATE_DOWNSTREAM_MAX_BYTES,
+        downstream_kick_secs: float = consts.GATE_DOWNSTREAM_KICK_SECS,
     ):
         self.gate_id = gate_id
         self.host = host
@@ -172,6 +194,40 @@ class GateService:
             "gate_downstream_batch_records",
             buckets=metrics.DEFAULT_SIZE_BUCKETS,
             help="records per downstream batch from games")
+        # admission control (utils/overload.py; docs/ROBUSTNESS.md
+        # "Overload & degradation"): connection cap, per-client
+        # token-bucket rate limits, bounded per-client downstream
+        # buffers with a kick-never-wedge policy, and the gate's own
+        # overload ladder (REJECTING refuses new handshakes)
+        self.max_clients = int(max_clients)
+        self.rate_limit_pps = float(rate_limit_pps)
+        self.rate_limit_bps = float(rate_limit_bps)
+        self.downstream_max_bytes = int(downstream_max_bytes)
+        self.downstream_kick_secs = float(downstream_kick_secs)
+        self.overload = overload.register(overload.OverloadGovernor(
+            f"gate{gate_id}",
+            # the gate is evaluated at the flush cadence (~10 Hz), not
+            # 60 Hz, so the descent run is shorter in observations
+            down_ticks=max(8, consts.OVERLOAD_DOWN_TICKS // 4),
+        ))
+        self._m_down_dropped = metrics.counter(
+            "gate_downstream_dropped_total",
+            help="client-bound packets dropped on a full per-client "
+                 "downstream buffer")
+        self._m_kicked = metrics.counter(
+            "gate_downstream_kicked_total",
+            help="clients disconnected after their downstream buffer "
+                 "stayed full past the kick window")
+        self._m_rejected = metrics.counter(
+            "gate_rejected_connects_total",
+            help="client handshakes refused (REJECTING state or "
+                 "max_clients cap)")
+        # clients whose downstream buffer is currently refusing sends,
+        # maintained incrementally by _send_to_client/_drop_client so
+        # the governor reads an O(1) FRACTION — one stalled phone must
+        # not read as gate-wide pressure, and a per-flush O(clients)
+        # buffer scan would itself be load at 1M clients
+        self._down_full: set[str] = set()
 
     # ------------------------------------------------------------------
     async def _handshake(self, conn: DispatcherConn) -> None:
@@ -249,11 +305,42 @@ class GateService:
         return self._kcp_server.bound_port
 
     # -- client side -----------------------------------------------------
+    def _refuse_new_client(self) -> str | None:
+        """Reason string when a new handshake must be refused: the
+        connection cap binds in ANY state; the REJECTING rung refuses
+        everyone (an overloaded gate that keeps admitting clients only
+        digs deeper)."""
+        if self.max_clients and len(self.clients) >= self.max_clients:
+            return f"max_clients={self.max_clients} reached"
+        if self.overload.state >= overload.REJECTING:
+            return "overload state REJECTING"
+        return None
+
     async def _handle_client(self, reader, writer) -> None:
+        refuse = self._refuse_new_client()
+        if refuse is not None:
+            self._m_rejected.inc()
+            if int(self._m_rejected.value) % 256 == 1:
+                logger.warning(
+                    "gate%d: refusing new client (%s; %d refused so "
+                    "far)", self.gate_id, refuse,
+                    int(self._m_rejected.value),
+                )
+            try:
+                writer.close()
+            except Exception:
+                pass
+            return
         conn = PacketConnection(reader, writer, compress=self.compress,
                                 compress_codec=self.compress_codec,
                                 edge="gate->client")
         cp = ClientProxy(conn)
+        if self.rate_limit_pps > 0:
+            cp.bucket = overload.TokenBucket(
+                self.rate_limit_pps, burst=2 * self.rate_limit_pps)
+        if self.rate_limit_bps > 0:
+            cp.byte_bucket = overload.TokenBucket(
+                self.rate_limit_bps, burst=2 * self.rate_limit_bps)
         cp.last_heartbeat = asyncio.get_event_loop().time()
         self.clients[cp.client_id] = cp
         # boot entity id is generated ON the gate
@@ -286,6 +373,7 @@ class GateService:
     def _drop_client(self, cp: ClientProxy) -> None:
         if self.clients.pop(cp.client_id, None) is None:
             return
+        self._down_full.discard(cp.client_id)
         self.filter_index.drop_client(cp)
         key = cp.owner_eid or cp.client_id
         self.cluster.select_by_entity_id(key).send(
@@ -305,6 +393,25 @@ class GateService:
         so the packets forwarded below carry it and the dispatcher's
         route span parents to ``gate_ingress``."""
         pkt.trace = None  # client-supplied contexts are not trusted
+        # admission control FIRST: a rate-limited or shed packet must
+        # cost neither a trace root nor handler work. Dropped packets
+        # still count as liveness (the client is demonstrably alive —
+        # reaping it for talking too MUCH would be perverse);
+        # heartbeats are exempt from the rate limiter for the same
+        # reason.
+        cls = overload.classify(msgtype)
+        if msgtype != proto.MT_HEARTBEAT and (
+            (cp.bucket is not None and not cp.bucket.allow())
+            or (cp.byte_bucket is not None
+                and not cp.byte_bucket.allow(len(pkt.buf) + HEADER_SIZE))
+        ):
+            cp.last_heartbeat = asyncio.get_event_loop().time()
+            overload.shed_counter(cls, "gate_ratelimit").inc()
+            return
+        if cls != overload.CLASS_NOISE and self.overload.should_shed(cls):
+            cp.last_heartbeat = asyncio.get_event_loop().time()
+            overload.shed_counter(cls, "gate_ingress").inc()
+            return
         if msgtype not in (proto.MT_HEARTBEAT,
                            proto.MT_CLIENT_SYNC_POSITION_YAW):
             # heartbeats are noise; sync records are staged into a
@@ -325,7 +432,13 @@ class GateService:
         client id onto entity RPCs; batch sync records per dispatcher."""
         cp.last_heartbeat = asyncio.get_event_loop().time()
         if msgtype == proto.MT_HEARTBEAT:
-            cp.send(new_packet(proto.MT_HEARTBEAT))
+            if self.overload.should_shed(overload.CLASS_NOISE):
+                # liveness was recorded above; the REPLY is the
+                # cheapest bytes on the wire and goes first
+                overload.shed_counter(
+                    overload.CLASS_NOISE, "gate_ingress").inc()
+                return
+            self._send_to_client(cp, new_packet(proto.MT_HEARTBEAT))
             return
         if msgtype == proto.MT_CLIENT_SYNC_POSITION_YAW:
             rec = pkt.read_bytes(proto.SYNC_RECORD_SIZE)
@@ -413,10 +526,65 @@ class GateService:
                 )
                 out.append_var_str(method)
                 out.append_bytes(args_raw)
-                cp.send(out)
+                self._send_to_client(cp, out)
             return
         logger.warning("gate%d: dispatcher sent unhandled msgtype %d",
                        self.gate_id, msgtype)
+
+    def _send_to_client(self, cp: ClientProxy, p: Packet) -> None:
+        """Downstream send with a per-client byte bound: a consumer
+        whose socket buffer is full gets SELF-HEALING packets (sync
+        records — the next tick re-sends current state) dropped,
+        counted in ``gate_downstream_dropped_total``, instead of
+        growing process memory without limit; it is disconnected once
+        the buffer stays full past ``downstream_kick_secs``, or
+        IMMEDIATELY when a correctness-critical message (create/
+        destroy/RPC — nothing ever re-sends those) would have to drop,
+        because a silently desynced world view is worse than a
+        reconnect — kick, never wedge (a 1M-user gate cannot carry
+        dead weight)."""
+        if self.downstream_max_bytes <= 0:
+            cp.send(p)
+            return
+        buffered = cp.downstream_buffered()
+        if buffered + len(p.buf) <= self.downstream_max_bytes:
+            if cp.down_full_since is not None:
+                cp.down_full_since = None
+                self._down_full.discard(cp.client_id)
+            cp.send(p)
+            return
+        self._m_down_dropped.inc()
+        now = asyncio.get_event_loop().time()
+        mt = (int.from_bytes(bytes(p.buf[:2]), "little") & MSGTYPE_MASK
+              if len(p.buf) >= 2 else 0)
+        p.release()
+        if overload.classify(mt) < overload.CLASS_SYNC:
+            self._kick_stalled(cp, buffered,
+                               f"cannot take msgtype {mt}")
+            return
+        if cp.down_full_since is None:
+            cp.down_full_since = now
+            self._down_full.add(cp.client_id)
+            logger.warning(
+                "gate%d: client %s downstream buffer full (%d B); "
+                "dropping (kick in %.0fs unless it drains)",
+                self.gate_id, cp.client_id, buffered,
+                self.downstream_kick_secs,
+            )
+        elif now - cp.down_full_since >= self.downstream_kick_secs:
+            self._kick_stalled(
+                cp, buffered,
+                f"full for {now - cp.down_full_since:.1f}s")
+
+    def _kick_stalled(self, cp: ClientProxy, buffered: int,
+                      why: str) -> None:
+        self._m_kicked.inc()
+        logger.warning(
+            "gate%d: kicking client %s — downstream buffer stalled at "
+            "%d B (%s)", self.gate_id, cp.client_id, buffered, why,
+        )
+        asyncio.ensure_future(cp.conn.close())
+        self._drop_client(cp)
 
     def _relay_to_client(self, msgtype: int, pkt: Packet) -> None:
         """Relay one redirect-range message to its client proxy; ``pkt``
@@ -437,7 +605,7 @@ class GateService:
             pkt.rpos = save
         out = new_packet(msgtype)
         out.append_bytes(bytes(memoryview(pkt.buf)[pkt.rpos:]))
-        cp.send(out)
+        self._send_to_client(cp, out)
 
     def _handle_sync_on_clients(self, pkt: Packet) -> None:
         """Regroup 48B (cid+eid+pos) records per client and send each its
@@ -465,12 +633,13 @@ class GateService:
             out.append_bytes(
                 codec.encode_sync_batch(eids[idxs], vals[idxs])
             )
-            cp.send(out)
+            self._send_to_client(cp, out)
 
     # -- periodic work ----------------------------------------------------
     async def _flush_loop(self) -> None:
         """Flush pending upstream sync batches every sync interval
-        (reference ``tryFlushPendingSyncPackets`` ``:402-429``)."""
+        (reference ``tryFlushPendingSyncPackets`` ``:402-429``); the
+        same cadence drives the gate's overload governor."""
         while True:
             await asyncio.sleep(self.sync_interval)
             for didx, buf in self._sync_pending.items():
@@ -480,6 +649,26 @@ class GateService:
                 p.append_bytes(bytes(buf))
                 self.cluster.conns[didx].send(p)
                 buf.clear()
+            self._observe_overload()
+
+    def _observe_overload(self) -> None:
+        """Feed the gate governor: the FRACTION of clients whose
+        downstream buffer is refusing sends (maintained incrementally
+        by ``_send_to_client`` — O(1) here, and one stalled phone
+        among thousands of healthy clients reads as ~0 pressure, not
+        gate-wide overload) and the reconnect-pend fraction (a gate
+        has no tick, so latency/backlog stay 0)."""
+        down_frac = (
+            len(self._down_full) / len(self.clients)
+            if self.clients else 0.0
+        )
+        pend_frac = 0.0
+        for c in self.cluster.conns:
+            if c.pend_max_bytes > 0:
+                pend_frac = max(
+                    pend_frac, c._pending_bytes / c.pend_max_bytes
+                )
+        self.overload.observe(0.0, 0.0, down_frac, pend_frac)
 
     async def _heartbeat_loop(self) -> None:
         """Kick clients that stopped heartbeating (reference ``:197-207``)."""
@@ -501,6 +690,10 @@ class GateService:
 
         async def handle(ws):
             loop = asyncio.get_event_loop()
+            if self._refuse_new_client() is not None:
+                self._m_rejected.inc()
+                await ws.close()
+                return
             # adapt the websocket into the PacketConnection interface via
             # an in-memory stream pair
             reader = asyncio.StreamReader()
@@ -519,6 +712,12 @@ class GateService:
 
             conn = PacketConnection(reader, _WSWriter())  # type: ignore
             cp = ClientProxy(conn)
+            if self.rate_limit_pps > 0:
+                cp.bucket = overload.TokenBucket(
+                    self.rate_limit_pps, burst=2 * self.rate_limit_pps)
+            if self.rate_limit_bps > 0:
+                cp.byte_bucket = overload.TokenBucket(
+                    self.rate_limit_bps, burst=2 * self.rate_limit_bps)
             cp.last_heartbeat = loop.time()
             self.clients[cp.client_id] = cp
             boot_eid = ids.gen_entity_id()

@@ -33,7 +33,8 @@ class ClusterHarness:
                  with_ws: bool = False, with_kcp: bool = False,
                  compress: bool = False,
                  tls_dir: str | None = None,
-                 gate_exit_on_dispatcher_loss: bool = False):
+                 gate_exit_on_dispatcher_loss: bool = False,
+                 gate_kwargs: dict | None = None):
         self.host = host
         self.n_dispatchers = n_dispatchers
         self.n_gates = n_gates
@@ -50,6 +51,9 @@ class ClusterHarness:
         # default False: the harness tears processes down in arbitrary
         # order; real deployments keep the gate default (True)
         self.gate_exit_on_dispatcher_loss = gate_exit_on_dispatcher_loss
+        # extra GateService kwargs (admission-control knobs in the
+        # overload tests: max_clients, rate_limit_pps, ...)
+        self.gate_kwargs = gate_kwargs or {}
         self.dispatchers: list[DispatcherService] = []
         self.gates: list[GateService] = []
         self.dispatcher_addrs: list[tuple[str, int]] = []
@@ -115,6 +119,7 @@ class ClusterHarness:
                 compress=self.compress,
                 ssl_context=ssl_ctx,
                 exit_on_dispatcher_loss=self.gate_exit_on_dispatcher_loss,
+                **self.gate_kwargs,
             )
             self.gates.append(g)
             self._tasks.append(asyncio.ensure_future(g.serve()))

@@ -25,7 +25,8 @@ from typing import Any, Callable
 
 import msgpack
 
-from goworld_tpu.utils import faults, log, metrics, opmon
+from goworld_tpu.utils import consts, faults, log, metrics, opmon, \
+    overload
 
 logger = log.get("storage")
 
@@ -224,6 +225,18 @@ class Storage:
         self._m_err = metrics.counter(
             "storage_op_errors_total",
             help="non-save storage ops that exhausted retries")
+        # circuit breaker around the backend: reads fail FAST while
+        # open (a dead backend must not stack 3-attempt retry sleeps
+        # per op); saves never give up — they wait out the open window
+        # and ride the half-open probe when it comes
+        self.breaker = overload.register_breaker(overload.CircuitBreaker(
+            "storage",
+            failure_threshold=consts.CIRCUIT_FAILURE_THRESHOLD,
+            reset_timeout=consts.CIRCUIT_RESET_TIMEOUT,
+        ))
+        self._m_circuit_rejected = metrics.counter(
+            "storage_circuit_rejected_total",
+            help="storage ops failed fast while the circuit was open")
         self._thread = threading.Thread(
             target=self._run, name="storage", daemon=True
         )
@@ -291,7 +304,23 @@ class Storage:
     def _execute(self, op: tuple) -> None:
         kind, type_name, eid, data, cb = op
         attempt = 0
+        t0 = time.perf_counter()
         while True:
+            if not self.breaker.allow():
+                self._m_circuit_rejected.inc()
+                if kind == "save":
+                    # saves never give up: wait out the open window,
+                    # then the half-open probe (one real attempt)
+                    # decides whether the backend is back
+                    time.sleep(min(self.breaker.reset_timeout,
+                                   SAVE_RETRY_MAX))
+                    continue
+                logger.error(
+                    "storage %s %s.%s rejected fast (circuit open)",
+                    kind, type_name, eid,
+                )
+                res = None
+                break
             # per-ATTEMPT timing (like the kvdb shim): folding the
             # retry backoff sleeps into storage_op_ms would report
             # injected wait, not backend latency
@@ -307,8 +336,10 @@ class Storage:
                     res = self.backend.exists(type_name, eid)
                 else:
                     res = self.backend.list_entity_ids(type_name)
+                self.breaker.record_success()
                 break
             except Exception as exc:
+                self.breaker.record_failure()
                 attempt += 1
                 if kind == "save":
                     # saves retry forever: losing entity data is worse

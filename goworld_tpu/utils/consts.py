@@ -43,6 +43,36 @@ MAX_PENDING_PACKETS_PER_ENTITY = 1_000
 MAX_RECONNECT_PEND_PACKETS = 65_536
 MAX_RECONNECT_PEND_BYTES = 32 << 20
 
+# --- overload protection (utils/overload.py; docs/ROBUSTNESS.md) -------
+# governor hysteresis: consecutive pressured observations to climb one
+# ladder rung / consecutive calm observations to descend one
+OVERLOAD_UP_TICKS = 8
+OVERLOAD_DOWN_TICKS = 120
+# tick wall time / tick_interval that counts as pressure (1.0 = the
+# loop exactly misses its cadence; 1.5 leaves headroom for one-off GC
+# or compile stalls)
+OVERLOAD_LATENCY_RATIO = 1.5
+OVERLOAD_BACKLOG_ENTER = 2.0
+# per-class ingress queue caps for the bounded (sheddable) classes;
+# critical/rpc use MAX_PENDING_PACKETS_PER_GAME as an OOM backstop
+OVERLOAD_QUEUE_CAP_SYNC = 65_536
+OVERLOAD_QUEUE_CAP_EVENTS = 65_536
+OVERLOAD_QUEUE_CAP_NOISE = 4_096
+# DEGRADED fan-out degradation: sync every Nth tick per entity cohort,
+# flush client event/sync bundles every Nth tick (bigger batches)
+DEGRADED_SYNC_STRIDE = 4
+DEGRADED_EVENT_COALESCE_TICKS = 2
+# gate admission: per-client downstream buffer budget; a client whose
+# socket stays full past the kick window is disconnected
+GATE_DOWNSTREAM_MAX_BYTES = 4 << 20
+GATE_DOWNSTREAM_KICK_SECS = 10.0
+# dispatcher per-game pend queue byte budget (packet budget is
+# MAX_PENDING_PACKETS_PER_GAME)
+MAX_PENDING_BYTES_PER_GAME = 64 << 20
+# circuit breakers around kvdb/storage backends
+CIRCUIT_FAILURE_THRESHOLD = 5
+CIRCUIT_RESET_TIMEOUT = 5.0
+
 # --- timeouts (reference consts.go:58-64) ------------------------------
 MIGRATE_TIMEOUT = 60.0
 LOAD_TIMEOUT = 60.0

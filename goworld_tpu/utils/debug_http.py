@@ -25,6 +25,9 @@ TPU-native analog exposes:
 * ``/faults`` — fault-injection plane state (:mod:`goworld_tpu.utils.
   faults`): seed, per-rule trial counts and the deterministic fired
   log; ``{"active": false}`` when no schedule is installed
+* ``/overload`` — overload-protection plane state (:mod:`goworld_tpu.
+  utils.overload`): every registered governor's ladder state and
+  transition log, circuit breaker states, per-class shed counters
 
 Stdlib-only (http.server on a daemon thread), one call to :func:`start`.
 """
@@ -44,7 +47,7 @@ from goworld_tpu.utils import log, metrics, opmon, tracing
 logger = log.get("debug_http")
 
 _ENDPOINTS = ["/healthz", "/vars", "/ops", "/metrics", "/trace",
-              "/tracing", "/clock", "/profile", "/faults"]
+              "/tracing", "/clock", "/profile", "/faults", "/overload"]
 
 # jax.profiler capture state (one capture at a time per process)
 _profile_lock = threading.Lock()
@@ -172,6 +175,12 @@ class _Handler(BaseHTTPRequestHandler):
             from goworld_tpu.utils import faults
 
             self._json(faults.snapshot())
+        elif path == "/overload":
+            # overload ladder state, per-class shed counters and
+            # circuit breakers (utils/overload.py)
+            from goworld_tpu.utils import overload
+
+            self._json(overload.snapshot())
         else:
             self._json({"error": "not found",
                         "endpoints": _ENDPOINTS}, 404)

@@ -44,6 +44,12 @@ def pytest_configure(config):
         "chaos: seeded fault-injection tests (tests/test_chaos.py); the "
         "fast smoke runs in tier-1, the full soak is also marked slow",
     )
+    config.addinivalue_line(
+        "markers",
+        "overload: overload-protection ladder tests "
+        "(tests/test_overload.py); the live smoke runs in tier-1, the "
+        "chaos_soak overload scenario is also marked slow",
+    )
 
 
 def spawn_on(states, dev, slot, **kw):

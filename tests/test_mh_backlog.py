@@ -11,7 +11,7 @@ but the queue + counters), so no multihost process pair is needed.
 import logging
 
 from goworld_tpu.net.game import GameServer
-from goworld_tpu.utils import opmon
+from goworld_tpu.utils import opmon, overload
 
 
 class _Stub:
@@ -23,6 +23,10 @@ class _Stub:
         self._mh_pending = []
         self._mh_backlog_ticks = 0
         self.world = type("W", (), {"op_stats": {}})()
+        # the sustained-backlog alarm reports the overload plane's
+        # state + shed deltas (ISSUE 4 satellite)
+        self.overload = overload.OverloadGovernor("stub-mh")
+        self._shed_at_alarm = {}
 
 
 def test_drain_orders_and_reports_backlog():

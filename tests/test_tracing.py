@@ -161,6 +161,7 @@ def test_pending_queues_preserve_trace_context():
     the queueing delay is exactly the hop a p99 investigation needs."""
     from goworld_tpu.net.cluster import DispatcherConn
     from goworld_tpu.net.dispatcher import _GameInfo
+    from goworld_tpu.utils import overload
 
     ctx = tracing.new_trace()
     gi = _GameInfo(1)  # conn is None: send() queues
@@ -168,7 +169,9 @@ def test_pending_queues_preserve_trace_context():
     p.append_var_str("x")
     p.trace = ctx
     gi.send(p, release=False)
-    mt, q = decode_wire(gi.pending[0])
+    # the pend queue is class-prioritized now (ISSUE 4); an entity RPC
+    # lands in the rpc-class deque
+    mt, q = decode_wire(gi.pending[overload.CLASS_RPC][0])
     assert mt == proto.MT_CALL_ENTITY_METHOD
     assert q.trace is not None and q.trace.trace_id == ctx.trace_id
     assert q.read_var_str() == "x"
