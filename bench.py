@@ -57,6 +57,9 @@ sys.path.insert(0, REPO)
 # jax-free (verified: pure constants) — safe in the no-jax parent
 from goworld_tpu.utils import consts as _consts
 BASELINE_ENTITY_TICKS_PER_CHIP = 7.5e6
+# packed-id bound shared with ops/aoi.py: the Verlet reuse path (and
+# its phase probes below) only exists for n below it
+_AOI_ID_BITS = _consts.AOI_ID_BITS
 
 # grid knob -> env var pinning it (shared by _grid_kw_from_env's
 # consumers, autotune's pin detection, and the variant forwarding)
@@ -66,7 +69,20 @@ GRID_ENV = {
     "row_block": "BENCH_ROW_BLOCK",
     "topk_impl": "BENCH_TOPK",
     "sweep_impl": "BENCH_SWEEP",
+    "sort_impl": "BENCH_SORT",
+    "skin": "BENCH_SKIN",
+    "verlet_cap": "BENCH_VERLET_CAP",
 }
+
+# Bench-default Verlet skin (world units). The bench movers advance
+# npc_speed * dt = 5/60 ~ 0.083/tick, so skin 4 rebuilds the AOI front
+# half every ~ (skin/2) / 0.083 ~ 24 ticks and every other tick
+# re-ranks cached candidates instead of re-sorting the world — exact by
+# the Verlet bound (ops/aoi.py GridSpec.skin). The LIBRARY default
+# stays 0 (consts.DEFAULT_AOI_SKIN): a skin must be sized to movement
+# speed, which the bench knows and a generic deploy doesn't. Pin
+# BENCH_SKIN=0 to A/B the skinless path.
+BENCH_SKIN_DEFAULT = 4.0
 
 # autotune_sweep's candidate pool: (selectable, grid overrides).
 # Module-level so tests can assert the fidelity contract directly:
@@ -78,12 +94,16 @@ AUTOTUNE_CANDIDATES = [
     (True, {"row_block": 32768}),
     # dense-table sweep (pre-r4 default; "ranges" won the r4 CPU A/B
     # by 18% and is never-worse on fidelity, so it is the default
-    # now) — kept so autotune can pick table back on TPU
-    (True, {"sweep_impl": "table"}),
+    # now) — kept so autotune can pick table back on TPU. Front-half
+    # A/Bs (sweep_impl / sort_impl) pin skin=0: under the skin-on
+    # default the structure build + cell sort only run on the ONE
+    # rebuild tick the scan-marginal cancels, so their timing would be
+    # pure reuse-tick noise measuring no front half at all.
+    (True, {"sweep_impl": "table", "skin": 0.0}),
     # table with premerged windows + one canonical row-gather per
     # query (bit-identical to table ALWAYS; built for TPU where
     # gather descriptors bound the sweep)
-    (True, {"sweep_impl": "cellrow"}),
+    (True, {"sweep_impl": "cellrow", "skin": 0.0}),
     # the generic int32 lax.top_k (pre-r4 default; "sort" is the
     # default now) — kept so autotune can still detect a platform
     # where it wins
@@ -91,6 +111,19 @@ AUTOTUNE_CANDIDATES = [
     # exact top-k in the f32 bit-pattern domain: rides the fast TPU
     # TopK custom-call instead of the generic int32 expansion
     (True, {"topk_impl": "f32"}),
+    # skinless Verlet A/B: strictly never-worse fidelity than the
+    # skin-on bench default (no candidate cache to overflow), so
+    # autotune may select it wherever the reuse doesn't pay
+    (True, {"skin": 0.0}),
+    # two-pass counting sort front half (ops/sort.py): stable, hence
+    # bit-identical results to argsort in every regime — a pure
+    # lowering A/B targeting the roofline's dominant bitonic term
+    # (skin pinned 0 so the sort actually runs every measured tick)
+    (True, {"sort_impl": "counting", "skin": 0.0}),
+    # the counting sort's Pallas kernel: interpret-mode (CPU) runs are
+    # emulation — meaningless to time off-TPU and compile-risky on new
+    # backends, so diagnostic until a relay window measures it
+    (False, {"sort_impl": "pallas", "skin": 0.0}),
     # cell-major gather-free sweep: DIAGNOSTIC despite its speed
     # potential — beyond cell_cap it drops overflowed entities as
     # watchers (strictly worse than table, unlike ranges' pooling),
@@ -98,8 +131,8 @@ AUTOTUNE_CANDIDATES = [
     # per-run chance of that regime. Selecting it would need the
     # headline run to verify the over-cap gauge stayed zero on the
     # measured workload; pin BENCH_SWEEP=shift to A/B by hand.
-    (False, {"sweep_impl": "shift"}),
-    (False, {"sweep_impl": "shift", "topk_impl": "sort"}),
+    (False, {"sweep_impl": "shift", "skin": 0.0}),
+    (False, {"sweep_impl": "shift", "topk_impl": "sort", "skin": 0.0}),
     (False, {"cell_cap": 8}),           # diagnostic: drop risk at 1M
     (False, {"topk_impl": "approx"}),   # diagnostic: recall < 1
 ]
@@ -142,9 +175,19 @@ def _grid_kw_from_env(n: int, overrides: dict | None = None) -> dict:
         topk_impl=os.environ.get("BENCH_TOPK", _consts.DEFAULT_TOPK_IMPL),
         sweep_impl=os.environ.get("BENCH_SWEEP",
                                   _consts.DEFAULT_SWEEP_IMPL),
+        sort_impl=os.environ.get("BENCH_SORT",
+                                 _consts.DEFAULT_SORT_IMPL),
+        skin=float(os.environ.get("BENCH_SKIN", BENCH_SKIN_DEFAULT)),
+        verlet_cap=int(os.environ.get("BENCH_VERLET_CAP", 0)),
     )
     grid_kw.update(overrides or {})
     grid_kw["row_block"] = min(n, grid_kw["row_block"])
+    if n >= (1 << _AOI_ID_BITS):
+        # the Verlet path needs the packed-id fast path; past the
+        # bound keep the grid geometry identical to the stateless
+        # config instead of binning at radius+skin with no reuse to
+        # show for it (api.py zeroes the skin the same way)
+        grid_kw["skin"] = 0.0
     return grid_kw
 
 
@@ -154,7 +197,7 @@ def build(n: int, client_frac: float, grid_overrides: dict | None = None):
 
     from goworld_tpu.core.state import SpaceState, WorldConfig
     from goworld_tpu.core.step import TickInputs
-    from goworld_tpu.ops.aoi import GridSpec
+    from goworld_tpu.ops.aoi import GridSpec, init_verlet_cache
 
     # ~12 avg Chebyshev neighbors at radius 50 (north-star AOI density)
     extent = float(int((n * 10000 / 12) ** 0.5))
@@ -201,6 +244,9 @@ def build(n: int, client_frac: float, grid_overrides: dict | None = None):
         dirty=jnp.zeros(n, bool),
         rng=jax.random.PRNGKey(1),
         tick=jnp.zeros((), jnp.int32),
+        aoi_cache=(init_verlet_cache(cfg.grid, n)
+                   if cfg.grid.skin > 0 and n < (1 << _AOI_ID_BITS)
+                   else None),
     )
     # steady stream of client position syncs (input-scatter path stays hot)
     inputs = TickInputs(
@@ -228,15 +274,18 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
     (cellrow is bit-identical to table always; both are bit-identical
     to ranges while per-cell occupancy <= cell_cap, a 9x margin at
     bench density, and the default ranges impl only ever ADDS neighbors
-    beyond that), and the exact/f32 top-k lowerings (same total key
-    order as sort). cell_cap=8 and the approx top-k are DIAGNOSTICS
-    only:
+    beyond that), the exact/f32 top-k lowerings (same total key
+    order as sort), the counting-sort front half (stable — bit-
+    identical to argsort everywhere), and skin=0 (strictly never-worse
+    fidelity than the skin-on default: no candidate cache to
+    overflow). cell_cap=8, the approx top-k, the pallas sort (CPU runs
+    are interpret-mode emulation) and shift are DIAGNOSTICS only:
     cap 8 drops neighbors in overflowing cells at 1M density and approx
     trades ~2% recall — autotune must never make the headline measure
     LESS than the documented default does. Knobs the caller pinned via
-    env are never overridden. Bounded cost: 6 selectable candidates x 2
-    jitted scan lengths = 12 sweep-only compiles at 131K (plus 4 more
-    candidate pairs with BENCH_AUTOTUNE_DIAG=1); any failure falls
+    env are never overridden. Bounded cost: 8 selectable candidates x 2
+    jitted scan lengths = 16 sweep-only compiles at 131K (plus the
+    diagnostic pairs with BENCH_AUTOTUNE_DIAG=1); any failure falls
     back to defaults."""
     import numpy as np
 
@@ -244,7 +293,12 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
     import jax.numpy as jnp
     from jax import lax
 
-    from goworld_tpu.ops.aoi import GridSpec, grid_neighbors_flags
+    from goworld_tpu.ops.aoi import (
+        GridSpec,
+        grid_neighbors_flags,
+        grid_neighbors_verlet,
+        init_verlet_cache,
+    )
 
     n = int(os.environ.get("BENCH_AUTOTUNE_N", 131072))
     extent = float(int((n * 10000 / 12) ** 0.5))
@@ -270,6 +324,31 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
                         **gk)
 
         def mk(length, spec=spec):
+            if spec.skin > 0 and n < (1 << _AOI_ID_BITS):
+                # verlet specs carry the candidate cache through the
+                # scan like the real tick does. The ~static positions
+                # mean one rebuild (tick 0) then pure reuse, and the
+                # 2x-minus-1x marginal cancels that rebuild — this
+                # times the REUSE tick; the rebuild amortization shows
+                # up in the headline run's real movement.
+                cache0 = init_verlet_cache(spec, n)
+
+                @jax.jit
+                def run(p):
+                    def body(carry, _):
+                        c, cache = carry
+                        nbr, cnt, fl, _st, cache, _rb, _sl = \
+                            grid_neighbors_verlet(
+                                spec, c, alive, cache, flag_bits=flags
+                            )
+                        c = c + (cnt[:, None] % 2).astype(c.dtype) * 1e-6
+                        return (c, cache), cnt.sum() + fl.sum()
+                    (pp, _), s = lax.scan(
+                        body, (p, cache0), None, length=length
+                    )
+                    return s.sum() + pp.sum()
+                return run
+
             @jax.jit
             def run(p):
                 def body(c, _):
@@ -416,6 +495,20 @@ def measure(n: int, ticks: int, client_frac: float, phases: bool,
         "compile_s": round(compile_s, 1),
         "compile2_s": round(compile2_s, 1),
         "behavior": cfg.behavior,
+        # the RESOLVED kernel choices this number was produced with
+        # (env defaults + autotune overrides), so trajectory files
+        # (BENCH_*.json) record which kernels made each headline
+        "sweep_impl": cfg.grid.sweep_impl,
+        "topk_impl": cfg.grid.topk_impl,
+        "sort_impl": cfg.grid.sort_impl,
+        # skin stamped as EFFECTIVE: past the packed-id bound the tick
+        # statically falls back to the stateless sweep, and the stamp
+        # must record what actually produced the number
+        "skin": (cfg.grid.skin
+                 if n < (1 << _AOI_ID_BITS) else 0.0),
+        "verlet_cap": (cfg.grid.verlet_cap_eff
+                       if cfg.grid.skin > 0
+                       and n < (1 << _AOI_ID_BITS) else 0),
         "device": str(jax.devices()[0]),
         "platform": jax.devices()[0].platform,
     }
@@ -502,24 +595,70 @@ def measure_phases(cfg, st, inputs, ticks: int) -> dict:
     from jax import lax
 
     from goworld_tpu.models.random_walk import random_walk_step
-    from goworld_tpu.ops.aoi import grid_neighbors, grid_neighbors_flags
+    from goworld_tpu.ops.aoi import (
+        grid_neighbors,
+        grid_neighbors_flags,
+        grid_neighbors_verlet,
+        init_verlet_cache,
+    )
     from goworld_tpu.ops.delta import interest_pairs
     from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
     from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
 
     n = cfg.capacity
+    # mirror tick_body's use_verlet guard: past the packed-id bound the
+    # real tick falls back to the stateless sweep, so the phase probes
+    # must too (grid_neighbors_verlet raises there)
+    verlet = cfg.grid.skin > 0 and getattr(st, "aoi_cache", None) \
+        is not None and n < (1 << _AOI_ID_BITS)
 
-    @jax.jit
-    def aoi_only(state):
-        def body(carry, _):
-            pos = carry
-            nbr, cnt = grid_neighbors(cfg.grid, pos, state.alive)
-            # feed a nbr-dependent perturbation back so scan iterations
-            # cannot be collapsed by the compiler
-            pos = pos + (cnt[:, None] % 2).astype(pos.dtype) * 1e-6
-            return pos, cnt.sum()
-        pos, s = lax.scan(body, state.pos, None, length=ticks)
-        return s.sum() + pos.sum()
+    if verlet:
+        # skin sub-phases: "aoi" is the REAL configured path (cache
+        # carried through the scan — one rebuild at tick 0, reuse
+        # after, like the live tick at low displacement);
+        # "aoi_rebuild" forces the front half every iteration (the
+        # rebuild-tick cost); "aoi_reuse" starts from a warmed cache
+        # (the steady-state reuse tick). Amortized truth at cadence C:
+        # (reuse*(C-1) + rebuild) / C.
+        cache0 = init_verlet_cache(cfg.grid, n)
+
+        def make_verlet(init_cache, force_rebuild):
+            @jax.jit
+            def probe(state):
+                def body(carry, _):
+                    pos, cache = carry
+                    _nbr, cnt, _fl, _s, cache2, _rb, _sl = \
+                        grid_neighbors_verlet(
+                            cfg.grid, pos, state.alive,
+                            cache0 if force_rebuild else cache,
+                        )
+                    pos = pos + (cnt[:, None] % 2).astype(pos.dtype) \
+                        * 1e-6
+                    return (pos, cache2), cnt.sum()
+                (pos, _c), s = lax.scan(
+                    body, (state.pos, init_cache), None, length=ticks
+                )
+                return s.sum() + pos.sum()
+            return probe
+
+        aoi_only = make_verlet(cache0, False)
+        aoi_rebuild_only = make_verlet(cache0, True)
+        warm_cache = grid_neighbors_verlet(
+            cfg.grid, st.pos, st.alive, cache0
+        )[4]
+        aoi_reuse_only = make_verlet(warm_cache, False)
+    else:
+        @jax.jit
+        def aoi_only(state):
+            def body(carry, _):
+                pos = carry
+                nbr, cnt = grid_neighbors(cfg.grid, pos, state.alive)
+                # feed a nbr-dependent perturbation back so scan
+                # iterations cannot be collapsed by the compiler
+                pos = pos + (cnt[:, None] % 2).astype(pos.dtype) * 1e-6
+                return pos, cnt.sum()
+            pos, s = lax.scan(body, state.pos, None, length=ticks)
+            return s.sum() + pos.sum()
 
     def make_sweep_probe(phase):
         from goworld_tpu.ops.aoi import sweep_phase_checksum
@@ -599,16 +738,25 @@ def measure_phases(cfg, st, inputs, ticks: int) -> dict:
     nbr, cnt, fl = grid_neighbors_flags(
         cfg.grid, st.pos, st.alive, flag_bits=st.dirty.astype(jnp.int32)
     )
-    for name, fn, args in (
+    phase_list = [
         ("aoi", aoi_only, (st,)),
         # sweep sub-phases (cumulative: sort ⊂ build ⊂ aoi): where the
         # AOI milliseconds go — cell sort vs candidate-structure build
-        # vs window gather + top_k (= aoi - build)
+        # vs window gather + top_k (= aoi - build). With a skin these
+        # attribute the REBUILD tick's front half.
         ("aoi_sort", make_sweep_probe("sort"), (st,)),
         ("aoi_build", make_sweep_probe("build"), (st,)),
+    ]
+    if verlet:
+        phase_list += [
+            ("aoi_rebuild", aoi_rebuild_only, (st,)),
+            ("aoi_reuse", aoi_reuse_only, (st,)),
+        ]
+    phase_list += [
         ("move", move_only, (st,)),
         ("collect", collect_only, (st, nbr, fl)),
-    ):
+    ]
+    for name, fn, args in phase_list:
         float(np.asarray(fn(*args)))  # compile + force
         t0 = time.perf_counter()
         r = float(np.asarray(fn(*args)))
@@ -1225,8 +1373,13 @@ def selftest_main() -> int:
         for k in ("wall_t_s_all", "wall_2t_s_all", "scale_2x",
                   "compile_s", "attempts"):
             check(f"full.{k}", k in art, "missing")
+        for k in ("sweep_impl", "topk_impl", "sort_impl", "skin"):
+            check(f"full.stamp.{k}", k in art, "missing kernel stamp")
         pm = art.get("phase_ms", {})
-        for k in ("aoi", "aoi_sort", "aoi_build", "move", "collect"):
+        phase_keys = ["aoi", "aoi_sort", "aoi_build", "move", "collect"]
+        if art.get("skin", 0) > 0:
+            phase_keys += ["aoi_rebuild", "aoi_reuse"]
+        for k in phase_keys:
             check(f"full.phase.{k}", k in pm, f"phase_ms={pm}")
         check("full.p99", "tick_p99_ms" in art, "missing p99 keys")
         check("full.p99_gate", "p99_suspect" not in art,

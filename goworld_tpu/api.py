@@ -163,6 +163,35 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
     from goworld_tpu.core.state import WorldConfig
     from goworld_tpu.ops.aoi import GridSpec
 
+    if gc.small_tier_rows \
+            and not os.environ.get("GOWORLD_SMALL_TIER_ROWS"):
+        # must land before the first trace: the tier budget is baked
+        # into the jitted extraction graphs. Env wins over ini, like
+        # GOWORLD_FAULTS[_SEED] (extract applied it at import); a
+        # negative ini value reaches the setter and fails loudly
+        # (0 = keep the library default)
+        from goworld_tpu.ops import extract
+
+        extract.set_small_tier_rows(gc.small_tier_rows)
+    aoi_skin = gc.aoi_skin
+    if gc.megaspace and aoi_skin > 0:
+        # the megaspace step queries ghost rows through the stateless
+        # sweep; there is no carried cache to reuse there
+        logger.warning("aoi_skin ignored for megaspace games")
+        aoi_skin = 0.0
+    if aoi_skin > 0 and gc.capacity >= (1 << consts.AOI_ID_BITS):
+        # the Verlet reuse path rides the packed-id fast path
+        logger.warning(
+            "aoi_skin ignored: capacity %d >= 2^%d (packed-id bound)",
+            gc.capacity, consts.AOI_ID_BITS,
+        )
+        aoi_skin = 0.0
+    kernel_kw = dict(
+        sort_impl=gc.aoi_sort_impl,
+        skin=aoi_skin,
+        verlet_cap=gc.aoi_verlet_cap,
+        rebuild_every_max=gc.aoi_rebuild_every_max,
+    )
     mega_shape = None
     if gc.megaspace:
         # user config speaks WORLD extents; the megaspace grid is the
@@ -204,6 +233,7 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
             else gc.extent_z,
             sweep_impl=gc.aoi_sweep_impl,
             topk_impl=gc.aoi_topk_impl,
+            **kernel_kw,
             **_grid_caps(gc),
         )
         mega_shape = (tx, tz)
@@ -212,6 +242,7 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
                         extent_z=gc.extent_z,
                         sweep_impl=gc.aoi_sweep_impl,
                         topk_impl=gc.aoi_topk_impl,
+                        **kernel_kw,
                         **_grid_caps(gc))
     wc = WorldConfig(
         capacity=gc.capacity,

@@ -62,6 +62,27 @@ class GameConfig:
     # of truth shared with GridSpec and bench.py.
     aoi_sweep_impl: str = consts.DEFAULT_SWEEP_IMPL
     aoi_topk_impl: str = consts.DEFAULT_TOPK_IMPL
+    # front-half cell-sort lowering ("argsort" | "counting" — two-pass
+    # counting sort, bit-identical to argsort, deletes the bitonic
+    # network | "pallas" — its kernel form). consts.DEFAULT_SORT_IMPL
+    # is the shared default literal.
+    aoi_sort_impl: str = consts.DEFAULT_SORT_IMPL
+    # Verlet skin width (world units; 0 = off): build the AOI grid for
+    # radius + skin and skip the whole front half on ticks where no
+    # entity moved more than skin/2 since the last rebuild — exact by
+    # the standard Verlet bound (ops/aoi.py GridSpec.skin). Size it
+    # from movement speed: rebuild cadence ~ skin / (2*speed*dt).
+    # Ignored for megaspace games (ghost query rows keep the stateless
+    # sweep) and for n_spaces > 1 (the vmapped multi-space step runs
+    # both cond branches). Memory: capacity x aoi_verlet_cap i32.
+    aoi_skin: float = consts.DEFAULT_AOI_SKIN
+    # cached candidate lanes per entity for the skin (0 = auto k+k//2);
+    # exactness holds while rebuild-time candidate demand fits — the
+    # aoi_over_k_rows gauge fires otherwise, like aoi_k
+    aoi_verlet_cap: int = 0
+    # force an AOI rebuild at least every N ticks regardless of
+    # displacement (staleness backstop; 0 = displacement-driven only)
+    aoi_rebuild_every_max: int = 0
     # AOI capacity bounds (ops/aoi.py GridSpec k / cell_cap): exactness
     # holds while true neighbor demand <= aoi_k and cell occupancy <=
     # aoi_cell_cap; overflow degrades to nearest-k and fires the
@@ -69,6 +90,11 @@ class GameConfig:
     # aoi_demand_max, aoi_cell_cap > aoi_cell_max. 0 = library default.
     aoi_k: int = 0
     aoi_cell_cap: int = 0
+    # churn-adaptive extraction small-tier row budget (ops/extract.py
+    # SMALL_TIER_ROWS; also env GOWORLD_SMALL_TIER_ROWS). 0 = library
+    # default (16384, sized from the 1M bench's client-row churn;
+    # TPU-profile re-derivation pending — docs/TODO_R5.md)
+    small_tier_rows: int = 0
     # periodic crash-recovery checkpoint cadence in seconds (0 = off):
     # the game snapshots the running world on this interval so a
     # watchdog restart (`ctl watchdog`) can -restore from it. Async

@@ -23,7 +23,12 @@ import jax
 import jax.numpy as jnp
 from flax import struct
 
-from goworld_tpu.ops.aoi import GridSpec
+from goworld_tpu.ops.aoi import (
+    _ID_BITS,
+    GridSpec,
+    VerletCache,
+    init_verlet_cache,
+)
 from goworld_tpu.utils import consts
 
 
@@ -115,6 +120,13 @@ class SpaceState:
     dirty: jax.Array        # bool[N]  moved this tick (syncInfoFlag analog)
     rng: jax.Array          # PRNG key
     tick: jax.Array         # i32 scalar
+    # Verlet AOI cache (ops.aoi.VerletCache): carried front-half
+    # products — candidate ids, reference positions/alive/radii, age,
+    # rebuild flag state — letting ticks whose max displacement stays
+    # under skin/2 skip the sweep's front half entirely. None when
+    # cfg.grid.skin == 0 (no memory cost); the skinless tick passes it
+    # through untouched.
+    aoi_cache: VerletCache | None = None
 
 
 def create_state(cfg: WorldConfig, seed: int = 0) -> SpaceState:
@@ -139,6 +151,13 @@ def create_state(cfg: WorldConfig, seed: int = 0) -> SpaceState:
         dirty=jnp.zeros((n,), bool),
         rng=jax.random.PRNGKey(seed),
         tick=jnp.zeros((), jnp.int32),
+        # mirrors tick_body's use_verlet guard: past the packed-id
+        # bound the tick statically falls back to the stateless sweep,
+        # so allocating the [n, verlet_cap] cache there would be
+        # carried dead weight (~400 MB at 2M capacity)
+        aoi_cache=(init_verlet_cache(cfg.grid, n)
+                   if cfg.grid.skin > 0.0 and n < (1 << _ID_BITS)
+                   else None),
     )
 
 

@@ -34,7 +34,11 @@ from goworld_tpu.models.npc_policy import (
     policy_accel,
 )
 from goworld_tpu.models.random_walk import random_walk_step
-from goworld_tpu.ops.aoi import grid_neighbors_flags
+from goworld_tpu.ops.aoi import (
+    _ID_BITS,
+    grid_neighbors_flags,
+    grid_neighbors_verlet,
+)
 from goworld_tpu.ops.delta import interest_pairs
 from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
 from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
@@ -94,6 +98,13 @@ class TickOutputs:
     aoi_over_k_rows: jax.Array    # rows truncated to nearest-k
     aoi_cell_max: jax.Array       # max grid-cell occupancy
     aoi_over_cap_cells: jax.Array  # cells past cell_cap (drop risk)
+    # Verlet skin-reuse telemetry (ops.aoi.grid_neighbors_verlet; None
+    # from producers predating the skin — manager guards). aoi_rebuilt
+    # is i32 0/1 (1 every tick when skin is off: the front half ran);
+    # aoi_skin_slack is f32 skin/2 minus the max displacement since the
+    # last rebuild (headroom left; meaningless 0.0 when skin is off).
+    aoi_rebuilt: jax.Array | None = None
+    aoi_skin_slack: jax.Array | None = None
 
 
 def compute_velocity(
@@ -188,13 +199,34 @@ def tick_body(
     # honors EntityTypeDesc.aoiDistance (0 = excluded from AOI). The dirty
     # bit rides the sweep's packed candidate words so sync collection
     # never re-gathers it over [N, k] (r02 TPU profile: that gather cost
-    # as much as the sweep itself).
-    nbr, nbr_cnt, nbr_fl, aoi_stats = grid_neighbors_flags(
-        cfg.grid, pos, state.alive, watch_radius=state.aoi_radius,
-        flag_bits=dirty.astype(jnp.int32)
-        | (state.has_client.astype(jnp.int32) << 1),
-        with_stats=True,
+    # as much as the sweep itself). With a Verlet skin configured the
+    # carried cache lets low-displacement ticks skip the front half +
+    # window fetch entirely (lax.cond — NOT valid under vmap, where both
+    # branches would run; the World manager clears skin for its vmapped
+    # multi-space step like adaptive_extract).
+    flag_bits = dirty.astype(jnp.int32) \
+        | (state.has_client.astype(jnp.int32) << 1)
+    use_verlet = (
+        cfg.grid.skin > 0.0
+        and state.aoi_cache is not None
+        and n < (1 << _ID_BITS)
     )
+    if use_verlet:
+        (nbr, nbr_cnt, nbr_fl, aoi_stats, aoi_cache, aoi_rebuilt,
+         aoi_slack) = grid_neighbors_verlet(
+            cfg.grid, pos, state.alive, state.aoi_cache,
+            watch_radius=state.aoi_radius, flag_bits=flag_bits,
+            with_stats=True,
+        )
+    else:
+        nbr, nbr_cnt, nbr_fl, aoi_stats = grid_neighbors_flags(
+            cfg.grid, pos, state.alive, watch_radius=state.aoi_radius,
+            flag_bits=flag_bits,
+            with_stats=True,
+        )
+        aoi_cache = state.aoi_cache
+        aoi_rebuilt = jnp.ones((), jnp.int32)
+        aoi_slack = jnp.zeros((), jnp.float32)
 
     # 5. interest deltas -> bounded enter/leave pair lists (changed rows
     # only; the k^2 membership compare never touches stable rows).
@@ -229,6 +261,7 @@ def tick_body(
         attr_dirty=jnp.zeros_like(state.attr_dirty),
         rng=rng,
         tick=state.tick + 1,
+        aoi_cache=aoi_cache,
     )
     outputs = TickOutputs(
         enter_w=enter_w, enter_j=enter_j, enter_n=enter_n,
@@ -239,6 +272,7 @@ def tick_body(
         alive_count=state.alive.sum().astype(jnp.int32),
         aoi_demand_max=aoi_stats[0], aoi_over_k_rows=aoi_stats[1],
         aoi_cell_max=aoi_stats[2], aoi_over_cap_cells=aoi_stats[3],
+        aoi_rebuilt=aoi_rebuilt, aoi_skin_slack=aoi_slack,
     )
     return new_state, outputs
 

@@ -93,12 +93,33 @@ def _type_aoi_radius(desc) -> float:
     return float("inf")
 
 
-def _make_local_tick(cfg: WorldConfig):
-    """jit(vmap(tick_body)) over stacked spaces on ONE device — the
-    single-process analog of the mesh's shard_map step."""
-    # vmap would batch the churn-adaptive lax.cond into select_n (both
-    # tiers executing every tick) — run the single full-tier graph here
-    cfg = dataclasses.replace(cfg, adaptive_extract=False)
+def _make_local_tick(cfg: WorldConfig, n_spaces: int = 1):
+    """Stacked-spaces step on ONE device — the single-process analog of
+    the mesh's shard_map step. n_spaces == 1 (the common production
+    shape) calls tick_body directly on the squeezed state, so runtime
+    lax.cond paths stay real branches: the churn-adaptive extraction
+    tiers AND the Verlet skin's rebuild-vs-reuse dispatch both work.
+    n_spaces > 1 vmaps, where cond batches to select_n (both branches
+    execute every tick) — the adaptive tiers and the skin are cleared
+    there because each would be a strict pessimization under vmap."""
+    if n_spaces == 1:
+        @jax.jit
+        def step1(state, inputs, policy):
+            s1, out = tick_body(
+                cfg,
+                jax.tree.map(lambda x: x[0], state),
+                jax.tree.map(lambda x: x[0], inputs),
+                policy,
+            )
+            return (jax.tree.map(lambda x: x[None], s1),
+                    jax.tree.map(lambda x: x[None], out))
+
+        return step1
+
+    cfg = dataclasses.replace(
+        cfg, adaptive_extract=False,
+        grid=dataclasses.replace(cfg.grid, skin=0.0),
+    )
 
     @jax.jit
     def step(state, inputs, policy):
@@ -195,8 +216,18 @@ class World:
             )
             self._step = make_mega_tick(self.mega, mesh)
         else:
+            state_cfg = cfg
+            if mesh is None and n_spaces > 1 and cfg.grid.skin > 0:
+                # the vmapped local step clears the skin (cond would
+                # batch to select_n — see _make_local_tick); don't
+                # allocate [capacity, verlet_cap] caches per space that
+                # the step statically never touches
+                state_cfg = dataclasses.replace(
+                    cfg,
+                    grid=dataclasses.replace(cfg.grid, skin=0.0),
+                )
             self.state: SpaceState = create_multi_state(
-                cfg, n_spaces, seed=seed
+                state_cfg, n_spaces, seed=seed
             )
             if mesh is not None:
                 from goworld_tpu.parallel.mesh import shard_state
@@ -207,7 +238,7 @@ class World:
                     cfg, mesh, migrate_cap=migrate_cap
                 )
             else:
-                self._step = _make_local_tick(cfg)
+                self._step = _make_local_tick(cfg, n_spaces)
 
         # host object model
         self.entities: dict[str, Entity] = {}
@@ -339,6 +370,15 @@ class World:
         )
         self._m_aoi_demand = metrics.gauge("aoi_demand_max")
         self._m_aoi_cell = metrics.gauge("aoi_cell_max")
+        # Verlet skin-reuse cadence (ops.aoi.grid_neighbors_verlet):
+        # rebuild_total counts front-half rebuilds (== tick count when
+        # the skin is off), skin_slack mirrors the headroom left before
+        # the next displacement-triggered rebuild
+        self._m_aoi_rebuild = metrics.counter(
+            "aoi_rebuild_total",
+            help="AOI front-half rebuilds (every tick when skin = 0)",
+        )
+        self._m_aoi_slack = metrics.gauge("aoi_skin_slack")
 
     # ==================================================================
     # registration / creation
@@ -1944,6 +1984,17 @@ class World:
         self.op_stats["aoi_over_cap_cells"] = over_cap
         self._m_aoi_demand.set(dem_max)
         self._m_aoi_cell.set(cell_max)
+        reb = getattr(base, "aoi_rebuilt", None)
+        if reb is not None:
+            rebuilds = int(np.sum(reb))
+            slack = float(np.min(base.aoi_skin_slack))
+            if rebuilds:
+                self._m_aoi_rebuild.inc(rebuilds)
+            self._m_aoi_slack.set(slack)
+            opmon.expose("aoi_rebuild_last", rebuilds)
+            opmon.expose("aoi_skin_slack", slack)
+            self.op_stats["aoi_rebuild_last"] = rebuilds
+            self.op_stats["aoi_skin_slack"] = slack
         if over_k or over_cap:
             self._m_aoi_overflow.inc(over_k + over_cap)
         if (over_k or over_cap) and \

@@ -55,6 +55,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
+from flax import struct
 from jax import lax
 
 from goworld_tpu.utils import consts
@@ -73,7 +74,7 @@ from goworld_tpu.utils import consts
 # TPU flushes to zero — the compare would return corrupted (zeroed) key
 # bits for near neighbors. Nonnegative normal floats order exactly like
 # their bit patterns, so int-domain and f32-domain ranking agree.
-_ID_BITS = 21
+_ID_BITS = consts.AOI_ID_BITS
 _ID_MASK = (1 << _ID_BITS) - 1
 _WORD_MASK = (1 << 23) - 1
 _QD_MAX = 254
@@ -180,6 +181,47 @@ class GridSpec:
     # GameConfig.aoi_sweep_impl and bench.py, so kernel-level GridSpec
     # users can't silently get a slower impl than the production stack.
     sweep_impl: str = consts.DEFAULT_SWEEP_IMPL
+    # Front-half cell-sort lowering:
+    #   "argsort"  — XLA's generic sort (a ~0.5*log2(n)^2-pass bitonic
+    #                network on TPU; the roofline's worst HBM term at
+    #                1M — docs/ROOFLINE.md), or the packed single-array
+    #                jnp.sort fast path where the key fits (small
+    #                worlds).
+    #   "counting" — two-pass counting sort over the cell-row keys
+    #                (ops/sort.py): histogram scatter-add + exclusive
+    #                cumsum + stable chunked scatter. STABLE, so
+    #                bit-identical to argsort in every regime
+    #                (including which entities a cell_cap overflow
+    #                drops) — a pure lowering choice, never a fidelity
+    #                knob.
+    #   "pallas"   — the counting sort's rank/scatter pass as a Pallas
+    #                kernel (VMEM-resident fill histogram on the
+    #                sequential TPU grid). Interpret-mode (and thus CPU)
+    #                validated; the hardware lowering is staged for a
+    #                relay window.
+    # Default literal in consts.DEFAULT_SORT_IMPL (one source of truth
+    # with GameConfig.aoi_sort_impl and bench.py).
+    sort_impl: str = consts.DEFAULT_SORT_IMPL
+    # Verlet skin (classic particle-code neighbor-list reuse): bin and
+    # sort at cell size ``radius + skin`` and admit candidates out to
+    # ``reach + skin``; then, while every entity has moved less than
+    # ``skin/2`` Chebyshev since the last rebuild, the cached candidate
+    # lists are still a SUPERSET of every true neighborhood (each pair
+    # approached at most ``skin``), so ticks can skip the entire front
+    # half AND the 9-cell window fetch — re-ranking current distances
+    # over the cached candidate ids instead (grid_neighbors_verlet;
+    # core/step.py carries the cache in SpaceState). 0 disables.
+    # Exactness: identical neighbor sets to a per-tick rebuild while
+    # rebuild-time candidate demand <= verlet_cap_eff (the over-cap
+    # gauge fires otherwise — same bounded-capacity contract as k /
+    # cell_cap, never a silent approximation).
+    skin: float = consts.DEFAULT_AOI_SKIN
+    # cached candidate lanes per entity; 0 = auto (k + k//2)
+    verlet_cap: int = 0
+    # force a rebuild at least every N ticks regardless of displacement
+    # (staleness backstop for float-drift paranoia and for bounding the
+    # cache's worst-case age in traces); 0 = displacement-driven only
+    rebuild_every_max: int = 0
 
     def __post_init__(self):
         # a typo'd knob would otherwise silently fall through every
@@ -195,14 +237,60 @@ class GridSpec:
                 f"sweep_impl must be table|ranges|cellrow|shift, "
                 f"got {self.sweep_impl!r}"
             )
+        if self.sort_impl not in ("argsort", "counting", "pallas"):
+            raise ValueError(
+                f"sort_impl must be argsort|counting|pallas, "
+                f"got {self.sort_impl!r}"
+            )
+        if not self.skin >= 0.0:
+            raise ValueError(
+                f"skin must be >= 0 (0 disables Verlet reuse), "
+                f"got {self.skin!r}"
+            )
+        if self.verlet_cap < 0 or 0 < self.verlet_cap < self.k:
+            # the reuse re-rank asks _rank_packed for k of the cached
+            # lanes — fewer lanes than k would shape-mismatch (sort) or
+            # crash lax.top_k (exact/f32) deep inside the trace
+            raise ValueError(
+                f"verlet_cap must be 0 (= auto k + k//2) or >= k "
+                f"(={self.k}), got {self.verlet_cap!r}"
+            )
+        if self.rebuild_every_max < 0:
+            raise ValueError(
+                f"rebuild_every_max must be >= 0 (0 = displacement-"
+                f"driven only), got {self.rebuild_every_max!r}"
+            )
+        if self.skin > 0 and self.verlet_cap_eff > 9 * self.cell_cap:
+            # the rebuild sweep can admit at most the 3x3 window's
+            # 9*cell_cap candidate lanes per row; asking it to keep
+            # more would shape-mismatch the lax.cond branches deep in
+            # the trace (the 'sort' top-k slices to the lane count)
+            raise ValueError(
+                f"verlet_cap (effective {self.verlet_cap_eff}) must be "
+                f"<= 9*cell_cap ({9 * self.cell_cap}) — raise cell_cap "
+                f"or lower verlet_cap/k"
+            )
+
+    @property
+    def cell_size(self) -> float:
+        """Grid cell edge. With a Verlet skin the cells grow by it so
+        the 3x3 window still covers ``reach + skin`` from any query
+        position (Chebyshev coverage needs reach <= cell edge)."""
+        return self.radius + self.skin
+
+    @property
+    def verlet_cap_eff(self) -> int:
+        """``verlet_cap`` resolved: 0 = auto ``k + k//2``."""
+        return self.verlet_cap if self.verlet_cap > 0 \
+            else self.k + self.k // 2
 
     @property
     def cells_x(self) -> int:
-        return max(1, int(-(-self.extent_x // self.radius)))
+        return max(1, int(-(-self.extent_x // self.cell_size)))
 
     @property
     def cells_z(self) -> int:
-        return max(1, int(-(-self.extent_z // self.radius)))
+        return max(1, int(-(-self.extent_z // self.cell_size)))
 
 
 def _cell_rows(spec: GridSpec, pos, alive, watch_radius):
@@ -217,12 +305,16 @@ def _cell_rows(spec: GridSpec, pos, alive, watch_radius):
         alive = alive & (watch_radius > 0.0)
 
     cx = jnp.clip(
-        jnp.floor((pos[:, 0] - spec.origin_x) / spec.radius).astype(jnp.int32),
+        jnp.floor(
+            (pos[:, 0] - spec.origin_x) / spec.cell_size
+        ).astype(jnp.int32),
         0,
         spec.cells_x - 1,
     )
     cz = jnp.clip(
-        jnp.floor((pos[:, 2] - spec.origin_z) / spec.radius).astype(jnp.int32),
+        jnp.floor(
+            (pos[:, 2] - spec.origin_z) / spec.cell_size
+        ).astype(jnp.int32),
         0,
         spec.cells_z - 1,
     )
@@ -232,8 +324,20 @@ def _cell_rows(spec: GridSpec, pos, alive, watch_radius):
     return cx, cz, srow, alive, czp, n_rows
 
 
-def _sort_cells(n: int, n_rows: int, srow):
-    """Front half, stage 2: entities ordered by cell row."""
+def _sort_cells(n: int, n_rows: int, srow, sort_impl: str = "argsort"):
+    """Front half, stage 2: entities ordered by cell row. Every impl is
+    stable (ties broken by ascending slot id), so they are
+    bit-interchangeable — including which entities a cell_cap overflow
+    drops (see GridSpec.sort_impl)."""
+    if sort_impl in ("counting", "pallas"):
+        from goworld_tpu.ops.sort import (
+            counting_sort_cells,
+            counting_sort_cells_pallas,
+        )
+
+        fn = counting_sort_cells_pallas if sort_impl == "pallas" \
+            else counting_sort_cells
+        return fn(srow, n_rows)
     if n < (1 << _ID_BITS) and n_rows < (1 << 10):
         # single-array sort of (row << 21 | idx) packed keys instead of
         # a key+payload argsort: half the sorted bytes, identical result
@@ -335,7 +439,8 @@ def _invalid_key(topk_impl):
         else jnp.int32(2**31 - 1)
 
 
-def _pack_keys(spec: GridSpec, dist, valid, cand_w, want_flags):
+def _pack_keys(spec: GridSpec, dist, valid, cand_w, want_flags,
+               qmax: float | None = None):
     """Pack (quantized distance, word) into one int32 ranking key so a
     single top_k yields ids AND flags — the take_along_axis re-gather it
     replaces was the single most expensive op of the sweep (minor-axis
@@ -345,20 +450,25 @@ def _pack_keys(spec: GridSpec, dist, valid, cand_w, want_flags):
     ("f32"/"approx", whose keys must be finite normal floats) — only
     affects WHICH neighbors win when the true count exceeds k (already
     best-effort); flags sit below the id so they never influence the
-    ranking. Shared by the entity-major and cell-major sweeps — their
-    bit-parity contract depends on one encoder."""
+    ranking. ``qmax`` is the largest representable distance (defaults
+    to the interest radius; the Verlet candidate build passes
+    ``radius + skin`` so skin-padded distances keep full resolution).
+    Shared by the entity-major and cell-major sweeps — their bit-parity
+    contract depends on one encoder."""
     invalid_key = _invalid_key(spec.topk_impl)
+    if qmax is None:
+        qmax = spec.radius
     if want_flags or spec.topk_impl in ("approx", "f32"):
         # 8-bit distance in [1, 254]: max key (254<<23)|word stays a
         # FINITE f32 pattern and min key (1<<23) stays a NORMAL one —
         # the f32-domain rankings require both (subnormals flush to
         # zero on TPU, corrupting returned key bits)
         qd = jnp.minimum(
-            (dist * (253.0 / spec.radius)).astype(jnp.int32), _QD_MAX - 1
+            (dist * (253.0 / qmax)).astype(jnp.int32), _QD_MAX - 1
         ) + 1
         return jnp.where(valid, (qd << 23) | cand_w, invalid_key)
     qd = jnp.minimum(
-        (dist * (1024.0 / spec.radius)).astype(jnp.int32), 1023
+        (dist * (1024.0 / qmax)).astype(jnp.int32), 1023
     )
     return jnp.where(valid, (qd << _ID_BITS) | cand_w, invalid_key)
 
@@ -427,6 +537,7 @@ def _sweep_shift(
     watch_radius: jax.Array | None,
     flag_bits: jax.Array | None,
     with_stats: bool = False,
+    reach_pad: float = 0.0,
 ) -> tuple[jax.Array, jax.Array, jax.Array | None, tuple | None]:
     """Cell-major, gather-free back half (GridSpec.sweep_impl="shift").
 
@@ -452,7 +563,7 @@ def _sweep_shift(
     )
     if with_stats:
         cell_max, over_cap_cells = _cell_occupancy_stats(srow, n_rows, cc)
-    order, sorted_row = _sort_cells(n, n_rows, srow)
+    order, sorted_row = _sort_cells(n, n_rows, srow, spec.sort_impl)
     src, _table_sentinel, sentinel_bits = _sorted_src(
         spec, pos, flag_bits, order
     )
@@ -495,9 +606,10 @@ def _sweep_shift(
         qw = lax.bitcast_convert_type(qs[..., 2 * cc:3 * cc], jnp.int32)
         qid = qw >> 2 if want_flags else qw
         if watch_radius is not None:
-            reach = jnp.minimum(qs[..., 3 * cc:4 * cc], spec.radius)
+            reach = jnp.minimum(qs[..., 3 * cc:4 * cc], spec.radius) \
+                + reach_pad
         else:
-            reach = jnp.full_like(qpx, spec.radius)
+            reach = jnp.full_like(qpx, spec.radius + reach_pad)
         keys = []
         dems = []
         for dx in range(3):
@@ -522,7 +634,8 @@ def _sweep_shift(
                 )
                 keys.append(
                     _pack_keys(
-                        spec, dist, valid, cw[..., None, :], want_flags
+                        spec, dist, valid, cw[..., None, :], want_flags,
+                        qmax=spec.radius + reach_pad,
                     )
                 )
                 if with_stats:
@@ -583,12 +696,13 @@ def _sweep(
     watch_radius: jax.Array | None,
     flag_bits: jax.Array | None,
     with_stats: bool = False,
+    reach_pad: float = 0.0,
 ) -> tuple[jax.Array, jax.Array, jax.Array | None, tuple | None]:
     n = pos.shape[0]
     if spec.sweep_impl == "shift" and n < (1 << _ID_BITS):
         return _sweep_shift(
             spec, pos, alive, query_rows, watch_radius, flag_bits,
-            with_stats,
+            with_stats, reach_pad,
         )
     q = n if query_rows is None else query_rows
     k = spec.k
@@ -602,7 +716,7 @@ def _sweep(
     )
     if with_stats:
         cell_max, over_cap_cells = _cell_occupancy_stats(srow, n_rows, cc)
-    order, sorted_row = _sort_cells(n, n_rows, srow)
+    order, sorted_row = _sort_cells(n, n_rows, srow, spec.sort_impl)
     src, table_sentinel, sentinel_bits = _sorted_src(
         spec, pos, flag_bits, order
     )
@@ -718,9 +832,10 @@ def _sweep(
         ddz = jnp.abs(cand_pz - pz[rows][:, None])
         dist = jnp.maximum(ddx, ddz)                 # Chebyshev XZ
         if watch_radius is None:
-            reach = spec.radius
+            reach = spec.radius + reach_pad
         else:  # per-watcher view distance, bounded by the cell size
-            reach = jnp.minimum(watch_radius[rows], spec.radius)[:, None]
+            reach = (jnp.minimum(watch_radius[rows], spec.radius)
+                     + reach_pad)[:, None]
 
         if packed_path:
             cand_id = cand_w >> 2 if want_flags else cand_w
@@ -729,7 +844,8 @@ def _sweep(
                 & (dist <= reach)
                 & (cand_id != rows[:, None])
             )
-            packed_key = _pack_keys(spec, dist, valid, cand_w, want_flags)
+            packed_key = _pack_keys(spec, dist, valid, cand_w, want_flags,
+                                    qmax=spec.radius + reach_pad)
             nbr_b, cnt_b, fl_b = _rank_packed(
                 packed_key, k, spec.topk_impl, want_flags, sentinel
             )
@@ -883,7 +999,7 @@ def sweep_phase_checksum(spec: GridSpec, pos, alive, phase: str):
     n = pos.shape[0]
     cc = spec.cell_cap
     cx, cz, srow, alive2, czp, n_rows = _cell_rows(spec, pos, alive, None)
-    order, sorted_row = _sort_cells(n, n_rows, srow)
+    order, sorted_row = _sort_cells(n, n_rows, srow, spec.sort_impl)
     if phase == "sort":
         return order.sum() + sorted_row.sum()
     src, _ts, sentinel_bits = _sorted_src(spec, pos, None, order)
@@ -895,6 +1011,248 @@ def sweep_phase_checksum(spec: GridSpec, pos, alive, phase: str):
     table = _build_table(cc, n_rows, sorted_row, src,
                          (jnp.inf, jnp.inf, sentinel_bits))
     return jnp.where(jnp.isfinite(table), table, 0.0).sum()
+
+
+# ==================================================================
+# Verlet skin reuse (GridSpec.skin > 0)
+# ==================================================================
+
+@struct.dataclass
+class VerletCache:
+    """Carried AOI front-half products (one per Space, in SpaceState).
+
+    ``cand`` holds, per entity, every candidate within
+    ``min(watch_radius, radius) + skin`` Chebyshev AT REBUILD TIME
+    (ascending ids, sentinel N). By the standard Verlet bound it stays
+    a superset of the true neighborhood while no entity has moved more
+    than ``skin/2`` since the rebuild — so reuse ticks re-rank current
+    distances over these ids and skip cell binning, sorting, structure
+    build and the 9-cell window fetch entirely."""
+
+    cand: jax.Array        # i32[N, V] candidate ids (sentinel N)
+    ref_x: jax.Array       # f32[N] x at last rebuild
+    ref_z: jax.Array       # f32[N] z at last rebuild
+    ref_alive: jax.Array   # bool[N] alive set at last rebuild
+    ref_radius: jax.Array  # f32[N] watch radii at last rebuild
+    age: jax.Array         # i32 scalar: ticks since rebuild
+    valid: jax.Array       # bool scalar: False until the first rebuild
+    # last-rebuild overflow gauges, carried so reuse ticks keep
+    # reporting the regime the cache was built in
+    cell_max: jax.Array        # i32 max cell occupancy at rebuild
+    over_cap_cells: jax.Array  # i32 cells past cell_cap at rebuild
+    over_v_rows: jax.Array     # i32 rows whose candidate demand
+                               # exceeded verlet_cap_eff at rebuild
+                               # (nonzero = this cache may be inexact)
+
+
+def init_verlet_cache(spec: GridSpec, n: int) -> VerletCache:
+    """Empty (invalid) cache: the first tick always rebuilds."""
+    v = spec.verlet_cap_eff
+    zi = jnp.zeros((), jnp.int32)
+    return VerletCache(
+        cand=jnp.full((n, v), n, jnp.int32),
+        ref_x=jnp.zeros((n,), jnp.float32),
+        ref_z=jnp.zeros((n,), jnp.float32),
+        ref_alive=jnp.zeros((n,), bool),
+        ref_radius=jnp.zeros((n,), jnp.float32),
+        age=zi,
+        valid=jnp.zeros((), bool),
+        cell_max=zi,
+        over_cap_cells=zi,
+        over_v_rows=zi,
+    )
+
+
+def _rank_candidates(
+    spec: GridSpec,
+    pos: jax.Array,
+    watch_radius: jax.Array | None,
+    flag_bits: jax.Array | None,
+    cand: jax.Array,
+    with_stats: bool,
+):
+    """Back half over CACHED candidate ids (the Verlet reuse path):
+    gather each candidate's current position (and flag bits) by id,
+    re-test exact ``dist <= reach`` and re-rank with the shared
+    packed-key machinery. V lanes per row instead of the grid path's
+    ``9 * cell_cap`` — and no cell structure or window fetch at all.
+    Produces the same lists a full rebuild would (the cached pool is a
+    superset of every true neighborhood under the skin bound)."""
+    n = pos.shape[0]
+    k = spec.k
+    sentinel = n
+    want_flags = flag_bits is not None
+    px = pos[:, 0]
+    pz = pos[:, 2]
+
+    def row_block(rows: jax.Array):
+        cb = cand[rows]                            # [B, V]
+        cbc = jnp.minimum(cb, n - 1)
+        dist = jnp.maximum(
+            jnp.abs(px[cbc] - px[rows][:, None]),
+            jnp.abs(pz[cbc] - pz[rows][:, None]),
+        )
+        if watch_radius is None:
+            reach = spec.radius
+        else:
+            reach = jnp.minimum(watch_radius[rows], spec.radius)[:, None]
+        valid = (cb != sentinel) & (dist <= reach)
+        if want_flags:
+            w = (cb << 2) | (flag_bits[cbc].astype(jnp.int32) & 3)
+        else:
+            w = cb
+        packed = _pack_keys(spec, dist, valid, w, want_flags)
+        nbr_b, cnt_b, fl_b = _rank_packed(
+            packed, k, spec.topk_impl, want_flags, sentinel
+        )
+        dem_b = valid.sum(axis=1).astype(jnp.int32) if with_stats \
+            else jnp.zeros(rows.shape, jnp.int32)
+        if fl_b is None:
+            fl_b = jnp.zeros_like(nbr_b)
+        return nbr_b, cnt_b, fl_b, dem_b
+
+    rb = min(spec.row_block, n)
+    nblocks = -(-n // rb)
+    padded = nblocks * rb
+    all_rows = jnp.minimum(jnp.arange(padded, dtype=jnp.int32), n - 1)
+    if nblocks == 1:
+        nbr, cnt, fl, dem = row_block(all_rows)
+    else:
+        nbr, cnt, fl, dem = lax.map(
+            row_block, all_rows.reshape(nblocks, rb)
+        )
+        nbr = nbr.reshape(padded, k)[:n]
+        cnt = cnt.reshape(padded)[:n]
+        fl = fl.reshape(padded, k)[:n]
+        dem = dem.reshape(padded)[:n]
+    return nbr[:n], cnt[:n], fl if want_flags else None, dem[:n]
+
+
+@partial(jax.jit, static_argnums=(0, 6))
+def grid_neighbors_verlet(
+    spec: GridSpec,
+    pos: jax.Array,
+    alive: jax.Array,
+    cache: VerletCache,
+    watch_radius: jax.Array | None = None,
+    flag_bits: jax.Array | None = None,
+    with_stats: bool = False,
+) -> tuple:
+    """:func:`grid_neighbors_flags` with Verlet-skin front-half reuse.
+
+    The rebuild decision is IN-GRAPH (``lax.cond``), a pure function of
+    the carried cache and this tick's state, so the whole tick still
+    scans on device:
+
+      rebuild iff  cache invalid
+               or  max alive Chebyshev displacement since rebuild
+                   > skin/2                       (the Verlet bound)
+               or  the alive set changed          (spawn/despawn)
+               or  any alive watch radius changed
+               or  age >= rebuild_every_max       (if > 0)
+
+    Rebuild ticks run the configured sweep front half once with reach
+    padded by ``skin`` and keep the ``verlet_cap_eff`` nearest
+    candidates per entity; every tick (rebuild or not) then ranks the
+    cached candidates at CURRENT positions/flags — so results are
+    exactly a per-tick rebuild's while candidate demand fits the cap
+    (``over_v_rows`` gauges the only divergence regime, like k /
+    cell_cap).
+
+    Returns ``(nbr, cnt, flags, stats-or-None, cache', rebuilt,
+    skin_slack)``: ``rebuilt`` is i32 0/1; ``skin_slack`` is
+    ``skin/2 - displacement`` (f32; headroom left when positive,
+    trigger overshoot when negative). ``stats`` (when requested) keeps
+    the 4-gauge contract — cell gauges are as of the last rebuild, and
+    ``over_k_rows`` folds in the rebuild's over-cap candidate rows so
+    "all gauges zero" still certifies an exact tick.
+
+    Constraints: packed-id fast path only (n < 2^21); no megaspace
+    ghost ``query_rows`` (the megaspace step keeps the stateless
+    sweep).
+    """
+    n = pos.shape[0]
+    if spec.skin <= 0.0:
+        raise ValueError(
+            "grid_neighbors_verlet requires spec.skin > 0 "
+            f"(got {spec.skin!r}); use grid_neighbors_flags instead"
+        )
+    if n >= (1 << _ID_BITS):
+        raise ValueError(
+            "Verlet reuse needs the packed-id fast path (n < 2^21); "
+            f"got n={n}"
+        )
+    want_flags = flag_bits is not None
+
+    disp = jnp.max(
+        jnp.where(
+            alive,
+            jnp.maximum(
+                jnp.abs(pos[:, 0] - cache.ref_x),
+                jnp.abs(pos[:, 2] - cache.ref_z),
+            ),
+            0.0,
+        )
+    )
+    need = (
+        ~cache.valid
+        | (2.0 * disp > spec.skin)
+        | jnp.any(alive != cache.ref_alive)
+    )
+    if watch_radius is not None:
+        need = need | jnp.any(
+            jnp.where(alive, watch_radius != cache.ref_radius, False)
+        )
+    age = cache.age + 1
+    if spec.rebuild_every_max > 0:
+        need = need | (age >= spec.rebuild_every_max)
+    # against an invalid cache the zero ref positions make disp ~ the
+    # world extent — report full headroom instead of a ~-extent spike
+    # in the aoi_skin_slack gauge on every (re)start
+    slack = jnp.where(
+        cache.valid,
+        jnp.float32(0.5 * spec.skin) - disp,
+        jnp.float32(0.5 * spec.skin),
+    )
+
+    spec_v = dataclasses.replace(spec, k=spec.verlet_cap_eff)
+
+    def rebuild(c: VerletCache) -> VerletCache:
+        cand, _cnt, _fl, cstats = _sweep(
+            spec_v, pos, alive, None, watch_radius, None,
+            with_stats=True, reach_pad=spec.skin,
+        )
+        return VerletCache(
+            cand=cand,
+            ref_x=pos[:, 0],
+            ref_z=pos[:, 2],
+            ref_alive=alive,
+            ref_radius=(watch_radius if watch_radius is not None
+                        else c.ref_radius),
+            age=jnp.zeros((), jnp.int32),
+            valid=jnp.ones((), bool),
+            cell_max=cstats[2],
+            over_cap_cells=cstats[3],
+            over_v_rows=cstats[1],
+        )
+
+    def reuse(c: VerletCache) -> VerletCache:
+        return c.replace(age=age)
+
+    cache = lax.cond(need, rebuild, reuse, cache)
+    nbr, cnt, fl, dem = _rank_candidates(
+        spec, pos, watch_radius, flag_bits, cache.cand, with_stats
+    )
+    stats = None
+    if with_stats:
+        stats = (
+            dem.max().astype(jnp.int32),
+            (dem > spec.k).sum().astype(jnp.int32) + cache.over_v_rows,
+            cache.cell_max,
+            cache.over_cap_cells,
+        )
+    return (nbr, cnt, fl if want_flags else None, stats, cache,
+            need.astype(jnp.int32), slack)
 
 
 def neighbors_oracle(pos, alive, radius):

@@ -20,9 +20,9 @@ import jax
 import jax.numpy as jnp
 
 from goworld_tpu.ops.extract import (
-    SMALL_TIER_ROWS,
     bounded_extract,
     bounded_extract_rows,
+    small_tier_rows,
     two_tier,
 )
 
@@ -138,7 +138,7 @@ def interest_pairs(
     # row_cap graph for mass-event ticks only. adaptive=False for
     # vmapped callers (see two_tier's docstring).
     out = two_tier(
-        changed_total, min(SMALL_TIER_ROWS, row_cap), row_cap, tier,
+        changed_total, min(small_tier_rows(), row_cap), row_cap, tier,
         adaptive,
     )
     return (*out, changed_total)

@@ -24,6 +24,8 @@ Two implementations with the same contract:
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
@@ -54,7 +56,33 @@ def bounded_extract(
 # touch a few thousand rows, so the [cap_rows, k] second-level work runs
 # at this size and the full-cap graph only executes on mass-event ticks
 # (lax.cond picks ONE branch at runtime, unlike where/select).
+# A deploy knob, not a compile-time constant: the 16384 default was
+# sized from the 1M bench's client-row churn (TPU-profile re-derivation
+# still pending — docs/TODO_R5.md); override via the
+# GOWORLD_SMALL_TIER_ROWS env var or ini [gameN] small_tier_rows
+# (api boot calls set_small_tier_rows BEFORE the world compiles — the
+# value is baked into traced graphs at jit time).
 SMALL_TIER_ROWS = 16384
+
+
+def set_small_tier_rows(rows: int) -> None:
+    """Override the small-tier row budget (must precede tracing)."""
+    global SMALL_TIER_ROWS
+    rows = int(rows)
+    if rows <= 0:
+        raise ValueError(f"small_tier_rows must be > 0, got {rows!r}")
+    SMALL_TIER_ROWS = rows
+
+
+if os.environ.get("GOWORLD_SMALL_TIER_ROWS"):
+    # route through the setter so a zero/negative env value fails loudly
+    # at import instead of building a degenerate zero-row small tier
+    set_small_tier_rows(os.environ["GOWORLD_SMALL_TIER_ROWS"])
+
+
+def small_tier_rows() -> int:
+    """The active small-tier row budget (read at trace time)."""
+    return SMALL_TIER_ROWS
 
 
 def two_tier(count, small: int, full: int, tier_fn, adaptive: bool = True):

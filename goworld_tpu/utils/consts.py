@@ -32,6 +32,27 @@ DEFAULT_ROW_BLOCK = 32768         # AOI row-block size (memory ceiling knob)
 # and was ~2.5x the generic int32 lax.top_k on both platforms).
 DEFAULT_SWEEP_IMPL = "ranges"
 DEFAULT_TOPK_IMPL = "sort"
+# Front-half cell-sort lowering (GridSpec.sort_impl): "argsort" is the
+# XLA sort; "counting" is the two-pass counting sort (ops/sort.py) that
+# deletes the bitonic network — the roofline's dominant HBM term at 1M
+# (docs/ROOFLINE.md); "pallas" is its kernel form (interpret-validated,
+# TPU lowering staged). Default stays "argsort" pending a CPU/TPU
+# measurement; bench autotune A/Bs "counting" every run.
+DEFAULT_SORT_IMPL = "argsort"
+# Verlet skin width (GridSpec.skin): 0 disables front-half reuse. The
+# library default is OFF — the skin trades cache memory (N x verlet_cap
+# i32) and a knob for skipping the whole front half + window fetch on
+# ticks where nothing moved more than skin/2; workloads opt in via
+# [gameN] aoi_skin or BENCH_SKIN with a value matched to their movement
+# speed (rebuild cadence ~ skin / (2 * speed * dt)).
+DEFAULT_AOI_SKIN = 0.0
+# Packed-key id width (ops/aoi.py _ID_BITS draws from here): slot ids
+# share an int32 with the quantized distance, so the packed fast paths
+# (single-array front sort, shift sweep, Verlet reuse) require
+# n < 2^AOI_ID_BITS. One source of truth for every n-bound guard —
+# core/step.py's verlet dispatch and bench.py's (jax-free parent)
+# phase probes mirror the same bound.
+AOI_ID_BITS = 21
 
 # --- queues / backpressure (reference consts.go:26-28) -----------------
 MAX_PENDING_PACKETS_PER_GAME = 1_000_000

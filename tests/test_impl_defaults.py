@@ -32,17 +32,26 @@ def test_one_source_of_truth():
     gc = GameConfig()
     assert gs.sweep_impl == consts.DEFAULT_SWEEP_IMPL
     assert gs.topk_impl == consts.DEFAULT_TOPK_IMPL
+    assert gs.sort_impl == consts.DEFAULT_SORT_IMPL
+    assert gs.skin == consts.DEFAULT_AOI_SKIN
     assert gc.aoi_sweep_impl == consts.DEFAULT_SWEEP_IMPL
     assert gc.aoi_topk_impl == consts.DEFAULT_TOPK_IMPL
+    assert gc.aoi_sort_impl == consts.DEFAULT_SORT_IMPL
+    assert gc.aoi_skin == consts.DEFAULT_AOI_SKIN
 
 
 def test_bench_grid_defaults_agree(monkeypatch):
-    for var in ("BENCH_TOPK", "BENCH_SWEEP"):
+    for var in ("BENCH_TOPK", "BENCH_SWEEP", "BENCH_SORT", "BENCH_SKIN"):
         monkeypatch.delenv(var, raising=False)
     bench = _load_bench()
     kw = bench._grid_kw_from_env(131072)
     assert kw["sweep_impl"] == consts.DEFAULT_SWEEP_IMPL
     assert kw["topk_impl"] == consts.DEFAULT_TOPK_IMPL
+    assert kw["sort_impl"] == consts.DEFAULT_SORT_IMPL
+    # the bench WORKLOAD defaults the skin ON (its movement speed is
+    # known, so the skin can be sized; consts keeps the library off) —
+    # documented divergence, pinned here so it stays deliberate
+    assert kw["skin"] == bench.BENCH_SKIN_DEFAULT > 0.0
 
 
 def test_autotune_never_selects_fidelity_degrading_configs(monkeypatch):

@@ -54,13 +54,17 @@ def test_adaptive_flag_controls_cond():
 
 
 def test_vmapped_world_tick_has_no_cond():
-    """The production single-device World path (jit(vmap(tick_body)))
-    must carry NO churn cond: under vmap batching cond lowers to
-    select_n and BOTH tiers would execute every tick. Tracer
-    introspection cannot see this through the collectors' own jit
-    boundary (pjit batches the traced jaxpr), so the manager threads
-    adaptive_extract=False statically — this test pins that wiring
-    end to end."""
+    """The VMAPPED multi-space World path (n_spaces > 1,
+    jit(vmap(tick_body))) must carry NO runtime cond: under vmap
+    batching cond lowers to select_n and BOTH branches would execute
+    every tick (the churn tiers AND the Verlet skin's rebuild/reuse
+    dispatch). Tracer introspection cannot see this through the
+    collectors' own jit boundary (pjit batches the traced jaxpr), so
+    the manager threads adaptive_extract=False / skin=0 statically —
+    this test pins that wiring end to end. The SINGLE-space local step
+    (the common production shape) now calls tick_body directly instead
+    of vmapping over one space, so there the real branches survive —
+    pinned too."""
     from goworld_tpu.core.state import WorldConfig
     from goworld_tpu.core.step import TickInputs, tick_body
     from goworld_tpu.entity.manager import _make_local_tick
@@ -69,30 +73,41 @@ def test_vmapped_world_tick_has_no_cond():
     cfg = WorldConfig(
         capacity=SMALL_TIER_ROWS * 2,
         grid=GridSpec(radius=20.0, extent_x=200.0, extent_z=200.0,
-                      k=8, cell_cap=8, row_block=1024),
+                      k=8, cell_cap=8, row_block=1024, skin=2.0),
     )
     from goworld_tpu.core.state import create_state
 
     st = create_state(cfg)
-    st_b = jax.tree.map(lambda x: x[None], st)
-    ins_b = jax.tree.map(lambda x: x[None], TickInputs.empty(cfg))
+    st_b2 = jax.tree.map(lambda x: jnp.stack([x, x]), st)
+    ins_b2 = jax.tree.map(lambda x: jnp.stack([x, x]),
+                          TickInputs.empty(cfg))
     import dataclasses as _dc
 
-    cfg_off = _dc.replace(cfg, adaptive_extract=False)
+    cfg_off = _dc.replace(cfg, adaptive_extract=False,
+                          grid=_dc.replace(cfg.grid, skin=0.0))
     batched = str(jax.make_jaxpr(
         jax.vmap(lambda s, i: tick_body(cfg_off, s, i, None))
-    )(st_b, ins_b))
+    )(st_b2, ins_b2))
     assert "cond" not in batched
-    # the manager's local step must be built with the flag off even
-    # though the caller's cfg has it on (the manager clears it)
-    step = _make_local_tick(cfg)
-    mgr = str(jax.make_jaxpr(lambda s, i: step(s, i, None))(st_b, ins_b))
+    # the manager's multi-space step must be built with the flag off
+    # and the skin cleared even though the caller's cfg has them on
+    step = _make_local_tick(cfg, 2)
+    mgr = str(jax.make_jaxpr(lambda s, i: step(s, i, None))(
+        st_b2, ins_b2))
     assert "cond" not in mgr
-    # while the unbatched tick keeps the real branch
+    # while the unbatched tick keeps the real branches (churn tiers +
+    # verlet rebuild dispatch) ...
     unbatched = str(jax.make_jaxpr(
         lambda s, i: tick_body(cfg, s, i, None)
     )(st, TickInputs.empty(cfg)))
     assert "cond" in unbatched
+    # ... and so does the manager's SINGLE-space local step
+    st_b1 = jax.tree.map(lambda x: x[None], st)
+    ins_b1 = jax.tree.map(lambda x: x[None], TickInputs.empty(cfg))
+    step1 = _make_local_tick(cfg, 1)
+    mgr1 = str(jax.make_jaxpr(lambda s, i: step1(s, i, None))(
+        st_b1, ins_b1))
+    assert "cond" in mgr1
 
 
 def test_vmapped_interest_pairs_matches_unbatched():

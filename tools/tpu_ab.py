@@ -5,6 +5,8 @@ Order puts the decision-critical experiments first in case the backend
 dies mid-run:
   1. full-sweep impl matrix at 131K (table/shift x exact/sort/f32 +
      approx + ranges) — picks the production config.
+  1b. Verlet skin reuse (rebuild vs reuse tick) + front-half sort impl
+     (argsort vs counting vs pallas) — the r5 levers.
   2. back-half stage bisect (gather / +key / +topk / +final-sort).
   3. collect-phase bisect (interest_pairs / collect_sync / attrs).
   4. move-phase bisect (inputs scatter / random_walk / integrate).
@@ -98,6 +100,62 @@ for impl, topk in (("ranges", "sort"), ("table", "sort"),
                    ("shift", "sort"), ("shift", "f32"),
                    ("table", "exact"), ("table", "approx")):
     timeit(f"sweep {impl}/{topk}", mk_full(impl, topk))
+
+# ---- 1b. Verlet skin + front-half sort impls ------------------------
+
+from goworld_tpu.ops.aoi import grid_neighbors_verlet, init_verlet_cache
+
+
+def mk_verlet(skin, force_rebuild, sort_impl="argsort"):
+    sp = GridSpec(radius=50.0, extent_x=extent, extent_z=extent,
+                  k=K, cell_cap=CC, row_block=65536, skin=skin,
+                  sort_impl=sort_impl)
+    cache0 = init_verlet_cache(sp, N)
+
+    def make(length):
+        def run(p0):
+            def body(carry, _):
+                p, cache = carry
+                nbr, cnt, fl, _s, cache2, _rb, _sl = \
+                    grid_neighbors_verlet(
+                        sp, p, alive, cache0 if force_rebuild else cache,
+                        flag_bits=flags)
+                p = p + (cnt[:, None] % 2).astype(p.dtype) * 1e-6
+                return (p, cache2), cnt.sum() + fl.sum()
+            (pp, _c), ss = lax.scan(body, (p0, cache0), None,
+                                    length=length)
+            return ss.sum().astype(jnp.float32) + pp.sum()
+        return run
+    return make
+
+
+timeit("verlet reuse  (skin=4)", mk_verlet(4.0, False))
+timeit("verlet rebuild(skin=4)", mk_verlet(4.0, True))
+timeit("verlet reuse  (skin=8)", mk_verlet(8.0, False))
+
+
+def mk_sort(sort_impl):
+    sp = GridSpec(radius=50.0, extent_x=extent, extent_z=extent,
+                  k=K, cell_cap=CC, sort_impl=sort_impl)
+
+    def make(length):
+        def run(p0):
+            def body(p, _):
+                cx, cz, srow, al2, czp, n_rows = _cell_rows(
+                    sp, p, alive, None)
+                order, sorted_row = _sort_cells(
+                    N, n_rows, srow, sp.sort_impl)
+                s = order.sum() + sorted_row.sum()
+                p = p + (s.astype(p.dtype) % 2) * 1e-7
+                return p, s
+            pp, ss = lax.scan(body, p0, None, length=length)
+            return ss.sum().astype(jnp.float32) + pp.sum()
+        return run
+    return make
+
+
+for si in ("argsort", "counting", "pallas"):
+    timeit(f"front sort {si}", mk_sort(si))
 
 # ---- 2. back-half stage bisect (table impl, no flags) ---------------
 
