@@ -40,7 +40,9 @@ Env knobs: BENCH_N (default 1_048_576), BENCH_TICKS (default 20),
 BENCH_CLIENT_FRAC (default 0.01), BENCH_PHASES=1 (add per-phase timing:
 separately-jitted AOI / behavior+integrate / collect variants),
 BENCH_TPU_ATTEMPTS (default 2), BENCH_CHILD_TIMEOUT seconds (default
-1200), BENCH_N_CPU (default 131072) for the CPU fallback.
+1200), BENCH_N_CPU (default 131072) for the CPU fallback,
+BENCH_BACKHALF_AB=0 to skip the fused-vs-split back-half A/B record
+(BENCH_BACKHALF_AB_N shapes it; default the 131K per-chip shard).
 """
 
 import argparse
@@ -124,6 +126,20 @@ AUTOTUNE_CANDIDATES = [
     # emulation — meaningless to time off-TPU and compile-risky on new
     # backends, so diagnostic until a relay window measures it
     (False, {"sort_impl": "pallas", "skin": 0.0}),
+    # the fused Pallas back half (ops/aoi.py _sweep_fused: window
+    # gather -> key pack -> top-k in one VMEM-resident kernel — the
+    # r6 lever on the two dominant post-r5 roofline terms). Results
+    # are bit-identical to ranges, but off-TPU it executes in
+    # interpret mode (emulation — meaningless to time, ~2x the split
+    # sweep on CPU), so DIAGNOSTIC like the pallas sort until a relay
+    # window measures it; child_main's backhalf_ab records the A/B
+    # into every round artifact regardless. Skin pinned 0 per the
+    # front/back-half A/B convention above. The second row is the
+    # full-Pallas pipeline (fused back half over the counting-sort
+    # front half).
+    (False, {"sweep_impl": "fused", "skin": 0.0}),
+    (False, {"sweep_impl": "fused", "sort_impl": "counting",
+             "skin": 0.0}),
     # cell-major gather-free sweep: DIAGNOSTIC despite its speed
     # potential — beyond cell_cap it drops overflowed entities as
     # watchers (strictly worse than table, unlike ranges' pooling),
@@ -287,10 +303,7 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
     jitted scan lengths = 16 sweep-only compiles at 131K (plus the
     diagnostic pairs with BENCH_AUTOTUNE_DIAG=1); any failure falls
     back to defaults."""
-    import numpy as np
-
     import jax
-    import jax.numpy as jnp
     from jax import lax
 
     from goworld_tpu.ops.aoi import (
@@ -301,15 +314,7 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
     )
 
     n = int(os.environ.get("BENCH_AUTOTUNE_N", 131072))
-    extent = float(int((n * 10000 / 12) ** 0.5))
-    key = jax.random.PRNGKey(2)
-    k1, k2, k3 = jax.random.split(key, 3)
-    pos = jnp.stack(
-        [jax.random.uniform(k1, (n,), maxval=extent),
-         jnp.zeros(n),
-         jax.random.uniform(k2, (n,), maxval=extent)], axis=1)
-    alive = jnp.ones(n, bool)
-    flags = (jax.random.uniform(k3, (n,)) < 0.5).astype(jnp.int32)
+    extent, pos, alive, flags = _ab_world(n, seed=2)
     candidates = AUTOTUNE_CANDIDATES
     if os.environ.get("BENCH_AUTOTUNE_DIAG", "0") != "1":
         # diagnostics cost 2 compiles each at 131K (~1 min apiece over
@@ -361,16 +366,7 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
                 return s.sum() + pp.sum()
             return run
 
-        r1, r2 = mk(ticks), mk(2 * ticks)
-        float(np.asarray(r1(pos)))           # compile + warm
-        float(np.asarray(r2(pos + 0.001)))
-        t0 = time.perf_counter()
-        float(np.asarray(r1(pos + 0.002)))
-        e1 = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        float(np.asarray(r2(pos + 0.003)))
-        e2 = time.perf_counter() - t0
-        ms = 1000.0 * max(e2 - e1, 1e-9) / ticks
+        ms = _scan_marginal_ms(mk, pos, ticks)
         name = ",".join(f"{kk}={vv}" for kk, vv in ov.items()) or "default"
         log_d[name] = round(ms, 3)
         pinned = any(env_pins[kk] in os.environ for kk in ov)
@@ -383,6 +379,98 @@ def autotune_sweep(ticks: int = 8) -> tuple[dict, dict]:
         best_ov = {}
     log(f"autotune sweep@{n}: {log_d} -> {best_ov or 'default'}")
     return best_ov, log_d
+
+
+def _ab_world(n: int, seed: int):
+    """Synthetic sweep-A/B world shared by autotune_sweep and
+    backhalf_ab: uniform XZ positions at the bench density formula,
+    all alive, ~half flagged. One synthesis so the A/B harnesses can
+    never drift apart in workload."""
+    import jax
+    import jax.numpy as jnp
+
+    extent = float(int((n * 10000 / 12) ** 0.5))
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(seed), 3)
+    pos = jnp.stack(
+        [jax.random.uniform(k1, (n,), maxval=extent),
+         jnp.zeros(n),
+         jax.random.uniform(k2, (n,), maxval=extent)], axis=1)
+    alive = jnp.ones(n, bool)
+    flags = (jax.random.uniform(k3, (n,)) < 0.5).astype(jnp.int32)
+    return extent, pos, alive, flags
+
+
+def _scan_marginal_ms(mk, pos, ticks: int) -> float:
+    """The 2x-minus-1x scan-marginal timing protocol shared by every
+    sweep A/B (autotune_sweep, backhalf_ab): compile + warm T- and
+    2T-tick scans, then ms/tick = (wall_2T - wall_T) / ticks so
+    constant costs (dispatch, transfer, result caching — the r01
+    mismeasurement mode) cancel. ``mk(length)`` must return a jitted
+    fn of the position array whose scan body is perturbed by its own
+    output (anti-LICM)."""
+    import numpy as np
+
+    r1, r2 = mk(ticks), mk(2 * ticks)
+    float(np.asarray(r1(pos)))           # compile + warm
+    float(np.asarray(r2(pos + 0.001)))
+    t0 = time.perf_counter()
+    float(np.asarray(r1(pos + 0.002)))
+    e1 = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    float(np.asarray(r2(pos + 0.003)))
+    e2 = time.perf_counter() - t0
+    return 1000.0 * max(e2 - e1, 1e-9) / ticks
+
+
+def backhalf_ab(n: int, ticks: int = 4) -> dict:
+    """Fused-vs-split back-half A/B: sweep-only scan-marginal ms/tick
+    for ``sweep_impl="fused"`` against the resolved split default at
+    the same shape, skin pinned 0 (the front/back-half A/B convention —
+    under a skin the back half only runs on rebuild ticks and the
+    marginal would time reuse noise). Runs on EVERY platform and is
+    stamped into the round artifact (BENCH_r*.json): off-TPU the fused
+    kernel executes in interpret mode, and recording that losing number
+    next to ``"interpret": true`` is exactly what documents why fused
+    stays non-default off-TPU; on TPU it is the ISSUE-6 headline A/B.
+    Any failure returns an {"error": ...} record instead of raising —
+    the headline must never die to a diagnostic."""
+    import jax
+    from jax import lax
+
+    from goworld_tpu.ops.aoi import GridSpec, grid_neighbors_flags
+    from goworld_tpu.ops.pallas_compat import on_tpu
+
+    extent, pos, alive, flags = _ab_world(n, seed=3)
+    split_impl = _grid_kw_from_env(n, {"skin": 0.0})["sweep_impl"]
+    if split_impl == "fused":        # env pinned fused: A/B vs ranges,
+        split_impl = "ranges"        # the fused front half's sibling
+    out: dict = {"n": n, "split_impl": split_impl,
+                 "interpret": not on_tpu()}
+    for label, impl in (("split_ms", split_impl), ("fused_ms", "fused")):
+        gk = _grid_kw_from_env(n, {"sweep_impl": impl, "skin": 0.0})
+        spec = GridSpec(radius=50.0, extent_x=extent, extent_z=extent,
+                        **gk)
+
+        def mk(length, spec=spec):
+            @jax.jit
+            def run(p):
+                def body(c, _):
+                    _nbr, cnt, fl = grid_neighbors_flags(
+                        spec, c, alive, flag_bits=flags
+                    )
+                    c = c + (cnt[:, None] % 2).astype(c.dtype) * 1e-6
+                    return c, cnt.sum() + fl.sum()
+                pp, s = lax.scan(body, p, None, length=length)
+                return s.sum() + pp.sum()
+            return run
+
+        try:
+            out[label] = round(_scan_marginal_ms(mk, pos, ticks), 3)
+        except Exception as exc:
+            out["error"] = f"{label}: {str(exc)[:200]}"
+            break
+    log(f"backhalf_ab@{n}: {out}")
+    return out
 
 
 def measure(n: int, ticks: int, client_frac: float, phases: bool,
@@ -740,12 +828,20 @@ def measure_phases(cfg, st, inputs, ticks: int) -> dict:
     )
     phase_list = [
         ("aoi", aoi_only, (st,)),
-        # sweep sub-phases (cumulative: sort ⊂ build ⊂ aoi): where the
-        # AOI milliseconds go — cell sort vs candidate-structure build
-        # vs window gather + top_k (= aoi - build). With a skin these
-        # attribute the REBUILD tick's front half.
+        # sweep sub-phases (cumulative: sort ⊂ build ⊂ gather ⊂ pack ⊂
+        # rank ⊂ aoi): where the AOI milliseconds go — cell sort vs
+        # candidate-structure build vs the BACK half staged (9-cell
+        # window fetch, + distance/key pack, + top-k). The back-half
+        # probes run the real split row-block path (sweep_impl="fused"
+        # probes its split sibling "ranges"), so at a fused config the
+        # delta between these split stages and the fused "aoi" phase IS
+        # the fusion win — the attribution ISSUE 6 asks for. With a
+        # skin these attribute the REBUILD tick.
         ("aoi_sort", make_sweep_probe("sort"), (st,)),
         ("aoi_build", make_sweep_probe("build"), (st,)),
+        ("aoi_gather", make_sweep_probe("gather"), (st,)),
+        ("aoi_pack", make_sweep_probe("pack"), (st,)),
+        ("aoi_rank", make_sweep_probe("rank"), (st,)),
     ]
     if verlet:
         phase_list += [
@@ -861,6 +957,20 @@ def child_main(args) -> int:
             r["autotune_sweep_ms"] = atlog
             if overrides:
                 r["autotuned_grid"] = overrides
+        if name == "full" \
+                and os.environ.get("BENCH_BACKHALF_AB", "1") == "1":
+            # fused-vs-split back half A/B, recorded into the round
+            # artifact on every platform (ISSUE 6: the CPU interpret
+            # number documents why fused stays non-default off-TPU;
+            # the TPU number is the round's headline lever). Runs at
+            # the 131K per-chip shard, never the full 1M (interpret
+            # mode at 1M would eat the child timeout).
+            ab_n = min(n, int(os.environ.get("BENCH_BACKHALF_AB_N",
+                                             131072)))
+            try:
+                r["backhalf_ab"] = backhalf_ab(ab_n)
+            except Exception as exc:  # belt over backhalf_ab's braces
+                r["backhalf_ab"] = {"error": str(exc)[:200]}
         print(json.dumps(r), flush=True)
         if name == "full" and p99_args is not None \
                 and os.environ.get("BENCH_SKIP_P99") != "1":
@@ -1099,10 +1209,13 @@ def parent_main() -> int:
                 # HBM bytes/tick vs v5e bandwidth, per phase)
                 result["roofline"] = {
                     "doc": "docs/ROOFLINE.md",
-                    "tick_ms_1M_1chip": [5.6, 7.6],
-                    "entity_ticks_per_s_per_chip": [1.4e8, 1.9e8],
-                    "vs_baseline_range": [18, 25],
-                    "derate_3x_vs_baseline": 7.0,
+                    # r6 model: fused back half + counting sort
+                    # (~1.5 GB/tick); the split-kernel model was
+                    # [5.6, 7.6] ms / 18-25x
+                    "tick_ms_1M_1chip": [1.8, 2.5],
+                    "entity_ticks_per_s_per_chip": [4.2e8, 5.7e8],
+                    "vs_baseline_range": [56, 76],
+                    "derate_3x_vs_baseline": 19.0,
                 }
             if best_final is None:
                 result["partial"] = True  # full run never landed
@@ -1376,11 +1489,20 @@ def selftest_main() -> int:
         for k in ("sweep_impl", "topk_impl", "sort_impl", "skin"):
             check(f"full.stamp.{k}", k in art, "missing kernel stamp")
         pm = art.get("phase_ms", {})
-        phase_keys = ["aoi", "aoi_sort", "aoi_build", "move", "collect"]
+        phase_keys = ["aoi", "aoi_sort", "aoi_build", "aoi_gather",
+                      "aoi_pack", "aoi_rank", "move", "collect"]
         if art.get("skin", 0) > 0:
             phase_keys += ["aoi_rebuild", "aoi_reuse"]
         for k in phase_keys:
             check(f"full.phase.{k}", k in pm, f"phase_ms={pm}")
+        if os.environ.get("BENCH_BACKHALF_AB", "1") == "1":
+            # on the selftest shape the A/B must actually land (an
+            # {"error": ...} record here IS harness rot); skipped when
+            # the operator disabled the record with BENCH_BACKHALF_AB=0
+            ab = art.get("backhalf_ab", {})
+            check("full.backhalf_ab",
+                  "fused_ms" in ab and "split_ms" in ab
+                  and "interpret" in ab, str(ab))
         check("full.p99", "tick_p99_ms" in art, "missing p99 keys")
         check("full.p99_gate", "p99_suspect" not in art,
               art.get("p99_suspect", ""))

@@ -186,6 +186,17 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
             gc.capacity, consts.AOI_ID_BITS,
         )
         aoi_skin = 0.0
+    if gc.aoi_sweep_impl in ("shift", "fused") \
+            and gc.capacity >= (1 << consts.AOI_ID_BITS):
+        # these impls pack slot ids into key words; past the bound the
+        # sweep statically falls back to its split sibling
+        # (ops/aoi.py _sweep) — say so rather than degrade silently
+        logger.warning(
+            "aoi_sweep_impl=%s falls back to %s: capacity %d >= 2^%d "
+            "(packed-id bound)", gc.aoi_sweep_impl,
+            "ranges" if gc.aoi_sweep_impl == "fused" else "table",
+            gc.capacity, consts.AOI_ID_BITS,
+        )
     kernel_kw = dict(
         sort_impl=gc.aoi_sort_impl,
         skin=aoi_skin,

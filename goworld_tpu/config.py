@@ -53,7 +53,10 @@ class GameConfig:
     # ("table" | "ranges" | "cellrow" — table with premerged windows +
     # one row-gather per query, bit-identical to table | "shift" —
     # cell-major/gather-free but drops cap-overflowed entities as
-    # watchers) and top-k select
+    # watchers | "fused" — the ranges front half with the whole back
+    # half (window gather -> key pack -> top-k) as one VMEM-resident
+    # Pallas kernel, bit-identical to ranges; interpret-mode emulation
+    # off-TPU, so never a CPU default) and top-k select
     # ("exact" | "sort" | "f32" — all three exact; sort/f32 lower to
     # faster TPU kernels — or "approx", which may miss a true neighbor
     # with ~2% probability on TPU). Unknown values are rejected at

@@ -176,6 +176,24 @@ class GridSpec:
     #              gauge (`with_stats`) alarms in exactly that regime.
     #              Packed-id fast path only (n < 2^21); wide worlds fall
     #              back to "table".
+    #   "fused"  — the "ranges" front half with the ENTIRE back half
+    #              (window gather -> key pack -> top-k) as ONE Pallas
+    #              kernel (_sweep_fused): per query block the 3
+    #              contiguous sorted-array runs of the 9-cell window
+    #              are sliced VMEM->VMEM into scratch, distances/keys
+    #              are packed with the SHARED _pack_keys encoder, and
+    #              the k smallest keys are selected by an unrolled
+    #              min-extract loop — so the [N, 9*cell_cap] candidate
+    #              window and packed-key arrays NEVER round-trip HBM
+    #              (the two dominant post-r5 roofline terms,
+    #              docs/ROOFLINE.md: ~1.3 GB gather + ~0.9 GB top-k at
+    #              1M). Bit-identical outputs to "ranges" under every
+    #              exact topk_impl (same candidates, same keys, and
+    #              valid keys are unique so the k winners are the
+    #              same set). Interpret-mode execution off-TPU (slow
+    #              emulation — never a CPU default; see
+    #              ops/pallas_compat.py). Packed-id fast path only
+    #              (n < 2^21); wide worlds fall back to "ranges".
     # The default literal lives in consts.DEFAULT_SWEEP_IMPL ("ranges",
     # the r4 measured winner) — one source of truth shared with
     # GameConfig.aoi_sweep_impl and bench.py, so kernel-level GridSpec
@@ -232,9 +250,9 @@ class GridSpec:
                 f"got {self.topk_impl!r}"
             )
         if self.sweep_impl not in ("table", "ranges", "cellrow",
-                                   "shift"):
+                                   "shift", "fused"):
             raise ValueError(
-                f"sweep_impl must be table|ranges|cellrow|shift, "
+                f"sweep_impl must be table|ranges|cellrow|shift|fused, "
                 f"got {self.sweep_impl!r}"
             )
         if self.sort_impl not in ("argsort", "counting", "pallas"):
@@ -430,13 +448,20 @@ def _build_table(cc: int, n_rows: int, sorted_row, src, comp_init):
     return table.reshape(n_rows, ncomp * cc)
 
 
+def _invalid_key_int(topk_impl) -> int:
+    """Sentinel ranking key as a plain Python int — the one source of
+    truth (the fused Pallas kernel closes over it; a jnp constant
+    would be a tracer under jit and uncapturable by the kernel). The
+    f32-domain rankings (approx min-k and the exact "f32" top_k) run
+    over the keys bitcast to f32, so their invalid key is +inf's bit
+    pattern (ordered above every finite key; 0x7FFFFFFF would be a
+    NaN and break the float order)."""
+    return 0x7F800000 if topk_impl in ("approx", "f32") else 2**31 - 1
+
+
 def _invalid_key(topk_impl):
-    """Sentinel ranking key. The f32-domain rankings (approx min-k and
-    the exact "f32" top_k) run over the keys bitcast to f32, so their
-    invalid key is +inf's bit pattern (ordered above every finite key;
-    0x7FFFFFFF would be a NaN and break the float order)."""
-    return jnp.int32(0x7F800000) if topk_impl in ("approx", "f32") \
-        else jnp.int32(2**31 - 1)
+    """:func:`_invalid_key_int` as a jnp scalar for the XLA paths."""
+    return jnp.int32(_invalid_key_int(topk_impl))
 
 
 def _pack_keys(spec: GridSpec, dist, valid, cand_w, want_flags,
@@ -514,6 +539,13 @@ def _rank_packed(packed_key, k, topk_impl, want_flags, sentinel):
         top = jnp.sort(packed_key, axis=-1)[..., :k]
     else:
         top = -lax.top_k(-packed_key, k)[0]  # k smallest
+    return _unpack_top(top, invalid_key, want_flags, sentinel)
+
+
+def _unpack_top(top, invalid_key, want_flags, sentinel):
+    """Unpack ranked keys to (nbr ascending ids, cnt, flags-or-None) —
+    the tail of :func:`_rank_packed`, shared with the fused Pallas
+    sweep (whose kernel emits the ranked keys directly)."""
     ok = top < invalid_key
     if want_flags:
         # the (id << 2) | flags words are already id-ordered: one sort
@@ -688,7 +720,16 @@ def _sweep_shift(
     return nbr, cnt, fl, stats
 
 
-def _sweep(
+# Fused-kernel query-block rows: the VMEM working set per grid step is
+# ~ block * 9*cell_cap * (3 comps + keys) f32/i32 plus the whole sorted
+# array (3 * (n + 3*cell_cap) f32 — resident ACROSS steps via the
+# constant-index_map block, one HBM read per sweep). 512 keeps the
+# per-step scratch under ~1 MB at bench cell_cap while leaving the
+# descriptor-free VPU work wide enough to fill the lanes.
+_FUSED_BLOCK = 512
+
+
+def _sweep_fused(
     spec: GridSpec,
     pos: jax.Array,
     alive: jax.Array,
@@ -698,9 +739,212 @@ def _sweep(
     with_stats: bool = False,
     reach_pad: float = 0.0,
 ) -> tuple[jax.Array, jax.Array, jax.Array | None, tuple | None]:
+    """One-kernel back half (GridSpec.sweep_impl="fused").
+
+    Front half = the "ranges" impl's (cell rows -> cell sort ->
+    row_start offsets + padded component-major sorted view). The back
+    half — window gather, distance/key pack, top-k — is a single
+    Pallas kernel over blocks of ``_FUSED_BLOCK`` query rows:
+
+    * the sorted view ``s_t`` [3, n + 3cc] enters VMEM once (constant
+      index_map — the sequential grid reuses the block, so HBM sees
+      ONE streaming read of the sorted world per sweep),
+    * per query, the 3 contiguous z-triple runs are VMEM->VMEM slices
+      into a [3, B, 3, 3cc] scratch (the r4 killer — 3 HBM descriptor
+      fetches per query — becomes on-chip addressing),
+    * keys are packed by the SHARED :func:`_pack_keys` (bit parity
+      with every split sweep is inherited, not re-proved),
+    * the k smallest keys per row are extracted by an unrolled
+      min-extract loop (valid keys are unique — the id bits differ —
+      so equality-masking removes exactly one lane per pass); ranked
+      keys leave the kernel as the only [Q, k]-sized output (plus a
+      [Q] demand vector — but only under ``with_stats``, mirroring
+      the split sweeps' gauge gating).
+
+    The [Q, 9cc] candidate window and packed-key arrays therefore
+    never exist in HBM. Outputs are bit-identical to the "ranges"
+    sweep under every exact ranking (see GridSpec.sweep_impl).
+    Interpret-mode execution off-TPU (ops/pallas_compat.py).
+    """
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from goworld_tpu.ops.pallas_compat import interpret_default
+
+    n = pos.shape[0]
+    q = n if query_rows is None else query_rows
+    k = spec.k
+    cc = spec.cell_cap
+    sentinel = n
+    want_flags = flag_bits is not None
+    # plain Python int (Pallas kernels cannot capture jnp constants),
+    # from the one sentinel source so fused can never diverge from
+    # what _pack_keys encodes
+    invalid_key = _invalid_key_int(spec.topk_impl)
+
+    cx, cz, srow, alive, czp, n_rows = _cell_rows(
+        spec, pos, alive, watch_radius
+    )
+    if with_stats:
+        cell_max, over_cap_cells = _cell_occupancy_stats(srow, n_rows, cc)
+    order, _sorted_row = _sort_cells(n, n_rows, srow, spec.sort_impl)
+    src, table_sentinel, sentinel_bits = _sorted_src(
+        spec, pos, flag_bits, order
+    )
+    row_start, s_t = _build_ranges(cc, n_rows, srow, src, sentinel_bits)
+
+    # query-side scalars ([N]-sized, trivial next to the back half)
+    dxs = jnp.array([-1, 0, 1], jnp.int32)
+    starts = (cx[:, None] + dxs[None, :] + 1) * czp + cz[:, None]
+    starts = jnp.where(alive[:, None], starts, 0)  # border rows: empty
+    lo = row_start[starts]                          # [N, 3]
+    hi = row_start[starts + 3]
+    if watch_radius is None:
+        reach = jnp.full((n,), spec.radius + reach_pad, jnp.float32)
+    else:
+        reach = jnp.minimum(watch_radius, spec.radius).astype(
+            jnp.float32
+        ) + reach_pad
+
+    b = max(1, min(q, _FUSED_BLOCK, spec.row_block))
+    nb = -(-q // b)
+    padded = nb * b
+    idxp = jnp.minimum(jnp.arange(padded, dtype=jnp.int32), q - 1)
+    # runs-per-dx-major layouts keep the lane dim = block rows (wide)
+    lo_p = lo[idxp].reshape(nb, b, 3).transpose(0, 2, 1)   # [nb, 3, B]
+    hi_p = hi[idxp].reshape(nb, b, 3).transpose(0, 2, 1)
+    qx_p = pos[:, 0][idxp].reshape(nb, b)
+    qz_p = pos[:, 2][idxp].reshape(nb, b)
+    qr_p = reach[idxp].reshape(nb, b)
+    qid_p = idxp.reshape(nb, b)
+
+    def kernel(s_ref, lo_ref, hi_ref, qx_ref, qz_ref, qr_ref, qid_ref,
+               top_ref, *rest):
+        # rest = (dem_ref, win_ref) under with_stats, else (win_ref,) —
+        # the demand reductions + [nb, b] HBM write exist only when the
+        # gauges were asked for, like every split sibling
+        win_ref = rest[-1]
+
+        def gather_one(i, carry):
+            for dx in range(3):
+                win_ref[:, i, dx, :] = s_ref[
+                    :, pl.ds(lo_ref[0, dx, i], 3 * cc)
+                ]
+            return carry
+
+        lax.fori_loop(0, b, gather_one, 0)
+
+        qx = qx_ref[0]
+        qz = qz_ref[0]
+        qreach = qr_ref[0]
+        qid = qid_ref[0]
+        lanes = lax.broadcasted_iota(jnp.int32, (b, 3 * cc), 1)
+        keys = []
+        dems = []
+        for dx in range(3):
+            cpx = win_ref[0, :, dx, :]
+            cpz = win_ref[1, :, dx, :]
+            cw = lax.bitcast_convert_type(win_ref[2, :, dx, :],
+                                          jnp.int32)
+            # out-of-range lanes of a run may hold entities of OTHER
+            # cells (the sorted array is dense): hard-invalidate, same
+            # as the ranges impl
+            inr = lanes < (hi_ref[0, dx] - lo_ref[0, dx])[:, None]
+            cpx = jnp.where(inr, cpx, jnp.inf)
+            cw = jnp.where(inr, cw, table_sentinel)
+            dist = jnp.maximum(
+                jnp.abs(cpx - qx[:, None]), jnp.abs(cpz - qz[:, None])
+            )
+            cid = cw >> 2 if want_flags else cw
+            valid = (
+                (cid != sentinel)
+                & (dist <= qreach[:, None])
+                & (cid != qid[:, None])
+            )
+            keys.append(
+                _pack_keys(spec, dist, valid, cw, want_flags,
+                           qmax=spec.radius + reach_pad)
+            )
+            if with_stats:
+                dems.append(valid.sum(axis=1, dtype=jnp.int32))
+        packed = jnp.concatenate(keys, axis=1)        # [B, 9cc], VMEM
+        # unrolled exact min-extract (k is static): ascending ranked
+        # keys, exactly jnp.sort(packed)[:, :k] — valid keys are
+        # unique, so each pass retires exactly one lane
+        outs = []
+        for _j in range(k):
+            m = jnp.min(packed, axis=1)
+            outs.append(m)
+            packed = jnp.where(packed == m[:, None], invalid_key,
+                               packed)
+        top_ref[0] = jnp.stack(outs, axis=1)
+        if with_stats:
+            rest[0][0] = sum(dems)
+
+    out_specs = [pl.BlockSpec((1, b, k), lambda i: (i, 0, 0))]
+    out_shape = [jax.ShapeDtypeStruct((nb, b, k), jnp.int32)]
+    if with_stats:
+        out_specs.append(pl.BlockSpec((1, b), lambda i: (i, 0)))
+        out_shape.append(jax.ShapeDtypeStruct((nb, b), jnp.int32))
+    outs_pl = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            pl.BlockSpec((3, s_t.shape[1]), lambda i: (0, 0)),
+            pl.BlockSpec((1, 3, b), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, 3, b), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, b), lambda i: (i, 0)),
+            pl.BlockSpec((1, b), lambda i: (i, 0)),
+            pl.BlockSpec((1, b), lambda i: (i, 0)),
+            pl.BlockSpec((1, b), lambda i: (i, 0)),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[pltpu.VMEM((3, b, 3, 3 * cc), jnp.float32)],
+        interpret=interpret_default("aoi_fused_sweep"),
+    )(s_t, lo_p, hi_p, qx_p, qz_p, qr_p, qid_p)
+    out_top = outs_pl[0]
+    out_dem = outs_pl[1] if with_stats else None
+
+    top = out_top.reshape(padded, k)[:q]
+    nbr, cnt, fl = _unpack_top(top, invalid_key, want_flags, sentinel)
+    stats = None
+    if with_stats:
+        dem = out_dem.reshape(padded)[:q]
+        stats = (
+            dem.max().astype(jnp.int32),
+            (dem > k).sum().astype(jnp.int32),
+            cell_max,
+            over_cap_cells,
+        )
+    return nbr, cnt, fl, stats
+
+
+def _sweep(
+    spec: GridSpec,
+    pos: jax.Array,
+    alive: jax.Array,
+    query_rows: int | None,
+    watch_radius: jax.Array | None,
+    flag_bits: jax.Array | None,
+    with_stats: bool = False,
+    reach_pad: float = 0.0,
+    _upto: str | None = None,
+) -> tuple[jax.Array, jax.Array, jax.Array | None, tuple | None]:
+    # ``_upto`` (sweep_phase_checksum only): stop the back half after
+    # "gather" (window fetch), "pack" (key packing) or "rank" (top-k)
+    # and return ONE scalar checksum instead of the normal 4-tuple —
+    # the bench sub-phase probes time the real row-block code path,
+    # not a reimplementation. Entity-major impls only (the caller maps
+    # shift/fused onto their split siblings).
     n = pos.shape[0]
     if spec.sweep_impl == "shift" and n < (1 << _ID_BITS):
         return _sweep_shift(
+            spec, pos, alive, query_rows, watch_radius, flag_bits,
+            with_stats, reach_pad,
+        )
+    if spec.sweep_impl == "fused" and n < (1 << _ID_BITS):
+        return _sweep_fused(
             spec, pos, alive, query_rows, watch_radius, flag_bits,
             with_stats, reach_pad,
         )
@@ -721,7 +965,9 @@ def _sweep(
         spec, pos, flag_bits, order
     )
 
-    ranges_impl = spec.sweep_impl == "ranges"
+    # "fused" past the packed-id bound falls back to its front-half
+    # sibling "ranges" (the fused kernel packs ids into key words)
+    ranges_impl = spec.sweep_impl in ("ranges", "fused")
     cellrow_impl = spec.sweep_impl == "cellrow"
     merged = None
     if ranges_impl:
@@ -828,6 +1074,12 @@ def _sweep(
                 win[:, :, 2 * cc:], jnp.int32
             ).reshape(b, 9 * cc)
 
+        if _upto == "gather":
+            return (
+                jnp.where(jnp.isfinite(cand_px), cand_px, 0.0).sum()
+                + jnp.where(jnp.isfinite(cand_pz), cand_pz, 0.0).sum()
+                + cand_w.sum().astype(jnp.float32)
+            )
         ddx = jnp.abs(cand_px - px[rows][:, None])
         ddz = jnp.abs(cand_pz - pz[rows][:, None])
         dist = jnp.maximum(ddx, ddz)                 # Chebyshev XZ
@@ -846,9 +1098,14 @@ def _sweep(
             )
             packed_key = _pack_keys(spec, dist, valid, cand_w, want_flags,
                                     qmax=spec.radius + reach_pad)
+            if _upto == "pack":
+                return packed_key.sum().astype(jnp.float32)
             nbr_b, cnt_b, fl_b = _rank_packed(
                 packed_key, k, spec.topk_impl, want_flags, sentinel
             )
+            if _upto == "rank":
+                return nbr_b.sum().astype(jnp.float32) \
+                    + cnt_b.sum().astype(jnp.float32)
             dem_b = (
                 valid.sum(axis=1).astype(jnp.int32) if with_stats else None
             )
@@ -860,11 +1117,15 @@ def _sweep(
             & (cand_w != rows[:, None])
         )
         key = jnp.where(valid, dist, jnp.inf)
+        if _upto == "pack":
+            return jnp.where(jnp.isfinite(key), key, 0.0).sum()
         top_val, top_idx = lax.top_k(-key, k)        # k nearest
         nbr_b = jnp.take_along_axis(cand_w, top_idx, axis=1)
         ok = jnp.isfinite(top_val)
         nbr_b = jnp.where(ok, nbr_b, sentinel).astype(jnp.int32)
         nbr_b = jnp.sort(nbr_b, axis=1)              # ascending ids
+        if _upto == "rank":
+            return nbr_b.sum().astype(jnp.float32)
         fl_b = None
         if want_flags:
             # wide-id fallback: flags can't ride the word; one bounded
@@ -885,6 +1146,11 @@ def _sweep(
     padded = nblocks * rb
     all_rows = jnp.minimum(jnp.arange(padded, dtype=jnp.int32), q - 1)
     blocks = all_rows.reshape(nblocks, rb)
+    if _upto is not None:
+        # sub-phase probe: row_block returned ONE scalar per block
+        if nblocks == 1:
+            return row_block(blocks[0])
+        return lax.map(row_block, blocks).sum()
     if nblocks == 1:
         nbr, cnt, fl, dem = row_block(blocks[0])
     else:
@@ -989,21 +1255,38 @@ def grid_neighbors_flags(
 
 def sweep_phase_checksum(spec: GridSpec, pos, alive, phase: str):
     """Sub-phase probe for on-chip attribution (bench.py phase harness):
-    runs the sweep's front half UP TO ``phase`` and reduces to one
-    scalar. Phases: "sort" = cell ids + cell sort; "build" = sort plus
-    the candidate structure (table scatter or ranges row_start/padded
-    view, per ``spec.sweep_impl``). Calls the exact helpers the real
-    sweep uses, so timings attribute the real code — NOT a reimplement.
-    Un-jitted; callers wrap in their own jit/scan with loop-carried
-    inputs (see bench.measure_phases)."""
+    runs the sweep UP TO ``phase`` and reduces to one scalar. Front-half
+    phases: "sort" = cell ids + cell sort; "build" = sort plus the
+    candidate structure (table scatter or ranges row_start/padded view,
+    per ``spec.sweep_impl``). Back-half phases (cumulative on top of
+    "build"): "gather" = the 9-cell window fetch, "pack" = plus the
+    distance/key pack, "rank" = plus the top-k — these run the REAL
+    ``_sweep`` row-block path with an early ``_upto`` exit, so the
+    fused-vs-split win is attributable stage by stage. Entity-major
+    impls only for the back half: "fused" probes its split sibling
+    "ranges" (identical front half and candidates — the delta between
+    the probed split stages and the fused "aoi" phase IS the fusion
+    win) and "shift" probes "table" (same structure, cell-major
+    execution). Calls the exact helpers the real sweep uses, so timings
+    attribute the real code — NOT a reimplement. Un-jitted; callers
+    wrap in their own jit/scan with loop-carried inputs (see
+    bench.measure_phases)."""
     n = pos.shape[0]
     cc = spec.cell_cap
+    if phase in ("gather", "pack", "rank"):
+        sibling = {"fused": "ranges", "shift": "table"}.get(
+            spec.sweep_impl, spec.sweep_impl
+        )
+        return _sweep(
+            dataclasses.replace(spec, sweep_impl=sibling),
+            pos, alive, None, None, None, _upto=phase,
+        )
     cx, cz, srow, alive2, czp, n_rows = _cell_rows(spec, pos, alive, None)
     order, sorted_row = _sort_cells(n, n_rows, srow, spec.sort_impl)
     if phase == "sort":
         return order.sum() + sorted_row.sum()
     src, _ts, sentinel_bits = _sorted_src(spec, pos, None, order)
-    if spec.sweep_impl == "ranges":
+    if spec.sweep_impl in ("ranges", "fused"):
         row_start, s_t = _build_ranges(cc, n_rows, srow, src,
                                        sentinel_bits)
         return row_start.sum().astype(jnp.float32) \

@@ -1,0 +1,49 @@
+"""Shared execution-mode policy for the Pallas kernels.
+
+Every Pallas kernel in :mod:`goworld_tpu.ops` (the counting-sort fill
+pass in :mod:`~goworld_tpu.ops.sort`, the fused AOI back half in
+:mod:`~goworld_tpu.ops.aoi`) has one hardware lowering and one
+interpret-mode form. Selecting a Pallas impl on a non-TPU backend must
+NEVER fail at trace time — tier-1 runs on CPU, and an operator typo'ing
+``sort_impl = pallas`` into a CPU deployment's ini should get a slow
+but correct game, not a crash loop. The fallback is loud exactly once
+per kernel per process: interpret mode emulates the kernel op-by-op
+(orders of magnitude slower than the native XLA impls), so a silent
+fallback would look like a perf regression with no cause in the logs.
+"""
+
+from __future__ import annotations
+
+from goworld_tpu.utils import log
+
+logger = log.get("ops.pallas")
+
+# kernels that already warned this process (one line per kernel, not
+# one per trace — jit re-traces must not spam)
+_WARNED: set[str] = set()
+
+
+def on_tpu() -> bool:
+    """True when the default jax backend is a real TPU."""
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def interpret_default(kernel: str) -> bool:
+    """Resolve ``interpret=None`` for a Pallas kernel.
+
+    Returns False (hardware lowering) on a TPU backend; True (interpret
+    mode) everywhere else, logging a one-time warning naming the kernel
+    so the CPU-emulation cost is attributable from the logs alone.
+    """
+    if on_tpu():
+        return False
+    if kernel not in _WARNED:
+        _WARNED.add(kernel)
+        logger.warning(
+            "Pallas kernel %r: no TPU backend — falling back to "
+            "interpret mode (correct but slow CPU emulation; pick a "
+            "non-pallas impl off-TPU for production)", kernel,
+        )
+    return True

@@ -38,10 +38,30 @@ choice (``GridSpec.sort_impl``), never a fidelity knob.
 
 :func:`counting_sort_cells_pallas` is the same algorithm as a Pallas
 kernel: the sequential TPU grid walks the chunks while the ``fill``
-histogram persists in VMEM scratch across grid steps. It is validated
-by interpret-mode parity tests (tests/test_sort.py); the non-interpret
-TPU lowering is staged for a relay window (the kernel's gathers over
-the fill array are the part XLA cannot fuse this way today).
+histogram persists in VMEM scratch across grid steps. Two kernel
+bodies share that structure (``lowering=``):
+
+* ``"vector"`` — the original interpret-mode form: the per-chunk fill
+  lookups are vector gathers (``fill[keys]``), which jax's interpreter
+  executes directly but Mosaic cannot lower (TPU has no vector
+  gather/scatter over VMEM).
+* ``"serial"`` — the REAL TPU lowering: bins live as a 2D
+  ``[ceil(bins/128), 128]`` VMEM tile (proper (8, 128) tiling — a
+  ``[bins, 1]`` layout would lane-pad 128x) and the fill walk is a
+  ``fori_loop`` of single-element reads/updates — the scalar-core
+  emulation of what atomicAdd returns on GPUs. The per-element walk
+  subsumes the within-chunk rank (the running counter already counts
+  earlier same-key elements of the chunk), so no [chunk, chunk]
+  triangle compare exists in this body at all. All block specs are
+  real and no interpret flag is involved on TPU; no DMA semaphores are
+  needed because the sequential grid + automatic block pipelining
+  already serialize the scratch reuse. The same body passes
+  interpret-mode parity on CPU (tests/test_sort.py), so hardware runs
+  exercise a CPU-validated algorithm.
+
+Off-TPU, selecting the pallas impl falls back to interpret mode with a
+one-time warning (:mod:`goworld_tpu.ops.pallas_compat`) instead of
+failing at trace time.
 """
 
 from __future__ import annotations
@@ -129,11 +149,17 @@ def counting_sort_cells(
 
 # ---------------------------------------------------------------- pallas ----
 
+# bins per VMEM lane row of the serial kernel's 2D fill/starts tiles
+_BIN_LANES = 128
+_BIN_SHIFT = _BIN_LANES.bit_length() - 1   # log2: bin b -> row b >> SHIFT
+
+
 def counting_sort_cells_pallas(
     srow: jax.Array,
     n_rows: int,
     chunk: int = DEFAULT_CHUNK,
     interpret: bool | None = None,
+    lowering: str = "auto",
 ) -> tuple[jax.Array, jax.Array]:
     """:func:`counting_sort_cells` with pass 3 as a Pallas kernel.
 
@@ -141,19 +167,80 @@ def counting_sort_cells_pallas(
     the running per-bin histogram across grid steps — the same
     loop-carried state the XLA path threads through ``lax.scan``.
 
-    ``interpret=None`` auto-selects interpret mode off-TPU (the kernel
-    body is platform-agnostic jnp; only the TPU lowering of its fill
-    gathers is hardware-specific and still unmeasured on a relay).
-    Identical results to the XLA path — and therefore to argsort.
+    ``interpret=None`` resolves via
+    :func:`goworld_tpu.ops.pallas_compat.interpret_default`: hardware
+    lowering on TPU, interpret mode (with a one-time warning) anywhere
+    else — never a trace-time failure. ``lowering`` picks the kernel
+    body (module docstring): ``"auto"`` = the ``"serial"`` TPU lowering
+    when compiling for hardware, the ``"vector"`` gather form under
+    interpret (the interpreter executes vector gathers directly and far
+    faster than a serial loop); both are explicitly selectable so tests
+    can run the hardware body under interpret for parity. Identical
+    results from every combination — and therefore to argsort.
     """
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    from goworld_tpu.ops.pallas_compat import interpret_default
+
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = interpret_default("counting_sort_fill")
+    if lowering not in ("auto", "serial", "vector"):
+        raise ValueError(
+            f"lowering must be auto|serial|vector, got {lowering!r}"
+        )
+    if lowering == "auto":
+        lowering = "vector" if interpret else "serial"
     n = srow.shape[0]
     starts = row_starts(srow, n_rows)
     keys_c, c, nb = _chunk_keys(srow, n_rows, chunk)
+
+    if lowering == "serial":
+        # 2D-tiled bins: [nrp, _BIN_LANES] i32 keeps the (8, 128) VMEM
+        # tiling dense; bin b lives at (b >> _BIN_SHIFT, b & LANES-1)
+        nrp = -(-(n_rows + 1) // _BIN_LANES)
+        starts2 = jnp.concatenate(
+            [starts,
+             jnp.zeros(nrp * _BIN_LANES - (n_rows + 1), jnp.int32)]
+        ).reshape(nrp, _BIN_LANES)
+        keys3 = keys_c.reshape(nb, c, 1)
+
+        def kernel(starts_ref, keys_ref, dst_ref, fill_ref):
+            @pl.when(pl.program_id(0) == 0)
+            def _init():
+                fill_ref[...] = jnp.zeros((nrp, _BIN_LANES), jnp.int32)
+
+            # the element-wise fill walk IS the stable rank: the running
+            # per-bin counter already counts earlier same-key elements
+            # of this chunk (unlike the vector body, whose fill only
+            # advances per chunk and needs the [c, c] triangle rank on
+            # top) — exactly what atomicAdd returns on GPUs
+            def body(i, _):
+                key = keys_ref[0, i, 0]
+                bs = key >> _BIN_SHIFT
+                bl = key & (_BIN_LANES - 1)
+                f = fill_ref[bs, bl]
+                dst_ref[0, i, 0] = starts_ref[bs, bl] + f
+                fill_ref[bs, bl] = f + 1
+                return 0
+
+            lax.fori_loop(0, c, body, 0)
+
+        dst = pl.pallas_call(
+            kernel,
+            grid=(nb,),
+            in_specs=[
+                pl.BlockSpec((nrp, _BIN_LANES), lambda i: (0, 0)),
+                pl.BlockSpec((1, c, 1), lambda i: (i, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, c, 1), lambda i: (i, 0, 0)),
+            out_shape=jax.ShapeDtypeStruct((nb, c, 1), jnp.int32),
+            scratch_shapes=[
+                pltpu.VMEM((nrp, _BIN_LANES), jnp.int32),
+            ],
+            interpret=interpret,
+        )(starts2, keys3)
+        return _finish(srow, dst.reshape(-1), n)
 
     def kernel(starts_ref, keys_ref, dst_ref, fill_ref):
         @pl.when(pl.program_id(0) == 0)

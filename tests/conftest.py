@@ -50,6 +50,13 @@ def pytest_configure(config):
         "(tests/test_overload.py); the live smoke runs in tier-1, the "
         "chaos_soak overload scenario is also marked slow",
     )
+    config.addinivalue_line(
+        "markers",
+        "pallas: interpret-mode Pallas kernel suites (the fused AOI "
+        "back half and the counting-sort fill kernel); all run in "
+        "tier-1 on CPU — the marker exists to select exactly the "
+        "kernel-parity set before/after a relay window",
+    )
 
 
 def spawn_on(states, dev, slot, **kw):

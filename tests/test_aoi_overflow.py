@@ -26,6 +26,9 @@ from goworld_tpu.entity.space import Space
 from goworld_tpu.ops.aoi import GridSpec, grid_neighbors_flags
 from goworld_tpu.utils import opmon
 
+# fused rows run the r6 Pallas kernel in interpret mode on CPU
+FUSED = pytest.param("fused", marks=pytest.mark.pallas)
+
 
 class Npc(Entity):
     pass
@@ -48,7 +51,8 @@ def _stats(spec, pos, alive=None):
     return int(cnt.max()), tuple(map(int, stats))
 
 
-@pytest.mark.parametrize("sweep_impl", ["table", "ranges", "shift"])
+@pytest.mark.parametrize("sweep_impl", ["table", "ranges", "shift",
+                                        FUSED])
 def test_k_overflow_gauges(sweep_impl):
     """Cells hold everyone (cell_cap=8 >= 6) but k=4 < demand 5: every
     clustered row reports truncation."""
@@ -67,7 +71,8 @@ def test_k_overflow_gauges(sweep_impl):
     assert cnt_max == 4             # lists really were capped at k
 
 
-@pytest.mark.parametrize("sweep_impl", ["table", "ranges", "shift"])
+@pytest.mark.parametrize("sweep_impl", ["table", "ranges", "shift",
+                                        FUSED])
 def test_cell_overflow_gauges(sweep_impl):
     """cell_cap=4 < occupancy 6: the cell gauge fires even where the
     pool-clipped demand cannot exceed k (the lower-bound case the
@@ -84,7 +89,8 @@ def test_cell_overflow_gauges(sweep_impl):
     assert over_cap == 1
 
 
-@pytest.mark.parametrize("sweep_impl", ["table", "ranges", "shift"])
+@pytest.mark.parametrize("sweep_impl", ["table", "ranges", "shift",
+                                        FUSED])
 def test_exact_tick_reports_all_zero(sweep_impl):
     spec = GridSpec(radius=10.0, extent_x=100.0, extent_z=100.0,
                     k=4, cell_cap=4, row_block=64, sweep_impl=sweep_impl)

@@ -1,9 +1,12 @@
-"""Cross-impl AOI parity: {table, ranges, cellrow, shift} x
+"""Cross-impl AOI parity: {table, ranges, cellrow, shift, fused} x
 {argsort, counting sort} x {skin off, skin on} must produce IDENTICAL
 neighbor sets (vs the NumPy oracle) in non-overflow regimes, and the
 front-half checksums (sweep_phase_checksum) must agree across sort
 lowerings — the counting sort is stable, so it is a pure lowering
-choice, and the Verlet skin is exact by the standard bound. Structure
+choice, and the Verlet skin is exact by the standard bound. The fused
+Pallas back half (r6) must additionally be BIT-identical to its split
+sibling "ranges" (same candidates, same packed keys, unique valid keys
+→ the same top-k) — asserted on raw arrays, not just sets. Structure
 follows tests/test_aoi_shift.py.
 """
 
@@ -20,6 +23,10 @@ from goworld_tpu.ops.aoi import (
     neighbors_oracle,
     sweep_phase_checksum,
 )
+
+# the fused rows run the Pallas kernel in interpret mode on CPU — part
+# of the kernel-parity set the `pallas` marker selects around a relay
+FUSED = pytest.param("fused", marks=pytest.mark.pallas)
 
 N = 600
 EXTENT = 300.0
@@ -72,7 +79,7 @@ def _check_flags(nbr, fl, fb):
 
 @pytest.mark.parametrize("sort_impl", ["argsort", "counting"])
 @pytest.mark.parametrize("sweep_impl", ["table", "ranges", "cellrow",
-                                        "shift"])
+                                        "shift", FUSED])
 def test_skinless_matrix_matches_oracle(sweep_impl, sort_impl):
     spec = _spec(sweep_impl, sort_impl, 0.0)
     nbr, cnt, fl = grid_neighbors_flags(
@@ -88,7 +95,7 @@ def test_skinless_matrix_matches_oracle(sweep_impl, sort_impl):
 
 @pytest.mark.parametrize("sort_impl", ["argsort", "counting"])
 @pytest.mark.parametrize("sweep_impl", ["table", "ranges", "cellrow",
-                                        "shift"])
+                                        "shift", FUSED])
 def test_skin_matrix_matches_oracle_rebuild_and_reuse(sweep_impl,
                                                       sort_impl):
     """Verlet path through every (sweep, sort) front half: the rebuild
@@ -135,6 +142,93 @@ def test_sweep_phase_checksums_agree_across_sort_impls(sweep_impl):
     assert outs["argsort"] == outs["counting"]
 
 
+@pytest.mark.pallas
+@pytest.mark.parametrize("topk_impl", ["exact", "sort", "f32"])
+def test_fused_bit_identical_to_ranges(topk_impl):
+    """Stronger than the oracle matrix: the fused kernel shares the
+    ranges front half and the _pack_keys encoder, and valid keys are
+    unique, so its (nbr, cnt, flags) arrays must equal the split
+    "ranges" sweep's BIT-FOR-BIT under every exact ranking. argsort
+    front half and k=32 keep the interpret-mode cost down — the
+    counting front half's bit-parity is proven by the oracle matrix
+    above plus test_sort.py, and k only sizes the unrolled
+    min-extract."""
+    outs = {}
+    for sweep_impl in ("ranges", "fused"):
+        spec = GridSpec(
+            radius=RADIUS, extent_x=EXTENT, extent_z=EXTENT,
+            k=32, cell_cap=64, row_block=256,
+            sweep_impl=sweep_impl, topk_impl=topk_impl,
+        )
+        nbr, cnt, fl = grid_neighbors_flags(
+            spec, jnp.asarray(POS), jnp.asarray(ALIVE),
+            flag_bits=jnp.asarray(FB),
+        )
+        outs[sweep_impl] = (np.asarray(nbr), np.asarray(cnt),
+                            np.asarray(fl))
+    for a, b in zip(outs["ranges"], outs["fused"]):
+        assert np.array_equal(a, b)
+
+
+@pytest.mark.pallas
+def test_fused_phase_checksums_follow_ranges():
+    """The front-half checksums ("sort"/"build") of a fused spec go
+    through the shared `sweep_impl in ("ranges", "fused")` build
+    branch — a real equality check that the fused front half IS the
+    ranges front half. The back-half probes ("gather"/"pack"/"rank")
+    are DEFINED to run the split sibling (sweep_phase_checksum maps
+    fused -> ranges before calling _sweep), so equality there is the
+    contract, not evidence — this leg only guards that a fused config
+    can evaluate every bench sub-phase probe without tracing the
+    Pallas kernel (finite scalar out, no crash)."""
+    for phase in ("sort", "build"):
+        a = float(sweep_phase_checksum(
+            _spec("ranges", "argsort", 0.0),
+            jnp.asarray(POS), jnp.asarray(ALIVE), phase))
+        b = float(sweep_phase_checksum(
+            _spec("fused", "argsort", 0.0),
+            jnp.asarray(POS), jnp.asarray(ALIVE), phase))
+        assert a == b, phase
+    for phase in ("gather", "pack", "rank"):
+        v = float(sweep_phase_checksum(
+            _spec("fused", "argsort", 0.0),
+            jnp.asarray(POS), jnp.asarray(ALIVE), phase))
+        assert np.isfinite(v), phase
+
+
+@pytest.mark.pallas
+def test_pallas_impls_fall_back_to_interpret_off_tpu(monkeypatch,
+                                                     caplog):
+    """Regression (ISSUE 6 satellite): selecting a Pallas impl on a
+    non-TPU backend must fall back to interpret mode with a ONE-TIME
+    warning — never fail at trace time, never warn per re-trace."""
+    import logging
+
+    import jax
+
+    from goworld_tpu.ops import pallas_compat
+
+    if jax.default_backend() == "tpu":
+        pytest.skip("fallback path is for non-TPU backends")
+    monkeypatch.setattr(pallas_compat, "_WARNED", set())
+    with caplog.at_level(logging.WARNING,
+                         logger="goworld_tpu.ops.pallas"):
+        for _ in range(2):   # second call: cached, no second warning
+            nbr, _cnt, _fl = grid_neighbors_flags(
+                _spec("fused", "pallas", 0.0),
+                jnp.asarray(POS), jnp.asarray(ALIVE),
+                flag_bits=jnp.asarray(FB),
+            )
+        got = [set(r[r < N].tolist()) for r in np.asarray(nbr)]
+        for i in range(N):
+            assert got[i] == (ORACLE[i] if ALIVE[i] else set()), i
+    warns = [r.message for r in caplog.records
+             if "interpret mode" in r.message]
+    assert sorted(warns.count(m) for m in set(warns)) == [1, 1], warns
+    assert any("aoi_fused_sweep" in m for m in warns)
+    assert any("counting_sort_fill" in m for m in warns)
+
+
 def test_new_knob_validation_mirrors_existing_messages():
     """GridSpec.__post_init__ rejects bad values for the r5 knobs with
     the same shape as the topk_impl/sweep_impl errors: the named
@@ -167,7 +261,8 @@ def test_new_knob_validation_mirrors_existing_messages():
         GridSpec(**base, rebuild_every_max=-7)
     # the existing knobs keep their messages (pinned here so the new
     # branches can't have reordered them away)
-    with pytest.raises(ValueError, match=r"table\|ranges\|cellrow\|shift"):
+    with pytest.raises(ValueError,
+                       match=r"table\|ranges\|cellrow\|shift\|fused"):
         GridSpec(**base, sweep_impl="bogus")
     with pytest.raises(ValueError, match=r"exact\|sort\|f32\|approx"):
         GridSpec(**base, topk_impl="bogus")

@@ -74,3 +74,36 @@ def test_diag_set_is_covered():
     """The parametrization above must include the diagnostics (the
     BENCH_AUTOTUNE_DIAG=1 set), not just the selectable pool."""
     assert any(not sel for sel, _ in BENCH.AUTOTUNE_CANDIDATES)
+
+
+def test_fused_rows_are_candidates():
+    """The r6 fused back half must stay in the candidate pool — both
+    the fused-over-argsort row and the full-Pallas pipeline (fused over
+    the counting-sort front half) — so the parametrized smoke above
+    keeps compiling them every tier-1 run."""
+    impls = [(ov.get("sweep_impl"), ov.get("sort_impl"))
+             for _sel, ov in BENCH.AUTOTUNE_CANDIDATES]
+    assert ("fused", None) in impls
+    assert ("fused", "counting") in impls
+
+
+@pytest.mark.pallas
+def test_lowered_counting_sort_compiles_at_bench_shape():
+    """The serial kernel body — the real TPU lowering of the
+    counting-sort fill pass (2D-tiled VMEM bins, no vector gathers) —
+    must keep building at the autotune smoke shape, under interpret on
+    CPU (the same body lowers on hardware). The autotune candidates
+    only reach the "vector" interpret body off-TPU, so this is the
+    tier-1 guard on the lowering itself."""
+    from goworld_tpu.ops.sort import counting_sort_cells_pallas
+
+    rng = np.random.default_rng(6)
+    n_rows = 37
+    srow = rng.integers(0, n_rows, N).astype(np.int32)
+    ref = np.argsort(srow, kind="stable").astype(np.int32)
+    order, sorted_row = counting_sort_cells_pallas(
+        jnp.asarray(srow), n_rows, chunk=64, interpret=True,
+        lowering="serial",
+    )
+    assert np.array_equal(np.asarray(order), ref)
+    assert np.array_equal(np.asarray(sorted_row), srow[ref])

@@ -1,9 +1,11 @@
 """Counting-sort front half (ops/sort.py): bit-parity with stable
 argsort — the contract that makes GridSpec.sort_impl a pure lowering
 choice (docs/ROOFLINE.md replaces the bitonic-network traffic term with
-this kernel). The Pallas form is validated in interpret mode (the CPU
-lowering of the same kernel body); the hardware lowering is staged for
-a relay window.
+this kernel). The Pallas form is validated in interpret mode for BOTH
+kernel bodies: the "vector" gather form (the interpret default) and the
+"serial" body that IS the TPU lowering (2D-tiled VMEM bins + per-element
+fill walk, real block specs, no interpret flag on hardware) — so a relay
+run exercises a CPU-validated algorithm.
 """
 
 import numpy as np
@@ -48,13 +50,43 @@ def test_counting_sort_matches_stable_argsort(n, n_rows, chunk):
     assert np.array_equal(np.asarray(sorted_row), srow[ref])
 
 
+@pytest.mark.pallas
+@pytest.mark.parametrize("lowering", ["vector", "serial"])
 @pytest.mark.parametrize("n,n_rows,chunk", CASES[:3])
-def test_pallas_kernel_interpret_parity(n, n_rows, chunk):
+def test_pallas_kernel_interpret_parity(n, n_rows, chunk, lowering):
+    """Both kernel bodies — the vector-gather interpret form and the
+    serial body that is the real TPU lowering — must match stable
+    argsort bit-for-bit under interpret mode."""
     rng = np.random.default_rng(3 * n + n_rows)
     srow = _keys(rng, n, n_rows)
     ref = np.argsort(srow, kind="stable").astype(np.int32)
     order, sorted_row = counting_sort_cells_pallas(
-        jnp.asarray(srow), n_rows, chunk, interpret=True
+        jnp.asarray(srow), n_rows, chunk, interpret=True,
+        lowering=lowering,
+    )
+    assert np.array_equal(np.asarray(order), ref)
+    assert np.array_equal(np.asarray(sorted_row), srow[ref])
+
+
+@pytest.mark.pallas
+def test_pallas_lowering_knob_validated():
+    with pytest.raises(ValueError, match=r"auto\|serial\|vector"):
+        counting_sort_cells_pallas(
+            jnp.zeros(8, jnp.int32), 4, lowering="bogus"
+        )
+
+
+@pytest.mark.pallas
+def test_serial_lowering_wide_bin_space():
+    """More bins than one 128-lane row (the 2D [ceil(bins/128), 128]
+    VMEM tile actually wraps) and a non-multiple-of-128 bin count."""
+    rng = np.random.default_rng(77)
+    n, n_rows = 3000, 1000          # nrp = ceil(1001/128) = 8 rows
+    srow = _keys(rng, n, n_rows)
+    ref = np.argsort(srow, kind="stable").astype(np.int32)
+    order, sorted_row = counting_sort_cells_pallas(
+        jnp.asarray(srow), n_rows, 512, interpret=True,
+        lowering="serial",
     )
     assert np.array_equal(np.asarray(order), ref)
     assert np.array_equal(np.asarray(sorted_row), srow[ref])

@@ -4,9 +4,15 @@ process (one backend init), streaming results to stdout as they land.
 Order puts the decision-critical experiments first in case the backend
 dies mid-run:
   1. full-sweep impl matrix at 131K (table/shift x exact/sort/f32 +
-     approx + ranges) — picks the production config.
+     approx + ranges + the r6 FUSED Pallas back half) — picks the
+     production config.
   1b. Verlet skin reuse (rebuild vs reuse tick) + front-half sort impl
      (argsort vs counting vs pallas) — the r5 levers.
+  1c. fused-vs-split sweep A/B at the SECOND shape (PROBE_N2, default
+     1M when PROBE_N is the 131K shard): fused against every split
+     impl, the ISSUE-6 headline rows. TPU-only — interpret-mode fused
+     at 1M would eat the session; off-TPU these rows print SKIP (the
+     CPU fused number is recorded by bench.py's backhalf_ab instead).
   2. back-half stage bisect (gather / +key / +topk / +final-sort).
   3. collect-phase bisect (interest_pairs / collect_sync / attrs).
   4. move-phase bisect (inputs scatter / random_walk / integrate).
@@ -98,7 +104,11 @@ for impl, topk in (("ranges", "sort"), ("table", "sort"),
                    ("cellrow", "sort"), ("cellrow", "f32"),
                    ("table", "f32"), ("ranges", "f32"),
                    ("shift", "sort"), ("shift", "f32"),
-                   ("table", "exact"), ("table", "approx")):
+                   ("table", "exact"), ("table", "approx"),
+                   # r6: one-kernel back half (bit-identical to
+                   # ranges; in-kernel ranking so topk only changes
+                   # the key encoding it packs)
+                   ("fused", "sort"), ("fused", "f32")):
     timeit(f"sweep {impl}/{topk}", mk_full(impl, topk))
 
 # ---- 1b. Verlet skin + front-half sort impls ------------------------
@@ -156,6 +166,51 @@ def mk_sort(sort_impl):
 
 for si in ("argsort", "counting", "pallas"):
     timeit(f"front sort {si}", mk_sort(si))
+
+# ---- 1c. fused-vs-split A/B at the second shape ---------------------
+# The ISSUE-6 headline rows: the fused Pallas back half against every
+# split sweep at the OTHER deployment shape (131K per-chip shard and
+# the 1M north-star world are both one env flip away). TPU-only: the
+# fused kernel off-TPU runs in interpret mode, where a 1M row would
+# burn the whole relay window emulating — bench.py's backhalf_ab
+# already records that CPU number at a sane shape.
+
+from goworld_tpu.ops.pallas_compat import on_tpu
+
+N2 = int(os.environ.get("PROBE_N2", 1048576 if N <= 262144 else 131072))
+if on_tpu():
+    extent2 = float(int((N2 * 10000 / 12) ** 0.5))
+    kk1, kk2, kk3 = jax.random.split(jax.random.PRNGKey(1), 3)
+    pos2 = jnp.stack([
+        jax.random.uniform(kk1, (N2,), maxval=extent2),
+        jnp.zeros(N2),
+        jax.random.uniform(kk2, (N2,), maxval=extent2)], axis=1)
+    alive2_ab = jnp.ones(N2, bool)
+    flags2 = (jax.random.uniform(kk3, (N2,)) < 0.5).astype(jnp.int32)
+
+    def mk_full2(impl):
+        sp = GridSpec(radius=50.0, extent_x=extent2, extent_z=extent2,
+                      k=K, cell_cap=CC, row_block=65536,
+                      sweep_impl=impl, topk_impl="sort")
+
+        def make(length):
+            def run(p0):
+                def body(p, _):
+                    nbr, cnt, fl = grid_neighbors_flags(
+                        sp, p, alive2_ab, flag_bits=flags2)
+                    p = p + (cnt[:, None] % 2).astype(p.dtype) * 1e-6
+                    return p, cnt.sum() + fl.sum()
+                pp, ss = lax.scan(body, p0, None, length=length)
+                return ss.sum().astype(jnp.float32) + pp.sum()
+            return run
+        return make
+
+    for impl in ("fused", "ranges", "table", "cellrow", "shift"):
+        timeit(f"sweep@{N2} {impl}/sort", mk_full2(impl), arg=pos2)
+else:
+    print(f"sweep@{N2} fused-vs-split       SKIP (no TPU backend; "
+          "interpret-mode fused at this shape would stall the session "
+          "— see bench.py backhalf_ab for the CPU record)", flush=True)
 
 # ---- 2. back-half stage bisect (table impl, no flags) ---------------
 
