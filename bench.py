@@ -58,6 +58,16 @@ sys.path.insert(0, REPO)
 
 # jax-free (verified: pure constants) — safe in the no-jax parent
 from goworld_tpu.utils import consts as _consts
+# jax-free scenario registry (goworld_tpu/scenarios/spec.py): the ONE
+# place the accepted BENCH_BEHAVIOR set, the --scenario names and their
+# error messages live (ISSUE 7 satellite — new scenarios are
+# bench-selectable for free)
+from goworld_tpu.scenarios import spec as _sspec  # noqa: E402
+from goworld_tpu.scenarios.spec import (  # noqa: E402
+    get_scenario,
+    resolve_bench_behavior,
+    scenario_names,
+)
 BASELINE_ENTITY_TICKS_PER_CHIP = 7.5e6
 # packed-id bound shared with ops/aoi.py: the Verlet reuse path (and
 # its phase probes below) only exists for n below it
@@ -154,11 +164,29 @@ AUTOTUNE_CANDIDATES = [
 ]
 
 N = int(os.environ.get("BENCH_N", 1_048_576))
-BEHAVIOR = os.environ.get("BENCH_BEHAVIOR", "random_walk")  # or "mlp"
-                                                            # (config 5)
-if BEHAVIOR not in ("random_walk", "mlp", "btree"):
-    raise SystemExit(f"BENCH_BEHAVIOR must be random_walk|mlp|btree, "
-                     f"got {BEHAVIOR!r}")
+BEHAVIOR = os.environ.get("BENCH_BEHAVIOR", "random_walk")  # a legacy
+# behavior (random_walk|mlp|btree) OR any scenario registry name —
+# validation and the (cfg.behavior, ScenarioSpec) resolution both live
+# in goworld_tpu/scenarios/spec.py, so the accepted set has one home
+try:
+    BEHAVIOR_RESOLVED = resolve_bench_behavior(BEHAVIOR)
+except ValueError as exc:
+    raise SystemExit(str(exc))
+# per-scenario headline blocks (ISSUE 7): "all" = every registry
+# scenario; a comma list selects; "0"/"none" skips. The parent's
+# --scenario flag writes this env for the children.
+SCENARIOS_SEL = os.environ.get("BENCH_SCENARIOS", "all")
+if SCENARIOS_SEL.strip().lower() not in ("0", "none", "", "all"):
+    # a typo'd env selection must fail fast pre-spawn with the registry
+    # list (same contract as BENCH_BEHAVIOR above), not as a KeyError
+    # inside the child minutes into the headline measurement
+    for _nm in (s.strip() for s in SCENARIOS_SEL.split(",") if s.strip()):
+        try:
+            get_scenario(_nm)
+        except KeyError as exc:
+            raise SystemExit(f"BENCH_SCENARIOS: {exc.args[0]}")
+SCENARIO_N = int(os.environ.get("BENCH_SCENARIO_N", 65536))
+SCENARIO_TICKS = int(os.environ.get("BENCH_SCENARIO_TICKS", 4))
 T = int(os.environ.get("BENCH_TICKS", 20))
 CLIENT_FRAC = float(os.environ.get("BENCH_CLIENT_FRAC", 0.01))
 SMOKE_N = int(os.environ.get("BENCH_SMOKE_N", 8192))
@@ -207,7 +235,8 @@ def _grid_kw_from_env(n: int, overrides: dict | None = None) -> dict:
     return grid_kw
 
 
-def build(n: int, client_frac: float, grid_overrides: dict | None = None):
+def build(n: int, client_frac: float, grid_overrides: dict | None = None,
+          scenario=None):
     import jax
     import jax.numpy as jnp
 
@@ -218,13 +247,21 @@ def build(n: int, client_frac: float, grid_overrides: dict | None = None):
     # ~12 avg Chebyshev neighbors at radius 50 (north-star AOI density)
     extent = float(int((n * 10000 / 12) ** 0.5))
     grid_kw = _grid_kw_from_env(n, grid_overrides)
+    if scenario is None:
+        # BENCH_BEHAVIOR may itself name a scenario (the headline then
+        # measures that workload); an explicit scenario arg overrides
+        # (the per-scenario block harness passes each registry spec)
+        behavior, scenario = BEHAVIOR_RESOLVED
+    else:
+        behavior = "random_walk"
     cfg = WorldConfig(
         capacity=n,
         grid=GridSpec(
             radius=50.0, extent_x=extent, extent_z=extent, **grid_kw
         ),
         npc_speed=5.0,
-        behavior=BEHAVIOR,  # "mlp" = config 5 (fused NPC behavior kernel)
+        behavior=behavior,  # "mlp" = config 5 (fused NPC behavior kernel)
+        scenario=scenario,
         enter_cap=65536, leave_cap=65536,
         sync_cap=65536, attr_sync_cap=4096, input_cap=4096,
         delta_rows_cap=65536,  # sized with enter/leave caps: 1M movers at
@@ -256,13 +293,17 @@ def build(n: int, client_frac: float, grid_overrides: dict | None = None):
         nbr_cnt=jnp.zeros(n, jnp.int32),
         nbr_client_cnt=jnp.zeros(n, jnp.int32),
         nbr_mean_off=jnp.zeros((n, 3), jnp.float32),
-        aoi_radius=jnp.full(n, jnp.inf, jnp.float32),
+        aoi_radius=(jnp.asarray(_sspec.assign_watch_radii(scenario, n))
+                    if scenario is not None
+                    else jnp.full(n, jnp.inf, jnp.float32)),
         dirty=jnp.zeros(n, bool),
         rng=jax.random.PRNGKey(1),
         tick=jnp.zeros((), jnp.int32),
         aoi_cache=(init_verlet_cache(cfg.grid, n)
                    if cfg.grid.skin > 0 and n < (1 << _AOI_ID_BITS)
                    else None),
+        behavior_id=(jnp.asarray(_sspec.assign_behavior_ids(scenario, n))
+                     if scenario is not None else None),
     )
     # steady stream of client position syncs (input-scatter path stays hot)
     inputs = TickInputs(
@@ -473,6 +514,207 @@ def backhalf_ab(n: int, ticks: int = 4) -> dict:
     return out
 
 
+# Per-scenario kernel A/B pool (the per-scenario kernel table ISSUE 7
+# feeds autotune): one candidate per knob family the scenarios stress —
+# the Verlet skin (teleport/hotspot thrash it, flock loves it), the
+# sweep impl and the front-half sort. Module-level so tests can pin the
+# pool like AUTOTUNE_CANDIDATES.
+SCENARIO_KERNEL_CANDIDATES = [
+    ("default", {}),
+    ("skin=0", {"skin": 0.0}),
+    ("sweep=table,skin=0", {"sweep_impl": "table", "skin": 0.0}),
+    ("sort=counting,skin=0", {"sort_impl": "counting", "skin": 0.0}),
+]
+
+
+def scenario_selection() -> list:
+    """BENCH_SCENARIOS -> registry names ("all" | comma list | 0/none)."""
+    sel = SCENARIOS_SEL.strip().lower()
+    if sel in ("0", "none", ""):
+        return []
+    if sel == "all":
+        return list(scenario_names())
+    names = [s.strip() for s in SCENARIOS_SEL.split(",") if s.strip()]
+    for nm in names:
+        get_scenario(nm)  # unknown names fail here with the registry list
+    return names
+
+
+def _scenario_tick_ms(cfg, st, inputs, policy, ticks: int):
+    """Scan-marginal full-tick timing for a scenario config — the same
+    protocol as the headline (2x-minus-1x, min-of-2 repeats, distinct
+    anti-cache inputs per timed call). Returns (per_tick_s, scale_2x)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from goworld_tpu.core.step import tick_body
+
+    def mk(length):
+        @jax.jit
+        def run(state):
+            def body(s, _):
+                s2, out = tick_body(cfg, s, inputs, policy)
+                chk = (out.enter_n + out.leave_n + out.sync_n).astype(
+                    jnp.float32) + out.sync_vals.sum()
+                return s2, chk
+            st2, checks = lax.scan(body, state, None, length=length)
+            return checks.sum() + st2.pos.sum()
+        return run
+
+    def variant(i):
+        return st.replace(
+            rng=jax.random.PRNGKey(500 + i),
+            pos=st.pos + jnp.float32(0.001 * (i + 1)),
+        )
+
+    r1, r2 = mk(ticks), mk(2 * ticks)
+    float(np.asarray(r1(variant(0))))        # compile + warm
+    float(np.asarray(r2(variant(1))))
+    es = []
+    for i in range(2):
+        t0 = time.perf_counter()
+        float(np.asarray(r1(variant(2 + 2 * i))))
+        e1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(np.asarray(r2(variant(3 + 2 * i))))
+        e2 = time.perf_counter() - t0
+        es.append((e1, e2))
+    e1 = min(e[0] for e in es)
+    e2 = min(e[1] for e in es)
+    per_tick = max(e2 - e1, 1e-9) / ticks
+    return per_tick, e2 / max(e1, 1e-9)
+
+
+def _scenario_gauges(cfg, st, inputs, policy, ticks: int) -> dict:
+    """One on-device scan aggregating the scenario-relevant gauges
+    (overflow/rebuild/migration stats the headline block stamps)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from goworld_tpu.core.step import tick_body
+
+    @jax.jit
+    def run(state):
+        acc0 = (
+            jnp.zeros((), jnp.int32),   # rebuilds
+            jnp.zeros((), jnp.int32),   # over_k max
+            jnp.zeros((), jnp.int32),   # over_cap max
+            jnp.zeros((), jnp.int32),   # demand max
+            jnp.full((), jnp.inf, jnp.float32),  # slack min
+            jnp.zeros((), jnp.int32),   # enter events
+            jnp.zeros((), jnp.int32),   # leave events
+        )
+
+        def body(carry, _):
+            s, acc = carry
+            s2, out = tick_body(cfg, s, inputs, policy)
+            acc = (
+                acc[0] + out.aoi_rebuilt,
+                jnp.maximum(acc[1], out.aoi_over_k_rows),
+                jnp.maximum(acc[2], out.aoi_over_cap_cells),
+                jnp.maximum(acc[3], out.aoi_demand_max),
+                jnp.minimum(acc[4], out.aoi_skin_slack),
+                acc[5] + out.enter_n,
+                acc[6] + out.leave_n,
+            )
+            return (s2, acc), 0
+        (s2, acc), _ = lax.scan(body, (state, acc0), None,
+                                length=ticks)
+        return acc
+    acc = [np.asarray(x) for x in run(st)]
+    return {
+        "aoi_rebuild_total": int(acc[0]),
+        "aoi_over_k_rows_max": int(acc[1]),
+        "aoi_over_cap_cells_max": int(acc[2]),
+        "aoi_demand_max": int(acc[3]),
+        "aoi_skin_slack_min": round(float(acc[4]), 3),
+        "aoi_enter_events": int(acc[5]),
+        "aoi_leave_events": int(acc[6]),
+    }
+
+
+def measure_scenarios(n: int, grid_overrides: dict | None = None) -> dict:
+    """Per-scenario headline blocks (ISSUE 7): for every selected
+    registry scenario, the full-tick scan-marginal throughput at the
+    scenario shape with resolved kernel stamps + overflow/rebuild
+    gauges, plus (BENCH_SCENARIO_AUTOTUNE=1, the default) the
+    per-scenario kernel table over SCENARIO_KERNEL_CANDIDATES — the
+    measured input the autotuner has been missing: kernel choice is now
+    per WORKLOAD, not just per platform."""
+    import jax
+
+    ns = min(n, SCENARIO_N)
+    ticks = SCENARIO_TICKS
+    kernels = os.environ.get("BENCH_SCENARIO_AUTOTUNE", "1") == "1"
+    out: dict = {"n": ns, "ticks": ticks, "scenarios": {}}
+    for name in scenario_selection():
+        spec = get_scenario(name)
+        block: dict = {"behaviors": list(spec.behavior_names)}
+        try:
+            cfg, st, inputs = build(ns, CLIENT_FRAC, grid_overrides,
+                                    scenario=spec)
+            policy = None
+            if spec.needs_policy:
+                from goworld_tpu.models.npc_policy import init_policy
+
+                policy = init_policy(jax.random.PRNGKey(5))
+            per_tick, scale = _scenario_tick_ms(cfg, st, inputs, policy,
+                                                ticks)
+            block.update(
+                value=round(ns / per_tick, 1),
+                tick_ms=round(1000.0 * per_tick, 3),
+                entities=ns,
+                ticks_timed=ticks,
+                scale_2x=round(scale, 2),
+                # resolved kernel stamps, headline-style (skin stamped
+                # EFFECTIVE past the packed-id bound like measure())
+                sweep_impl=cfg.grid.sweep_impl,
+                topk_impl=cfg.grid.topk_impl,
+                sort_impl=cfg.grid.sort_impl,
+                skin=(cfg.grid.skin if ns < (1 << _AOI_ID_BITS)
+                      else 0.0),
+            )
+            if not (1.5 <= scale <= 3.0):
+                block["timing_suspect"] = (
+                    f"2x scan took {scale:.2f}x the 1x time"
+                )
+            block["gauges"] = _scenario_gauges(cfg, st, inputs, policy,
+                                               max(ticks, 4))
+            if kernels:
+                table: dict = {}
+                for label, ov in SCENARIO_KERNEL_CANDIDATES:
+                    if label == "default":
+                        table[label] = block["tick_ms"]
+                        continue
+                    try:
+                        kcfg, kst, kin = build(
+                            ns, CLIENT_FRAC,
+                            {**(grid_overrides or {}), **ov},
+                            scenario=spec)
+                        kms, _ = _scenario_tick_ms(kcfg, kst, kin,
+                                                   policy, ticks)
+                        table[label] = round(1000.0 * kms, 3)
+                    except Exception as exc:
+                        table[label] = f"error: {str(exc)[:120]}"
+                block["kernels_ms"] = table
+                numeric = {k: v for k, v in table.items()
+                           if isinstance(v, (int, float))}
+                if numeric:
+                    block["best_kernel"] = min(numeric, key=numeric.get)
+        except Exception as exc:  # one broken scenario must not zero
+            block["error"] = str(exc)[:200]  # out the whole stage
+        out["scenarios"][name] = block
+        log(f"scenario {name}@{ns}: "
+            f"{block.get('tick_ms', block.get('error'))} ms/tick")
+    return out
+
+
 def measure(n: int, ticks: int, client_frac: float, phases: bool,
             grid_overrides: dict | None = None) -> dict:
     import jax
@@ -484,7 +726,8 @@ def measure(n: int, ticks: int, client_frac: float, phases: bool,
     cfg, st, inputs = build(n, client_frac, grid_overrides)
 
     policy = None
-    if cfg.behavior == "mlp":
+    if cfg.behavior == "mlp" or (
+            cfg.scenario is not None and cfg.scenario.needs_policy):
         from goworld_tpu.models.npc_policy import init_policy
 
         policy = init_policy(jax.random.PRNGKey(5))
@@ -972,6 +1215,16 @@ def child_main(args) -> int:
             except Exception as exc:  # belt over backhalf_ab's braces
                 r["backhalf_ab"] = {"error": str(exc)[:200]}
         print(json.dumps(r), flush=True)
+        if name == "full" and scenario_selection():
+            # per-scenario headline blocks, AFTER the headline line is
+            # safely on stdout (same contract as p99: an adversarial-
+            # workload wedge must never zero out the measured number)
+            try:
+                sc = measure_scenarios(n, overrides)
+                sc["stage"] = "scenarios"
+                print(json.dumps(sc), flush=True)
+            except Exception as exc:
+                log(f"scenario stage failed: {exc}")
         if name == "full" and p99_args is not None \
                 and os.environ.get("BENCH_SKIP_P99") != "1":
             # separate stage AFTER the headline line is on stdout: a
@@ -996,7 +1249,9 @@ def child_main(args) -> int:
                     scfg, sst, sinputs = build(shard_n, args.client_frac,
                                                overrides)
                     spolicy = None
-                    if scfg.behavior == "mlp":
+                    if scfg.behavior == "mlp" or (
+                            scfg.scenario is not None
+                            and scfg.scenario.needs_policy):
                         from goworld_tpu.models.npc_policy import init_policy
                         import jax as _jax
 
@@ -1124,6 +1379,7 @@ def parent_main() -> int:
     partial = None       # any stage result at all (smoke counts)
     p99 = None           # the optional per-tick latency stage (full n)
     p99_shard = None     # same, at the 131K north-star per-chip shard
+    scen = None          # the per-scenario headline blocks (ISSUE 7)
     variants = {}        # config-5 behavior variants (btree/mlp)
 
     live_stages: list = []   # current child's streamed stages
@@ -1135,7 +1391,7 @@ def parent_main() -> int:
         has OFFICIALLY completed, stages streamed from the in-flight
         child count too (they are per-line complete results)."""
         b, sb, pt = best, suspect_best, partial
-        cp99, cp99s = p99, p99_shard
+        cp99, cp99s, csc = p99, p99_shard, scen
         if b is None:
             for s in list(live_stages):
                 st = s.get("stage")
@@ -1148,14 +1404,18 @@ def parent_main() -> int:
                     cp99 = s
                 elif st == "p99_shard":
                     cp99s = s
+                elif st == "scenarios":
+                    csc = s
                 elif pt is None:
                     pt = s
         chosen = b or sb or pt
         best_final = b
-        # latency only attaches when a same-child headline exists
+        # latency/scenario blocks only attach when a same-child
+        # headline exists
         if b is None:
             cp99 = None
             cp99s = None
+            csc = None
         if chosen is not None and cp99 is not None:
             chosen = dict(chosen)
             for k in ("tick_p50_ms", "tick_p99_ms",
@@ -1183,6 +1443,14 @@ def parent_main() -> int:
                           "p99_samples")
                 if k in cp99s
             }
+        if chosen is not None and csc is not None:
+            # the per-scenario headline blocks ride the round artifact
+            # next to the single-workload headline (ISSUE 7: "fast"
+            # proven across the workload space, not at one point)
+            chosen = dict(chosen)
+            chosen["scenarios"] = csc.get("scenarios", {})
+            chosen["scenario_n"] = csc.get("n")
+            chosen["scenario_ticks"] = csc.get("ticks")
         result = {
             "metric": "entity_ticks_per_sec_per_chip",
             "value": 0.0,
@@ -1257,6 +1525,7 @@ def parent_main() -> int:
         had_suspect = False
         child_p99 = None
         child_p99_shard = None
+        child_scen = None
         got_best = False
         for s in stages:
             if s.get("stage") == "p99":
@@ -1264,6 +1533,9 @@ def parent_main() -> int:
                 continue
             if s.get("stage") == "p99_shard":
                 child_p99_shard = s
+                continue
+            if s.get("stage") == "scenarios":
+                child_scen = s
                 continue
             partial = s
             if s.get("stage") == "full":
@@ -1278,11 +1550,12 @@ def parent_main() -> int:
                     best = s
                     got_best = True
         if got_best:
-            # latency only attaches to the SAME child's headline: a p99
-            # from a failed TPU attempt must not graft onto a CPU
-            # fallback (or smoke-only) result
+            # latency/scenario stages only attach to the SAME child's
+            # headline: a p99 from a failed TPU attempt must not graft
+            # onto a CPU fallback (or smoke-only) result
             p99 = child_p99
             p99_shard = child_p99_shard
+            scen = child_scen
         attempts_log.append({
             "attempt": i + 1, "env": {},
             "stages": [s.get("stage") for s in stages],
@@ -1327,12 +1600,15 @@ def parent_main() -> int:
         })
         child_p99 = None
         child_p99_shard = None
+        child_scen = None
         got_best = False
         for s in stages:
             if s.get("stage") == "p99":
                 child_p99 = s
             elif s.get("stage") == "p99_shard":
                 child_p99_shard = s
+            elif s.get("stage") == "scenarios":
+                child_scen = s
             elif s.get("stage") == "full":
                 # same rule as the TPU loop: a full stage that failed its
                 # 2x-scale self-check never becomes the headline
@@ -1345,6 +1621,7 @@ def parent_main() -> int:
                 partial = s
         p99 = child_p99 if got_best else None
         p99_shard = child_p99_shard if got_best else None
+        scen = child_scen if got_best else None
 
     # BASELINE config 5 (fused NPC behavior kernels): once a TPU headline
     # is in hand, time the btree and mlp behaviors at the same N so the
@@ -1364,6 +1641,10 @@ def parent_main() -> int:
             if kk in GRID_ENV
         }
         var_env["BENCH_AUTOTUNE"] = "0"
+        # the scenario blocks already landed with the headline child;
+        # re-measuring them per behavior variant burns relay time on
+        # workloads whose motion doesn't depend on cfg.behavior
+        var_env["BENCH_SCENARIOS"] = "0"
         for b in ("btree", "mlp"):
             if time.monotonic() - t_start > VARIANT_DEADLINE:
                 # never risk the headline: if the driver's patience may
@@ -1416,6 +1697,7 @@ def selftest_main() -> int:
         "BENCH_AUTOTUNE_N": "512", "BENCH_P99_SAMPLES": "8",
         "BENCH_P99_SHARD_N": "1024", "BENCH_N_CPU": "2048",
         "BENCH_CHILD_TIMEOUT": "420", "BENCH_TIME_REPEATS": "2",
+        "BENCH_SCENARIO_N": "512", "BENCH_SCENARIO_TICKS": "2",
     }
     failures: list[str] = []
     report: dict = {}
@@ -1503,6 +1785,39 @@ def selftest_main() -> int:
             check("full.backhalf_ab",
                   "fused_ms" in ab and "split_ms" in ab
                   and "interpret" in ab, str(ab))
+        # per-scenario headline blocks (ISSUE 7): present for every
+        # registry scenario by default, hotspot + shrink being the
+        # named worst cases, each stamped with resolved kernels,
+        # overflow/rebuild gauges and the per-scenario kernel table
+        if os.environ.get("BENCH_SCENARIOS", "all") not in ("0", "none"):
+            scs = art.get("scenarios", {})
+            check("full.scenarios", bool(scs), "missing scenarios block")
+            from goworld_tpu.scenarios.spec import scenario_names as _sn
+
+            for nm in _sn():
+                check(f"full.scenario.{nm}", nm in scs, "missing")
+            for nm in ("hotspot", "shrink"):
+                blk = scs.get(nm, {})
+                check(f"full.scenario.{nm}.headline",
+                      blk.get("value", 0) > 0 and "tick_ms" in blk,
+                      json.dumps(blk)[:160])
+                for k in ("sweep_impl", "topk_impl", "sort_impl",
+                          "skin", "gauges"):
+                    check(f"full.scenario.{nm}.{k}", k in blk,
+                          "missing stamp")
+                g = blk.get("gauges", {})
+                for k in ("aoi_rebuild_total", "aoi_over_k_rows_max",
+                          "aoi_over_cap_cells_max", "aoi_enter_events"):
+                    check(f"full.scenario.{nm}.gauges.{k}", k in g,
+                          f"gauges={g}")
+                if os.environ.get("BENCH_SCENARIO_AUTOTUNE", "1") == "1":
+                    check(f"full.scenario.{nm}.kernels",
+                          "kernels_ms" in blk and "best_kernel" in blk,
+                          "missing per-scenario kernel table")
+            mixed = scs.get("mixed", {})
+            check("full.scenario.mixed.heterogeneous",
+                  len(mixed.get("behaviors", [])) >= 3,
+                  str(mixed.get("behaviors")))
         check("full.p99", "tick_p99_ms" in art, "missing p99 keys")
         check("full.p99_gate", "p99_suspect" not in art,
               art.get("p99_suspect", ""))
@@ -1556,7 +1871,22 @@ def main() -> int:
     ap.add_argument("--ticks", type=int, default=T)
     ap.add_argument("--client-frac", type=float, default=CLIENT_FRAC)
     ap.add_argument("--phases", action="store_true", default=PHASES)
+    ap.add_argument(
+        "--scenario", default=None, metavar="NAME|all|none",
+        help="per-scenario headline blocks to stamp (scenario registry "
+             f"names: {'|'.join(scenario_names())}; comma list, 'all' "
+             "(the default via BENCH_SCENARIOS), or 'none')")
     args = ap.parse_args()
+    if args.scenario is not None:
+        # children inherit the selection through the env (one knob for
+        # both the CLI and env-driven invocations)
+        os.environ["BENCH_SCENARIOS"] = args.scenario
+        global SCENARIOS_SEL
+        SCENARIOS_SEL = args.scenario
+        try:
+            scenario_selection()  # unknown names fail fast, pre-spawn
+        except KeyError as exc:
+            raise SystemExit(f"--scenario: {exc.args[0]}")
     if args.child:
         return child_main(args)
     if args.selftest:

@@ -255,11 +255,23 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
                         topk_impl=gc.aoi_topk_impl,
                         **kernel_kw,
                         **_grid_caps(gc))
+    scenario = None
+    if gc.scenario:
+        from goworld_tpu.scenarios.spec import get_scenario
+
+        if gc.megaspace:
+            # the megaspace shard step keeps the homogeneous behavior
+            # path (gid neighbor lists can't feed the scenario feature
+            # gathers) — say so instead of failing at trace time
+            logger.warning("scenario ignored for megaspace games")
+        else:
+            scenario = get_scenario(gc.scenario)  # KeyError lists names
     wc = WorldConfig(
         capacity=gc.capacity,
         grid=grid,
         npc_speed=gc.npc_speed,
         behavior=gc.behavior,
+        scenario=scenario,
     )
     mesh = None
     if gc.mesh_devices > 1:

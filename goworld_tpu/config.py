@@ -158,6 +158,13 @@ class GameConfig:
     npc_speed: float = 5.0
     behavior: str = "random_walk"  # random_walk | mlp | btree (the fused
                                    # NPC kernels, BASELINE config 5)
+    # adversarial workload scenario (goworld_tpu/scenarios registry:
+    # hotspot | shrink | flock | teleport | mixed_radius | mixed —
+    # docs/SCENARIOS.md). When set, NPC motion dispatches the spec's
+    # heterogeneous behavior mix through one vmapped lax.switch and
+    # `behavior` above is ignored for velocity. "" = off. Ignored for
+    # megaspace games (the tile step keeps the homogeneous path).
+    scenario: str = ""
     # ONE logical space spanning the whole mesh as spatial tiles
     # (parallel/megaspace.py; BASELINE config 4). extent_x/extent_z are
     # the WORLD extents; tiles are derived from mega_shape ("8" = 1D
@@ -453,6 +460,9 @@ aoi_radius = 50.0
 extent_x = 1000.0
 extent_z = 1000.0
 # behavior = btree   # fused NPC kernel: random_walk | mlp | btree
+# scenario = hotspot # adversarial workload mix (goworld_tpu/scenarios
+#                    # registry; docs/SCENARIOS.md): hotspot | shrink |
+#                    # flock | teleport | mixed_radius | mixed
 # pipeline_decode = true   # overlap host event decode with the device
 #                          # step (single-controller non-mesh games;
 #                          # client events lag one tick)

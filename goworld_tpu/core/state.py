@@ -29,6 +29,11 @@ from goworld_tpu.ops.aoi import (
     VerletCache,
     init_verlet_cache,
 )
+from goworld_tpu.scenarios.spec import (
+    ScenarioSpec,
+    assign_behavior_ids,
+    assign_watch_radii,
+)
 from goworld_tpu.utils import consts
 
 
@@ -54,6 +59,13 @@ class WorldConfig:
     # execute. The World manager clears it for its vmapped local step.
     adaptive_extract: bool = True
     input_cap: int = consts.DEFAULT_INPUT_CAP
+    # Adversarial scenario matrix (goworld_tpu/scenarios): when set, the
+    # tick's behavior phase dispatches a HETEROGENEOUS population — every
+    # entity carries a behavior lane (SpaceState.behavior_id indexing the
+    # spec's mix order) through ONE vmapped lax.switch, and `behavior`
+    # above is ignored for velocity. ScenarioSpec is frozen/hashable so
+    # the config still closes over jit exactly like GridSpec.
+    scenario: ScenarioSpec | None = None
     delta_rows_cap: int = 0  # max rows whose AOI list may change per tick
     # before enter/leave events overflow (ops.delta.interest_pairs).
     # <= 0 means "capacity": the row pre-filter then never drops events
@@ -69,6 +81,13 @@ class WorldConfig:
             raise ValueError(
                 f"behavior must be random_walk|mlp|btree, "
                 f"got {self.behavior!r}"
+            )
+        if self.scenario is not None \
+                and not isinstance(self.scenario, ScenarioSpec):
+            raise ValueError(
+                "scenario must be a ScenarioSpec (see "
+                "goworld_tpu.scenarios.spec.get_scenario), "
+                f"got {type(self.scenario).__name__}"
             )
 
     @property
@@ -127,10 +146,28 @@ class SpaceState:
     # cfg.grid.skin == 0 (no memory cost); the skinless tick passes it
     # through untouched.
     aoi_cache: VerletCache | None = None
+    # Per-entity scenario behavior lane (i32[N], dense index into
+    # cfg.scenario.mix order; scenarios/behaviors.py dispatches the
+    # population through one vmapped lax.switch on it). None when
+    # cfg.scenario is None — legacy homogeneous worlds carry no lane.
+    # The lane belongs to the SLOT: a respawn inherits it, which is
+    # exactly what scenario churn wants (the mix fractions hold).
+    behavior_id: jax.Array | None = None
 
 
 def create_state(cfg: WorldConfig, seed: int = 0) -> SpaceState:
     n, a, k = cfg.capacity, cfg.attr_width, cfg.grid.k
+    scn = cfg.scenario
+    if scn is not None:
+        # deterministic per-slot scenario lanes: behavior mix + the
+        # watch-radius distribution (host spawns through an entity
+        # registry overwrite aoi_radius per type — the runner registers
+        # one type per radius class, so both paths agree)
+        behavior_id = jnp.asarray(assign_behavior_ids(scn, n, seed))
+        aoi_radius = jnp.asarray(assign_watch_radii(scn, n, seed))
+    else:
+        behavior_id = None
+        aoi_radius = jnp.full((n,), jnp.inf, jnp.float32)
     return SpaceState(
         pos=jnp.zeros((n, 3), jnp.float32),
         yaw=jnp.zeros((n,), jnp.float32),
@@ -147,7 +184,7 @@ def create_state(cfg: WorldConfig, seed: int = 0) -> SpaceState:
         nbr_cnt=jnp.zeros((n,), jnp.int32),
         nbr_client_cnt=jnp.zeros((n,), jnp.int32),
         nbr_mean_off=jnp.zeros((n, 3), jnp.float32),
-        aoi_radius=jnp.full((n,), jnp.inf, jnp.float32),
+        aoi_radius=aoi_radius,
         dirty=jnp.zeros((n,), bool),
         rng=jax.random.PRNGKey(seed),
         tick=jnp.zeros((), jnp.int32),
@@ -158,6 +195,7 @@ def create_state(cfg: WorldConfig, seed: int = 0) -> SpaceState:
         aoi_cache=(init_verlet_cache(cfg.grid, n)
                    if cfg.grid.skin > 0.0 and n < (1 << _ID_BITS)
                    else None),
+        behavior_id=behavior_id,
     )
 
 

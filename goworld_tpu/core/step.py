@@ -42,6 +42,7 @@ from goworld_tpu.ops.aoi import (
 from goworld_tpu.ops.delta import interest_pairs
 from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
 from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
+from goworld_tpu.scenarios.behaviors import scenario_velocity
 
 
 @struct.dataclass
@@ -177,19 +178,35 @@ def tick_body(
         inputs.pos_sync_idx, inputs.pos_sync_vals, inputs.pos_sync_n,
     )
 
-    # 2. behaviors (vectorized; MXU when behavior == 'mlp').
+    # 2. behaviors (vectorized; MXU when behavior == 'mlp'). A scenario
+    # config dispatches a heterogeneous population through ONE vmapped
+    # lax.switch on the per-entity behavior lane instead of the static
+    # Python-if below (goworld_tpu/scenarios/behaviors.py) — one trace
+    # per WorldConfig either way.
     rng, k_behave = jax.random.split(state.rng)
-    vel = compute_velocity(
-        cfg, k_behave, pos, yaw, state, policy,
-        (cfg.grid.extent_x, cfg.grid.extent_z),
-        nbr=state.nbr, nbr_cnt=state.nbr_cnt,
-    )
+    tele = None
+    if cfg.scenario is not None:
+        vel, tele_pos, tele = scenario_velocity(
+            cfg, k_behave, pos, yaw, state, policy
+        )
+    else:
+        vel = compute_velocity(
+            cfg, k_behave, pos, yaw, state, policy,
+            (cfg.grid.extent_x, cfg.grid.extent_z),
+            nbr=state.nbr, nbr_cnt=state.nbr_cnt,
+        )
 
     # 3. integrate + world clamp.
     pos, moved = integrate(
         pos, vel, state.npc_moving, cfg.dt,
         cfg.bounds_min, cfg.bounds_max,
     )
+    if tele is not None:
+        # scenario teleports override the integrated position BEFORE
+        # the sweep, so the Verlet displacement check sees the full
+        # jump and trips the in-graph rebuild cond on this exact tick
+        pos = jnp.where(tele[:, None], tele_pos, pos)
+        moved = moved | tele
     # state.dirty carries host-set pending force-syncs (spawn marks the
     # new entity dirty so watchers get its position, the syncInfoFlag
     # analog — Entity.go:1189-1205); consumed here, cleared below.

@@ -163,8 +163,11 @@ class World:
         self.game_id = game_id
         self.registry = Registry()
         self.mesh = mesh
-        self.policy = None  # MLPPolicy when cfg.behavior == 'mlp'
-        if cfg.behavior == "mlp":
+        self.policy = None  # MLPPolicy when cfg.behavior == 'mlp' (or a
+        # scenario mix includes the mlp member)
+        if cfg.behavior == "mlp" or (
+            cfg.scenario is not None and cfg.scenario.needs_policy
+        ):
             # config-built worlds need a live policy; callers may replace
             # it (e.g. with trained weights) before the first tick
             from goworld_tpu.models.npc_policy import init_policy
@@ -1974,6 +1977,16 @@ class World:
         over_k = int(np.sum(base.aoi_over_k_rows))
         cell_max = int(np.max(base.aoi_cell_max))
         over_cap = int(np.sum(base.aoi_over_cap_cells))
+        # interest-migration volume (TRUE demand — may exceed the
+        # enter/leave caps, which the overflow warnings above already
+        # alarm): the scenario runner reads these as its per-tick
+        # migration gauges (battle-royale shrink = sustained churn)
+        enters = int(np.sum(base.enter_n))
+        leaves = int(np.sum(base.leave_n))
+        opmon.expose("aoi_enter_events", enters)
+        opmon.expose("aoi_leave_events", leaves)
+        self.op_stats["aoi_enter_events"] = enters
+        self.op_stats["aoi_leave_events"] = leaves
         opmon.expose("aoi_demand_max", dem_max)
         opmon.expose("aoi_over_k_rows", over_k)
         opmon.expose("aoi_cell_max", cell_max)

@@ -1538,21 +1538,35 @@ def grid_neighbors_verlet(
             need.astype(jnp.int32), slack)
 
 
-def neighbors_oracle(pos, alive, radius):
-    """NumPy reference implementation (unbounded, uncapped) for tests."""
+def neighbors_oracle(pos, alive, radius, watch_radius=None):
+    """NumPy reference implementation (unbounded, uncapped) for tests.
+
+    ``watch_radius`` (optional f32[N]) applies the per-entity AOI
+    semantics of :func:`grid_neighbors`: radius <= 0 excludes the
+    entity from AOI entirely (invisible AND blind); otherwise watcher
+    ``i`` sees participants within ``min(watch_radius[i], radius)``.
+    The scenario oracle gates (scenarios/runner.py, the mixed-radius
+    workloads) compare World interest sets against exactly this."""
     import numpy as np
 
     pos = np.asarray(pos)
     alive = np.asarray(alive)
     n = pos.shape[0]
+    if watch_radius is None:
+        participates = alive
+        reach = np.full(n, radius, np.float64)
+    else:
+        wr = np.asarray(watch_radius, np.float64)
+        participates = alive & (wr > 0)
+        reach = np.minimum(wr, radius)
     out = []
     for i in range(n):
-        if not alive[i]:
+        if not participates[i]:
             out.append(set())
             continue
         dx = np.abs(pos[:, 0] - pos[i, 0])
         dz = np.abs(pos[:, 2] - pos[i, 2])
-        mask = (np.maximum(dx, dz) <= radius) & alive
+        mask = (np.maximum(dx, dz) <= reach[i]) & participates
         mask[i] = False
         out.append(set(np.nonzero(mask)[0].tolist()))
     return out

@@ -1,0 +1,28 @@
+"""Adversarial scenario matrix: device-side workload engine (ISSUE 7 /
+ROADMAP item 3).
+
+* :mod:`goworld_tpu.scenarios.spec` — the ScenarioSpec registry
+  (behavior mix, watch-radius distributions, phase schedules, churn).
+  jax-free: bench.py's parent imports it for workload validation.
+* :mod:`goworld_tpu.scenarios.behaviors` — per-entity behavior kernels
+  dispatched through ONE ``jit(vmap(lax.switch))`` on the per-entity
+  ``SpaceState.behavior_id`` lane (jaxsgp4-style batched heterogeneous
+  propagation, PAPERS.md).
+* :mod:`goworld_tpu.scenarios.runner` — drives a World through a spec,
+  collects the scenario gauges and gates interest sets against the
+  brute-force oracle at small N.
+
+Keep this module import-light (spec only): the jax-bearing halves load
+lazily so no parent/dispatcher process trips a backend init.
+"""
+
+from goworld_tpu.scenarios.spec import (  # noqa: F401
+    BEHAVIORS,
+    LEGACY_BEHAVIORS,
+    SCENARIOS,
+    ScenarioSpec,
+    bench_workloads,
+    get_scenario,
+    resolve_bench_behavior,
+    scenario_names,
+)

@@ -1,0 +1,344 @@
+"""Per-entity scenario behavior kernels: ONE vmapped ``lax.switch``.
+
+This generalizes the Python-if dispatch of
+:func:`goworld_tpu.core.step.compute_velocity`: instead of one static
+behavior string per Space, every entity carries a dense behavior lane
+(``SpaceState.behavior_id`` indexes the spec's mix order) and the whole
+heterogeneous population advances through one ``jax.vmap(lax.switch)``
+— the ECS-archetype / jaxsgp4 batched-propagation pattern (PAPERS.md).
+Under vmap the switch batches to ``select_n`` (every member kernel runs
+over the full population, lanes select), which is exactly the TPU
+tradeoff wanted: one trace, one compile, zero per-behavior retrace —
+``TRACE_COUNTS`` records per-kernel trace entries so tests can assert
+the no-retrace property directly.
+
+Each kernel is a pure per-entity function
+``(key, ent, ctx) -> (velocity f32[3], pos_override f32[3], teleport
+bool)``: velocity feeds the normal integrate step; ``teleport`` rows
+override their integrated position with ``pos_override`` (and are
+marked dirty), which is what trips the Verlet skin's in-graph rebuild
+cond on exactly that tick (displacement > skin/2 —
+ops/aoi.py grid_neighbors_verlet).
+
+The phase schedule (moving hotspot attractor, battle-royale zone
+radius, flock wind direction) is a pure function of the traced tick
+counter — :func:`scenario_context` — so multi-tick ``lax.scan`` benches
+stay entirely on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from goworld_tpu.scenarios.spec import ScenarioSpec
+
+# Python-level trace counters keyed by kernel name: each entry
+# increments when jax TRACES the kernel body (never when the compiled
+# program runs). tests/test_scenarios.py asserts the counts stay frozen
+# across ticks — the "no per-behavior retrace" acceptance criterion.
+TRACE_COUNTS: dict = {}
+
+
+def _traced(name: str) -> None:
+    TRACE_COUNTS[name] = TRACE_COUNTS.get(name, 0) + 1
+
+
+def _unit_xz(dx, dz, eps: float = 1e-6):
+    norm = jnp.sqrt(dx * dx + dz * dz + eps)
+    return dx / norm, dz / norm
+
+
+def _vel3(vx, vz):
+    return jnp.stack([vx, jnp.zeros_like(vx), vz])
+
+
+def scenario_context(spec: ScenarioSpec, cfg, t: jax.Array) -> dict:
+    """Scalar phase state for tick ``t`` (traced i32): attractor
+    position, shrink-zone radius, wind heading. All closed-form in t so
+    the scan carries nothing extra."""
+    g = cfg.grid
+    tf = t.astype(jnp.float32)
+    two_pi = 2.0 * jnp.pi
+    cx = g.origin_x + 0.5 * g.extent_x
+    cz = g.origin_z + 0.5 * g.extent_z
+    # hotspot attractor: an ellipse inset by attractor_margin, one loop
+    # per attractor_period ticks
+    ph = two_pi * tf / float(spec.attractor_period)
+    ax = cx + (0.5 - spec.attractor_margin) * g.extent_x * jnp.cos(ph)
+    az = cz + (0.5 - spec.attractor_margin) * g.extent_z * jnp.sin(ph)
+    # battle-royale zone: linear shrink to shrink_min_frac, then hold
+    half = 0.5 * float(min(g.extent_x, g.extent_z))
+    prog = jnp.minimum(tf / float(spec.shrink_over), 1.0)
+    zone_r = half * (1.0 - (1.0 - spec.shrink_min_frac) * prog)
+    # flock wind: slowly rotating global heading
+    wph = two_pi * tf / float(spec.flock_wind_period)
+    return dict(
+        attractor=(ax, az),
+        zone_c=(cx, cz),
+        zone_r=zone_r,
+        wind=(jnp.cos(wph), jnp.sin(wph)),
+    )
+
+
+# ----------------------------------------------------------------------
+# per-entity kernels (all share the (key, ent, ctx) -> out signature)
+# ----------------------------------------------------------------------
+# ``ent`` is a dict pytree of per-entity leaves: pos f32[3], vel f32[3],
+# yaw f32, moving bool, mean_off f32[3], nbr_cnt f32, client_cnt f32,
+# client_off f32[3]. ``ctx`` (closed over per branch, NOT vmapped) adds
+# the scalar phase state + static knobs.
+
+def _no_teleport(pos):
+    return pos, jnp.zeros((), bool)
+
+
+def _walk_vel(key, ent, speed: float, turn_prob: float):
+    """Per-entity random walk: keep heading, re-draw with turn_prob
+    (models/random_walk.py semantics, one entity at a time)."""
+    k_turn, k_head = jax.random.split(key)
+    turn = jax.random.uniform(k_turn, ()) < turn_prob
+    heading = jax.random.uniform(k_head, (), minval=0.0,
+                                 maxval=2.0 * jnp.pi)
+    new_vel = _vel3(jnp.cos(heading) * speed, jnp.sin(heading) * speed)
+    stopped = jnp.sum(jnp.abs(ent["vel"])) < 1e-6
+    pick = (turn | stopped) & ent["moving"]
+    return jnp.where(pick, new_vel, ent["vel"])
+
+
+def make_kernel(name: str, spec: ScenarioSpec, cfg, ctx: dict,
+                policy):
+    """Build the per-entity kernel for one mix member. Static params
+    come from the spec/cfg closure (no per-entity parameter lanes
+    needed); traced scalars come from ``ctx``."""
+    speed = float(cfg.npc_speed)
+    turn_prob = float(cfg.turn_prob)
+    dt = float(cfg.dt)
+    g = cfg.grid
+    # teleports land strictly inside the world so the border clamp can
+    # never move a fresh teleport (which would shrink its displacement)
+    lo_x, lo_z = g.origin_x + 1e-3, g.origin_z + 1e-3
+    hi_x = g.origin_x + g.extent_x - 1e-3
+    hi_z = g.origin_z + g.extent_z - 1e-3
+
+    if name == "random_walk":
+        def k_random_walk(key, ent, _ctx=ctx):
+            _traced("random_walk")
+            vel = _walk_vel(key, ent, speed, turn_prob)
+            return vel, *_no_teleport(ent["pos"])
+        return k_random_walk
+
+    if name == "hotspot":
+        def k_hotspot(key, ent, _ctx=ctx):
+            _traced("hotspot")
+            ax, az = _ctx["attractor"]
+            dx = ax - ent["pos"][0]
+            dz = az - ent["pos"][2]
+            dist = jnp.sqrt(dx * dx + dz * dz + 1e-12)
+            ux, uz = _unit_xz(dx, dz)
+            # never overshoot the attractor: the radial step is
+            # min(speed*dt, dist), a non-expansive contraction — this
+            # is what makes hotspot demand growth MONOTONE (the
+            # overflow-gauge regression tests pin that)
+            eff = jnp.minimum(speed, dist / dt)
+            vx, vz = ux * eff, uz * eff
+            if spec.hotspot_jitter > 0.0:
+                jh = jax.random.uniform(key, (), minval=0.0,
+                                        maxval=2.0 * jnp.pi)
+                js = spec.hotspot_jitter * speed
+                vx = vx + jnp.cos(jh) * js
+                vz = vz + jnp.sin(jh) * js
+            vel = jnp.where(ent["moving"], _vel3(vx, vz), 0.0)
+            return vel, *_no_teleport(ent["pos"])
+        return k_hotspot
+
+    if name == "shrink":
+        def k_shrink(key, ent, _ctx=ctx):
+            _traced("shrink")
+            cx, cz = _ctx["zone_c"]
+            dx = cx - ent["pos"][0]
+            dz = cz - ent["pos"][2]
+            d = jnp.sqrt(dx * dx + dz * dz + 1e-12)
+            outside = d > _ctx["zone_r"]
+            ux, uz = _unit_xz(dx, dz)
+            inward = _vel3(ux * speed, uz * speed)
+            # survivors inside the zone mill at reduced speed
+            wander = _walk_vel(key, ent, 0.4 * speed, turn_prob)
+            vel = jnp.where(outside, inward, wander)
+            vel = jnp.where(ent["moving"], vel, 0.0)
+            return vel, *_no_teleport(ent["pos"])
+        return k_shrink
+
+    if name == "flock":
+        def k_flock(key, ent, _ctx=ctx):
+            _traced("flock")
+            wx, wz = _ctx["wind"]
+            cx, cz = _unit_xz(ent["mean_off"][0], ent["mean_off"][2])
+            coh = spec.flock_coherence
+            has_nbr = ent["nbr_cnt"] > 0
+            dxv = wx + jnp.where(has_nbr, coh * cx, 0.0)
+            dzv = wz + jnp.where(has_nbr, coh * cz, 0.0)
+            ux, uz = _unit_xz(dxv, dzv)
+            s = spec.flock_speed_frac * speed
+            vel = jnp.where(ent["moving"], _vel3(ux * s, uz * s), 0.0)
+            return vel, ent["pos"], jnp.zeros((), bool)
+        return k_flock
+
+    if name == "teleport":
+        def k_teleport(key, ent, _ctx=ctx):
+            _traced("teleport")
+            k_walk, k_p, k_x, k_z = jax.random.split(key, 4)
+            vel = _walk_vel(k_walk, ent, speed, turn_prob)
+            tele = (jax.random.uniform(k_p, ()) < spec.teleport_prob) \
+                & ent["moving"]
+            nx = jax.random.uniform(k_x, (), minval=lo_x, maxval=hi_x)
+            nz = jax.random.uniform(k_z, (), minval=lo_z, maxval=hi_z)
+            dest = jnp.stack([nx, ent["pos"][1], nz])
+            # a teleporting entity keeps no momentum into the new cell
+            vel = jnp.where(tele, 0.0, vel)
+            return vel, dest, tele
+        return k_teleport
+
+    if name == "btree":
+        def k_btree(key, ent, _ctx=ctx):
+            _traced("btree")
+            # the monster tree's mask algebra, one entity at a time
+            # (models/behavior_tree.py monster_tree: chase nearest
+            # player > separate from crowds > wander)
+            def toward(off, sign):
+                ux, uz = _unit_xz(off[0], off[2])
+                return _vel3(sign * speed * ux, sign * speed * uz)
+
+            chase = ent["client_cnt"] > 0
+            crowded = ent["nbr_cnt"] >= 12
+            wander = _walk_vel(key, ent, speed, turn_prob)
+            vel = jnp.where(
+                chase, toward(ent["client_off"], 1.0),
+                jnp.where(crowded, toward(ent["mean_off"], -1.0), wander),
+            )
+            vel = jnp.where(ent["moving"], vel, 0.0)
+            return vel, *_no_teleport(ent["pos"])
+        return k_btree
+
+    if name == "mlp":
+        if policy is None:
+            raise ValueError(
+                "scenario mix includes 'mlp' but no MLPPolicy was "
+                "passed to the tick (spec.needs_policy)"
+            )
+        ex, ez = float(g.extent_x), float(g.extent_z)
+        kk = float(g.k)
+
+        def k_mlp(key, ent, _ctx=ctx):
+            _traced("mlp")
+            # per-entity models/npc_policy.py observation + forward;
+            # vmap batches the matvecs back into the MXU matmuls
+            obs = jnp.concatenate([
+                ent["pos"][:1] / ex,
+                ent["pos"][2:3] / ez,
+                ent["vel"] / 10.0,
+                jnp.sin(ent["yaw"])[None],
+                jnp.cos(ent["yaw"])[None],
+                (ent["nbr_cnt"] / kk)[None],
+                ent["mean_off"][::2] / 100.0,
+            ]).astype(jnp.bfloat16)
+            x = jnp.tanh(obs @ policy.w1 + policy.b1)
+            x = jnp.tanh(x @ policy.w2 + policy.b2)
+            accel = (x @ policy.w3 + policy.b3).astype(jnp.float32)
+            vel = ent["vel"] + accel * dt
+            sp = jnp.sqrt(vel[0] ** 2 + vel[2] ** 2 + 1e-12)
+            vel = vel * jnp.minimum(1.0, speed / sp)
+            vel = jnp.where(ent["moving"], vel, 0.0)
+            return vel, *_no_teleport(ent["pos"])
+        return k_mlp
+
+    raise ValueError(f"no kernel for behavior {name!r}")
+
+
+# ----------------------------------------------------------------------
+# population dispatch
+# ----------------------------------------------------------------------
+
+def _neighbor_features(pos, has_client, nbr, nbr_cnt, want_client: bool):
+    """Mean/nearest-client neighbor offsets from the previous tick's
+    sweep lists — the SAME build the legacy btree path uses
+    (models/behavior_tree.py features_from_neighbors), so btree-as-
+    switch-member can never diverge from btree-as-cfg.behavior. When no
+    mix member reads client features the lanes are zeroed (XLA drops
+    the client gather as dead code)."""
+    from goworld_tpu.models.behavior_tree import features_from_neighbors
+
+    f = features_from_neighbors(pos, has_client, nbr, nbr_cnt)
+    if not want_client:
+        z = jnp.zeros((pos.shape[0],), jnp.float32)
+        return f.mean_off, z, jnp.zeros_like(f.mean_off)
+    return f.mean_off, f.client_cnt.astype(jnp.float32), f.client_off
+
+
+def scenario_velocity(
+    cfg,
+    key: jax.Array,
+    pos: jax.Array,
+    yaw: jax.Array,
+    state,
+    policy,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """The heterogeneous-population step: returns ``(vel f32[N,3],
+    teleport_pos f32[N,3], teleport bool[N])`` for
+    :func:`goworld_tpu.core.step.tick_body`.
+
+    One ``jax.vmap(lax.switch)`` over the per-entity
+    ``state.behavior_id`` lane; member kernels come from
+    :func:`make_kernel` in the spec's mix order."""
+    spec: ScenarioSpec = cfg.scenario
+    if state.behavior_id is None:
+        raise ValueError(
+            "cfg.scenario is set but state.behavior_id is None — build "
+            "the state with create_state(cfg) (or assign_behavior_ids)"
+        )
+    n = pos.shape[0]
+    names = spec.behavior_names
+    ctx = scenario_context(spec, cfg, state.tick)
+
+    want_feats = any(b in ("flock", "btree", "mlp") for b in names)
+    want_client = "btree" in names
+    if want_feats:
+        mean_off, client_cnt, client_off = _neighbor_features(
+            pos, state.has_client, state.nbr, state.nbr_cnt, want_client
+        )
+    else:
+        mean_off = jnp.zeros((n, 3), jnp.float32)
+        client_cnt = jnp.zeros((n,), jnp.float32)
+        client_off = jnp.zeros((n, 3), jnp.float32)
+
+    ent = dict(
+        pos=pos,
+        vel=state.vel,
+        yaw=yaw,
+        moving=state.npc_moving,
+        mean_off=mean_off,
+        nbr_cnt=state.nbr_cnt.astype(jnp.float32),
+        client_cnt=client_cnt,
+        client_off=client_off,
+    )
+    branches = tuple(
+        make_kernel(b, spec, cfg, ctx, policy) for b in names
+    )
+    bid = jnp.clip(state.behavior_id, 0, len(branches) - 1)
+    keys = jax.random.split(key, n)
+
+    if len(branches) == 1:
+        # degenerate mix: skip the switch (identical semantics, and the
+        # homogeneous single-scenario benches pay zero select overhead)
+        vel, tele_pos, tele = jax.vmap(
+            lambda k, e: branches[0](k, e)
+        )(keys, ent)
+    else:
+        vel, tele_pos, tele = jax.vmap(
+            lambda b, k, e: lax.switch(b, branches, k, e)
+        )(bid, keys, ent)
+    alive = state.alive
+    vel = jnp.where(alive[:, None], vel, 0.0)
+    tele = tele & alive & state.npc_moving
+    return vel, tele_pos, tele

@@ -57,6 +57,12 @@ def pytest_configure(config):
         "tier-1 on CPU — the marker exists to select exactly the "
         "kernel-parity set before/after a relay window",
     )
+    config.addinivalue_line(
+        "markers",
+        "scenarios: adversarial-workload suites (tests/test_scenarios"
+        ".py + the scenario-driven AOI regressions); the small-N "
+        "oracle gates run in tier-1, long soaks are also marked slow",
+    )
 
 
 def spawn_on(states, dev, slot, **kw):

@@ -214,6 +214,73 @@ def test_tick_body_integration_bit_parity_and_gauges():
         assert oa[2:] == ob[2:], f"event counts diverged @ tick {t}"
 
 
+@pytest.mark.scenarios
+def test_scenario_teleport_flips_rebuild_cond_on_exact_tick():
+    """ISSUE 7 regression: under the teleport scenario kernel a jump
+    (>> skin/2 by construction: uniform over the world) must flip the
+    in-graph rebuild cond ON THAT TICK — predicted here tick-by-tick by
+    mirroring the cache contract host-side (max Chebyshev displacement
+    since the last rebuild vs skin/2), while the walk drift between
+    jumps stays under skin/2 and correctly does NOT rebuild. Every tick
+    also stays bit-identical to the skinless sweep."""
+    from goworld_tpu.scenarios.spec import ScenarioSpec
+
+    cap, live, ext, skin = 64, 48, 150.0, 8.0
+    spec = ScenarioSpec(name="tp_exact_tick",
+                        mix=(("teleport", 1.0),), teleport_prob=0.06)
+
+    def mk(skin_v):
+        return WorldConfig(
+            capacity=cap,
+            grid=GridSpec(radius=20.0, extent_x=ext, extent_z=ext,
+                          k=16, cell_cap=48, row_block=cap,
+                          verlet_cap=63, skin=skin_v),
+            npc_speed=1.0,       # drift/tick = dt << skin/2
+            scenario=spec,
+        )
+
+    cfg, cfg0 = mk(skin), mk(0.0)
+    st = create_state(cfg, seed=21)
+    st0 = create_state(cfg0, seed=21)
+    rng = np.random.default_rng(21)
+    for s in range(live):
+        p = (rng.random() * ext, 0.0, rng.random() * ext)
+        st = spawn(st, s, pos=p, npc_moving=True)
+        st0 = spawn(st0, s, pos=p, npc_moving=True)
+    tick, tick0 = make_tick(cfg), make_tick(cfg0)
+    ins = TickInputs.empty(cfg)
+
+    ref = None                    # pos snapshot at the last rebuild
+    saw_jump_tick = saw_still_tick = 0
+    for t in range(25):
+        st, out = tick(st, ins, None)
+        st0, _ = tick0(st0, ins, None)
+        pos = np.asarray(st.pos)[:live, ::2]
+        if ref is None:
+            expect = 1            # cold cache: first tick rebuilds
+        else:
+            disp = np.max(np.abs(pos - ref))
+            expect = int(disp > skin / 2.0)
+        assert int(out.aoi_rebuilt) == expect, (
+            f"tick {t}: rebuild={int(out.aoi_rebuilt)} but the "
+            f"displacement bound says {expect}"
+        )
+        if expect:
+            ref = pos
+            if t > 0:
+                saw_jump_tick += 1
+        else:
+            saw_still_tick += 1
+        # the skin is exact through the churn (same rng stream -> the
+        # two configs' populations coincide; teleports don't read nbr)
+        assert np.array_equal(np.asarray(st.nbr), np.asarray(st0.nbr)), t
+        assert np.array_equal(np.asarray(st.nbr_cnt),
+                              np.asarray(st0.nbr_cnt)), t
+    # the run must actually exercise both sides of the cond
+    assert saw_jump_tick >= 3, "no teleport tick ever tripped the cond"
+    assert saw_still_tick >= 3, "reuse never happened (skin too small?)"
+
+
 def test_world_manager_exports_rebuild_gauges():
     """Single-space World with a skin: ticks run through the direct
     (un-vmapped) local step so the rebuild cond stays a real branch,

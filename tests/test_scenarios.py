@@ -1,0 +1,350 @@
+"""Adversarial scenario matrix (goworld_tpu/scenarios, ISSUE 7).
+
+tier-1 gates, one per registry scenario: the full interest-set contract
+(device lists == brute-force per-entity-radius oracle, interested_by
+mirrors it, client mirrors == interest sets) must hold under EVERY
+adversarial workload — hotspot convergence, battle-royale shrink,
+teleport churn (incl. host-side respawn churn through the real World
+API) and mixed-radius populations. Plus the heterogeneous-dispatch
+acceptance criterion: a >= 3-behavior mix compiles to ONE traced tick
+(one vmapped ``lax.switch``; asserted via the TRACE_COUNTS trace
+counters in scenarios/behaviors.py — zero per-behavior retrace across
+ticks), and the spec registry's validation / bench-name resolution.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from goworld_tpu.scenarios.runner import run_scenario
+from goworld_tpu.scenarios.spec import (
+    BEHAVIORS,
+    LEGACY_BEHAVIORS,
+    SCENARIOS,
+    ScenarioSpec,
+    assign_behavior_ids,
+    assign_watch_radii,
+    bench_workloads,
+    get_scenario,
+    resolve_bench_behavior,
+    scenario_names,
+)
+
+pytestmark = pytest.mark.scenarios
+
+_INF = float("inf")
+
+
+# ----------------------------------------------------------------------
+# spec validation (GridSpec.__post_init__ style: loud at construction)
+# ----------------------------------------------------------------------
+
+class TestSpecValidation:
+    def test_unknown_mix_behavior_rejected(self):
+        with pytest.raises(ValueError, match="mix behavior must be"):
+            ScenarioSpec(name="x", mix=(("warp_drive", 1.0),))
+
+    def test_mix_fractions_must_sum_to_one(self):
+        with pytest.raises(ValueError, match="sum to 1"):
+            ScenarioSpec(name="x", mix=(("hotspot", 0.5),
+                                        ("flock", 0.4)))
+
+    def test_zero_fraction_rejected(self):
+        with pytest.raises(ValueError, match="fraction"):
+            ScenarioSpec(name="x", mix=(("hotspot", 0.0),
+                                        ("flock", 1.0)))
+
+    def test_zero_radius_class_rejected(self):
+        # radius 0 would silently exclude the class from AOI
+        with pytest.raises(ValueError, match="radii must be > 0"):
+            ScenarioSpec(name="x", radius_mix=((0.0, 0.5), (_INF, 0.5)))
+
+    def test_radius_fractions_must_sum_to_one(self):
+        with pytest.raises(ValueError, match="sum to 1"):
+            ScenarioSpec(name="x", radius_mix=((10.0, 0.5),))
+
+    def test_churn_rate_bounds(self):
+        with pytest.raises(ValueError, match="churn_rate"):
+            ScenarioSpec(name="x", churn_rate=1.0)
+
+    def test_teleport_prob_bounds(self):
+        with pytest.raises(ValueError, match="teleport_prob"):
+            ScenarioSpec(name="x", teleport_prob=1.5)
+
+    def test_phase_periods_positive(self):
+        with pytest.raises(ValueError, match="shrink_over"):
+            ScenarioSpec(name="x", shrink_over=0)
+
+    def test_unknown_scenario_lists_registry(self):
+        with pytest.raises(KeyError, match="hotspot"):
+            get_scenario("nope")
+
+    def test_registry_covers_roadmap_worst_cases(self):
+        names = scenario_names()
+        for nm in ("hotspot", "shrink", "flock", "teleport",
+                   "mixed_radius", "mixed"):
+            assert nm in names
+        # the acceptance spec: >= 3 behaviors in ONE world
+        assert len(get_scenario("mixed").behavior_names) >= 3
+
+
+# ----------------------------------------------------------------------
+# bench workload resolution (the BENCH_BEHAVIOR satellite: ONE home for
+# the accepted set and its error message)
+# ----------------------------------------------------------------------
+
+class TestBenchResolution:
+    def test_legacy_behaviors_resolve_homogeneous(self):
+        for b in LEGACY_BEHAVIORS:
+            assert resolve_bench_behavior(b) == (b, None)
+
+    def test_scenario_names_resolve_to_specs(self):
+        for nm in scenario_names():
+            behavior, spec = resolve_bench_behavior(nm)
+            assert behavior == "random_walk"
+            assert spec is SCENARIOS[nm]
+
+    def test_unknown_name_error_names_both_sets(self):
+        with pytest.raises(ValueError) as exc:
+            resolve_bench_behavior("warp")
+        msg = str(exc.value)
+        for nm in bench_workloads():
+            assert nm in msg
+
+    def test_bench_workloads_is_union(self):
+        assert bench_workloads() == LEGACY_BEHAVIORS + scenario_names()
+
+
+# ----------------------------------------------------------------------
+# deterministic population assignment
+# ----------------------------------------------------------------------
+
+class TestAssignment:
+    def test_behavior_ids_exact_proportions(self):
+        spec = get_scenario("mixed")
+        ids = assign_behavior_ids(spec, 100)
+        counts = np.bincount(ids, minlength=len(spec.mix))
+        for i, (_, f) in enumerate(spec.mix):
+            assert abs(int(counts[i]) - f * 100) <= 1
+        assert counts.sum() == 100
+
+    def test_behavior_ids_deterministic_and_shuffled(self):
+        spec = get_scenario("mixed")
+        a = assign_behavior_ids(spec, 64)
+        b = assign_behavior_ids(spec, 64)
+        assert np.array_equal(a, b)
+        # slot order must not correlate with behavior: not sorted
+        assert not np.array_equal(a, np.sort(a))
+
+    def test_single_member_mix_fills_every_slot(self):
+        spec = get_scenario("hotspot")
+        assert np.all(assign_behavior_ids(spec, 17) == 0)
+
+    def test_watch_radii_match_mix(self):
+        spec = get_scenario("mixed_radius")
+        radii = assign_watch_radii(spec, 50)
+        vals, counts = np.unique(radii, return_counts=True)
+        want = {r: f for r, f in spec.radius_mix}
+        assert set(vals) == set(want)
+        for v, c in zip(vals, counts):
+            assert abs(int(c) - want[float(v)] * 50) <= 1
+
+
+# ----------------------------------------------------------------------
+# the tier-1 oracle gates: EVERY registry scenario, full contract
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("name", scenario_names())
+def test_registry_scenario_oracle_exact(name):
+    """Interest sets == brute-force oracle, interested_by mirrors,
+    client mirrors == interest sets — checked repeatedly while the
+    adversarial motion (and, for teleport, respawn churn) runs through
+    the real World API."""
+    rep = run_scenario(name, n=40, ticks=6, oracle_every=3,
+                       client_frac=0.25, seed=3,
+                       # 2 units/tick: enough motion that interest
+                       # actually churns inside 6 ticks (default-speed
+                       # drift is ~0.08/tick — a near-static gate)
+                       cfg_kw=dict(npc_speed=120.0),
+                       raise_on_mismatch=True)
+    assert rep.oracle_ok
+    assert rep.oracle_ticks_checked == 2
+
+
+def test_teleport_churn_exercises_slot_reuse():
+    """Respawn churn high enough to actually recycle slots at small N:
+    every freed slot is re-spawned same-tick (the one-tick quarantine
+    path) and the contract still holds on every checked tick."""
+    spec = dataclasses.replace(get_scenario("teleport"),
+                               churn_rate=0.15)
+    rep = run_scenario(spec, n=40, ticks=8, oracle_every=2,
+                       client_frac=0.2, seed=5,
+                       raise_on_mismatch=True)
+    assert rep.churned >= 6 * 7  # 6 per tick from tick 1
+    assert rep.oracle_ok
+
+
+def test_skin_cadence_flock_reuses_teleport_thrashes():
+    """The workload-vs-kernel interplay the subsystem exists to expose:
+    under one skin setting, flock (slow correlated motion) almost never
+    rebuilds while teleport rebuilds nearly every tick — both exact."""
+    flock = run_scenario("flock", n=48, ticks=10, oracle_every=5,
+                         skin=6.0, client_frac=0.0, seed=7,
+                         raise_on_mismatch=True)
+    # at small N the registry's 1% churn leaves whole ticks teleport-
+    # free; 20% makes >= 1 jump per tick near-certain (and the jump is
+    # world-scale, >> skin/2 by construction)
+    tspec = dataclasses.replace(get_scenario("teleport"),
+                                teleport_prob=0.2, churn_rate=0.0)
+    tele = run_scenario(tspec, n=48, ticks=10, oracle_every=5,
+                        skin=6.0, client_frac=0.0, seed=7,
+                        raise_on_mismatch=True)
+    assert flock.rebuilds <= 3          # cold build + stragglers
+    assert tele.rebuilds >= 8           # ~every tick trips the cond
+    assert flock.oracle_ok and tele.oracle_ok
+
+
+def test_shrink_migration_pressure_rises():
+    """The battle-royale phase schedule produces sustained interest
+    migration: enter events keep arriving well after the start (the
+    zone keeps forcing movement), and the density (AOI demand) grows
+    as the zone contracts."""
+    spec = dataclasses.replace(get_scenario("shrink"), shrink_over=30)
+    # npc_speed 180 -> 3 units/tick at 60 Hz: the default 5 moves
+    # ~0.08/tick, which would leave every enter event on tick 1 and
+    # make both assertions vacuously compare identical runs
+    kw = dict(cfg_kw=dict(npc_speed=180.0))
+    early = run_scenario(spec, n=48, ticks=4, oracle_every=0,
+                         client_frac=0.0, seed=11, **kw)
+    late = run_scenario(spec, n=48, ticks=28, oracle_every=0,
+                        client_frac=0.0, seed=11, **kw)
+    assert late.demand_max > early.demand_max
+    assert late.enter_events > early.enter_events
+
+
+# ----------------------------------------------------------------------
+# heterogeneous dispatch: ONE traced tick, no per-behavior retrace
+# ----------------------------------------------------------------------
+
+def _scenario_cfg(spec, n=96, skin=0.0):
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.ops.aoi import GridSpec
+
+    return WorldConfig(
+        capacity=n,
+        grid=GridSpec(radius=20.0, extent_x=150.0, extent_z=150.0,
+                      k=16, cell_cap=32, row_block=n, skin=skin),
+        npc_speed=5.0,
+        scenario=spec,
+    )
+
+
+def test_mixed_population_single_trace_no_retrace():
+    """The ISSUE 7 acceptance criterion, asserted via trace counting:
+    a >= 3-behavior world compiles each member kernel in ONE trace of
+    the tick, and ticking N more times re-traces NOTHING."""
+    import jax
+
+    from goworld_tpu.core.state import create_state, spawn
+    from goworld_tpu.core.step import TickInputs, make_tick
+    from goworld_tpu.scenarios import behaviors as B
+
+    spec = get_scenario("mixed")
+    assert len(spec.behavior_names) >= 3
+    cfg = _scenario_cfg(spec)
+    st = create_state(cfg, seed=1)
+    rng = np.random.default_rng(1)
+    for s in range(64):
+        st = spawn(st, s, pos=(rng.random() * 150, 0.0,
+                               rng.random() * 150),
+                   npc_moving=True)
+    tick = make_tick(cfg)
+    ins = TickInputs.empty(cfg)
+
+    before = dict(B.TRACE_COUNTS)
+    st, out = tick(st, ins, None)         # the one compile
+    jax.block_until_ready(st.pos)
+    after_compile = dict(B.TRACE_COUNTS)
+    deltas = {
+        name: after_compile.get(name, 0) - before.get(name, 0)
+        for name in spec.behavior_names
+    }
+    # every mix member traced, all as part of the SAME switch trace
+    assert all(d >= 1 for d in deltas.values()), deltas
+    assert len(set(deltas.values())) == 1, deltas
+
+    for _ in range(5):                    # steady state: zero retrace
+        st, out = tick(st, ins, None)
+    jax.block_until_ready(st.pos)
+    assert dict(B.TRACE_COUNTS) == after_compile, \
+        "per-behavior retrace detected"
+
+
+def test_mixed_legacy_members_need_and_get_policy():
+    """random_walk/mlp/btree as switch members of one population: the
+    World auto-builds the MLP policy when the mix demands it and the
+    oracle contract holds for the heterogeneous world."""
+    spec = ScenarioSpec(
+        name="legacy_mix_test",
+        mix=(("random_walk", 0.34), ("mlp", 0.33), ("btree", 0.33)),
+    )
+    assert spec.needs_policy
+    rep = run_scenario(spec, n=36, ticks=6, oracle_every=3,
+                       client_frac=0.2, seed=13,
+                       raise_on_mismatch=True)
+    assert rep.oracle_ok
+
+
+def test_scenario_velocity_requires_behavior_lane():
+    """A scenario config with a lane-less state fails loudly (not with
+    a shape error three layers deep)."""
+    import jax
+
+    from goworld_tpu.core.state import create_state
+    from goworld_tpu.scenarios.behaviors import scenario_velocity
+
+    cfg = _scenario_cfg(get_scenario("hotspot"), n=16)
+    st = create_state(cfg, seed=0).replace(behavior_id=None)
+    with pytest.raises(ValueError, match="behavior_id"):
+        scenario_velocity(cfg, jax.random.PRNGKey(0), st.pos, st.yaw,
+                          st, None)
+
+    # and an mlp mix without a policy names the real problem
+    mspec = ScenarioSpec(name="mlp_only_test", mix=(("mlp", 1.0),))
+    mcfg = _scenario_cfg(mspec, n=16)
+    mst = create_state(mcfg, seed=0)
+    with pytest.raises(ValueError, match="MLPPolicy"):
+        scenario_velocity(mcfg, jax.random.PRNGKey(0), mst.pos,
+                          mst.yaw, mst, None)
+
+
+# ----------------------------------------------------------------------
+# phase schedule: closed-form in the traced tick counter
+# ----------------------------------------------------------------------
+
+def test_scenario_context_schedule():
+    import jax.numpy as jnp
+
+    from goworld_tpu.scenarios.behaviors import scenario_context
+
+    spec = dataclasses.replace(get_scenario("shrink"), shrink_over=100)
+    cfg = _scenario_cfg(spec, n=16)
+    half = 0.5 * min(cfg.grid.extent_x, cfg.grid.extent_z)
+    c0 = scenario_context(spec, cfg, jnp.asarray(0, jnp.int32))
+    cmid = scenario_context(spec, cfg, jnp.asarray(50, jnp.int32))
+    cend = scenario_context(spec, cfg, jnp.asarray(100, jnp.int32))
+    cpast = scenario_context(spec, cfg, jnp.asarray(500, jnp.int32))
+    assert float(c0["zone_r"]) == pytest.approx(half)
+    assert float(c0["zone_r"]) > float(cmid["zone_r"]) \
+        > float(cend["zone_r"])
+    # shrink holds at the floor, never collapses to 0
+    assert float(cend["zone_r"]) == pytest.approx(
+        half * spec.shrink_min_frac)
+    assert float(cpast["zone_r"]) == float(cend["zone_r"])
+    # the hotspot attractor stays strictly inside the world
+    for t in (0, 450, 900, 1350):
+        c = scenario_context(spec, cfg, jnp.asarray(t, jnp.int32))
+        ax, az = (float(c["attractor"][0]), float(c["attractor"][1]))
+        assert 0.0 < ax < cfg.grid.extent_x
+        assert 0.0 < az < cfg.grid.extent_z

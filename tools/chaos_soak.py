@@ -38,6 +38,8 @@ Usage::
         --deposits 25 --out chaos_report.json
     python tools/chaos_soak.py --scenario overload --dir /tmp/ov \
         --seed 77 --flood-secs 6 --msg-rate 120 --out ov_report.json
+    python tools/chaos_soak.py --dir /tmp/chaos --seed 77 \
+        --workload teleport   # faults under adversarial NPC motion
 """
 
 from __future__ import annotations
@@ -110,22 +112,33 @@ def _free_port() -> int:
 
 
 def build_server_dir(path: str,
-                     overload_knobs: bool = False) -> tuple[str, int, int]:
+                     overload_knobs: bool = False,
+                     workload: str = "") -> tuple[str, int, int]:
     os.makedirs(path, exist_ok=True)
     with open(os.path.join(path, "server.py"), "w") as f:
         f.write(SERVER_PY)
     dport, gport, hport = _free_port(), _free_port(), _free_port()
     ghport = _free_port()  # game debug-http (/overload scrapes)
     extra = ""
+    if workload:
+        # --workload <scenario>: the game tick runs the adversarial
+        # behavior mix (goworld_tpu/scenarios registry) instead of the
+        # homogeneous random_walk, so faults/overload land under
+        # adversarial motion (ISSUE 7). Validated jax-free up front —
+        # a typo must not surface as a mid-soak game crash.
+        from goworld_tpu.scenarios.spec import get_scenario
+
+        get_scenario(workload)  # KeyError lists the registry
+        extra += f"scenario = {workload}\n"
     if overload_knobs:
         # aggressive ladder so a short flood engages it, a fast
         # descent so the report's recovery wait stays bounded, and a
         # 10 Hz tick budget a loaded CI box can actually hold when
         # idle (the governor judges wall time against 1/tick_hz — on a
         # budget the host can never meet, NORMAL is unreachable)
-        extra = ("tick_hz = 10\n"
-                 "overload_up_ticks = 3\noverload_down_ticks = 30\n"
-                 "degraded_sync_stride = 2\n")
+        extra += ("tick_hz = 10\n"
+                  "overload_up_ticks = 3\noverload_down_ticks = 30\n"
+                  "degraded_sync_stride = 2\n")
     with open(os.path.join(path, "goworld_tpu.ini"), "w") as f:
         f.write(
             f"[dispatcher1]\nhost = 127.0.0.1\nport = {dport}\n"
@@ -456,16 +469,23 @@ def main() -> int:
                     help="overload scenario: bot flood duration")
     ap.add_argument("--msg-rate", type=float, default=120.0,
                     help="overload scenario: flood messages per second")
+    ap.add_argument("--workload", default="",
+                    help="adversarial NPC workload for the game under "
+                         "test (goworld_tpu/scenarios registry name, "
+                         "e.g. hotspot|teleport|mixed); default: the "
+                         "homogeneous random_walk")
     ap.add_argument("--out", default="chaos_report.json")
     args = ap.parse_args()
     server_dir, _, _ = build_server_dir(
-        args.dir, overload_knobs=args.scenario == "overload")
+        args.dir, overload_knobs=args.scenario == "overload",
+        workload=args.workload)
     if args.scenario == "overload":
         report = run_overload(server_dir, args.seed, args.flood_secs,
                               args.msg_rate)
     else:
         report = run_soak(server_dir, args.seed, args.deposits,
                           kill_tick=args.kill_tick)
+    report["workload"] = args.workload or "random_walk"
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print(json.dumps(report, indent=2))

@@ -41,16 +41,36 @@ K = 32
 CC = 12
 extent = float(int((N * 10000 / 12) ** 0.5))
 
+# --workload <scenario>: every experiment measures on the ADVERSARIAL
+# layout that scenario converges to (hotspot blob, shrink ring, ...)
+# instead of the uniform start — the ISSUE-7 passthrough; scenario
+# registry names (goworld_tpu/scenarios/spec.py). Env PROBE_WORKLOAD
+# works too (the relay driver is env-oriented).
+WORKLOAD = os.environ.get("PROBE_WORKLOAD", "")
+if "--workload" in sys.argv:
+    WORKLOAD = sys.argv[sys.argv.index("--workload") + 1]
+
+
+def _layout(workload: str, n: int, ext: float, seed: int = 0):
+    from goworld_tpu.scenarios.runner import scenario_layout
+
+    return jnp.asarray(scenario_layout(workload, n, ext, seed=seed))
+
+
 key = jax.random.PRNGKey(0)
 k1, k2, k3 = jax.random.split(key, 3)
-pos = jnp.stack([
-    jax.random.uniform(k1, (N,), maxval=extent),
-    jnp.zeros(N),
-    jax.random.uniform(k2, (N,), maxval=extent)], axis=1)
+if WORKLOAD:
+    pos = _layout(WORKLOAD, N, extent)   # KeyError lists the registry
+else:
+    pos = jnp.stack([
+        jax.random.uniform(k1, (N,), maxval=extent),
+        jnp.zeros(N),
+        jax.random.uniform(k2, (N,), maxval=extent)], axis=1)
 alive = jnp.ones(N, bool)
 flags = (jax.random.uniform(k3, (N,)) < 0.5).astype(jnp.int32)
 
-print(f"device={jax.devices()[0]} N={N}", flush=True)
+print(f"device={jax.devices()[0]} N={N} "
+      f"workload={WORKLOAD or 'uniform'}", flush=True)
 
 
 def timeit(name, mk, arg=None):
@@ -110,6 +130,22 @@ for impl, topk in (("ranges", "sort"), ("table", "sort"),
                    # the key encoding it packs)
                    ("fused", "sort"), ("fused", "f32")):
     timeit(f"sweep {impl}/{topk}", mk_full(impl, topk))
+
+# ---- 1a. hotspot row at the matrix shape (ISSUE 7) ------------------
+# The matrix above measures the uniform density; this row times the
+# production sweep on the hotspot-CONVERGED blob (max cap overflow,
+# every row truncating) so the relay answers "fast under the named
+# worst case" too, not just at one workload point. Skipped when
+# --workload already made the whole matrix adversarial.
+if WORKLOAD not in ("", "hotspot"):
+    print("sweep @hotspot                     SKIP "
+          f"(--workload {WORKLOAD} owns the layout)", flush=True)
+elif not WORKLOAD:
+    hot_pos = _layout("hotspot", N, extent, seed=2)
+    for impl, topk in (("table", "sort"), ("ranges", "sort"),
+                       ("fused", "sort")):
+        timeit(f"sweep {impl}/{topk} @hotspot", mk_full(impl, topk),
+               arg=hot_pos)
 
 # ---- 1b. Verlet skin + front-half sort impls ------------------------
 
@@ -181,10 +217,13 @@ N2 = int(os.environ.get("PROBE_N2", 1048576 if N <= 262144 else 131072))
 if on_tpu():
     extent2 = float(int((N2 * 10000 / 12) ** 0.5))
     kk1, kk2, kk3 = jax.random.split(jax.random.PRNGKey(1), 3)
-    pos2 = jnp.stack([
-        jax.random.uniform(kk1, (N2,), maxval=extent2),
-        jnp.zeros(N2),
-        jax.random.uniform(kk2, (N2,), maxval=extent2)], axis=1)
+    if WORKLOAD:
+        pos2 = _layout(WORKLOAD, N2, extent2, seed=1)
+    else:
+        pos2 = jnp.stack([
+            jax.random.uniform(kk1, (N2,), maxval=extent2),
+            jnp.zeros(N2),
+            jax.random.uniform(kk2, (N2,), maxval=extent2)], axis=1)
     alive2_ab = jnp.ones(N2, bool)
     flags2 = (jax.random.uniform(kk3, (N2,)) < 0.5).astype(jnp.int32)
 
