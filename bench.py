@@ -43,6 +43,14 @@ BENCH_TPU_ATTEMPTS (default 2), BENCH_CHILD_TIMEOUT seconds (default
 1200), BENCH_N_CPU (default 131072) for the CPU fallback,
 BENCH_BACKHALF_AB=0 to skip the fused-vs-split back-half A/B record
 (BENCH_BACKHALF_AB_N shapes it; default the 131K per-chip shard).
+
+Device-plane observability (ISSUE 8): BENCH_DEVPROF=0 skips the
+compiled-tick CostReport + roofline_audit stamps (XLA cost_analysis vs
+the docs/ROOFLINE.md hand model, per phase); BENCH_SLO=0 skips the
+in-graph telemetry scan + slo stamp; BENCH_SLO_MS (default 16.0, the
+paper's p99 target) sets the budget; BENCH_SLO_TICKS (default 64) the
+histogram scan length. `--check-slo` turns the stamped verdict into
+the exit code.
 """
 
 import argparse
@@ -777,6 +785,12 @@ def measure(n: int, ticks: int, client_frac: float, phases: bool,
         return float(_np.asarray(x))
 
     t0 = time.perf_counter()
+    # AOT lower+compile: the SAME executable serves the timed calls
+    # below AND the devprof cost audit (cost_analysis needs the
+    # compiled artifact; going through .lower here means the audit
+    # costs zero extra compiles)
+    run_compiled = run.lower(variant(0)).compile()
+    run = lambda s: run_compiled(s)  # noqa: E731
     force(run(variant(0)))
     compile_s = time.perf_counter() - t0
     log(f"n={n}: compile+warmup {compile_s:.1f}s")
@@ -848,13 +862,153 @@ def measure(n: int, ticks: int, client_frac: float, phases: bool,
             f"2x-tick scan took {scale:.2f}x the 1x time; "
             "per-tick figure may not reflect real execution"
         )
+    phase_costs: dict = {}
     if phases:
-        result["phase_ms"] = measure_phases(cfg, st, inputs, ticks)
+        result["phase_ms"], phase_costs = measure_phases(
+            cfg, st, inputs, ticks)
+    # Device-plane stamps (ISSUE 8). EVERY path stamps each block —
+    # real, {"error": ...} (an exception must never cost a headline;
+    # each stamp records its OWN failure so a cost_report error is
+    # never misfiled under roofline_audit) or {"skipped": ...} (the
+    # documented BENCH_DEVPROF=0/BENCH_SLO=0/phases-off knobs) — so a
+    # deliberately-thinner run still produces a schema-valid artifact
+    # (tools/bench_schema.py accepts error/skipped records).
+    if os.environ.get("BENCH_DEVPROF", "1") == "1":
+        try:
+            from goworld_tpu.utils import devprof
+
+            result["cost_report"] = devprof.cost_report(
+                run_compiled, name="tick_scan",
+                config=devprof.grid_config_key(cfg.grid), n=n,
+            ).as_dict()
+        except Exception as exc:
+            result["cost_report"] = {"error": str(exc)[:200]}
+        if phases:
+            try:
+                from goworld_tpu.utils import devprof
+
+                result["roofline_audit"] = devprof.roofline_audit(
+                    result["phase_ms"], phase_costs, n,
+                    _model_grid_kw(cfg, n),
+                    platform=result["platform"],
+                )
+            except Exception as exc:
+                result["roofline_audit"] = {"error": str(exc)[:200]}
+        else:
+            result["roofline_audit"] = {
+                "skipped": "phases disabled (BENCH_PHASES=0 or "
+                           "smoke stage)"}
+    else:
+        result["cost_report"] = {"skipped": "BENCH_DEVPROF=0"}
+        result["roofline_audit"] = {"skipped": "BENCH_DEVPROF=0"}
+    if phases and os.environ.get("BENCH_SLO", "1") == "1":
+        # in-graph telemetry lanes + the SLO verdict (ISSUE 8): one
+        # extra on-device scan, zero per-tick host syncs, drained once
+        try:
+            result["op_stats"], result["slo"] = measure_telemetry(
+                cfg, variant(6), inputs, policy,
+                int(os.environ.get("BENCH_SLO_TICKS", 64)),
+                result["tick_ms"], result.get("phase_ms") or {},
+            )
+        except Exception as exc:
+            result["slo"] = {"error": str(exc)[:200]}
+            result["op_stats"] = {"error": str(exc)[:200]}
+    else:
+        why = ("BENCH_SLO=0" if phases
+               else "phases disabled (BENCH_PHASES=0 or smoke stage)")
+        result["slo"] = {"skipped": why}
+        result["op_stats"] = {"skipped": why}
     # hand the caller what it needs to run the p99 pass AFTER the
     # headline line is safely on stdout (a hang mid-p99 must not discard
     # the already-measured result)
     result["_p99_args"] = (cfg, variant(4), inputs, policy)
     return result
+
+
+def _skin_effective(grid, n: int) -> bool:
+    """Whether the Verlet skin is LIVE at this shape: configured on AND
+    inside the packed-id bound (past it the tick statically falls back
+    to the stateless sweep — api.py/tick_body mirror this predicate).
+    The one helper for the device-plane stamp sites, so the roofline
+    model, the slo constants and the headline skin stamp can never
+    describe different kernels for the same run."""
+    return grid.skin > 0 and n < (1 << _AOI_ID_BITS)
+
+
+def _model_grid_kw(cfg, n: int) -> dict:
+    """The grid-knob dict the roofline hand model prices (devprof.
+    roofline_model_bytes), with skin stamped EFFECTIVE like the
+    headline stamps."""
+    g = cfg.grid
+    skin_on = _skin_effective(g, n)
+    return {
+        "radius": g.radius, "extent_x": g.extent_x,
+        "extent_z": g.extent_z, "k": g.k, "cell_cap": g.cell_cap,
+        "sort_impl": g.sort_impl, "sweep_impl": g.sweep_impl,
+        "skin": g.skin if skin_on else 0.0,
+        "verlet_cap": g.verlet_cap_eff if skin_on else 0,
+    }
+
+
+def measure_telemetry(cfg, st, inputs, policy, ticks: int,
+                      tick_ms: float, phase_ms: dict) -> tuple[dict, dict]:
+    """The in-graph telemetry scan (ops/telemetry.py): fixed-bucket
+    histograms of per-tick signals accumulated ON DEVICE through one
+    ``lax.scan`` — zero host syncs per tick, one drain at the end —
+    plus the SLO verdict evaluated from the tick_ms lane.
+
+    The tick_ms lane's per-tick latency model: ``base + rebuilt_i *
+    delta`` with host-measured constants (the scan-marginal tick and
+    the aoi_rebuild/aoi_reuse phase probes) selected per tick by the
+    in-graph Verlet rebuild bit; with no skin the lane is the constant
+    scan-marginal tick. The model constants are stamped into the slo
+    block so the figure is never mistaken for per-tick wall clock."""
+    import jax
+    from jax import lax
+
+    from goworld_tpu.core.step import tick_body
+    from goworld_tpu.ops import telemetry
+    from goworld_tpu.utils import devprof
+
+    n = cfg.capacity
+    skin_on = (_skin_effective(cfg.grid, n)
+               and getattr(st, "aoi_cache", None) is not None)
+    base_ms, delta_ms = tick_ms, 0.0
+    if skin_on and {"aoi", "aoi_rebuild", "aoi_reuse"} <= set(phase_ms):
+        delta_ms = max(phase_ms["aoi_rebuild"] - phase_ms["aoi_reuse"],
+                       0.0)
+        base_ms = max(tick_ms - phase_ms["aoi"], 0.0) \
+            + phase_ms["aoi_reuse"]
+    half_skin = cfg.grid.skin / 2.0 if skin_on else 0.0
+
+    @jax.jit
+    def run(state):
+        acc0 = telemetry.telemetry_init(skin_on)
+
+        def body(carry, _):
+            s, acc = carry
+            s2, out = tick_body(cfg, s, inputs, policy)
+            acc = telemetry.telemetry_update(acc, out, base_ms,
+                                             delta_ms, half_skin)
+            return (s2, acc), 0
+        (_s2, acc), _ = lax.scan(body, (state, acc0), None,
+                                 length=ticks)
+        return acc
+
+    op_stats = telemetry.telemetry_drain(run(st), skin_on, half_skin)
+    target = float(os.environ.get("BENCH_SLO_MS",
+                                  devprof.DEFAULT_SLO_TARGET_MS))
+    lane = op_stats["tick_ms"]
+    slo = devprof.slo_from_histogram(lane["edges"], lane["counts"],
+                                     target,
+                                     source="in-graph-histogram")
+    slo["model"] = {"base_ms": round(base_ms, 3),
+                    "rebuild_delta_ms": round(delta_ms, 3)}
+    devprof.record_slo(slo)
+    log(f"slo@{n}: p50={slo['p50_ms']} p90={slo['p90_ms']} "
+        f"p99={slo['p99_ms']} target={target} "
+        f"-> {'PASS' if slo['pass'] else 'FAIL'}")
+    return op_stats, slo
 
 
 def measure_p99(cfg, st, inputs, policy, samples: int | None = None) -> dict:
@@ -910,12 +1064,14 @@ def measure_p99(cfg, st, inputs, policy, samples: int | None = None) -> dict:
     }
 
 
-def measure_phases(cfg, st, inputs, ticks: int) -> dict:
+def measure_phases(cfg, st, inputs, ticks: int) -> tuple[dict, dict]:
     """Per-phase timings via separately-jitted partial ticks: aoi (grid
     sweep only), move (inputs+behavior+integrate), collect (changed-row
     interest pairs + sync + attr extraction, AOI held fixed). Sum != whole
     tick (XLA fuses across phases in the real program); it localizes where
-    the time goes. Each phase reduces to ONE scalar which is fetched with
+    the time goes. Returns ``(phase_ms, phase_cost_reports)`` — the
+    second dict maps phase name -> devprof CostReport of the SAME
+    AOT-compiled probe (empty with BENCH_DEVPROF=0). Each phase reduces to ONE scalar which is fetched with
     np.asarray — block_until_ready returns early on the tunneled backend
     (see measure_p99) and a lazily-left-on-device result would time as
     ~0 ms."""
@@ -1095,14 +1251,28 @@ def measure_phases(cfg, st, inputs, ticks: int) -> dict:
         ("move", move_only, (st,)),
         ("collect", collect_only, (st, nbr, fl)),
     ]
+    devprof_on = os.environ.get("BENCH_DEVPROF", "1") == "1"
+    costs: dict = {}
     for name, fn, args in phase_list:
-        float(np.asarray(fn(*args)))  # compile + force
+        # AOT-compile so the SAME executable is timed and cost-audited
+        # (XLA counts a while-loop body ONCE, so a scan probe's
+        # cost_analysis is per-tick already)
+        try:
+            fnc = fn.lower(*args).compile()
+        except Exception:
+            fnc = fn  # fall back to the plain jit path
+        float(np.asarray(fnc(*args)))  # compile + force
         t0 = time.perf_counter()
-        r = float(np.asarray(fn(*args)))
+        r = float(np.asarray(fnc(*args)))
         dt = time.perf_counter() - t0
         out[name] = round(1000.0 * dt / ticks, 3)
+        if devprof_on and hasattr(fnc, "cost_analysis"):
+            from goworld_tpu.utils import devprof
+
+            costs[name] = devprof.cost_report(
+                fnc, name=f"phase:{name}", n=cfg.capacity)
         log(f"phase {name}: {out[name]} ms/tick")
-    return out
+    return out, costs
 
 
 def child_main(args) -> int:
@@ -1493,12 +1663,15 @@ def parent_main() -> int:
         return result
 
     emitted = []
+    composed_final: dict = {}
 
     def emit_once() -> None:
         if emitted:
             return
         emitted.append(True)
-        print(json.dumps(compose()), flush=True)
+        result = compose()
+        composed_final.update(result)
+        print(json.dumps(result), flush=True)
 
     def on_term(signum, frame):
         log(f"signal {signum}: emitting best-so-far result before exit")
@@ -1674,7 +1847,29 @@ def parent_main() -> int:
                     }
 
     emit_once()
-    return 0 if (best or suspect_best or partial) is not None else 1
+    if (best or suspect_best or partial) is None:
+        return 1
+    if os.environ.get("BENCH_CHECK_SLO") == "1":
+        # --check-slo: the stamped verdict becomes a GATE — rc != 0
+        # when the measured p99 misses the budget (CI/relay usage; the
+        # default invocation only stamps, the driver contract's rc
+        # semantics stay untouched)
+        slo = composed_final.get("slo")
+        if not isinstance(slo, dict) or "skipped" in slo \
+                or "error" in slo:
+            # the gate is UNSATISFIABLE, not failed: no verdict was
+            # measured (BENCH_PHASES=0 / BENCH_SLO=0 skip the
+            # telemetry scan, or it errored) — still rc != 0, but say
+            # why instead of an opaque FAIL
+            log(f"--check-slo: no slo verdict measured ({slo}); "
+                "BENCH_PHASES=0/BENCH_SLO=0 skip the telemetry scan, "
+                "and only a full-stage headline carries one")
+            return 4
+        if not slo.get("pass"):
+            log(f"--check-slo: FAIL ({slo})")
+            return 4
+        log("--check-slo: PASS")
+    return 0
 
 
 def selftest_main() -> int:
@@ -1777,6 +1972,40 @@ def selftest_main() -> int:
             phase_keys += ["aoi_rebuild", "aoi_reuse"]
         for k in phase_keys:
             check(f"full.phase.{k}", k in pm, f"phase_ms={pm}")
+        # device-plane stamps (ISSUE 8): the SLO verdict from the
+        # in-graph histogram scan, the telemetry lanes it drains, the
+        # compiled-tick CostReport and the machine-checked roofline
+        # audit — gated like the kernel stamps so a malformed device
+        # plane can never ship silently
+        if os.environ.get("BENCH_SLO", "1") == "1":
+            slo = art.get("slo", {})
+            check("full.slo", isinstance(slo, dict)
+                  and {"target_ms", "p50_ms", "p99_ms", "pass"}
+                  <= set(slo), str(slo)[:160])
+            ost = art.get("op_stats", {})
+            lanes = ["tick_ms", "sync_n", "enter_n", "leave_n",
+                     "rebuilt", "over_k_rows", "over_cap_cells"]
+            if art.get("skin", 0) > 0:
+                lanes.append("skin_slack")
+            for lane in lanes:
+                check(f"full.op_stats.{lane}", lane in ost
+                      and "counts" in ost.get(lane, {}),
+                      f"op_stats lanes={sorted(ost)[:10]}")
+        if os.environ.get("BENCH_DEVPROF", "1") == "1":
+            cr = art.get("cost_report", {})
+            check("full.cost_report", isinstance(cr, dict)
+                  and "error" not in cr
+                  and ("bytes_accessed" in cr or "flops" in cr),
+                  str(cr)[:160])
+            ra = art.get("roofline_audit", {})
+            check("full.roofline_audit", isinstance(ra, dict)
+                  and "phases" in ra, str(ra)[:160])
+            if "phases" in ra:
+                for ph in ("aoi", "move", "collect"):
+                    check(f"full.roofline_audit.{ph}",
+                          ph in ra["phases"]
+                          and "model_mb" in ra["phases"][ph],
+                          str(ra["phases"].get(ph))[:120])
         if os.environ.get("BENCH_BACKHALF_AB", "1") == "1":
             # on the selftest shape the A/B must actually land (an
             # {"error": ...} record here IS harness rot); skipped when
@@ -1872,11 +2101,21 @@ def main() -> int:
     ap.add_argument("--client-frac", type=float, default=CLIENT_FRAC)
     ap.add_argument("--phases", action="store_true", default=PHASES)
     ap.add_argument(
+        "--check-slo", action="store_true",
+        help="gate the exit code on the stamped slo verdict (the "
+             "in-graph tick_ms histogram vs BENCH_SLO_MS, default "
+             "16 ms p99 — the paper target)")
+    ap.add_argument(
         "--scenario", default=None, metavar="NAME|all|none",
         help="per-scenario headline blocks to stamp (scenario registry "
              f"names: {'|'.join(scenario_names())}; comma list, 'all' "
              "(the default via BENCH_SCENARIOS), or 'none')")
     args = ap.parse_args()
+    if args.check_slo:
+        # children + parent share the knob through the env (like
+        # --scenario); the gate itself is applied in parent_main after
+        # the artifact is safely on stdout
+        os.environ["BENCH_CHECK_SLO"] = "1"
     if args.scenario is not None:
         # children inherit the selection through the env (one knob for
         # both the CLI and env-driven invocations)

@@ -819,6 +819,17 @@ def cmd_status(server_dir: str) -> int:
             if results:
                 print()
                 print(scraper.merged_table(results))
+            # device-plane SLO verdicts (debug_http /costs, utils/
+            # devprof): one pass/fail line per process against its
+            # tick budget, next to the raw series above. Only reach
+            # targets the metric scrape answered — a dead process
+            # would stall a second timeout here.
+            costs = scraper.scrape_costs(
+                [t for t in targets if t[0] in results])
+            if costs:
+                print()
+                for line in scraper.slo_lines(costs):
+                    print(line)
             for e in errors:
                 print(f"metrics: {e}", file=sys.stderr)
     return 0 if all_up else 1

@@ -243,6 +243,29 @@ class World:
             else:
                 self._step = _make_local_tick(cfg, n_spaces)
 
+        # device-plane cost observability (utils/devprof, served at
+        # debug_http /costs): register the compiled step as a LAZY
+        # analyze provider — a lower+compile costs seconds, so it runs
+        # only when an operator asks (?analyze=1), never per scrape.
+        # Registered through a weakref: the devprof registry is
+        # process-global, and a bound method would pin a discarded
+        # World's full device-array state (hundreds of MB at bench
+        # scale) for the life of the process.
+        import weakref
+
+        from goworld_tpu.utils import devprof
+
+        wself = weakref.ref(self)
+
+        def _tick_cost_provider():
+            w = wself()
+            if w is None:
+                return {"name": "world.tick",
+                        "error": "world discarded"}
+            return w.cost_report()
+
+        devprof.register_provider("world.tick", _tick_cost_provider)
+
         # host object model
         self.entities: dict[str, Entity] = {}
         self.spaces: dict[str, Space] = {}
@@ -1289,6 +1312,32 @@ class World:
     # ==================================================================
     # the tick
     # ==================================================================
+    def cost_report(self):
+        """XLA cost/memory analysis of this World's compiled step — the
+        live-process devprof provider (``/costs?analyze=1``). Lowers the
+        step at the current state/empty-inputs shapes (mesh + megaspace
+        steps take MultiTickInputs — make_mega_tick matches
+        make_multi_tick's signature); analysis errors are folded into
+        the report, never raised (devprof contract)."""
+        from goworld_tpu.utils import devprof
+
+        if self.mesh is not None:
+            from goworld_tpu.parallel.step import MultiTickInputs
+
+            inputs = MultiTickInputs.empty(self.cfg, self.n_spaces)
+        else:
+            inputs = jax.tree.map(
+                lambda x: jnp.broadcast_to(
+                    x, (self.n_spaces,) + x.shape),
+                TickInputs.empty(self.cfg),
+            )
+        return devprof.cost_report(
+            self._step, self.state, inputs, self.policy,
+            name="world.tick",
+            config=devprof.grid_config_key(self.cfg.grid),
+            n=self.cfg.capacity * self.n_spaces,
+        )
+
     def tick(self) -> None:
         # per-tick phase timeline (debug_http /trace): the GameServer's
         # serve loop opens the tick record (so pump/fan-out spans land in

@@ -1,0 +1,171 @@
+"""In-graph telemetry lanes: on-device per-tick histograms for scan loops.
+
+The bench/soak ``lax.scan`` loops used to surface last-tick point
+samples (or sums/maxes) of the tick's health signals; a p99 claim needs
+the DISTRIBUTION. These lanes thread a fixed-bucket histogram
+accumulator through the scan carry — one ``at[i].add(1)`` per signal
+per tick, ZERO host syncs inside the loop — and drain it once per scan
+into the artifact's ``op_stats`` block.
+
+Lanes (per-tick signals, from :class:`TickOutputs`):
+
+* ``tick_ms`` — the modeled per-tick latency (see below), bucketed on
+  the live metrics ladder (:data:`metrics.DEFAULT_MS_BUCKETS`) so the
+  SLO verdict reads identically on- and off-device.
+* ``sync_n`` / ``enter_n`` / ``leave_n`` — event volumes.
+* ``over_k_rows`` / ``over_cap_cells`` — AOI saturation gauges.
+* ``rebuilt`` — the Verlet rebuild bit (the skin's duty cycle).
+* ``skin_slack`` — headroom before the next displacement rebuild, as a
+  fraction of skin/2 (lane present only when the skin is on).
+
+**The tick_ms model.** Wall time is not readable inside a compiled
+scan, and inside one fixed-shape program the only data-dependent cost
+branch is the Verlet rebuild-vs-reuse dispatch. The lane therefore
+histograms ``base_ms + rebuilt_i * delta_ms`` where the constants are
+HOST-MEASURED once per scan (bench's scan-marginal tick and its
+aoi_rebuild/aoi_reuse phase probes) and the PER-TICK selection is the
+in-graph rebuild bit — measured constants, device-resident
+distribution. With no skin (or no phase probes) the lane degenerates
+to the constant scan-marginal tick, which is exactly the information
+available. The model is stamped next to the verdict so no reader can
+mistake it for per-tick wall clock.
+
+Bucketing uses ``bisect_left`` semantics on upper edges — identical to
+:class:`goworld_tpu.utils.metrics.Histogram` — and
+:func:`host_histogram` is the numpy recompute the parity tests hold
+the scan accumulator bit-exact against.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from goworld_tpu.utils.metrics import DEFAULT_MS_BUCKETS
+
+__all__ = [
+    "TICK_MS_EDGES", "COUNT_EDGES", "SLACK_EDGES", "REBUILD_EDGES",
+    "lane_edges", "telemetry_init", "telemetry_update",
+    "telemetry_drain", "host_histogram", "TRACE_COUNTS",
+]
+
+# one ladder with the live metrics plane: a bench SLO and a serve-loop
+# SLO bucket identically
+TICK_MS_EDGES = tuple(DEFAULT_MS_BUCKETS)
+# event volumes / saturation gauges: 0 and powers of 4 up past the caps
+COUNT_EDGES = (0.0, 1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0,
+               16384.0, 65536.0, 262144.0, 1048576.0)
+# Verlet skin slack as a fraction of skin/2 (1.0 = untouched headroom)
+SLACK_EDGES = (0.0, 0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0)
+# the rebuild bit: buckets <=0 (reuse) and <=1 (rebuild)
+REBUILD_EDGES = (0.0, 1.0)
+
+_COUNT_LANES = ("sync_n", "enter_n", "leave_n", "over_k_rows",
+                "over_cap_cells")
+
+# per-trace-entry counters so tests can assert the telemetry scan
+# compiles ONCE per config (the scenarios/behaviors.py idiom)
+TRACE_COUNTS: dict = {}
+
+
+def lane_edges(skin_on: bool) -> dict[str, tuple]:
+    """Static bucket edges per lane for a config (lane set depends only
+    on whether the Verlet skin is live)."""
+    lanes = {"tick_ms": TICK_MS_EDGES, "rebuilt": REBUILD_EDGES}
+    for nm in _COUNT_LANES:
+        lanes[nm] = COUNT_EDGES
+    if skin_on:
+        lanes["skin_slack"] = SLACK_EDGES
+    return lanes
+
+
+def telemetry_init(skin_on: bool):
+    """Zeroed accumulator pytree: one int32 count vector per lane
+    (len(edges)+1, last = +Inf) plus the tick_ms running sum."""
+    import jax.numpy as jnp
+
+    acc = {nm: jnp.zeros(len(e) + 1, jnp.int32)
+           for nm, e in lane_edges(skin_on).items()}
+    acc["tick_ms_sum"] = jnp.zeros((), jnp.float32)
+    return acc
+
+
+def _bucket_add(acc_vec, edges, value):
+    import jax.numpy as jnp
+
+    i = jnp.searchsorted(jnp.asarray(edges, jnp.float32),
+                         value.astype(jnp.float32), side="left")
+    return acc_vec.at[i].add(1)
+
+
+def telemetry_update(acc, out, base_ms: float, delta_ms: float,
+                     half_skin: float = 0.0):
+    """Fold one tick's :class:`TickOutputs` into the accumulator.
+    ``base_ms``/``delta_ms`` are the host-measured tick-cost model
+    constants (see module docstring) and ``half_skin`` (= skin/2, the
+    slack lane's unit) normalizes ``aoi_skin_slack`` into a fraction;
+    all are trace-time constants so the scan stays one compile per
+    config. Runs entirely on device — callers assert that with
+    ``jax.transfer_guard`` in the tests."""
+    import jax.numpy as jnp
+
+    TRACE_COUNTS["telemetry_update"] = \
+        TRACE_COUNTS.get("telemetry_update", 0) + 1
+    skin_on = "skin_slack" in acc
+    rebuilt = out.aoi_rebuilt
+    if rebuilt is None:
+        rebuilt = jnp.ones((), jnp.int32)
+    tick_ms = jnp.float32(base_ms) \
+        + rebuilt.astype(jnp.float32) * jnp.float32(delta_ms)
+    acc = dict(acc)
+    acc["tick_ms"] = _bucket_add(acc["tick_ms"], TICK_MS_EDGES, tick_ms)
+    acc["tick_ms_sum"] = acc["tick_ms_sum"] + tick_ms
+    acc["rebuilt"] = _bucket_add(acc["rebuilt"], REBUILD_EDGES,
+                                 rebuilt.astype(jnp.float32))
+    signals = {
+        "sync_n": out.sync_n, "enter_n": out.enter_n,
+        "leave_n": out.leave_n, "over_k_rows": out.aoi_over_k_rows,
+        "over_cap_cells": out.aoi_over_cap_cells,
+    }
+    for nm, v in signals.items():
+        acc[nm] = _bucket_add(acc[nm], COUNT_EDGES,
+                              v.astype(jnp.float32))
+    if skin_on:
+        slack = out.aoi_skin_slack
+        if slack is None:
+            slack = jnp.zeros((), jnp.float32)
+        if half_skin > 0:
+            slack = slack / jnp.float32(half_skin)
+        acc["skin_slack"] = _bucket_add(acc["skin_slack"], SLACK_EDGES,
+                                        slack)
+    return acc
+
+
+def telemetry_drain(acc, skin_on: bool, half_skin: float = 0.0) -> dict:
+    """ONE host readback for the whole scan: fetched lane counts as
+    ``{lane: {"edges": [...], "counts": [...]}}`` plus the tick_ms
+    mean. ``half_skin`` documents the skin_slack lane's unit (its
+    edges are fractions of skin/2)."""
+    fetched = {k: np.asarray(v) for k, v in acc.items()}
+    out: dict = {}
+    for nm, edges in lane_edges(skin_on).items():
+        out[nm] = {
+            "edges": [float(e) for e in edges],
+            "counts": [int(c) for c in fetched[nm]],
+        }
+    if skin_on and half_skin > 0:
+        out["skin_slack"]["unit"] = f"fraction of skin/2 ({half_skin:g})"
+    n = sum(out["tick_ms"]["counts"])
+    if n:
+        out["tick_ms"]["mean_ms"] = round(
+            float(fetched["tick_ms_sum"]) / n, 3)
+    return out
+
+
+def host_histogram(values, edges) -> np.ndarray:
+    """Numpy recompute of the device bucketing (bisect_left on upper
+    edges, +Inf tail) — the parity oracle for the scan accumulator."""
+    edges = np.asarray(edges, np.float32)
+    counts = np.zeros(len(edges) + 1, np.int64)
+    for v in np.asarray(values, np.float32).ravel():
+        counts[int(np.searchsorted(edges, v, side="left"))] += 1
+    return counts

@@ -20,8 +20,17 @@ TPU-native analog exposes:
   alignment (``tools/merge_traces.py``)
 * ``/healthz``— liveness probe
 * ``/profile``— jax.profiler capture trigger: GET starts a device trace
-  (``?logdir=`` overrides the output dir), ``?stop=1`` stops it; a
-  clear JSON error when jax.profiler is unavailable
+  (``?logdir=`` overrides the output dir), ``?stop=1`` stops it,
+  ``?seconds=N`` auto-stops the capture after N seconds (a started
+  capture that is never stopped would otherwise hold the per-process
+  profiler lock forever), ``?status=1`` reports without side effects;
+  a clear JSON error when jax.profiler is unavailable
+* ``/costs`` — device-plane cost observability (:mod:`goworld_tpu.
+  utils.devprof`): registered :class:`CostReport`s of compiled tick
+  executables, lazy analyze providers (run with ``?analyze=1`` —
+  a lower+compile costs seconds, so it is operator-triggered), and
+  the freshest SLO verdict (recorded, or derived live from the
+  ``tick_latency_ms`` histogram)
 * ``/faults`` — fault-injection plane state (:mod:`goworld_tpu.utils.
   faults`): seed, per-rule trial counts and the deterministic fired
   log; ``{"active": false}`` when no schedule is installed
@@ -36,6 +45,7 @@ from __future__ import annotations
 
 import gzip as _gzip
 import json
+import math
 import os
 import threading
 import time
@@ -47,11 +57,16 @@ from goworld_tpu.utils import log, metrics, opmon, tracing
 logger = log.get("debug_http")
 
 _ENDPOINTS = ["/healthz", "/vars", "/ops", "/metrics", "/trace",
-              "/tracing", "/clock", "/profile", "/faults", "/overload"]
+              "/tracing", "/clock", "/profile", "/faults", "/overload",
+              "/costs"]
 
 # jax.profiler capture state (one capture at a time per process)
 _profile_lock = threading.Lock()
 _profile_dir: str | None = None
+# monotonically bumped per start: the ?seconds auto-stop timer only
+# fires for ITS capture (a manual stop + fresh start must not be
+# killed by a stale timer)
+_profile_gen = 0
 
 
 def merged_trace(process_name: str) -> dict:
@@ -66,9 +81,27 @@ def merged_trace(process_name: str) -> dict:
     return obj
 
 
+def _profile_auto_stop(gen: int) -> None:
+    """Timer body for ``?seconds=N``: stop the capture IF it is still
+    the one that armed this timer (generation check — a manual stop +
+    restart must never be killed by a stale timer)."""
+    global _profile_dir
+    with _profile_lock:
+        if _profile_dir is None or gen != _profile_gen:
+            return
+        try:
+            from jax import profiler as jax_profiler
+
+            jax_profiler.stop_trace()
+        except Exception as exc:  # the capture is still torn down
+            logger.warning("profile auto-stop failed: %s", exc)
+        logger.info("profile auto-stopped (logdir %s)", _profile_dir)
+        _profile_dir = None
+
+
 def _profile_action(query: dict) -> tuple[dict, int]:
     """Start/stop a jax.profiler trace capture; (json body, status)."""
-    global _profile_dir
+    global _profile_dir, _profile_gen
     try:
         from jax import profiler as jax_profiler
     except Exception:
@@ -76,7 +109,12 @@ def _profile_action(query: dict) -> tuple[dict, int]:
                 501)
     # presence of the key counts (`?stop` and `?stop=1` both stop)
     stop = "stop" in query and query["stop"][0] not in ("0", "false")
+    status = "status" in query and query["status"][0] not in ("0",
+                                                              "false")
     with _profile_lock:
+        if status:
+            return ({"active": _profile_dir is not None,
+                     "logdir": _profile_dir}, 200)
         if stop:
             if _profile_dir is None:
                 return ({"error": "no capture in progress"}, 409)
@@ -90,6 +128,20 @@ def _profile_action(query: dict) -> tuple[dict, int]:
         if _profile_dir is not None:
             return ({"error": "capture already in progress",
                      "logdir": _profile_dir}, 409)
+        seconds = 0.0
+        if "seconds" in query:
+            # parse BEFORE starting: a bad value must not leave a
+            # capture running with no auto-stop armed
+            try:
+                seconds = float(query["seconds"][0])
+            except ValueError:
+                return ({"error": "seconds must be a number"}, 400)
+            # reject non-finite too: Timer(nan) fires immediately and
+            # Timer(inf) never — both defeat the auto-stop guarantee
+            # this parameter exists to provide
+            if not math.isfinite(seconds) or seconds <= 0:
+                return ({"error": "seconds must be a finite number "
+                                  "> 0"}, 400)
         logdir = query.get("logdir", [""])[0] or os.path.join(
             os.getcwd(), "jax_profile"
         )
@@ -98,7 +150,15 @@ def _profile_action(query: dict) -> tuple[dict, int]:
         except Exception as exc:
             return ({"error": f"start_trace failed: {exc}"}, 500)
         _profile_dir = logdir
-        return ({"ok": True, "started": True, "logdir": logdir}, 200)
+        _profile_gen += 1
+        body = {"ok": True, "started": True, "logdir": logdir}
+        if seconds:
+            t = threading.Timer(seconds, _profile_auto_stop,
+                                args=(_profile_gen,))
+            t.daemon = True
+            t.start()
+            body["auto_stop_s"] = seconds
+        return (body, 200)
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -181,6 +241,15 @@ class _Handler(BaseHTTPRequestHandler):
             from goworld_tpu.utils import overload
 
             self._json(overload.snapshot())
+        elif path == "/costs":
+            # device-plane cost reports + SLO verdict (utils/devprof):
+            # ?analyze=1 runs the registered lazy providers (a
+            # lower+compile of the live tick — seconds, so opt-in)
+            from goworld_tpu.utils import devprof
+
+            analyze = "analyze" in query \
+                and query["analyze"][0] not in ("0", "false")
+            self._json(devprof.snapshot(analyze=analyze))
         else:
             self._json({"error": "not found",
                         "endpoints": _ENDPOINTS}, 404)

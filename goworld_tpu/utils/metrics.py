@@ -258,6 +258,18 @@ class Registry:
                 )
         return "\n".join(out) + "\n" if out else ""
 
+    def histogram_snapshot(self, name: str) -> list | None:
+        """``[(labels, Histogram.snapshot()), ...]`` for a histogram
+        family, or None when it doesn't exist (or isn't a histogram).
+        The devprof ``/costs`` SLO verdict reads ``tick_latency_ms``
+        through this instead of poking family internals."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None or fam.kind != "histogram":
+                return None
+            children = list(fam.children.values())
+        return [(dict(labels), m.snapshot()) for labels, m in children]
+
     def reset(self) -> None:
         """Drop every registered metric (tests)."""
         with self._lock:

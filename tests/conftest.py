@@ -63,6 +63,13 @@ def pytest_configure(config):
         ".py + the scenario-driven AOI regressions); the small-N "
         "oracle gates run in tier-1, long soaks are also marked slow",
     )
+    config.addinivalue_line(
+        "markers",
+        "devprof: device-plane observability suites (XLA cost auditor, "
+        "in-graph telemetry lanes, roofline audit, bench trend/schema "
+        "gates — tests/test_devprof.py, test_bench_trend.py, "
+        "test_bench_schema.py); all run in tier-1 on CPU",
+    )
 
 
 def spawn_on(states, dev, slot, **kw):

@@ -1,0 +1,165 @@
+"""tools/bench_schema.py — artifact schema validation, in tier-1.
+
+Every CHECKED-IN BENCH_r*/MULTICHIP_r* artifact must validate (so a
+malformed stamp can never land again), and the checker must actually
+catch malformation (required keys, device-plane blocks since r8,
+multichip invariants).
+"""
+
+import importlib.util
+import glob
+import json
+import os
+
+import pytest
+
+pytestmark = pytest.mark.devprof
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+spec = importlib.util.spec_from_file_location(
+    "bench_schema_under_test",
+    os.path.join(REPO, "tools", "bench_schema.py"))
+SCHEMA = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(SCHEMA)
+
+
+def test_every_checked_in_artifact_validates():
+    files = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json"))
+                   + glob.glob(os.path.join(REPO, "MULTICHIP_r*.json")))
+    assert files, "no artifacts in the repo root?"
+    problems = {os.path.basename(f): SCHEMA.validate_file(f)
+                for f in files}
+    assert all(not errs for errs in problems.values()), problems
+
+
+def test_cli_passes_on_repo(capsys):
+    assert SCHEMA.main(["--dir", REPO]) == 0
+
+
+def _full_rec(rno=8, **extra):
+    rec = {
+        "metric": "entity_ticks_per_sec_per_chip", "value": 100.0,
+        "unit": "entity-ticks/s/chip", "vs_baseline": 0.0,
+        "entities": 1024, "tick_ms": 5.0, "platform": "cpu",
+        "attempts": [],
+        "sweep_impl": "ranges", "topk_impl": "sort",
+        "sort_impl": "argsort", "skin": 0.0,
+        "slo": {"target_ms": 16.0, "p50_ms": 1.0, "p90_ms": 2.0,
+                "p99_ms": 3.0, "pass": True, "source": "x"},
+        "op_stats": {"tick_ms": {"edges": [], "counts": []}},
+        "roofline_audit": {"phases": {}},
+    }
+    rec.update(extra)
+    return rec
+
+
+def _validate(tmp_path, name, doc):
+    p = tmp_path / name
+    p.write_text(json.dumps(doc))
+    return SCHEMA.validate_file(str(p))
+
+
+def test_valid_r8_record_passes(tmp_path):
+    assert _validate(tmp_path, "BENCH_r08.json", _full_rec()) == []
+
+
+def test_missing_kernel_stamp_caught(tmp_path):
+    rec = _full_rec()
+    del rec["sweep_impl"]
+    errs = _validate(tmp_path, "BENCH_r08.json", rec)
+    assert any("sweep_impl" in e for e in errs)
+
+
+def test_missing_device_plane_blocks_caught_since_r8(tmp_path):
+    rec = _full_rec()
+    del rec["slo"], rec["roofline_audit"], rec["op_stats"]
+    errs = _validate(tmp_path, "BENCH_r08.json", rec)
+    assert any("slo" in e for e in errs)
+    assert any("roofline_audit" in e for e in errs)
+    assert any("op_stats" in e for e in errs)
+    # the same record is a VALID r7 artifact (grandfathered)
+    assert _validate(tmp_path, "BENCH_r07.json", rec) == []
+
+
+def test_honest_error_blocks_accepted(tmp_path):
+    rec = _full_rec(slo={"error": "telemetry scan failed"},
+                    roofline_audit={"error": "no phases"},
+                    op_stats={"error": "x"})
+    assert _validate(tmp_path, "BENCH_r08.json", rec) == []
+
+
+def test_deliberate_skip_blocks_accepted(tmp_path):
+    """BENCH_DEVPROF=0 / BENCH_SLO=0 / BENCH_PHASES=0 runs stamp
+    {"skipped": ...} records — a documented thinner run (e.g. a relay
+    window avoiding the extra compiles) must stay schema-valid."""
+    rec = _full_rec(slo={"skipped": "BENCH_SLO=0"},
+                    roofline_audit={"skipped": "BENCH_DEVPROF=0"},
+                    op_stats={"skipped": "BENCH_SLO=0"})
+    assert _validate(tmp_path, "BENCH_r08.json", rec) == []
+
+
+def test_value_zero_error_record_is_a_failed_round(tmp_path):
+    """compose()'s "no stage completed" artifact (value 0.0 + error)
+    is a FAILED round, not a headline held to the headline contract —
+    the same definition bench_trend/roofline_audit use
+    (devprof.artifact_headline)."""
+    failed = {"metric": "entity_ticks_per_sec_per_chip", "value": 0.0,
+              "unit": "entity-ticks/s/chip", "vs_baseline": 0.0,
+              "error": "no stage completed on any backend",
+              "attempts": []}
+    doc = {"cmd": "x", "rc": 1, "parsed": failed, "tail": ""}
+    assert _validate(tmp_path, "BENCH_r09.json", doc) == []
+    # ...but an rc that claims success next to no headline is a lie
+    doc_lie = dict(doc, rc=0)
+    errs = _validate(tmp_path, "BENCH_r09.json", doc_lie)
+    assert any("rc == 0" in e for e in errs)
+
+
+def test_malformed_slo_shape_caught(tmp_path):
+    rec = _full_rec(slo={"target_ms": 16.0})  # percentiles missing
+    errs = _validate(tmp_path, "BENCH_r08.json", rec)
+    assert any("slo" in e and "p99_ms" in e for e in errs)
+
+
+def test_non_numeric_value_caught(tmp_path):
+    errs = _validate(tmp_path, "BENCH_r08.json",
+                     _full_rec(value="fast"))
+    assert any("not a number" in e for e in errs)
+
+
+def test_failed_round_requires_nonzero_rc(tmp_path):
+    ok = {"cmd": "x", "rc": 1, "parsed": None, "tail": ""}
+    assert _validate(tmp_path, "BENCH_r09.json", ok) == []
+    lie = {"cmd": "x", "rc": 0, "parsed": None, "tail": ""}
+    errs = _validate(tmp_path, "BENCH_r09.json", lie)
+    assert any("rc == 0" in e for e in errs)
+
+
+def test_scenario_blocks_validated(tmp_path):
+    rec = _full_rec(scenarios={"hotspot": {"tick_ms": 1.0}})
+    errs = _validate(tmp_path, "BENCH_r08.json", rec)
+    assert any("hotspot" in e and "value" in e for e in errs)
+    rec2 = _full_rec(scenarios={
+        "hotspot": {"value": 1.0, "tick_ms": 1.0, "entities": 10},
+        "shrink": {"error": "boom"},
+    })
+    assert _validate(tmp_path, "BENCH_r08.json", rec2) == []
+
+
+def test_multichip_invariants(tmp_path):
+    good = {"n_devices": 8, "rc": 0, "ok": True, "tail": ""}
+    assert _validate(tmp_path, "MULTICHIP_r08.json", good) == []
+    bad = {"n_devices": 8, "rc": 3, "ok": True, "tail": ""}
+    errs = _validate(tmp_path, "MULTICHIP_r08.json", bad)
+    assert any("rc=3" in e for e in errs)
+    errs = _validate(tmp_path, "MULTICHIP_r08.json",
+                     {"rc": 0, "ok": False})
+    assert any("n_devices" in e for e in errs)
+    assert any("tail" in e for e in errs)
+
+
+def test_unreadable_file_reported(tmp_path):
+    p = tmp_path / "BENCH_r08.json"
+    p.write_text("{not json")
+    errs = SCHEMA.validate_file(str(p))
+    assert errs and "unreadable" in errs[0]

@@ -1,0 +1,158 @@
+"""tools/bench_trend.py — the trajectory regression gate, in tier-1.
+
+The real checked-in BENCH_r*/MULTICHIP_r* trajectory must PASS (the
+gate runs after every round; a red gate on the committed history would
+make it dead on arrival), an injected regression must FAIL, and a
+missing file is a usage error, not a silent pass.
+"""
+
+import importlib.util
+import json
+import os
+
+import pytest
+
+pytestmark = pytest.mark.devprof
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_test", os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+TREND = _load("bench_trend")
+
+
+def _bench_rec(value, entities=1000, platform="cpu", tick_ms=10.0,
+               phase_ms=None, slo=None, scenarios=None):
+    rec = {
+        "metric": "entity_ticks_per_sec_per_chip", "value": value,
+        "unit": "entity-ticks/s/chip", "vs_baseline": 0.0,
+        "entities": entities, "tick_ms": tick_ms, "platform": platform,
+        "stage": "full", "attempts": [],
+        "phase_ms": phase_ms or {"aoi": 5.0, "move": 1.0},
+    }
+    if slo is not None:
+        rec["slo"] = slo
+    if scenarios is not None:
+        rec["scenarios"] = scenarios
+    return rec
+
+
+def _write(tmp_path, name, rec):
+    p = tmp_path / name
+    p.write_text(json.dumps(rec))
+    return str(p)
+
+
+def test_real_checked_in_trajectory_passes():
+    assert TREND.main(["--dir", REPO]) == 0
+
+
+def test_missing_file_is_an_error(capsys):
+    assert TREND.main([os.path.join(REPO, "BENCH_r99_missing.json")]) \
+        == 1
+    assert "missing file" in capsys.readouterr().err
+
+
+def test_improvement_passes(tmp_path):
+    f1 = _write(tmp_path, "BENCH_r01.json", _bench_rec(1000.0))
+    f2 = _write(tmp_path, "BENCH_r02.json",
+                _bench_rec(1500.0, tick_ms=7.0))
+    assert TREND.main([f1, f2]) == 0
+
+
+def test_injected_headline_regression_fails(tmp_path):
+    f1 = _write(tmp_path, "BENCH_r01.json", _bench_rec(1000.0))
+    f2 = _write(tmp_path, "BENCH_r02.json", _bench_rec(500.0))
+    assert TREND.main([f1, f2]) == 2
+
+
+def test_regression_vs_best_prior_not_just_previous(tmp_path):
+    # r2 dipped (historic, not gated), r3 must still beat r1's best
+    f1 = _write(tmp_path, "BENCH_r01.json", _bench_rec(1000.0))
+    f2 = _write(tmp_path, "BENCH_r02.json", _bench_rec(100.0))
+    f3 = _write(tmp_path, "BENCH_r03.json", _bench_rec(650.0))
+    assert TREND.main([f1, f2, f3]) == 2  # 650 < 0.7 * 1000
+    f3b = _write(tmp_path, "BENCH_r04.json", _bench_rec(900.0))
+    assert TREND.main([f1, f2, f3b]) == 0
+
+
+def test_phase_regression_fails(tmp_path):
+    f1 = _write(tmp_path, "BENCH_r01.json",
+                _bench_rec(1000.0, phase_ms={"aoi": 5.0}))
+    f2 = _write(tmp_path, "BENCH_r02.json",
+                _bench_rec(1000.0, phase_ms={"aoi": 9.0}))
+    assert TREND.main([f1, f2]) == 2
+
+
+def test_shape_change_is_not_compared(tmp_path):
+    f1 = _write(tmp_path, "BENCH_r01.json",
+                _bench_rec(1000.0, entities=1000))
+    f2 = _write(tmp_path, "BENCH_r02.json",
+                _bench_rec(10.0, entities=8))  # different shape
+    assert TREND.main([f1, f2]) == 0
+
+
+def test_slo_pass_to_fail_transition_fails(tmp_path):
+    ok = {"target_ms": 16.0, "p99_ms": 8.0, "pass": True}
+    bad = {"target_ms": 16.0, "p99_ms": 33.0, "pass": False}
+    f1 = _write(tmp_path, "BENCH_r01.json", _bench_rec(1000.0, slo=ok))
+    f2 = _write(tmp_path, "BENCH_r02.json",
+                _bench_rec(1000.0, slo=bad))
+    assert TREND.main([f1, f2]) == 2
+    # fail -> fail is the recorded status quo, not a regression
+    f1b = _write(tmp_path, "BENCH_r03.json",
+                 _bench_rec(1000.0, slo=bad))
+    f2b = _write(tmp_path, "BENCH_r04.json",
+                 _bench_rec(1000.0, slo=bad))
+    assert TREND.main([f1b, f2b]) == 0
+
+
+def test_scenario_value_regression_fails(tmp_path):
+    sc_ok = {"hotspot": {"value": 500.0, "entities": 512,
+                         "tick_ms": 1.0}}
+    sc_bad = {"hotspot": {"value": 100.0, "entities": 512,
+                          "tick_ms": 5.0}}
+    f1 = _write(tmp_path, "BENCH_r01.json",
+                _bench_rec(1000.0, scenarios=sc_ok))
+    f2 = _write(tmp_path, "BENCH_r02.json",
+                _bench_rec(1000.0, scenarios=sc_bad))
+    assert TREND.main([f1, f2]) == 2
+
+
+def test_suspect_and_failed_rounds_are_skipped(tmp_path):
+    f1 = _write(tmp_path, "BENCH_r01.json",
+                {"cmd": "x", "rc": 1, "parsed": None, "tail": ""})
+    rec = _bench_rec(1000.0)
+    rec["timing_suspect"] = "2x scan took 1.1x"
+    f2 = _write(tmp_path, "BENCH_r02.json", rec)
+    f3 = _write(tmp_path, "BENCH_r03.json", _bench_rec(900.0))
+    # only r3 has a trustworthy headline -> nothing to gate
+    assert TREND.main([f1, f2, f3]) == 0
+
+
+def test_multichip_ok_regression_fails(tmp_path):
+    f1 = _write(tmp_path, "MULTICHIP_r01.json",
+                {"n_devices": 8, "rc": 0, "ok": True, "tail": "",
+                 "skipped": False})
+    f2 = _write(tmp_path, "MULTICHIP_r02.json",
+                {"n_devices": 8, "rc": 1, "ok": False, "tail": "",
+                 "skipped": False})
+    assert TREND.main([f1, f2]) == 2
+    f2b = _write(tmp_path, "MULTICHIP_r03.json",
+                 {"n_devices": 8, "rc": 0, "ok": True, "tail": "",
+                  "skipped": False})
+    assert TREND.main([f1, f2b]) == 0
+
+
+def test_threshold_knob(tmp_path):
+    f1 = _write(tmp_path, "BENCH_r01.json", _bench_rec(1000.0))
+    f2 = _write(tmp_path, "BENCH_r02.json", _bench_rec(850.0))
+    assert TREND.main([f1, f2]) == 0              # within default 30%
+    assert TREND.main(["--threshold", "0.1", f1, f2]) == 2

@@ -322,6 +322,54 @@ def test_profile_endpoint_start_stop(http_srv, tmp_path):
     assert code4 == 409
 
 
+def test_profile_seconds_auto_stop_releases_lock(http_srv, tmp_path):
+    """`?seconds=N` regression (ISSUE 8 satellite): a started capture
+    that is never stopped used to hold the per-process profiler lock
+    forever; with auto-stop the lock frees itself and a new capture
+    can start."""
+    _, base = http_srv
+    code, _, body = _get(
+        base + f"/profile?logdir={tmp_path}/auto&seconds=0.5",
+        timeout=90)
+    out = json.loads(body)
+    if code == 501:
+        assert "unavailable" in out["error"]
+        return
+    assert code == 200 and out["started"] and out["auto_stop_s"] == 0.5
+    # ?status reports without side effects while active or not
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        _, _, sbody = _get(base + "/profile?status=1")
+        if not json.loads(sbody)["active"]:
+            break
+        time.sleep(0.2)
+    else:
+        raise AssertionError("auto-stop never fired within 30s")
+    # the lock is free again: a fresh capture starts cleanly and a
+    # manual stop still works (no stale-timer interference)
+    code2, _, _ = _get(base + f"/profile?logdir={tmp_path}/fresh",
+                       timeout=90)
+    assert code2 == 200
+    code3, _, _ = _get(base + "/profile?stop=1", timeout=90)
+    assert code3 == 200
+    # a malformed seconds value is a 400, never a wedged capture
+    code4, _, body4 = _get(
+        base + f"/profile?logdir={tmp_path}/bad&seconds=abc")
+    assert code4 == 400 and "seconds" in json.loads(body4)["error"]
+    code5, _, _ = _get(
+        base + f"/profile?logdir={tmp_path}/bad&seconds=-1")
+    assert code5 == 400
+    # non-finite values defeat the auto-stop guarantee: nan's Timer
+    # fires immediately, inf's never — both must be 400s
+    for bad in ("nan", "inf"):
+        code6, _, _ = _get(
+            base + f"/profile?logdir={tmp_path}/bad&seconds={bad}")
+        assert code6 == 400, bad
+    # ...and it left NO capture behind
+    _, _, sbody = _get(base + "/profile?status=1")
+    assert not json.loads(sbody)["active"]
+
+
 # =======================================================================
 # end-to-end: one sampled client RPC across a standalone cluster
 # =======================================================================

@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Schema checker for the checked-in BENCH_r*.json / MULTICHIP_r*.json.
+
+The round artifacts are the repo's performance memory — trend gating
+(tools/bench_trend.py), the roofline audit and the ROADMAP all read
+them — so a malformed stamp is corruption that compounds. This
+validates every file's shape and is run as a tier-1 test
+(tests/test_bench_schema.py), so a malformed stamp can never land
+again.
+
+Rules are VERSIONED by round number (the artifact grew stamps over
+time; old rounds are grandfathered, new rounds are held to the current
+contract):
+
+* every BENCH file: either a headline record with the base contract
+  (metric/value/unit/vs_baseline/entities/tick_ms/platform/attempts),
+  or an honestly-recorded failed round (no headline, rc != 0);
+* rounds >= 6 (the first artifacts produced by the stamp-carrying
+  bench): resolved kernel stamps (sweep/topk/sort/skin);
+* rounds >= 8 (the device-plane era): ``slo``, ``op_stats`` and
+  ``roofline_audit`` blocks with their required inner shape (an
+  ``{"error": ...}`` record is an accepted honest failure, a
+  ``{"skipped": ...}`` record a documented deliberate skip —
+  BENCH_DEVPROF=0/BENCH_SLO=0/BENCH_PHASES=0);
+* MULTICHIP files: n_devices/rc/ok/tail, with ok => rc == 0.
+
+Exit codes: 0 all valid, 1 usage/missing, 2 schema violations.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# jax-free artifact conventions shared with bench_trend/roofline_audit
+from goworld_tpu.utils.devprof import (  # noqa: E402
+    artifact_headline,
+    artifact_round as round_no,
+)
+
+BASE_KEYS = ("metric", "value", "unit", "vs_baseline", "entities",
+             "tick_ms", "platform", "attempts")
+KERNEL_STAMPS = ("sweep_impl", "topk_impl", "sort_impl", "skin")
+SLO_KEYS = ("target_ms", "p50_ms", "p90_ms", "p99_ms", "pass",
+            "source")
+# round number from which a stamp family is REQUIRED (the stamps
+# landed in the r5 SESSION, so the first artifact carrying them is r6)
+KERNEL_STAMPS_SINCE = 6
+DEVICE_PLANE_SINCE = 8
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def _check_block(rec: dict, key: str, inner: tuple,
+                 errs: list[str]) -> None:
+    """A device-plane block: present, a dict, and either an honest
+    ``{"error": ...}`` / ``{"skipped": ...}`` record (an exception in
+    the stamping, or a documented BENCH_DEVPROF=0/BENCH_SLO=0/
+    BENCH_PHASES=0 skip) or the full inner shape."""
+    blk = rec.get(key)
+    if not isinstance(blk, dict):
+        errs.append(f"missing/invalid {key} block")
+        return
+    if "error" in blk or "skipped" in blk:
+        return  # honestly-recorded failure or deliberate skip
+    for k in inner:
+        if k not in blk:
+            errs.append(f"{key} missing key {k!r}")
+
+
+def validate_bench(path: str, doc: dict) -> list[str]:
+    errs: list[str] = []
+    rno = round_no(path)
+    # the ONE headline definition shared with bench_trend/
+    # roofline_audit (devprof.artifact_headline): a value-0 error
+    # record (compose()'s "no stage completed" artifact) is a FAILED
+    # round, not a headline to hold to the headline contract
+    rec = artifact_headline(doc)
+    if rec is None:
+        # a failed round: honest only when its rc says so
+        if doc.get("rc", 1) == 0 and "parsed" in doc:
+            errs.append("no headline record but rc == 0")
+        return errs
+    for k in BASE_KEYS:
+        if k not in rec:
+            errs.append(f"missing base key {k!r}")
+    if "value" in rec and not _is_num(rec["value"]):
+        errs.append(f"value is {type(rec['value']).__name__}, "
+                    "not a number")
+    if _is_num(rec.get("value")) and rec["value"] < 0:
+        errs.append("negative headline value")
+    if not isinstance(rec.get("attempts", []), list):
+        errs.append("attempts is not a list")
+    if rno >= KERNEL_STAMPS_SINCE:
+        for k in KERNEL_STAMPS:
+            if k not in rec:
+                errs.append(f"missing kernel stamp {k!r} "
+                            f"(required since r{KERNEL_STAMPS_SINCE:02d})")
+    if rno >= DEVICE_PLANE_SINCE:
+        _check_block(rec, "slo", SLO_KEYS, errs)
+        _check_block(rec, "roofline_audit", ("phases",), errs)
+        ost = rec.get("op_stats")
+        if not isinstance(ost, dict) or not (
+                {"error", "skipped"} & set(ost) or "tick_ms" in ost):
+            errs.append("missing/invalid op_stats block")
+    # per-scenario blocks, wherever present: each needs either a
+    # headline-style shape or an honest error
+    for sc, blk in (rec.get("scenarios") or {}).items():
+        if not isinstance(blk, dict):
+            errs.append(f"scenario {sc}: not a dict")
+            continue
+        if "error" in blk:
+            continue
+        for k in ("value", "tick_ms", "entities"):
+            if k not in blk:
+                errs.append(f"scenario {sc}: missing {k!r}")
+    return errs
+
+
+def validate_multichip(path: str, doc: dict) -> list[str]:
+    errs: list[str] = []
+    for k in ("n_devices", "rc", "ok", "tail"):
+        if k not in doc:
+            errs.append(f"missing key {k!r}")
+    if doc.get("ok") and doc.get("rc", 0) != 0:
+        errs.append(f"ok but rc={doc.get('rc')}")
+    if "n_devices" in doc and (not _is_num(doc["n_devices"])
+                               or doc["n_devices"] <= 0):
+        errs.append(f"n_devices={doc.get('n_devices')!r}")
+    return errs
+
+
+def validate_file(path: str) -> list[str]:
+    try:
+        with open(path) as fh:
+            doc = json.load(fh)
+    except (OSError, json.JSONDecodeError) as exc:
+        return [f"unreadable: {exc}"]
+    if not isinstance(doc, dict):
+        return ["top level is not a JSON object"]
+    if "MULTICHIP" in os.path.basename(path):
+        return validate_multichip(path, doc)
+    return validate_bench(path, doc)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate checked-in BENCH/MULTICHIP artifacts")
+    ap.add_argument("files", nargs="*",
+                    help="explicit files (default: repo glob)")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    args = ap.parse_args(argv)
+
+    files = args.files or sorted(
+        glob.glob(os.path.join(args.dir, "BENCH_r*.json"))
+        + glob.glob(os.path.join(args.dir, "MULTICHIP_r*.json"))
+    )
+    if not files:
+        print(f"no artifacts under {args.dir}", file=sys.stderr)
+        return 1
+    bad = 0
+    for path in files:
+        if not os.path.exists(path):
+            print(f"missing file: {path}", file=sys.stderr)
+            return 1
+        errs = validate_file(path)
+        name = os.path.basename(path)
+        if errs:
+            bad += 1
+            for e in errs:
+                print(f"{name}: {e}", file=sys.stderr)
+        else:
+            print(f"{name}: ok")
+    return 2 if bad else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

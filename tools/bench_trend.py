@@ -1,0 +1,219 @@
+#!/usr/bin/env python
+"""Regression gate over the BENCH_r*.json / MULTICHIP_r*.json trajectory.
+
+The round artifacts were a pile of snapshots; this turns them into an
+ENFORCED contract: read the whole checked-in trajectory and exit
+non-zero when the LATEST round regresses against its comparable
+predecessors. Runs in tier-1 against the checked-in files (jax-free,
+milliseconds) and in CI after any new round lands.
+
+Gating policy — the latest round only (historic inter-round swings,
+e.g. r02->r03's workload change, are the recorded past, not a
+regression introduced by the change under test):
+
+* headline ``value`` (higher is better): latest must be within
+  ``--threshold`` of the BEST prior round at the same
+  (entities, platform) shape;
+* ``tick_ms`` and every shared ``phase_ms`` entry (lower is better):
+  latest vs the MOST RECENT comparable prior round;
+* per-scenario block ``value``s: same rule, matched by scenario name
+  at equal entities;
+* ``slo.pass``: a true -> false transition at the same shape fails;
+* MULTICHIP: the latest record must keep ``ok`` (when any prior round
+  had it) and ``rc == 0``.
+
+Exit codes: 0 pass, 1 usage/missing file, 2 regression.
+
+Usage::
+
+    python tools/bench_trend.py                     # repo trajectory
+    python tools/bench_trend.py --threshold 0.2
+    python tools/bench_trend.py BENCH_r04.json BENCH_r05.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# jax-free artifact conventions shared with bench_schema/roofline_audit
+from goworld_tpu.utils.devprof import (  # noqa: E402
+    artifact_headline,
+    artifact_round as _round_no,
+)
+
+DEFAULT_THRESHOLD = 0.30  # fractional regression that fails the gate
+
+
+def load_headline(path: str) -> dict | None:
+    """The stamped artifact dict (driver wrapper or bare); None when
+    the round recorded no usable headline (failed rounds are skipped,
+    not gated — their failure is already recorded honestly)."""
+    with open(path) as fh:
+        rec = artifact_headline(json.load(fh))
+    if rec is not None and rec.get("timing_suspect"):
+        return None  # a flagged headline is not a trustworthy baseline
+    return rec
+
+
+def _shape(rec: dict) -> tuple:
+    return (rec.get("entities"), rec.get("platform"))
+
+
+def check_bench(files: list[str], threshold: float,
+                problems: list[str], notes: list[str]) -> None:
+    rounds = []
+    for path in sorted(files, key=_round_no):
+        rec = load_headline(path)
+        if rec is None:
+            notes.append(f"{os.path.basename(path)}: no headline "
+                         "(failed/suspect round) — skipped")
+            continue
+        rounds.append((path, rec))
+    if len(rounds) < 2:
+        notes.append("bench: <2 comparable rounds, nothing to gate")
+        return
+    latest_path, latest = rounds[-1]
+    name = os.path.basename(latest_path)
+    prior = [(p, r) for p, r in rounds[:-1]
+             if _shape(r) == _shape(latest)]
+    if not prior:
+        notes.append(f"{name}: shape {_shape(latest)} has no prior "
+                     "round — headline not gated")
+        return
+    # headline value vs the BEST comparable predecessor
+    best_path, best = max(prior, key=lambda pr: pr[1]["value"])
+    floor = (1.0 - threshold) * best["value"]
+    if latest["value"] < floor:
+        problems.append(
+            f"{name}: headline {latest['value']:.0f} < "
+            f"{floor:.0f} ({(1 - threshold) * 100:.0f}% of "
+            f"{os.path.basename(best_path)}'s {best['value']:.0f})")
+    else:
+        notes.append(f"{name}: headline {latest['value']:.0f} vs best "
+                     f"prior {best['value']:.0f} — ok")
+    # tick_ms + phases vs the MOST RECENT comparable predecessor
+    prev_path, prev = prior[-1]
+    pname = os.path.basename(prev_path)
+    for key in ("tick_ms",):
+        if key in latest and key in prev and prev[key] > 0:
+            if latest[key] > (1.0 + threshold) * prev[key]:
+                problems.append(
+                    f"{name}: {key} {latest[key]} > "
+                    f"{(1 + threshold) * 100:.0f}% of {pname}'s "
+                    f"{prev[key]}")
+    for ph, ms in (latest.get("phase_ms") or {}).items():
+        pms = (prev.get("phase_ms") or {}).get(ph)
+        if pms and isinstance(ms, (int, float)) and pms > 0:
+            if ms > (1.0 + threshold) * pms:
+                problems.append(
+                    f"{name}: phase {ph} {ms} ms > "
+                    f"{(1 + threshold) * 100:.0f}% of {pname}'s "
+                    f"{pms} ms")
+    # per-scenario headline blocks, matched by name at equal entities
+    for sc, blk in (latest.get("scenarios") or {}).items():
+        pblk = (prev.get("scenarios") or {}).get(sc)
+        if not (isinstance(blk, dict) and isinstance(pblk, dict)):
+            continue
+        if blk.get("entities") != pblk.get("entities"):
+            continue
+        v, pv = blk.get("value"), pblk.get("value")
+        if isinstance(v, (int, float)) and isinstance(pv, (int, float)) \
+                and pv > 0 and v < (1.0 - threshold) * pv:
+            problems.append(
+                f"{name}: scenario {sc} value {v:.0f} < "
+                f"{(1 - threshold) * 100:.0f}% of {pname}'s {pv:.0f}")
+    # SLO: a pass that turns into a fail at the same shape regressed
+    lslo, pslo = latest.get("slo"), prev.get("slo")
+    if isinstance(lslo, dict) and isinstance(pslo, dict):
+        if pslo.get("pass") and not lslo.get("pass"):
+            problems.append(
+                f"{name}: slo pass regressed true -> false "
+                f"(p99 {lslo.get('p99_ms')} vs target "
+                f"{lslo.get('target_ms')})")
+
+
+def check_multichip(files: list[str], problems: list[str],
+                    notes: list[str]) -> None:
+    recs = []
+    for path in sorted(files, key=_round_no):
+        with open(path) as fh:
+            recs.append((path, json.load(fh)))
+    if not recs:
+        return
+    latest_path, latest = recs[-1]
+    name = os.path.basename(latest_path)
+    any_prior_ok = any(r.get("ok") for _p, r in recs[:-1])
+    if latest.get("skipped"):
+        notes.append(f"{name}: skipped run — not gated")
+        return
+    if any_prior_ok and not latest.get("ok"):
+        problems.append(f"{name}: multichip ok regressed true -> false")
+    if latest.get("rc", 0) != 0 and any_prior_ok:
+        problems.append(f"{name}: multichip rc={latest.get('rc')}")
+    if latest.get("ok"):
+        notes.append(f"{name}: multichip ok "
+                     f"(n_devices={latest.get('n_devices')})")
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail on regressions across the checked-in bench "
+                    "trajectory")
+    ap.add_argument("files", nargs="*",
+                    help="explicit artifact files (default: repo glob "
+                         "of BENCH_r*.json + MULTICHIP_r*.json)")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repo root to glob (default: this checkout)")
+    ap.add_argument("--threshold", type=float,
+                    default=DEFAULT_THRESHOLD,
+                    help="fractional regression that fails "
+                         f"(default {DEFAULT_THRESHOLD})")
+    args = ap.parse_args(argv)
+
+    if args.files:
+        files = args.files
+        for f in files:
+            if not os.path.exists(f):
+                print(f"missing file: {f}", file=sys.stderr)
+                return 1
+    else:
+        files = sorted(
+            glob.glob(os.path.join(args.dir, "BENCH_r*.json"))
+            + glob.glob(os.path.join(args.dir, "MULTICHIP_r*.json"))
+        )
+        if not files:
+            print(f"no BENCH_r*/MULTICHIP_r* files under {args.dir}",
+                  file=sys.stderr)
+            return 1
+    bench = [f for f in files
+             if "BENCH" in os.path.basename(f)
+             and "_interim" not in os.path.basename(f)]
+    multi = [f for f in files if "MULTICHIP" in os.path.basename(f)]
+
+    problems: list[str] = []
+    notes: list[str] = []
+    if bench:
+        check_bench(bench, args.threshold, problems, notes)
+    if multi:
+        check_multichip(multi, problems, notes)
+    for n in notes:
+        print(f"  {n}")
+    if problems:
+        print(f"\nREGRESSIONS ({len(problems)}):", file=sys.stderr)
+        for p in problems:
+            print(f"  {p}", file=sys.stderr)
+        return 2
+    print("trend: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

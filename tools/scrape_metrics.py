@@ -12,6 +12,13 @@ smoke runs::
     python tools/scrape_metrics.py <server_dir> --buckets  # + histogram
                                                            # bucket rows
 
+Also scrapes every process's ``/costs`` endpoint (the device-plane
+observability of :mod:`goworld_tpu.utils.devprof`) and prints one SLO
+verdict line per process under the metric table — p50/p90/p99 against
+the process's latency budget, plus any registered compiled-tick cost
+reports with ``--costs``. Processes predating the endpoint are
+skipped silently.
+
 Exit status: 0 if every target answered, 1 otherwise (a process with a
 configured http_port that cannot be scraped is a finding, not noise).
 """
@@ -19,6 +26,7 @@ configured http_port that cannot be scraped is a finding, not noise).
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 import urllib.error
@@ -123,6 +131,48 @@ def _cell(v: float | None) -> str:
     return str(int(v)) if float(v).is_integer() else f"{v:.3f}"
 
 
+# ----------------------------------------------------------------------
+# /costs: per-process SLO verdicts + cost reports (utils/devprof)
+# ----------------------------------------------------------------------
+def scrape_costs(targets: list[tuple[str, str]], timeout: float = 2.0,
+                 ) -> dict[str, dict]:
+    """Fetch each target's ``/costs`` (derived from its /metrics url);
+    {label: payload}. Unreachable processes or processes predating the
+    endpoint (404) are skipped — the metric scrape already reports
+    reachability."""
+    out: dict[str, dict] = {}
+    for label, url in targets:
+        costs_url = url.rsplit("/", 1)[0] + "/costs"
+        try:
+            with urllib.request.urlopen(costs_url,
+                                        timeout=timeout) as resp:
+                payload = json.loads(
+                    resp.read().decode("utf-8", "replace"))
+        except (urllib.error.URLError, OSError, ValueError):
+            continue
+        if isinstance(payload, dict) and "error" not in payload:
+            out[label] = payload
+    return out
+
+
+def slo_lines(costs: dict[str, dict]) -> list[str]:
+    """One human line per process: the SLO verdict (or its absence)."""
+    lines: list[str] = []
+    for label, payload in sorted(costs.items()):
+        slo = payload.get("slo")
+        if not isinstance(slo, dict):
+            lines.append(f"{label}: slo -(no latency histogram yet)")
+            continue
+        verdict = "PASS" if slo.get("pass") else "FAIL"
+        lines.append(
+            f"{label}: slo {verdict} p50={slo.get('p50_ms')} "
+            f"p90={slo.get('p90_ms')} p99={slo.get('p99_ms')} ms "
+            f"vs target {slo.get('target_ms')} ms "
+            f"({slo.get('samples', 0)} samples, "
+            f"{slo.get('source', '?')})")
+    return lines
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="scrape /metrics from every cluster process")
@@ -132,6 +182,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="scrape this /metrics url directly (repeatable)")
     ap.add_argument("--buckets", action="store_true",
                     help="include histogram bucket rows")
+    ap.add_argument("--costs", action="store_true",
+                    help="also dump each process's registered cost "
+                         "reports (/costs), not just the SLO verdict")
     ap.add_argument("--timeout", type=float, default=2.0)
     args = ap.parse_args(argv)
 
@@ -155,6 +208,19 @@ def main(argv: list[str] | None = None) -> int:
 
     results, errors = scrape_all(targets, timeout=args.timeout)
     print(merged_table(results, include_buckets=args.buckets))
+    # only re-probe processes the metric scrape already reached — a
+    # dead target would otherwise stall a second full timeout here
+    costs = scrape_costs([t for t in targets if t[0] in results],
+                         timeout=args.timeout)
+    if costs:
+        print()
+        for line in slo_lines(costs):
+            print(line)
+    if args.costs:
+        for label, payload in sorted(costs.items()):
+            for name, rep in (payload.get("reports") or {}).items():
+                print(f"{label}: cost {name}: "
+                      f"{json.dumps(rep, default=str)}")
     for e in errors:
         print(e, file=sys.stderr)
     return 1 if errors else 0
