@@ -44,6 +44,18 @@ BENCH_TPU_ATTEMPTS (default 2), BENCH_CHILD_TIMEOUT seconds (default
 BENCH_BACKHALF_AB=0 to skip the fused-vs-split back-half A/B record
 (BENCH_BACKHALF_AB_N shapes it; default the 131K per-chip shard).
 
+`--multichip` (ISSUE 10) runs the MESH headline instead: the megaspace
+tick (parallel/megaspace.py) under the real device mesh, driven by one
+on-device ``lax.scan`` (zero host syncs per tick), stamped in the
+MULTICHIP_r*.json shape — ``entity_ticks_per_sec_mesh``,
+``per_chip_efficiency`` vs the same-capacity 1-chip number, comms
+gauges, a hotspot-driven ``border_churn`` phase and the multichip
+roofline audit. Knobs: BENCH_MULTI_N (default 1M; capacity/chip x
+n_dev auto-derived), BENCH_MULTI_N_CPU (CPU fallback total, default
+65536 on BENCH_MULTI_FAKE_DEVICES=8 fake devices), BENCH_MULTI_TICKS,
+BENCH_HALO_IMPL (ppermute|async), BENCH_HALO_CAP, BENCH_MIGRATE_CAP,
+BENCH_CHURN_SCENARIO/BENCH_CHURN_SPEED.
+
 Device-plane observability (ISSUE 8): BENCH_DEVPROF=0 skips the
 compiled-tick CostReport + roofline_audit stamps (XLA cost_analysis vs
 the docs/ROOFLINE.md hand model, per phase); BENCH_SLO=0 skips the
@@ -196,6 +208,16 @@ if SCENARIOS_SEL.strip().lower() not in ("0", "none", "", "all"):
 SCENARIO_N = int(os.environ.get("BENCH_SCENARIO_N", 65536))
 SCENARIO_TICKS = int(os.environ.get("BENCH_SCENARIO_TICKS", 4))
 T = int(os.environ.get("BENCH_TICKS", 20))
+# --multichip knobs: the megaspace mesh bench (ISSUE 10). Total
+# entities target (capacity/chip x n_dev is auto-derived from it), the
+# reduced CPU-fallback shape (8 fake devices), scan length, halo impl
+# ("" = the MegaConfig default), and the border-churn scenario.
+MULTI_N = int(os.environ.get("BENCH_MULTI_N", 1_048_576))
+MULTI_N_CPU = int(os.environ.get("BENCH_MULTI_N_CPU", 65536))
+MULTI_TICKS = int(os.environ.get("BENCH_MULTI_TICKS", 8))
+MULTI_HALO_IMPL = os.environ.get("BENCH_HALO_IMPL", "")
+MULTI_CHURN = os.environ.get("BENCH_CHURN_SCENARIO", "hotspot")
+MULTI_FAKE_DEVICES = int(os.environ.get("BENCH_MULTI_FAKE_DEVICES", 8))
 CLIENT_FRAC = float(os.environ.get("BENCH_CLIENT_FRAC", 0.01))
 SMOKE_N = int(os.environ.get("BENCH_SMOKE_N", 8192))
 SMOKE_T = int(os.environ.get("BENCH_SMOKE_TICKS", 5))
@@ -244,7 +266,7 @@ def _grid_kw_from_env(n: int, overrides: dict | None = None) -> dict:
 
 
 def build(n: int, client_frac: float, grid_overrides: dict | None = None,
-          scenario=None):
+          scenario=None, force_behavior: str | None = None):
     import jax
     import jax.numpy as jnp
 
@@ -255,7 +277,13 @@ def build(n: int, client_frac: float, grid_overrides: dict | None = None,
     # ~12 avg Chebyshev neighbors at radius 50 (north-star AOI density)
     extent = float(int((n * 10000 / 12) ** 0.5))
     grid_kw = _grid_kw_from_env(n, grid_overrides)
-    if scenario is None:
+    if force_behavior is not None:
+        # caller pins the workload regardless of BENCH_BEHAVIOR (the
+        # multichip 1-chip reference must measure the SAME motion the
+        # mesh headline ran, or per_chip_efficiency compares apples
+        # to oranges)
+        behavior, scenario = force_behavior, None
+    elif scenario is None:
         # BENCH_BEHAVIOR may itself name a scenario (the headline then
         # measures that workload); an explicit scenario arg overrides
         # (the per-scenario block harness passes each registry spec)
@@ -548,12 +576,41 @@ def scenario_selection() -> list:
     return names
 
 
+def _marginal_full_tick_ms(mk, variant, ticks: int, aot_first: bool):
+    """The ONE 2x-minus-1x full-tick protocol shared by the scenario
+    blocks and the multichip mesh headline (per_chip_efficiency
+    divides one by the other, so they MUST measure identically):
+    compile + warm T- and 2T-tick scans, time each min-of-2 with a
+    DISTINCT anti-cache input per call, marginal per-tick = (2T - T)/T.
+    ``mk(length)`` returns a jitted scan of one state arg; ``variant(i)``
+    produces the distinct inputs. With ``aot_first`` the T-scan is
+    AOT-compiled and returned so the caller's devprof audit costs zero
+    extra compiles. Returns (per_tick_s, scale_2x, compiled_or_None)."""
+    import numpy as np
+
+    r1, r2 = mk(ticks), mk(2 * ticks)
+    r1c = r1.lower(variant(0)).compile() if aot_first else r1
+    float(np.asarray(r1c(variant(0))))       # compile + warm
+    float(np.asarray(r2(variant(1))))
+    es = []
+    for i in range(2):
+        t0 = time.perf_counter()
+        float(np.asarray(r1c(variant(2 + 2 * i))))
+        e1 = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        float(np.asarray(r2(variant(3 + 2 * i))))
+        e2 = time.perf_counter() - t0
+        es.append((e1, e2))
+    e1 = min(e[0] for e in es)
+    e2 = min(e[1] for e in es)
+    per_tick = max(e2 - e1, 1e-9) / ticks
+    return per_tick, e2 / max(e1, 1e-9), (r1c if aot_first else None)
+
+
 def _scenario_tick_ms(cfg, st, inputs, policy, ticks: int):
     """Scan-marginal full-tick timing for a scenario config — the same
     protocol as the headline (2x-minus-1x, min-of-2 repeats, distinct
     anti-cache inputs per timed call). Returns (per_tick_s, scale_2x)."""
-    import numpy as np
-
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -578,22 +635,9 @@ def _scenario_tick_ms(cfg, st, inputs, policy, ticks: int):
             pos=st.pos + jnp.float32(0.001 * (i + 1)),
         )
 
-    r1, r2 = mk(ticks), mk(2 * ticks)
-    float(np.asarray(r1(variant(0))))        # compile + warm
-    float(np.asarray(r2(variant(1))))
-    es = []
-    for i in range(2):
-        t0 = time.perf_counter()
-        float(np.asarray(r1(variant(2 + 2 * i))))
-        e1 = time.perf_counter() - t0
-        t0 = time.perf_counter()
-        float(np.asarray(r2(variant(3 + 2 * i))))
-        e2 = time.perf_counter() - t0
-        es.append((e1, e2))
-    e1 = min(e[0] for e in es)
-    e2 = min(e[1] for e in es)
-    per_tick = max(e2 - e1, 1e-9) / ticks
-    return per_tick, e2 / max(e1, 1e-9)
+    per_tick, scale, _ = _marginal_full_tick_ms(mk, variant, ticks,
+                                                aot_first=False)
+    return per_tick, scale
 
 
 def _scenario_gauges(cfg, st, inputs, policy, ticks: int) -> dict:
@@ -1275,6 +1319,526 @@ def measure_phases(cfg, st, inputs, ticks: int) -> tuple[dict, dict]:
     return out, costs
 
 
+# ---------------------------------------------------------- multichip ----
+
+def _mega_factor(n_dev: int) -> tuple[int, int]:
+    """Most-square (tx, tz) tiling of n_dev (the dryrun convention:
+    8 -> 4x2, 16 -> 4x4; primes fall back to 1D x-strips)."""
+    tz = max(d for d in range(1, int(n_dev ** 0.5) + 1)
+             if n_dev % d == 0)
+    return n_dev // tz, tz
+
+
+def build_mega(n_total: int, scenario=None, halo_impl: str | None = None,
+               grid_overrides: dict | None = None, seed: int = 0,
+               npc_speed: float = 5.0):
+    """The megaspace bench world: n_total entities tiled over EVERY
+    visible device at the headline density formula (~12 Chebyshev
+    neighbors at radius 50). Returns (mc, mesh, state, inputs, policy).
+
+    Capacity/chip is auto-derived (alive rows + 1/8 headroom for
+    migration imbalance); positions start uniform inside each tile's
+    owned rectangle so tick 0 needs no cross-tile migration storm.
+    The megaspace sweep is stateless (no Verlet cache to carry), so
+    the grid kw pins skin=0 whatever the env says."""
+    import jax
+    import jax.numpy as jnp
+
+    from goworld_tpu.core.state import SpaceState, WorldConfig
+    from goworld_tpu.core.step import TickInputs
+    from goworld_tpu.ops.aoi import GridSpec
+    from goworld_tpu.parallel.megaspace import MegaConfig, make_mega_tick
+    from goworld_tpu.parallel.mesh import make_mesh, shard_state
+    from goworld_tpu.parallel.step import MultiTickInputs
+
+    n_dev = len(jax.devices())
+    tx, tz = _mega_factor(n_dev)
+    alive_per = max(64, n_total // n_dev)
+    cap = alive_per + max(64, alive_per // 8)
+    radius = 50.0
+    extent = float(int((n_total * 10000 / 12) ** 0.5))
+    tile_w = extent / tx
+    tile_d = extent / tz if tz > 1 else 0.0
+    if radius > min(tile_w, tile_d if tz > 1 else tile_w):
+        raise ValueError(
+            f"tiles {tile_w:.0f}x{tile_d:.0f} thinner than AOI radius "
+            f"{radius} at n_total={n_total}, n_dev={n_dev}; raise "
+            "BENCH_MULTI_N or use fewer devices"
+        )
+    # worst-strip occupancy estimate x4 safety (hotspot churn piles
+    # entities onto borders), clamped to sane pow2-ish bounds
+    strip_frac = radius / min(tile_w, tile_d or tile_w)
+    halo_cap = int(os.environ.get(
+        "BENCH_HALO_CAP",
+        max(512, min(16384, 1 << int(4 * alive_per * strip_frac)
+                     .bit_length()))))
+    migrate_cap = int(os.environ.get("BENCH_MIGRATE_CAP", 256))
+    gk = _grid_kw_from_env(cap, {**(grid_overrides or {}),
+                                 "skin": 0.0, "verlet_cap": 0})
+    gk["row_block"] = min(cap, gk["row_block"])
+    cfg = WorldConfig(
+        capacity=cap,
+        grid=GridSpec(
+            radius=radius,
+            extent_x=tile_w + 2 * radius,
+            extent_z=(tile_d + 2 * radius) if tz > 1 else extent,
+            **gk,
+        ),
+        npc_speed=npc_speed,
+        behavior="random_walk",
+        scenario=scenario,
+        enter_cap=65536, leave_cap=65536,
+        sync_cap=65536, attr_sync_cap=4096, input_cap=4096,
+        delta_rows_cap=65536,
+    )
+    mc = MegaConfig(
+        cfg=cfg, n_dev=n_dev, tile_w=tile_w,
+        halo_cap=halo_cap, migrate_cap=migrate_cap,
+        mesh_shape=(tx, tz) if tz > 1 else None, tile_d=tile_d,
+        halo_impl=halo_impl or MULTI_HALO_IMPL or "ppermute",
+    )
+    mesh = make_mesh(n_dev)
+
+    key = jax.random.PRNGKey(seed)
+    k1, k2, k3, k4, k5, k6 = jax.random.split(key, 6)
+    # per-tile owned rectangles in GLOBAL coords
+    dix = (jnp.arange(n_dev, dtype=jnp.int32) // tz).astype(jnp.float32)
+    diz = (jnp.arange(n_dev, dtype=jnp.int32) % tz).astype(jnp.float32)
+    px = dix[:, None] * tile_w \
+        + jax.random.uniform(k1, (n_dev, cap), maxval=tile_w)
+    if tz > 1:
+        pz = diz[:, None] * tile_d \
+            + jax.random.uniform(k2, (n_dev, cap), maxval=tile_d)
+    else:
+        pz = jax.random.uniform(k2, (n_dev, cap), maxval=extent)
+    pos = jnp.stack([px, jnp.zeros_like(px), pz], axis=-1)
+    alive = jnp.arange(cap) < alive_per
+    alive = jnp.broadcast_to(alive, (n_dev, cap))
+    if scenario is not None:
+        bid = jnp.stack([
+            jnp.asarray(_sspec.assign_behavior_ids(scenario, cap,
+                                                   seed * n_dev + d))
+            for d in range(n_dev)
+        ])
+        wr = jnp.stack([
+            jnp.asarray(_sspec.assign_watch_radii(scenario, cap,
+                                                  seed * n_dev + d))
+            for d in range(n_dev)
+        ])
+    else:
+        bid = None
+        wr = jnp.full((n_dev, cap), jnp.inf, jnp.float32)
+    st = SpaceState(
+        pos=pos,
+        yaw=jnp.zeros((n_dev, cap)),
+        vel=jnp.zeros((n_dev, cap, 3)),
+        alive=alive,
+        npc_moving=alive,
+        has_client=(jax.random.uniform(k3, (n_dev, cap)) < CLIENT_FRAC)
+        & alive,
+        client_gate=jnp.zeros((n_dev, cap), jnp.int32),
+        type_id=jnp.zeros((n_dev, cap), jnp.int32),
+        gen=jnp.zeros((n_dev, cap), jnp.int32),
+        hot_attrs=jnp.zeros((n_dev, cap, 8)),
+        attr_dirty=jnp.zeros((n_dev, cap), jnp.uint32),
+        nbr=jnp.full((n_dev, cap, cfg.grid.k), mc.gid_sentinel,
+                     jnp.int32),
+        nbr_cnt=jnp.zeros((n_dev, cap), jnp.int32),
+        nbr_client_cnt=jnp.zeros((n_dev, cap), jnp.int32),
+        nbr_mean_off=jnp.zeros((n_dev, cap, 3), jnp.float32),
+        aoi_radius=wr,
+        dirty=jnp.zeros((n_dev, cap), bool),
+        rng=jax.vmap(jax.random.PRNGKey)(
+            jnp.arange(1, n_dev + 1) + seed * n_dev),
+        tick=jnp.zeros((n_dev,), jnp.int32),
+        aoi_cache=None,
+        behavior_id=bid,
+    )
+    st = shard_state(st, mesh)
+    # steady client-sync stream, like the single-chip headline — but
+    # TILE-LOCAL positions: a client correction lands near the entity,
+    # it does not teleport it across the world (a world-uniform stream
+    # here was measured turning every tick into a migration storm that
+    # overflowed arrival slots — that load case is the border_churn
+    # phase's job, driven by the scenario kernels, not the input path)
+    n_sync = min(cfg.input_cap, max(16, alive_per // 16))
+    sx = dix[:, None] * tile_w \
+        + jax.random.uniform(k4, (n_dev, n_sync), maxval=tile_w)
+    if tz > 1:
+        sz = diz[:, None] * tile_d \
+            + jax.random.uniform(k5, (n_dev, n_sync), maxval=tile_d)
+    else:
+        sz = jax.random.uniform(k5, (n_dev, n_sync), maxval=extent)
+    sync_vals = jnp.zeros((n_dev, cfg.input_cap, 4))
+    sync_vals = sync_vals.at[:, :n_sync, 0].set(sx)
+    sync_vals = sync_vals.at[:, :n_sync, 2].set(sz)
+    base = TickInputs(
+        pos_sync_idx=jax.random.randint(k6, (n_dev, cfg.input_cap),
+                                        0, alive_per),
+        pos_sync_vals=sync_vals,
+        pos_sync_n=jnp.full((n_dev,), n_sync, jnp.int32),
+    )
+    inputs = MultiTickInputs(
+        base=base,
+        migrate_target=jnp.full((n_dev, cap), -1, jnp.int32),
+        migrate_tag=jnp.full((n_dev, cap), -1, jnp.int32),
+    )
+    policy = None
+    if scenario is not None and scenario.needs_policy:
+        from goworld_tpu.models.npc_policy import init_policy
+
+        policy = init_policy(jax.random.PRNGKey(5))
+    return mc, mesh, st, inputs, policy
+
+
+def _mega_variant(st, i: int):
+    import jax
+    import jax.numpy as jnp
+
+    n_dev = st.pos.shape[0]
+    return st.replace(
+        rng=jax.vmap(jax.random.PRNGKey)(
+            jnp.arange(n_dev) + 1000 + 31 * i),
+        pos=st.pos + jnp.float32(0.001 * (i + 1)),
+    )
+
+
+def _mega_tick_ms(tick, st, inputs, policy, ticks: int):
+    """Scan-marginal mesh tick timing: the SHARED 2x-minus-1x protocol
+    (``_marginal_full_tick_ms`` — one harness with the single-chip
+    side, so per_chip_efficiency compares identical measurements),
+    driving the shard_map'd mega step through ``lax.scan`` with zero
+    host syncs per tick. Returns (per_tick_s, scale_2x, compiled_run —
+    AOT-compiled, so the devprof audit costs zero extra compiles)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def mk(length):
+        @jax.jit
+        def run(state):
+            def body(s, _):
+                s2, outs = tick(s, inputs, policy)
+                b = outs.base
+                chk = (b.enter_n.sum() + b.leave_n.sum()
+                       + b.sync_n.sum()).astype(jnp.float32) \
+                    + b.sync_vals.sum() \
+                    + outs.global_alive[0].astype(jnp.float32)
+                return s2, chk
+            st2, checks = lax.scan(body, state, None, length=length)
+            return checks.sum() + st2.pos.sum()
+        return run
+
+    return _marginal_full_tick_ms(
+        mk, lambda i: _mega_variant(st, i), ticks, aot_first=True)
+
+
+def _mega_gauges(tick, st, inputs, policy, ticks: int,
+                 base_ms: float) -> tuple[dict, dict]:
+    """One on-device scan over the mega tick accumulating (a) the
+    in-graph telemetry lanes (ops/telemetry.py mega set — zero host
+    syncs, one drain) and (b) scalar comms gauges: halo/migrate demand
+    maxima, dropped/migrated totals, mesh event volumes. Returns
+    (gauges, op_stats)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from goworld_tpu.ops import telemetry
+
+    @jax.jit
+    def run(state):
+        acc0 = telemetry.telemetry_init(False, mega=True)
+        g0 = (jnp.zeros((), jnp.int32),   # halo demand max
+              jnp.zeros((), jnp.int32),   # migrate demand max
+              jnp.zeros((), jnp.int32),   # migrate dropped total
+              jnp.zeros((), jnp.int32),   # arrivals (migrations) total
+              jnp.zeros((), jnp.int32),   # enter events total
+              jnp.zeros((), jnp.int32))   # leave events total
+
+        def body(carry, _):
+            s, acc, g = carry
+            s2, outs = tick(s, inputs, policy)
+            acc = telemetry.telemetry_update_mega(acc, outs, base_ms)
+            g = (jnp.maximum(g[0], outs.halo_demand.max()),
+                 jnp.maximum(g[1], outs.migrate_demand.max()),
+                 g[2] + outs.migrate_dropped.sum(),
+                 g[3] + outs.arr_n.sum(),
+                 g[4] + outs.base.enter_n.sum(),
+                 g[5] + outs.base.leave_n.sum())
+            return (s2, acc, g), 0
+        (s2, acc, g), _ = lax.scan(body, (state, acc0, g0), None,
+                                   length=ticks)
+        return acc, g
+
+    acc, g = run(_mega_variant(st, 9))
+    op_stats = telemetry.telemetry_drain(acc, False, mega=True)
+    gv = [int(np.asarray(x)) for x in g]
+    gauges = {
+        "halo_demand_max": gv[0],
+        "migrate_demand_max": gv[1],
+        "migrate_dropped_total": gv[2],
+        "migrated_total": gv[3],
+        "aoi_enter_events": gv[4],
+        "aoi_leave_events": gv[5],
+        "ticks": ticks,
+    }
+    return gauges, op_stats
+
+
+def measure_multichip(n_total: int, ticks: int) -> dict:
+    """The mesh headline (ISSUE 10): `entity_ticks_per_sec_mesh` from a
+    scan-driven megaspace tick across every visible device, with
+    per-chip efficiency vs the same-capacity 1-chip number, a
+    border_churn phase (hotspot drift forcing sustained tile
+    crossings), comms-demand gauges, and the device-plane stamps
+    (cost_report + multichip roofline_audit)."""
+    import jax
+
+    from goworld_tpu.parallel.megaspace import make_mega_tick
+    from goworld_tpu.utils import devprof
+
+    mc, mesh, st, inputs, policy = build_mega(n_total)
+    n_dev = mc.n_dev
+    alive_total = int(jax.numpy.asarray(st.alive).sum())
+    tick = make_mega_tick(mc, mesh)
+    per_tick, scale, run_compiled = _mega_tick_ms(
+        tick, st, inputs, policy, ticks)
+    value = alive_total / per_tick
+    grid_kw = _model_grid_kw(mc.cfg, mc.cfg.capacity)
+    mega_kw = {
+        "n_dev": n_dev, "halo_cap": mc.halo_cap,
+        "migrate_cap": mc.migrate_cap, "mesh_shape": mc.mesh_shape,
+        "halo_impl": mc.halo_impl, "dirty_frac": 1.0,
+    }
+    result: dict = {
+        "headline": {
+            "metric": "entity_ticks_per_sec_mesh",
+            "entity_ticks_per_sec_mesh": round(value, 1),
+            "per_chip": round(value / n_dev, 1),
+            "n_entities": alive_total,
+            "n_devices": n_dev,
+            "capacity_per_chip": mc.cfg.capacity,
+            "mesh_shape": list(mc.mesh_shape or (n_dev, 1)),
+            "tick_ms": round(1000.0 * per_tick, 3),
+            "ticks_timed": ticks,
+            "scale_2x": round(scale, 2),
+            "halo_impl": mc.halo_impl,
+            "halo_cap": mc.halo_cap,
+            "migrate_cap": mc.migrate_cap,
+            # resolved kernel stamps (headline convention; megaspace
+            # is statically skinless)
+            "sweep_impl": mc.cfg.grid.sweep_impl,
+            "topk_impl": mc.cfg.grid.topk_impl,
+            "sort_impl": mc.cfg.grid.sort_impl,
+            "skin": 0.0,
+            "platform": jax.devices()[0].platform,
+            "device": str(jax.devices()[0]),
+        },
+    }
+    if not (1.5 <= scale <= 3.0):
+        result["headline"]["timing_suspect"] = (
+            f"2x scan took {scale:.2f}x the 1x time"
+        )
+    # same-capacity 1-chip reference: the single-space tick at the
+    # per-chip alive count, same resolved kernels (skin pinned 0 to
+    # match the stateless mega sweep), same scan-marginal protocol
+    try:
+        ref_n = max(64, alive_total // n_dev)
+        rcfg, rst, rinputs = build(ref_n, CLIENT_FRAC, {"skin": 0.0},
+                                   force_behavior="random_walk")
+        ref_tick, ref_scale = _scenario_tick_ms(rcfg, rst, rinputs,
+                                                None, ticks)
+        ref_value = ref_n / ref_tick
+        result["headline"]["one_chip_value"] = round(ref_value, 1)
+        result["headline"]["one_chip_n"] = ref_n
+        result["headline"]["per_chip_efficiency"] = round(
+            (value / n_dev) / ref_value, 4)
+        if not (1.5 <= ref_scale <= 3.0):
+            result["headline"]["one_chip_timing_suspect"] = round(
+                ref_scale, 2)
+    except Exception as exc:
+        result["headline"]["per_chip_efficiency"] = None
+        result["headline"]["one_chip_error"] = str(exc)[:200]
+    log(f"multichip@{alive_total}x{n_dev}dev: "
+        f"{result['headline']['tick_ms']} ms/tick, "
+        f"mesh={value:.0f}, eff="
+        f"{result['headline'].get('per_chip_efficiency')}")
+
+    # comms gauges + telemetry lanes at rest (the headline workload)
+    try:
+        result["gauges"], result["op_stats"] = _mega_gauges(
+            tick, st, inputs, policy, max(ticks, 4),
+            result["headline"]["tick_ms"])
+    except Exception as exc:
+        result["gauges"] = {"error": str(exc)[:200]}
+        result["op_stats"] = {"error": str(exc)[:200]}
+
+    # border_churn phase: hotspot-style drift (scenarios/behaviors.py
+    # kernels — megaspace honors the scenario knob now) pulls the whole
+    # population toward an orbiting attractor, forcing sustained tile
+    # crossings, so all_to_all migration + ghost traffic are measured
+    # under load, not at rest
+    try:
+        churn_spec = get_scenario(MULTI_CHURN)
+        # drift speed raised (the dryrun's border-crossing speed, 5x
+        # the headline movers) so crossings SUSTAIN inside the
+        # measured window instead of needing thousands of ticks to
+        # reach a border — the phase exists to price comms under load
+        churn_speed = float(os.environ.get("BENCH_CHURN_SPEED", 25.0))
+        cmc, cmesh, cst, cin, cpol = build_mega(
+            n_total, scenario=churn_spec, npc_speed=churn_speed)
+        ctick = make_mega_tick(cmc, cmesh)
+        cper, cscale, _ = _mega_tick_ms(ctick, cst, cin, cpol, ticks)
+        churn: dict = {
+            "scenario": MULTI_CHURN,
+            "npc_speed": churn_speed,
+            "tick_ms": round(1000.0 * cper, 3),
+            "entity_ticks_per_sec_mesh": round(alive_total / cper, 1),
+            "scale_2x": round(cscale, 2),
+        }
+        cg, _cop = _mega_gauges(ctick, cst, cin, cpol, max(ticks, 16),
+                                churn["tick_ms"])
+        churn["gauges"] = cg
+        result["phases"] = {"border_churn": churn}
+        log(f"border_churn@{alive_total}: {churn['tick_ms']} ms/tick, "
+            f"migrated={cg.get('migrated_total')}, "
+            f"halo_max={cg.get('halo_demand_max')}")
+    except Exception as exc:
+        result["phases"] = {"border_churn": {"error": str(exc)[:200]}}
+
+    # device-plane stamps (PR 8 convention: real, or an honest error)
+    if os.environ.get("BENCH_DEVPROF", "1") == "1":
+        try:
+            cr = devprof.cost_report(
+                run_compiled, name="mega_tick_scan",
+                config={**devprof.grid_config_key(mc.cfg.grid),
+                        "halo_impl": mc.halo_impl},
+                n=alive_total, n_devices=n_dev,
+            )
+            result["cost_report"] = cr.as_dict()
+        except Exception as exc:
+            cr = None
+            result["cost_report"] = {"error": str(exc)[:200]}
+        try:
+            result["roofline_audit"] = devprof.roofline_audit_multichip(
+                result["headline"]["tick_ms"], cr, alive_total,
+                grid_kw, mega_kw,
+                platform=result["headline"]["platform"],
+            )
+        except Exception as exc:
+            result["roofline_audit"] = {"error": str(exc)[:200]}
+    else:
+        result["cost_report"] = {"skipped": "BENCH_DEVPROF=0"}
+        result["roofline_audit"] = {"skipped": "BENCH_DEVPROF=0"}
+    return result
+
+
+def multichip_child_main(args) -> int:
+    if os.environ.get("BENCH_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    res = measure_multichip(args.n, args.ticks)
+    res["stage"] = "multichip"
+    print(json.dumps(res), flush=True)
+    return 0
+
+
+def multichip_parent_main() -> int:
+    """--multichip orchestration: TPU attempts (relay-probed, like the
+    single-chip parent), then the CPU fallback on
+    BENCH_MULTI_FAKE_DEVICES fake devices at MULTI_N_CPU — the same
+    code path the tier-1 multichip marker runs. Emits ONE JSON line in
+    the MULTICHIP_r*.json artifact shape."""
+    attempts_log: list = []
+    child = None
+    fallback = False
+    # only attempt the full-N mesh run where a TPU can plausibly
+    # answer (the axon relay env, or an explicit tpu platform pin) —
+    # unlike the single-chip parent, a 1M-entity mesh scan on a bare
+    # CPU backend would grind past every timeout before the fallback
+    tpu_plausible = bool(os.environ.get("PALLAS_AXON_POOL_IPS")) \
+        or "tpu" in os.environ.get("JAX_PLATFORMS", "")
+    for i in range(TPU_ATTEMPTS if tpu_plausible else 0):
+        if not relay_up():
+            attempts_log.append({
+                "attempt": f"relay-probe-{i + 1}",
+                "error": "relay port 8082 refused/unreachable"})
+            break
+        stages, note = run_child(
+            {}, MULTI_N, CHILD_TIMEOUT,
+            extra_args=["--multichip"], ticks=MULTI_TICKS)
+        attempts_log.append({
+            "attempt": i + 1,
+            "stages": [s.get("stage") for s in stages],
+            "error": note or None})
+        for s in stages:
+            if s.get("stage") == "multichip":
+                child = s
+        if child is not None:
+            break
+    if child is None:
+        log(f"multichip CPU fallback at n={MULTI_N_CPU} on "
+            f"{MULTI_FAKE_DEVICES} fake devices")
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            flags = (f"{flags} --xla_force_host_platform_device_count="
+                     f"{MULTI_FAKE_DEVICES}").strip()
+        cpu_env = {
+            "BENCH_FORCE_CPU": "1",
+            "PALLAS_AXON_POOL_IPS": None,
+            "JAX_PLATFORMS": "cpu",
+            "XLA_FLAGS": flags,
+        }
+        stages, note = run_child(
+            cpu_env, MULTI_N_CPU, CHILD_TIMEOUT, uses_tpu=False,
+            extra_args=["--multichip"], ticks=MULTI_TICKS)
+        attempts_log.append({
+            "attempt": "cpu-fallback",
+            "stages": [s.get("stage") for s in stages],
+            "error": note or None})
+        for s in stages:
+            if s.get("stage") == "multichip":
+                child = s
+                fallback = True
+    artifact: dict = {
+        "n_devices": 0,
+        "rc": 0 if child is not None else 1,
+        "ok": False,
+        "skipped": False,
+        "tail": "",
+    }
+    if child is not None:
+        child.pop("stage", None)
+        hl = child.get("headline", {})
+        artifact["n_devices"] = hl.get("n_devices", 0)
+        artifact["ok"] = bool(hl.get("entity_ticks_per_sec_mesh", 0)
+                              and "timing_suspect" not in hl)
+        artifact["tail"] = (
+            f"multichip({hl.get('n_devices')}): "
+            f"{hl.get('entity_ticks_per_sec_mesh')} entity-ticks/s/mesh "
+            f"at {hl.get('n_entities')} entities "
+            f"({hl.get('tick_ms')} ms/tick, per_chip_efficiency="
+            f"{hl.get('per_chip_efficiency')}, "
+            f"halo_impl={hl.get('halo_impl')}, "
+            f"platform={hl.get('platform')})"
+        )
+        artifact.update(child)
+        if fallback and tpu_plausible:
+            # a TPU was plausible (relay env or platform pin) but every
+            # attempt failed — flag the degraded record like the
+            # single-chip parent does
+            artifact["fallback"] = "cpu"
+    else:
+        artifact["tail"] = "no multichip stage completed on any backend"
+    artifact["attempts"] = attempts_log
+    print(json.dumps(artifact), flush=True)
+    return 0 if child is not None else 1
+
+
 def child_main(args) -> int:
     """Staged measurement: smoke first, then full. One JSON line per stage
     on stdout; the parent harvests whatever stages completed."""
@@ -1439,7 +2003,9 @@ def child_main(args) -> int:
 
 def run_child(env_extra: dict, n: int, timeout: float,
               uses_tpu: bool = True, phases: bool | None = None,
-              live: list | None = None) -> tuple[list, str]:
+              live: list | None = None,
+              extra_args: list | None = None,
+              ticks: int | None = None) -> tuple[list, str]:
     """Run one child attempt; returns (parsed stage dicts, failure note).
 
     Child stdout is STREAMED (reader thread), not buffered until exit:
@@ -1459,9 +2025,10 @@ def run_child(env_extra: dict, n: int, timeout: float,
             env[k] = v
     cmd = [
         sys.executable, os.path.abspath(__file__), "--child",
-        "--n", str(n), "--ticks", str(T),
+        "--n", str(n), "--ticks", str(T if ticks is None else ticks),
         "--client-frac", str(CLIENT_FRAC),
     ]
+    cmd.extend(extra_args or [])
     if PHASES if phases is None else phases:
         cmd.append("--phases")
     log(f"spawn child: n={n} env+={env_extra} timeout={timeout:.0f}s")
@@ -2096,6 +2663,13 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--child", action="store_true")
     ap.add_argument("--selftest", action="store_true")
+    ap.add_argument(
+        "--multichip", action="store_true",
+        help="mesh headline: the scan-driven megaspace tick across "
+             "every visible device (entity_ticks_per_sec_mesh + "
+             "per_chip_efficiency + border_churn, stamped in the "
+             "MULTICHIP_r*.json shape; CPU fallback runs the same "
+             "code on fake devices at BENCH_MULTI_N_CPU)")
     ap.add_argument("--n", type=int, default=N)
     ap.add_argument("--ticks", type=int, default=T)
     ap.add_argument("--client-frac", type=float, default=CLIENT_FRAC)
@@ -2126,6 +2700,9 @@ def main() -> int:
             scenario_selection()  # unknown names fail fast, pre-spawn
         except KeyError as exc:
             raise SystemExit(f"--scenario: {exc.args[0]}")
+    if args.multichip:
+        return (multichip_child_main(args) if args.child
+                else multichip_parent_main())
     if args.child:
         return child_main(args)
     if args.selftest:

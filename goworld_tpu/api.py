@@ -259,13 +259,10 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
     if gc.scenario:
         from goworld_tpu.scenarios.spec import get_scenario
 
-        if gc.megaspace:
-            # the megaspace shard step keeps the homogeneous behavior
-            # path (gid neighbor lists can't feed the scenario feature
-            # gathers) — say so instead of failing at trace time
-            logger.warning("scenario ignored for megaspace games")
-        else:
-            scenario = get_scenario(gc.scenario)  # KeyError lists names
+        # honored by megaspace games too since the multichip bench PR:
+        # the tile step dispatches the same vmapped lax.switch with the
+        # phase schedule anchored to world bounds (parallel/megaspace)
+        scenario = get_scenario(gc.scenario)  # KeyError lists names
     wc = WorldConfig(
         capacity=gc.capacity,
         grid=grid,
@@ -299,6 +296,7 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
         mesh=mesh, game_id=gid,
         megaspace=gc.megaspace, mega_shape=mega_shape,
         halo_cap=gc.halo_cap, migrate_cap=gc.migrate_cap,
+        halo_impl=gc.halo_impl,
         pipeline_decode=gc.pipeline_decode and mesh is None
         and not gc.megaspace,
     )

@@ -174,6 +174,11 @@ class GameConfig:
     mega_shape: str = ""           # "" = 1D strips over mesh_devices
     halo_cap: int = 1024
     migrate_cap: int = 256
+    # halo ghost shipping impl (parallel/halo.py): "ppermute" (default,
+    # barriered collective) | "async" (Pallas make_async_remote_copy
+    # per edge, dirty-only packed payload — overlap-capable; off-TPU it
+    # runs interpret mode with a one-time warning, never a CPU default)
+    halo_impl: str = "ppermute"
 
 
 @dataclasses.dataclass
@@ -463,6 +468,11 @@ extent_z = 1000.0
 # scenario = hotspot # adversarial workload mix (goworld_tpu/scenarios
 #                    # registry; docs/SCENARIOS.md): hotspot | shrink |
 #                    # flock | teleport | mixed_radius | mixed
+#                    # (megaspace games honor it too — border churn)
+# halo_impl = ppermute # megaspace ghost shipping: ppermute (barriered
+#                    # collective) | async (Pallas per-edge remote DMA,
+#                    # dirty-only packed payload; interpret + warning
+#                    # off-TPU — never a CPU default)
 # pipeline_decode = true   # overlap host event decode with the device
 #                          # step (single-controller non-mesh games;
 #                          # client events lag one tick)

@@ -155,6 +155,7 @@ class World:
         migrate_cap: int = 256,
         megaspace: bool = False,
         halo_cap: int = 1024,
+        halo_impl: str = "ppermute",
         mega_shape: tuple[int, int] | None = None,
         pipeline_decode: bool = False,
     ):
@@ -213,6 +214,7 @@ class World:
                 cfg=cfg, n_dev=n_spaces, tile_w=tile_w,
                 halo_cap=halo_cap, migrate_cap=migrate_cap,
                 mesh_shape=mega_shape, tile_d=tile_d,
+                halo_impl=halo_impl,
             )
             self.state = shard_state(
                 create_mega_state(self.mega, seed=seed), mesh

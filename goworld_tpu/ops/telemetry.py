@@ -46,6 +46,7 @@ __all__ = [
     "TICK_MS_EDGES", "COUNT_EDGES", "SLACK_EDGES", "REBUILD_EDGES",
     "lane_edges", "telemetry_init", "telemetry_update",
     "telemetry_drain", "host_histogram", "TRACE_COUNTS",
+    "mega_signals", "telemetry_update_mega",
 ]
 
 # one ladder with the live metrics plane: a bench SLO and a serve-loop
@@ -61,30 +62,38 @@ REBUILD_EDGES = (0.0, 1.0)
 
 _COUNT_LANES = ("sync_n", "enter_n", "leave_n", "over_k_rows",
                 "over_cap_cells")
+# megaspace comms-demand lanes (per-tick MESH maxima/sums of the
+# MegaTickOutputs gauges — the halo/migrate capacity alarms as
+# device-resident distributions)
+_MEGA_LANES = ("halo_demand", "migrate_demand", "migrate_dropped")
 
 # per-trace-entry counters so tests can assert the telemetry scan
 # compiles ONCE per config (the scenarios/behaviors.py idiom)
 TRACE_COUNTS: dict = {}
 
 
-def lane_edges(skin_on: bool) -> dict[str, tuple]:
+def lane_edges(skin_on: bool, mega: bool = False) -> dict[str, tuple]:
     """Static bucket edges per lane for a config (lane set depends only
-    on whether the Verlet skin is live)."""
+    on whether the Verlet skin is live, plus the megaspace comms lanes
+    when ``mega``)."""
     lanes = {"tick_ms": TICK_MS_EDGES, "rebuilt": REBUILD_EDGES}
     for nm in _COUNT_LANES:
         lanes[nm] = COUNT_EDGES
     if skin_on:
         lanes["skin_slack"] = SLACK_EDGES
+    if mega:
+        for nm in _MEGA_LANES:
+            lanes[nm] = COUNT_EDGES
     return lanes
 
 
-def telemetry_init(skin_on: bool):
+def telemetry_init(skin_on: bool, mega: bool = False):
     """Zeroed accumulator pytree: one int32 count vector per lane
     (len(edges)+1, last = +Inf) plus the tick_ms running sum."""
     import jax.numpy as jnp
 
     acc = {nm: jnp.zeros(len(e) + 1, jnp.int32)
-           for nm, e in lane_edges(skin_on).items()}
+           for nm, e in lane_edges(skin_on, mega).items()}
     acc["tick_ms_sum"] = jnp.zeros((), jnp.float32)
     return acc
 
@@ -140,14 +149,54 @@ def telemetry_update(acc, out, base_ms: float, delta_ms: float,
     return acc
 
 
-def telemetry_drain(acc, skin_on: bool, half_skin: float = 0.0) -> dict:
+def mega_signals(mouts):
+    """Reduce one tick's :class:`MegaTickOutputs` (leading [n_dev]
+    leaves inside the jitted scan) to the scalar per-MESH signals the
+    lanes histogram: event volumes SUM across shards (they are mesh
+    totals), saturation/demand gauges take the mesh MAX (one hot tile
+    is the alarm condition)."""
+    import types
+
+    import jax.numpy as jnp
+
+    b = mouts.base
+    return types.SimpleNamespace(
+        sync_n=b.sync_n.sum(),
+        enter_n=b.enter_n.sum(),
+        leave_n=b.leave_n.sum(),
+        aoi_over_k_rows=b.aoi_over_k_rows.max(),
+        aoi_over_cap_cells=b.aoi_over_cap_cells.max(),
+        aoi_rebuilt=jnp.ones((), jnp.int32),  # megaspace is skinless
+        aoi_skin_slack=None,
+        halo_demand=mouts.halo_demand.max(),
+        migrate_demand=mouts.migrate_demand.max(),
+        migrate_dropped=mouts.migrate_dropped.sum(),
+    )
+
+
+def telemetry_update_mega(acc, mouts, base_ms: float):
+    """Fold one megaspace tick's outputs into the accumulator: the
+    shared lanes ride :func:`telemetry_update` on the mesh-reduced
+    signals; the comms lanes (halo/migrate demand, dropped arrivals)
+    bucket on the count ladder. On-device like telemetry_update —
+    the multichip bench asserts zero host syncs across the scan."""
+    sig = mega_signals(mouts)
+    acc = telemetry_update(acc, sig, base_ms, 0.0)
+    for nm in _MEGA_LANES:
+        acc[nm] = _bucket_add(acc[nm], COUNT_EDGES,
+                              getattr(sig, nm).astype("float32"))
+    return acc
+
+
+def telemetry_drain(acc, skin_on: bool, half_skin: float = 0.0,
+                    mega: bool = False) -> dict:
     """ONE host readback for the whole scan: fetched lane counts as
     ``{lane: {"edges": [...], "counts": [...]}}`` plus the tick_ms
     mean. ``half_skin`` documents the skin_slack lane's unit (its
     edges are fractions of skin/2)."""
     fetched = {k: np.asarray(v) for k, v in acc.items()}
     out: dict = {}
-    for nm, edges in lane_edges(skin_on).items():
+    for nm, edges in lane_edges(skin_on, mega).items():
         out[nm] = {
             "edges": [float(e) for e in edges],
             "counts": [int(c) for c in fetched[nm]],

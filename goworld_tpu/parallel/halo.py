@@ -12,6 +12,31 @@ while each shard computes locally.
 Ghost buffers are fixed capacity ``halo_cap``; entities in a boundary strip
 beyond the cap are dropped from the neighbor's view that tick (the AOI-limit
 tradeoff again — size halo_cap for the worst expected strip density).
+
+Two shipping impls (``halo_impl`` knob on :class:`MegaConfig`):
+
+* ``"ppermute"`` (default): one ``lax.ppermute`` per payload lane per
+  direction. Collectives are barriered — every device enters the
+  exchange together, so the halo serializes against the whole tick.
+* ``"async"``: the Pallas ``make_async_remote_copy`` pattern
+  (SNIPPETS.md [2] / the jax distributed-Pallas guide). Each device
+  DMAs ONE packed i32 strip buffer straight into its neighbor's
+  receive buffer — no mesh-wide barrier, only a sender/receiver
+  semaphore pair per edge, so the copy can overlap every part of the
+  tick that does not consume ghosts (behavior, integrate, the migrate
+  pack: the ghost block's only consumer is the AOI window gather).
+  The packed payload is dirty-only: pos (12 B) + one meta word
+  (gid/dirty/valid bits, 4 B) always ship, and the yaw lane (4 B) is
+  zero unless the row is dirty — 16 B + 4 B·dirty versus the 22 B/row
+  of the 5-lane ppermute path in the modeled ICI budget
+  (``devprof.roofline_model_bytes_multichip``). Off-TPU the kernel
+  runs in interpret mode behind
+  :func:`goworld_tpu.ops.pallas_compat.interpret_default` (loud
+  one-time warning, never a CPU default).
+
+Both impls are bit-identical: same ghost blocks, same demand gauges
+(tests/test_halo_async.py holds them exact across dirty/visible
+permutations and halo_cap overflow).
 """
 
 from __future__ import annotations
@@ -21,6 +46,106 @@ import jax.numpy as jnp
 from jax import lax
 
 from goworld_tpu.ops.extract import bounded_extract
+
+HALO_IMPLS = ("ppermute", "async")
+
+# packed meta word: (gid + 1) << 2 | dirty << 1 | valid. gid ∈ [-1,
+# gid_sentinel], so the +1 shift keeps it non-negative and the pack is
+# exact while gid_sentinel + 1 < 2^29 (1M/chip × 64 chips = 2^26 —
+# plenty; megaspace.py guards the bound at build time).
+_META_GID_BITS = 29
+
+
+def meta_gid_bound() -> int:
+    """Largest gid the async meta word can carry exactly."""
+    return (1 << _META_GID_BITS) - 2
+
+
+def _pack_strip(gpos, gyaw, gdirty, gvalid, ggid) -> jax.Array:
+    """One i32[H, 5] buffer per strip: cols 0-2 pos bits, col 3 yaw
+    bits, col 4 meta. f32 -> i32 is a bitcast (exact roundtrip); the
+    meta word packs gid/dirty/valid."""
+    meta = ((ggid + 1) << 2) \
+        | (gdirty.astype(jnp.int32) << 1) \
+        | gvalid.astype(jnp.int32)
+    return jnp.concatenate([
+        lax.bitcast_convert_type(gpos, jnp.int32),
+        lax.bitcast_convert_type(gyaw, jnp.int32)[:, None],
+        meta[:, None],
+    ], axis=1)
+
+
+def _unpack_strip(buf: jax.Array):
+    pos = lax.bitcast_convert_type(buf[:, 0:3], jnp.float32)
+    yaw = lax.bitcast_convert_type(buf[:, 3], jnp.float32)
+    meta = buf[:, 4]
+    return (
+        pos,
+        yaw,
+        ((meta >> 1) & 1).astype(bool),
+        (meta & 1).astype(bool),
+        (meta >> 2) - 1,
+    )
+
+
+def _async_ship(axis: str, n_dev: int, shift: int, buf: jax.Array,
+                recv_ok) -> jax.Array:
+    """DMA ``buf`` to device ``(d + shift) % n_dev`` with one Pallas
+    ``make_async_remote_copy`` per device — the SNIPPETS.md [2] ring
+    pattern. The ring wraps so no device conditionally skips its send
+    (conditional DMAs deadlock interpret mode); non-participating
+    receivers (``recv_ok`` False — world-edge tiles) zero their block
+    instead, reproducing ``ppermute``'s fill exactly."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    from goworld_tpu.ops.pallas_compat import interpret_default
+
+    def kernel(in_ref, out_ref, send_sem, recv_sem):
+        my_id = lax.axis_index(axis)
+        dst = lax.rem(my_id + shift + n_dev, n_dev)
+        op = pltpu.make_async_remote_copy(
+            src_ref=in_ref, dst_ref=out_ref,
+            send_sem=send_sem, recv_sem=recv_sem,
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        op.start()
+        op.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=0,
+        in_specs=[pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        scratch_shapes=[pltpu.SemaphoreType.DMA] * 2,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(buf.shape, buf.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret_default("halo_async"),
+    )(buf)
+    return jnp.where(recv_ok, out, 0)
+
+
+def _ship(axis: str, n_dev: int, shift: int, perm, pack, recv_ok,
+          impl: str):
+    """Ship one packed strip tuple ``(pos, yaw, dirty, valid, gid)``
+    ``shift`` devices along the flat axis. ``perm`` is the explicit
+    non-periodic (src, dst) list the ppermute impl uses; the async impl
+    rides the periodic ring (every device sends — conditional DMAs
+    would deadlock interpret mode) and non-participating receivers
+    (``recv_ok`` False — world-edge tiles) zero their block instead,
+    reproducing ``ppermute``'s fill exactly."""
+    if impl == "async":
+        buf = _pack_strip(*pack)
+        return _unpack_strip(_async_ship(axis, n_dev, shift, buf,
+                                         recv_ok))
+    if impl != "ppermute":
+        raise ValueError(
+            f"halo_impl {impl!r} not in {HALO_IMPLS}"
+        )
+    return jax.tree.map(lambda t: lax.ppermute(t, axis, perm), pack)
 
 
 def exchange_halo(
@@ -33,6 +158,7 @@ def exchange_halo(
     tile_w: float,
     radius: float,
     halo_cap: int,
+    impl: str = "ppermute",
 ):
     """Ship boundary strips to lateral neighbor tiles.
 
@@ -42,6 +168,11 @@ def exchange_halo(
     plus ``strip_demand`` i32: the true occupancy of this shard's fuller
     boundary strip (host alarm when it exceeds halo_cap — ghosts beyond the
     cap were invisible to the neighbor tile this tick).
+
+    The yaw lane ships dirty-gated (zero for clean rows) under BOTH
+    impls: sync collection only ever reads the yaw of dirty subjects,
+    so the ghost outputs are consumer-invariant and the two impls stay
+    bit-identical.
     """
     n = pos.shape[0]
     d = lax.axis_index(axis)
@@ -51,10 +182,11 @@ def exchange_halo(
     def pack(mask):
         flat, valid, demand = bounded_extract(mask, halo_cap)
         slots = jnp.where(valid, flat, n - 1)
+        sel_dirty = dirty[slots] & valid
         return (
             jnp.where(valid[:, None], pos[slots], 0.0),
-            jnp.where(valid, yaw[slots], 0.0),
-            dirty[slots] & valid,
+            jnp.where(sel_dirty, yaw[slots], 0.0),
+            sel_dirty,
             valid,
             jnp.where(valid, d * n + slots, -1),
         ), demand
@@ -73,18 +205,20 @@ def exchange_halo(
     # Non-periodic: edge tiles receive zeros (gvalid False).
     to_left = [(i, i - 1) for i in range(1, n_dev)]
     to_right = [(i, i + 1) for i in range(n_dev - 1)]
-    from_right = jax.tree.map(
-        lambda t: lax.ppermute(t, axis, to_left), left_pack
-    )
-    from_left = jax.tree.map(
-        lambda t: lax.ppermute(t, axis, to_right), right_pack
-    )
+    from_right = _ship(axis, n_dev, -1, to_left, left_pack,
+                       d < n_dev - 1, impl)
+    from_left = _ship(axis, n_dev, +1, to_right, right_pack, d > 0,
+                      impl)
 
     gpos = jnp.concatenate([from_left[0], from_right[0]])
     gyaw = jnp.concatenate([from_left[1], from_right[1]])
     gdirty = jnp.concatenate([from_left[2], from_right[2]])
     gvalid = jnp.concatenate([from_left[3], from_right[3]])
     ggid = jnp.concatenate([from_left[4], from_right[4]])
+    # normalize invalid rows' gid to 0 (ppermute edge fill / async
+    # zero block / packed -1 all collapse): consumers gate on gvalid,
+    # and one canonical fill keeps the impls bit-identical
+    ggid = jnp.where(gvalid, ggid, 0)
     return gpos, gyaw, gdirty, gvalid, ggid, strip_demand
 
 
@@ -100,6 +234,7 @@ def exchange_halo_2d(
     tile_d: float,            # z tile depth
     radius: float,
     halo_cap: int,
+    impl: str = "ppermute",
 ):
     """Two-phase 8-neighbor halo for 2D (XZ) tiling.
 
@@ -116,7 +251,8 @@ def exchange_halo_2d(
 
     Returns (gpos[4H,3], gyaw[4H], gdirty[4H], gvalid[4H], ggid[4H],
     strip_demand) — strip_demand is the max true occupancy over this
-    shard's inward-facing strips (alarm when > halo_cap).
+    shard's inward-facing strips (alarm when > halo_cap). The yaw lane
+    ships dirty-gated like the 1D exchange.
     """
     tx, tz = shape
     n = pos.shape[0]
@@ -133,10 +269,11 @@ def exchange_halo_2d(
         m = src_pos.shape[0]
         flat, valid, demand = bounded_extract(mask, halo_cap)
         slots = jnp.where(valid, flat, m - 1)
+        sel_dirty = src_dirty[slots] & valid
         return (
             jnp.where(valid[:, None], src_pos[slots], 0.0),
-            jnp.where(valid, src_yaw[slots], 0.0),
-            src_dirty[slots] & valid,
+            jnp.where(sel_dirty, src_yaw[slots], 0.0),
+            sel_dirty,
             valid,
             jnp.where(valid, src_gid[slots], -1),
         ), demand
@@ -152,12 +289,10 @@ def exchange_halo_2d(
     n_dev = tx * tz
     to_west = [(i, i - tz) for i in range(n_dev) if i // tz > 0]
     to_east = [(i, i + tz) for i in range(n_dev) if i // tz < tx - 1]
-    from_east = jax.tree.map(
-        lambda t: lax.ppermute(t, axis, to_west), west_pack
-    )
-    from_west = jax.tree.map(
-        lambda t: lax.ppermute(t, axis, to_east), east_pack
-    )
+    from_east = _ship(axis, n_dev, -tz, to_west, west_pack,
+                      ix < tx - 1, impl)
+    from_west = _ship(axis, n_dev, +tz, to_east, east_pack, ix > 0,
+                      impl)
 
     # ---- phase 2: z strips of local + phase-1 ghosts ------------------
     cpos = jnp.concatenate([pos, from_west[0], from_east[0]])
@@ -175,12 +310,10 @@ def exchange_halo_2d(
     )
     to_north = [(i, i - 1) for i in range(n_dev) if i % tz > 0]
     to_south = [(i, i + 1) for i in range(n_dev) if i % tz < tz - 1]
-    from_south = jax.tree.map(
-        lambda t: lax.ppermute(t, axis, to_north), north_pack
-    )
-    from_north = jax.tree.map(
-        lambda t: lax.ppermute(t, axis, to_south), south_pack
-    )
+    from_south = _ship(axis, n_dev, -1, to_north, north_pack,
+                       iz < tz - 1, impl)
+    from_north = _ship(axis, n_dev, +1, to_south, south_pack, iz > 0,
+                       impl)
 
     gpos = jnp.concatenate(
         [from_west[0], from_east[0], from_north[0], from_south[0]]
@@ -197,6 +330,7 @@ def exchange_halo_2d(
     ggid = jnp.concatenate(
         [from_west[4], from_east[4], from_north[4], from_south[4]]
     )
+    ggid = jnp.where(gvalid, ggid, 0)
     # inward-facing strips only: world-edge outward strips never ship
     strip_demand = jnp.max(jnp.stack([
         jnp.where(ix > 0, west_dem, 0),

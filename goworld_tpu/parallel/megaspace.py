@@ -34,9 +34,15 @@ from goworld_tpu.ops.delta import interest_pairs
 from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
 from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
 from goworld_tpu.parallel import migrate as mig
-from goworld_tpu.parallel.halo import exchange_halo, exchange_halo_2d
-from goworld_tpu.parallel.mesh import SPACE_AXIS
+from goworld_tpu.parallel.halo import (
+    HALO_IMPLS,
+    exchange_halo,
+    exchange_halo_2d,
+    meta_gid_bound,
+)
+from goworld_tpu.parallel.mesh import SPACE_AXIS, shard_map_norep
 from goworld_tpu.parallel.step import MultiTickInputs
+from goworld_tpu.scenarios.behaviors import scenario_velocity
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,9 +67,39 @@ class MegaConfig:
     migrate_cap: int = 256
     mesh_shape: tuple[int, int] | None = None  # (tx, tz); None = (n_dev, 1)
     tile_d: float = 0.0                        # z tile depth (2D only)
+    # halo shipping impl (parallel/halo.py): "ppermute" (barriered
+    # collective, the default) or "async" (Pallas make_async_remote_copy
+    # per edge with a dirty-only packed payload — overlap-capable;
+    # interpret mode + one-time warning off-TPU, never a CPU default)
+    halo_impl: str = "ppermute"
 
     def __post_init__(self):
         g = self.cfg.grid
+        if self.cfg.scenario is not None \
+                and "btree" in self.cfg.scenario.behavior_names:
+            # the tile step feeds the switch from summary feature lanes
+            # (mean offset / client count); the btree chase branch also
+            # needs the NEAREST-CLIENT offset, which those lanes don't
+            # carry — monsters would silently freeze instead of chasing.
+            # Refuse at build time rather than diverge from the
+            # single-chip semantics.
+            raise ValueError(
+                "megaspace scenarios cannot include the 'btree' mix "
+                "member: the tile step's summary features carry no "
+                "nearest-client offset (pick a non-btree mix, or run "
+                "cfg.behavior='btree' homogeneous)"
+            )
+        if self.halo_impl not in HALO_IMPLS:
+            raise ValueError(
+                f"halo_impl {self.halo_impl!r} not in {HALO_IMPLS}"
+            )
+        if self.halo_impl == "async" \
+                and self.n_dev * self.cfg.capacity > meta_gid_bound():
+            raise ValueError(
+                "halo_impl='async' packs gids into a 29-bit meta lane; "
+                f"n_dev * capacity = {self.n_dev * self.cfg.capacity} "
+                f"exceeds {meta_gid_bound()} — use halo_impl='ppermute'"
+            )
         expected = self.tile_w + 2.0 * g.radius
         if abs(g.extent_x - expected) > 1e-6:
             raise ValueError(
@@ -206,14 +242,39 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
         # observation instead reads state.nbr_cnt/nbr_mean_off — neighbor
         # features computed over local+ghost positions by the PREVIOUS
         # tick's AOI sweep (step 5 below)
-        vel = compute_velocity(
-            cfg, k_behave, pos, yaw, state, policy,
-            (mc.world_x, mc.world_z), nbr=None, nbr_cnt=None,
-        )
+        tele = None
+        if cfg.scenario is not None:
+            # heterogeneous scenario mix (goworld_tpu/scenarios): the
+            # same vmapped lax.switch as tick_body, with the phase
+            # schedule anchored to WORLD bounds (the tile grid's
+            # extents are tile-local) and the neighbor features read
+            # from the summary lanes the previous tick's sweep left
+            # behind — gid neighbor lists can't feed the per-slot
+            # feature gathers. This is how the multichip bench's
+            # border_churn phase drives sustained tile crossings.
+            vel, tele_pos, tele = scenario_velocity(
+                cfg, k_behave, pos, yaw, state, policy,
+                bounds=(0.0, 0.0, mc.world_x, mc.world_z),
+                features=(
+                    state.nbr_mean_off,
+                    state.nbr_client_cnt.astype(jnp.float32),
+                    jnp.zeros_like(state.nbr_mean_off),
+                ),
+            )
+        else:
+            vel = compute_velocity(
+                cfg, k_behave, pos, yaw, state, policy,
+                (mc.world_x, mc.world_z), nbr=None, nbr_cnt=None,
+            )
         pos, moved = integrate(
             pos, vel, state.npc_moving, cfg.dt,
             (0.0, -1e9, 0.0), (mc.world_x, 1e9, mc.world_z),
         )
+        if tele is not None:
+            # teleports override the integrated position BEFORE tile
+            # targeting, so a cross-tile jump migrates on this tick
+            pos = jnp.where(tele[:, None], tele_pos, pos)
+            moved = moved | tele
         state = state.replace(pos=pos, yaw=yaw, vel=vel, rng=rng)
         pre_dirty = (moved | touched | state.dirty) & state.alive
 
@@ -253,11 +314,12 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
                 exchange_halo_2d(
                     SPACE_AXIS, (tx, tz), n, state.pos, state.yaw, dirty,
                     visible, mc.tile_w, mc.tile_d, radius, mc.halo_cap,
+                    impl=mc.halo_impl,
                 )
         else:
             gpos, gyaw, gdirty, gvalid, ggid, halo_demand = exchange_halo(
                 SPACE_AXIS, n_dev, state.pos, state.yaw, dirty, visible,
-                mc.tile_w, radius, mc.halo_cap,
+                mc.tile_w, radius, mc.halo_cap, impl=mc.halo_impl,
             )
 
         # 4. AOI over the extended local+ghost population, in tile-shifted
@@ -300,7 +362,11 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
         #    translation below the positions are no longer addressable),
         #    then translate to stable GLOBAL ids and diff.
         p_ext = n + ghost_rows
-        if cfg.behavior in ("mlp", "btree"):  # static at trace time
+        wants_features = (
+            cfg.behavior in ("mlp", "btree")
+            if cfg.scenario is None else cfg.scenario.needs_features
+        )
+        if wants_features:  # static at trace time
             mean_off = neighbor_mean_offset(
                 pos_ext, state.pos, nbr_ext, nbr_cnt, p_ext
             )
@@ -375,7 +441,9 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
         outputs = jax.tree.map(lambda x: x[None], outputs)
         return state, outputs
 
-    mapped = jax.shard_map(
+    # norep: pallas_call (the async halo) has no replication rule; the
+    # static rep check adds nothing here — every output is sharded
+    mapped = shard_map_norep(
         shard_fn,
         mesh=mesh,
         in_specs=(P(SPACE_AXIS), P(SPACE_AXIS), P()),

@@ -27,7 +27,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from goworld_tpu.core.state import SpaceState, WorldConfig
 from goworld_tpu.core.step import TickInputs, TickOutputs, tick_body
 from goworld_tpu.parallel import migrate as mig
-from goworld_tpu.parallel.mesh import SPACE_AXIS
+from goworld_tpu.parallel.mesh import SPACE_AXIS, shard_map
 
 
 @struct.dataclass
@@ -111,7 +111,7 @@ def make_multi_tick(cfg: WorldConfig, mesh: Mesh, migrate_cap: int = 256):
         outputs = jax.tree.map(lambda x: x[None], outputs)
         return state, outputs
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         shard_fn,
         mesh=mesh,
         in_specs=(P(SPACE_AXIS), P(SPACE_AXIS), P()),

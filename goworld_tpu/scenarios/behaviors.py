@@ -54,22 +54,25 @@ def _vel3(vx, vz):
     return jnp.stack([vx, jnp.zeros_like(vx), vz])
 
 
-def scenario_context(spec: ScenarioSpec, cfg, t: jax.Array) -> dict:
+def scenario_context(spec: ScenarioSpec, cfg, t: jax.Array,
+                     bounds: tuple | None = None) -> dict:
     """Scalar phase state for tick ``t`` (traced i32): attractor
     position, shrink-zone radius, wind heading. All closed-form in t so
-    the scan carries nothing extra."""
-    g = cfg.grid
+    the scan carries nothing extra. ``bounds`` = (origin_x, origin_z,
+    extent_x, extent_z) overrides the grid extents — the megaspace
+    passes WORLD bounds because its grid describes one tile."""
+    ox, oz, ex_, ez_ = _bounds(cfg, bounds)
     tf = t.astype(jnp.float32)
     two_pi = 2.0 * jnp.pi
-    cx = g.origin_x + 0.5 * g.extent_x
-    cz = g.origin_z + 0.5 * g.extent_z
+    cx = ox + 0.5 * ex_
+    cz = oz + 0.5 * ez_
     # hotspot attractor: an ellipse inset by attractor_margin, one loop
     # per attractor_period ticks
     ph = two_pi * tf / float(spec.attractor_period)
-    ax = cx + (0.5 - spec.attractor_margin) * g.extent_x * jnp.cos(ph)
-    az = cz + (0.5 - spec.attractor_margin) * g.extent_z * jnp.sin(ph)
+    ax = cx + (0.5 - spec.attractor_margin) * ex_ * jnp.cos(ph)
+    az = cz + (0.5 - spec.attractor_margin) * ez_ * jnp.sin(ph)
     # battle-royale zone: linear shrink to shrink_min_frac, then hold
-    half = 0.5 * float(min(g.extent_x, g.extent_z))
+    half = 0.5 * float(min(ex_, ez_))
     prog = jnp.minimum(tf / float(spec.shrink_over), 1.0)
     zone_r = half * (1.0 - (1.0 - spec.shrink_min_frac) * prog)
     # flock wind: slowly rotating global heading
@@ -90,6 +93,17 @@ def scenario_context(spec: ScenarioSpec, cfg, t: jax.Array) -> dict:
 # client_off f32[3]. ``ctx`` (closed over per branch, NOT vmapped) adds
 # the scalar phase state + static knobs.
 
+def _bounds(cfg, bounds: tuple | None) -> tuple:
+    """(origin_x, origin_z, extent_x, extent_z) the kernels steer
+    within: the grid's by default, caller-supplied WORLD bounds in the
+    megaspace (whose grid describes one tile, not the world)."""
+    if bounds is not None:
+        return tuple(float(v) for v in bounds)
+    g = cfg.grid
+    return (float(g.origin_x), float(g.origin_z),
+            float(g.extent_x), float(g.extent_z))
+
+
 def _no_teleport(pos):
     return pos, jnp.zeros((), bool)
 
@@ -108,19 +122,21 @@ def _walk_vel(key, ent, speed: float, turn_prob: float):
 
 
 def make_kernel(name: str, spec: ScenarioSpec, cfg, ctx: dict,
-                policy):
+                policy, bounds: tuple | None = None):
     """Build the per-entity kernel for one mix member. Static params
     come from the spec/cfg closure (no per-entity parameter lanes
-    needed); traced scalars come from ``ctx``."""
+    needed); traced scalars come from ``ctx``. ``bounds`` overrides
+    the grid extents (megaspace: world bounds)."""
     speed = float(cfg.npc_speed)
     turn_prob = float(cfg.turn_prob)
     dt = float(cfg.dt)
     g = cfg.grid
+    b_ox, b_oz, b_ex, b_ez = _bounds(cfg, bounds)
     # teleports land strictly inside the world so the border clamp can
     # never move a fresh teleport (which would shrink its displacement)
-    lo_x, lo_z = g.origin_x + 1e-3, g.origin_z + 1e-3
-    hi_x = g.origin_x + g.extent_x - 1e-3
-    hi_z = g.origin_z + g.extent_z - 1e-3
+    lo_x, lo_z = b_ox + 1e-3, b_oz + 1e-3
+    hi_x = b_ox + b_ex - 1e-3
+    hi_z = b_oz + b_ez - 1e-3
 
     if name == "random_walk":
         def k_random_walk(key, ent, _ctx=ctx):
@@ -227,7 +243,7 @@ def make_kernel(name: str, spec: ScenarioSpec, cfg, ctx: dict,
                 "scenario mix includes 'mlp' but no MLPPolicy was "
                 "passed to the tick (spec.needs_policy)"
             )
-        ex, ez = float(g.extent_x), float(g.extent_z)
+        ex, ez = b_ex, b_ez
         kk = float(g.k)
 
         def k_mlp(key, ent, _ctx=ctx):
@@ -283,6 +299,8 @@ def scenario_velocity(
     yaw: jax.Array,
     state,
     policy,
+    bounds: tuple | None = None,
+    features: tuple | None = None,
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """The heterogeneous-population step: returns ``(vel f32[N,3],
     teleport_pos f32[N,3], teleport bool[N])`` for
@@ -290,7 +308,16 @@ def scenario_velocity(
 
     One ``jax.vmap(lax.switch)`` over the per-entity
     ``state.behavior_id`` lane; member kernels come from
-    :func:`make_kernel` in the spec's mix order."""
+    :func:`make_kernel` in the spec's mix order.
+
+    ``bounds`` = (origin_x, origin_z, extent_x, extent_z) overrides the
+    grid extents for the phase schedule and teleport targets;
+    ``features`` = (mean_off f32[N,3], client_cnt f32[N], client_off
+    f32[N,3]) supplies precomputed neighbor features instead of the
+    slot-list gather. The megaspace step passes both: its grid
+    describes one tile and its neighbor lists hold global gids, so it
+    anchors the schedule to WORLD bounds and feeds the summary lanes
+    its previous tick's sweep left behind."""
     spec: ScenarioSpec = cfg.scenario
     if state.behavior_id is None:
         raise ValueError(
@@ -299,11 +326,13 @@ def scenario_velocity(
         )
     n = pos.shape[0]
     names = spec.behavior_names
-    ctx = scenario_context(spec, cfg, state.tick)
+    ctx = scenario_context(spec, cfg, state.tick, bounds)
 
-    want_feats = any(b in ("flock", "btree", "mlp") for b in names)
+    want_feats = spec.needs_features
     want_client = "btree" in names
-    if want_feats:
+    if features is not None:
+        mean_off, client_cnt, client_off = features
+    elif want_feats:
         mean_off, client_cnt, client_off = _neighbor_features(
             pos, state.has_client, state.nbr, state.nbr_cnt, want_client
         )
@@ -323,7 +352,7 @@ def scenario_velocity(
         client_off=client_off,
     )
     branches = tuple(
-        make_kernel(b, spec, cfg, ctx, policy) for b in names
+        make_kernel(b, spec, cfg, ctx, policy, bounds) for b in names
     )
     bid = jnp.clip(state.behavior_id, 0, len(branches) - 1)
     keys = jax.random.split(key, n)

@@ -184,6 +184,14 @@ class ScenarioSpec:
         return "mlp" in self.behavior_names
 
     @property
+    def needs_features(self) -> bool:
+        """True when any mix member reads neighbor features (mean
+        offset / client lanes) — the megaspace step uses this to keep
+        computing its summary features for the next tick."""
+        return any(b in ("flock", "btree", "mlp")
+                   for b in self.behavior_names)
+
+    @property
     def uniform_radius(self) -> bool:
         return self.radius_mix == ((_INF, 1.0),)
 

@@ -41,6 +41,8 @@ from typing import Any, Callable
 __all__ = [
     "CostReport", "cost_report", "grid_config_key",
     "roofline_model_bytes", "roofline_audit", "V5E_HBM_GBPS",
+    "roofline_model_bytes_multichip", "roofline_audit_multichip",
+    "V5E_ICI_GBPS", "HALO_ROW_BYTES",
     "hist_quantile", "slo_from_histogram",
     "register_report", "register_provider", "record_slo", "snapshot",
     "set_slo_target", "reset",
@@ -48,6 +50,19 @@ __all__ = [
 
 # public v5e figure the ROOFLINE.md model is priced against
 V5E_HBM_GBPS = 819.0
+
+# public v5e ICI figure: ~400 GB/s aggregate inter-chip bandwidth per
+# chip (4 links x ~100 GB/s each way) — the multichip halo/migrate
+# terms are priced against it (docs/ROOFLINE.md "Multichip")
+V5E_ICI_GBPS = 400.0
+
+# modeled halo payload bytes per ghost row by halo_impl
+# (parallel/halo.py): the 5-lane ppermute path ships pos f32[3] +
+# yaw f32 + dirty/valid bools + gid i32 = 22 B; the async packed path
+# ships pos + one meta word always (16 B) and its yaw lane is zero
+# unless the row is dirty, so the model charges it at dirty duty
+HALO_ROW_BYTES = {"ppermute": 22.0, "async": 16.0}
+HALO_ASYNC_YAW_BYTES = 4.0
 
 # the paper's AOI-sync latency target (BASELINE.md: p99 < 16 ms at the
 # 1M/60 Hz headline shape) — the default SLO budget everywhere
@@ -79,6 +94,9 @@ class CostReport:
     peak_hbm_bytes: int | None = None
     generated_code_size: int | None = None
     n: int | None = None
+    # multichip mode: device count of the mesh executable (cost figures
+    # then cover the WHOLE mesh — divide by n_devices for per-chip)
+    n_devices: int | None = None
     platform: str | None = None
     config: dict | None = None
     error: str | None = None
@@ -110,7 +128,8 @@ def grid_config_key(grid) -> dict:
 
 
 def cost_report(fn, *args, name: str = "tick", config: dict | None = None,
-                n: int | None = None) -> CostReport:
+                n: int | None = None,
+                n_devices: int | None = None) -> CostReport:
     """Lower + compile ``fn(*args)`` and emit its :class:`CostReport`.
 
     ``fn`` may be an ALREADY-COMPILED executable (has
@@ -121,7 +140,7 @@ def cost_report(fn, *args, name: str = "tick", config: dict | None = None,
     must never kill a measurement run."""
     import jax
 
-    rep = CostReport(name=name, config=config, n=n)
+    rep = CostReport(name=name, config=config, n=n, n_devices=n_devices)
     try:
         rep.platform = jax.devices()[0].platform
         if hasattr(fn, "cost_analysis"):
@@ -294,6 +313,109 @@ def roofline_audit(phase_ms: dict, phase_costs: dict, n: int,
             (tot_xla - tot_model) / tot_model * 100.0, 1)
     elif xla_covered:
         out["xla_coverage_partial"] = sorted(xla_covered)
+    return out
+
+
+def roofline_model_bytes_multichip(n_per_chip: int, grid_kw: dict,
+                                   mega_kw: dict) -> dict[str, float]:
+    """The multichip hand model: PER-CHIP HBM bytes/tick of the tile
+    step plus the ICI halo/migrate terms (docs/ROOFLINE.md
+    "Multichip"). ``mega_kw`` needs n_dev, halo_cap, migrate_cap;
+    optional mesh_shape (default 1D strips), halo_impl (default
+    "ppermute"), dirty_frac (fraction of ghost rows shipping a live
+    yaw word — the async packed payload's dirty-only lane; default
+    1.0, the conservative all-dirty bound) and hot_attrs (default 8).
+
+    Keys: the single-chip phase terms at the EXTENDED population
+    (local + ghost rows all ride the sweep), plus ``ici_halo`` and
+    ``ici_migrate`` — bytes SHIPPED per chip per tick over ICI."""
+    n_dev = int(mega_kw["n_dev"])
+    halo_cap = int(mega_kw["halo_cap"])
+    migrate_cap = int(mega_kw["migrate_cap"])
+    shape = mega_kw.get("mesh_shape") or (n_dev, 1)
+    halo_impl = mega_kw.get("halo_impl", "ppermute")
+    dirty_frac = float(mega_kw.get("dirty_frac", 1.0))
+    attrs = int(mega_kw.get("hot_attrs", 8))
+    if halo_impl not in HALO_ROW_BYTES:
+        raise ValueError(f"unknown halo_impl {halo_impl!r}")
+
+    # the AOI terms price the extended local+ghost population
+    strips = 4 if shape[1] > 1 else 2
+    ghost_rows = strips * halo_cap
+    out = roofline_model_bytes(n_per_chip + ghost_rows, grid_kw)
+    # ICI halo: every inward-facing strip ships halo_cap rows each way
+    row_b = HALO_ROW_BYTES[halo_impl]
+    if halo_impl == "async":
+        row_b = row_b + HALO_ASYNC_YAW_BYTES * dirty_frac
+    out["ici_halo"] = float(strips * halo_cap) * row_b
+    # ICI migrate: the all_to_all ships [n_dev, cap] rows of
+    # (8 + attrs) f32 + 6 i32 each, both directions ~= one buffer out
+    out["ici_migrate"] = float(n_dev * migrate_cap) \
+        * ((8.0 + attrs) * 4.0 + 24.0)
+    return out
+
+
+def roofline_audit_multichip(tick_ms: float | None, cost, n_total: int,
+                             grid_kw: dict, mega_kw: dict,
+                             platform: str | None = None) -> dict:
+    """The MULTICHIP artifact's ``roofline_audit`` block: per-chip
+    modeled HBM phases + ICI halo/migrate terms (priced against the
+    v5e ICI figure), diffed against XLA's accounting of the compiled
+    mesh scan where available. Same shape contract as
+    :func:`roofline_audit` (a ``phases`` dict of ``model_mb`` rows) so
+    tools/bench_schema.py validates both with one rule. Also stamps
+    the dirty-only packing delta: modeled ICI halo bytes under each
+    halo_impl at the same dirty fraction, so the async win is visible
+    in the artifact."""
+    n_dev = int(mega_kw["n_dev"])
+    n_per_chip = max(1, n_total // n_dev)
+    model = roofline_model_bytes_multichip(n_per_chip, grid_kw, mega_kw)
+    phases: dict[str, dict] = {}
+    hbm_total = 0.0
+    for name, mbytes in model.items():
+        row: dict[str, Any] = {"model_mb": round(mbytes / 1e6, 3)}
+        if name.startswith("ici_"):
+            row["model_ms_v5e_ici"] = round(
+                mbytes / (V5E_ICI_GBPS * 1e6), 4)
+        else:
+            row["model_ms_v5e"] = round(
+                mbytes / (V5E_HBM_GBPS * 1e6), 4)
+            if name in ("aoi", "move", "collect"):
+                hbm_total += mbytes
+        phases[name] = row
+    out = {
+        "doc": "docs/ROOFLINE.md#multichip",
+        "mode": "multichip",
+        "n": n_total,
+        "n_devices": n_dev,
+        "n_per_chip": n_per_chip,
+        "bandwidth_gbps": V5E_HBM_GBPS,
+        "ici_gbps": V5E_ICI_GBPS,
+        "platform": platform,
+        "phases": phases,
+        "total_model_mb_per_chip": round(hbm_total / 1e6, 3),
+    }
+    if tick_ms is not None:
+        out["measured_tick_ms"] = tick_ms
+    if cost is not None:
+        crd = cost.as_dict() if isinstance(cost, CostReport) else cost
+        if crd.get("bytes_accessed") is not None:
+            # whole-mesh bytes: divide by n_dev for the per-chip view
+            out["xla_mb_mesh"] = round(crd["bytes_accessed"] / 1e6, 3)
+            out["xla_mb_per_chip"] = round(
+                crd["bytes_accessed"] / n_dev / 1e6, 3)
+        if crd.get("error"):
+            out["cost_error"] = crd["error"]
+    # the dirty-only packing delta, made visible: ICI halo bytes under
+    # both impls at this config's dirty fraction
+    deltas = {}
+    for impl in HALO_ROW_BYTES:
+        mk = dict(mega_kw)
+        mk["halo_impl"] = impl
+        deltas[impl] = round(
+            roofline_model_bytes_multichip(
+                n_per_chip, grid_kw, mk)["ici_halo"] / 1e6, 3)
+    out["ici_halo_mb_by_impl"] = deltas
     return out
 
 

@@ -65,6 +65,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "multichip: megaspace mesh suites (the scan-driven multichip "
+        "bench path, halo_impl async/ppermute parity, mesh "
+        "schema/trend gates — tests/test_multichip_bench.py, "
+        "test_halo_async.py); tier-1 on 8 fake CPU devices at small N "
+        "— the marker selects exactly the mesh set before/after a "
+        "relay window",
+    )
+    config.addinivalue_line(
+        "markers",
         "devprof: device-plane observability suites (XLA cost auditor, "
         "in-graph telemetry lanes, roofline audit, bench trend/schema "
         "gates — tests/test_devprof.py, test_bench_trend.py, "

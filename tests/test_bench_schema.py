@@ -158,6 +158,57 @@ def test_multichip_invariants(tmp_path):
     assert any("tail" in e for e in errs)
 
 
+def _multi_rec(**extra):
+    """A valid r>=10 MULTICHIP record (the measured-mesh contract)."""
+    rec = {
+        "n_devices": 8, "rc": 0, "ok": True, "skipped": False,
+        "tail": "multichip(8): ...",
+        "headline": {
+            "entity_ticks_per_sec_mesh": 159907.2,
+            "per_chip_efficiency": 0.19,
+            "n_entities": 65536, "platform": "cpu",
+        },
+        "gauges": {"halo_demand_max": 252, "migrate_demand_max": 2,
+                   "migrate_dropped_total": 0},
+        "cost_report": {"name": "mega_tick_scan"},
+        "roofline_audit": {"phases": {"ici_halo": {"model_mb": 0.1}}},
+        "phases": {"border_churn": {"tick_ms": 905.0}},
+    }
+    rec.update(extra)
+    return rec
+
+
+def test_multichip_r10_contract(tmp_path):
+    assert _validate(tmp_path, "MULTICHIP_r10.json", _multi_rec()) == []
+    # old dryrun-only records stay grandfathered below r10
+    old = {"n_devices": 8, "rc": 0, "ok": True, "tail": ""}
+    assert _validate(tmp_path, "MULTICHIP_r09.json", old) == []
+    # ... but r10+ requires the measured blocks
+    errs = _validate(tmp_path, "MULTICHIP_r10.json", old)
+    assert any("headline" in e for e in errs)
+    assert any("border_churn" in e for e in errs)
+    # missing headline keys caught
+    rec = _multi_rec()
+    del rec["headline"]["per_chip_efficiency"]
+    errs = _validate(tmp_path, "MULTICHIP_r10.json", rec)
+    assert any("per_chip_efficiency" in e for e in errs)
+    # honest error blocks accepted for the device-plane stamps
+    rec = _multi_rec(cost_report={"error": "boom"},
+                     roofline_audit={"error": "boom"})
+    assert _validate(tmp_path, "MULTICHIP_r10.json", rec) == []
+    # ok with no mesh number is a lie
+    rec = _multi_rec()
+    rec["headline"]["entity_ticks_per_sec_mesh"] = 0
+    errs = _validate(tmp_path, "MULTICHIP_r10.json", rec)
+    assert any("no mesh number" in e for e in errs)
+    # failed rounds and skips stay exempt
+    failed = {"n_devices": 8, "rc": 2, "ok": False, "tail": "died"}
+    assert _validate(tmp_path, "MULTICHIP_r11.json", failed) == []
+    skipped = {"n_devices": 8, "rc": 0, "ok": True, "skipped": True,
+               "tail": ""}
+    assert _validate(tmp_path, "MULTICHIP_r11.json", skipped) == []
+
+
 def test_unreadable_file_reported(tmp_path):
     p = tmp_path / "BENCH_r08.json"
     p.write_text("{not json")

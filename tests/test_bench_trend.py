@@ -156,3 +156,62 @@ def test_threshold_knob(tmp_path):
     f2 = _write(tmp_path, "BENCH_r02.json", _bench_rec(850.0))
     assert TREND.main([f1, f2]) == 0              # within default 30%
     assert TREND.main(["--threshold", "0.1", f1, f2]) == 2
+
+
+def _multi_rec(value, eff=0.8, n=65536, n_dev=8, platform="cpu",
+               **extra):
+    rec = {
+        "n_devices": n_dev, "rc": 0, "ok": True, "skipped": False,
+        "tail": "",
+        "headline": {
+            "entity_ticks_per_sec_mesh": value,
+            "per_chip_efficiency": eff,
+            "n_entities": n, "platform": platform, "n_devices": n_dev,
+        },
+    }
+    rec.update(extra)
+    return rec
+
+
+def test_multichip_headline_regression_fails(tmp_path):
+    f1 = _write(tmp_path, "MULTICHIP_r10.json", _multi_rec(100000.0))
+    f2 = _write(tmp_path, "MULTICHIP_r11.json", _multi_rec(60000.0))
+    assert TREND.main([f1, f2]) == 2
+    f2b = _write(tmp_path, "MULTICHIP_r12.json", _multi_rec(95000.0))
+    assert TREND.main([f1, f2b]) == 0
+
+
+def test_multichip_efficiency_drop_fails(tmp_path):
+    """A mesh that keeps throughput but burns per-chip efficiency
+    (>30% drop) regresses even with the headline flat."""
+    f1 = _write(tmp_path, "MULTICHIP_r10.json",
+                _multi_rec(100000.0, eff=0.8))
+    f2 = _write(tmp_path, "MULTICHIP_r11.json",
+                _multi_rec(100000.0, eff=0.5))
+    assert TREND.main([f1, f2]) == 2
+    f2b = _write(tmp_path, "MULTICHIP_r12.json",
+                 _multi_rec(100000.0, eff=0.7))
+    assert TREND.main([f1, f2b]) == 0
+
+
+def test_multichip_shape_change_not_compared(tmp_path):
+    """A different (entities, platform, n_devices) shape is a new
+    baseline, not a regression."""
+    f1 = _write(tmp_path, "MULTICHIP_r10.json",
+                _multi_rec(100000.0, n=65536))
+    f2 = _write(tmp_path, "MULTICHIP_r11.json",
+                _multi_rec(20000.0, n=8192))
+    assert TREND.main([f1, f2]) == 0
+    f3 = _write(tmp_path, "MULTICHIP_r12.json",
+                _multi_rec(20000.0, n_dev=16))
+    assert TREND.main([f1, f3]) == 0
+
+
+def test_multichip_dryrun_rounds_not_headline_gated(tmp_path):
+    """Pre-r10 dryrun-only records neither gate nor anchor the mesh
+    headline; the ok/rc invariants still apply."""
+    f1 = _write(tmp_path, "MULTICHIP_r05.json",
+                {"n_devices": 8, "rc": 0, "ok": True, "tail": "",
+                 "skipped": False})
+    f2 = _write(tmp_path, "MULTICHIP_r10.json", _multi_rec(100.0))
+    assert TREND.main([f1, f2]) == 0

@@ -1,0 +1,218 @@
+"""Tier-1 gate for the scan-driven multichip bench path (ISSUE 10):
+`bench.py --multichip` machinery on the 8 fake CPU devices the test env
+arms, at small N — headline keys present, oracle-exact interest sets
+after the scan (the dryrun's per-type Chebyshev oracle over the raw
+stacked state), and zero host syncs across the scan body
+(``jax.transfer_guard("disallow")``).
+"""
+
+import importlib.util
+import os
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_spec = importlib.util.spec_from_file_location(
+    "bench", os.path.join(REPO, "bench.py"))
+BENCH = importlib.util.module_from_spec(_spec)
+sys.modules.setdefault("bench", BENCH)
+_spec.loader.exec_module(BENCH)
+
+from goworld_tpu.parallel.megaspace import make_mega_tick  # noqa: E402
+from goworld_tpu.scenarios.spec import get_scenario  # noqa: E402
+
+pytestmark = pytest.mark.multichip
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    for var in list(BENCH.GRID_ENV.values()) + [
+            "BENCH_HALO_CAP", "BENCH_MIGRATE_CAP", "BENCH_HALO_IMPL"]:
+        monkeypatch.delenv(var, raising=False)
+
+
+def _scan_states(mc, tick, st, inputs, policy, ticks: int):
+    """Drive the mega tick through one jitted lax.scan (the bench's
+    shape) and return (final_state, last_outputs)."""
+
+    @jax.jit
+    def run(state):
+        def body(s, _):
+            s2, outs = tick(s, inputs, policy)
+            return s2, outs
+        st2, outs = lax.scan(body, state, None, length=ticks)
+        return st2, jax.tree.map(lambda x: x[-1], outs)
+    return run
+
+
+def test_mega_scan_oracle_exact_and_zero_sync():
+    """After a scan of mega ticks, every alive entity's interest set
+    matches the per-type (per-watch-radius) brute-force Chebyshev
+    oracle — with the exactness preconditions (no over_k/over_cap, no
+    halo overflow, no dropped migrants) asserted, the scenarios-runner
+    contract. The scan itself runs under transfer_guard("disallow")."""
+    spec = get_scenario("mixed_radius")  # heterogeneous watch radii
+    mc, mesh, st, inputs, policy = BENCH.build_mega(512, scenario=spec)
+    tick = make_mega_tick(mc, mesh)
+    run = _scan_states(mc, tick, st, inputs, policy, 4)
+    st_dev = jax.device_put(st)
+    run(st_dev)  # trace + compile outside the guard
+    with jax.transfer_guard("disallow"):
+        st2, outs = run(jax.tree.map(lambda x: x, st_dev))
+
+    # exactness preconditions (a degraded config can never "pass")
+    b = outs.base
+    assert int(np.asarray(b.aoi_over_k_rows).max()) == 0
+    assert int(np.asarray(b.aoi_over_cap_cells).max()) == 0
+    assert int(np.asarray(outs.halo_demand).max()) <= mc.halo_cap
+    assert int(np.asarray(outs.migrate_dropped).sum()) == 0
+
+    n_dev, cap = np.asarray(st2.alive).shape
+    alive = np.asarray(st2.alive)
+    pos = np.asarray(st2.pos)
+    wr = np.asarray(st2.aoi_radius)
+    nbr = np.asarray(st2.nbr)
+    gsent = mc.gid_sentinel
+    radius = mc.cfg.grid.radius
+
+    gids, xy, wrs = [], [], []
+    for d in range(n_dev):
+        for s in range(cap):
+            if alive[d, s]:
+                gids.append(d * cap + s)
+                xy.append((pos[d, s, 0], pos[d, s, 2]))
+                wrs.append(wr[d, s])
+    xy = np.asarray(xy, np.float32)
+    wrs = np.asarray(wrs, np.float32)
+    gids = np.asarray(gids)
+    assert len(gids) >= 256
+
+    checked = 0
+    for i, g in enumerate(gids):
+        if wrs[i] <= 0:
+            continue
+        d = np.maximum(np.abs(xy[:, 0] - xy[i, 0]),
+                       np.abs(xy[:, 1] - xy[i, 1]))
+        reach = min(wrs[i], radius)
+        want = {int(gids[j]) for j in np.nonzero(
+            (d <= reach) & (wrs > 0))[0] if gids[j] != g}
+        got = {int(v) for v in nbr[g // cap, g % cap] if v != gsent}
+        assert got == want, (
+            f"gid {g}: {len(got)} vs {len(want)} oracle neighbors"
+        )
+        checked += 1
+    assert checked >= 256
+
+
+def test_measure_multichip_headline_keys(monkeypatch):
+    """The full measure_multichip path at tiny N: headline block keys,
+    comms gauges, border_churn phase, device-plane stamps — the
+    MULTICHIP_r10 artifact contract, produced by the real code."""
+    monkeypatch.setenv("BENCH_CHURN_SPEED", "40")
+    res = BENCH.measure_multichip(1024, 2)
+    hl = res["headline"]
+    for k in ("entity_ticks_per_sec_mesh", "per_chip_efficiency",
+              "n_entities", "n_devices", "platform", "tick_ms",
+              "scale_2x", "halo_impl", "halo_cap", "migrate_cap",
+              "sweep_impl", "topk_impl", "sort_impl", "skin"):
+        assert k in hl, f"headline missing {k}"
+    assert hl["entity_ticks_per_sec_mesh"] > 0
+    assert hl["n_devices"] == len(jax.devices())
+    assert hl["n_entities"] > 0
+    g = res["gauges"]
+    for k in ("halo_demand_max", "migrate_demand_max",
+              "migrate_dropped_total", "migrated_total"):
+        assert k in g, f"gauges missing {k}"
+    churn = res["phases"]["border_churn"]
+    assert "error" not in churn, churn
+    assert churn["scenario"]
+    assert churn["gauges"]["migrated_total"] > 0, (
+        "border_churn phase forced no tile crossings"
+    )
+    # telemetry lanes incl. the mega comms set, drained once
+    ost = res["op_stats"]
+    for lane in ("tick_ms", "halo_demand", "migrate_demand",
+                 "migrate_dropped"):
+        assert lane in ost and "counts" in ost[lane]
+    # device-plane stamps: real or honest error records
+    assert isinstance(res["cost_report"], dict)
+    assert isinstance(res["roofline_audit"], dict)
+    if "error" not in res["roofline_audit"]:
+        ph = res["roofline_audit"]["phases"]
+        assert "ici_halo" in ph and "ici_migrate" in ph
+        assert res["roofline_audit"]["mode"] == "multichip"
+
+
+def test_mega_async_matches_ppermute_through_tick():
+    """End-to-end: a mega scan with halo_impl=async produces the SAME
+    final neighbor lists and event counts as ppermute (the halo parity
+    holds through the whole tick pipeline)."""
+    finals = {}
+    for impl in ("ppermute", "async"):
+        mc, mesh, st, inputs, policy = BENCH.build_mega(
+            512, halo_impl=impl)
+        tick = make_mega_tick(mc, mesh)
+        run = _scan_states(mc, tick, st, inputs, policy, 3)
+        st2, outs = run(st)
+        finals[impl] = (np.asarray(st2.nbr), np.asarray(st2.pos),
+                        np.asarray(outs.base.enter_n),
+                        np.asarray(outs.base.sync_n))
+    for a, b in zip(finals["ppermute"], finals["async"]):
+        assert np.array_equal(a, b)
+
+
+def test_mega_rejects_btree_scenario_mix():
+    """A scenario mix with the btree member is refused at build time:
+    the tile step's summary features carry no nearest-client offset,
+    so the chase branch would silently freeze instead of chasing."""
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.ops.aoi import GridSpec
+    from goworld_tpu.parallel.megaspace import MegaConfig
+    from goworld_tpu.scenarios.spec import ScenarioSpec
+
+    spec = ScenarioSpec(name="chasey",
+                        mix=(("btree", 0.5), ("random_walk", 0.5)))
+    cfg = WorldConfig(
+        capacity=64,
+        grid=GridSpec(radius=10.0, extent_x=120.0, extent_z=100.0,
+                      k=8, cell_cap=16, row_block=64),
+        scenario=spec,
+    )
+    with pytest.raises(ValueError, match="btree"):
+        MegaConfig(cfg=cfg, n_dev=8, tile_w=100.0)
+
+
+def test_roofline_multichip_dirty_only_packing():
+    """The async packed payload models FEWER ICI halo bytes than the
+    5-lane ppermute path, and the dirty fraction scales the yaw lane
+    (the acceptance criterion's modeled-bytes delta)."""
+    from goworld_tpu.utils import devprof
+
+    gk = dict(k=32, cell_cap=12, radius=50.0, extent_x=2000.0,
+              extent_z=2000.0, sort_impl="argsort",
+              sweep_impl="ranges", skin=0.0)
+    base = dict(n_dev=8, halo_cap=1024, migrate_cap=256,
+                mesh_shape=(4, 2))
+    pp = devprof.roofline_model_bytes_multichip(
+        65536, gk, {**base, "halo_impl": "ppermute"})
+    asy = devprof.roofline_model_bytes_multichip(
+        65536, gk, {**base, "halo_impl": "async", "dirty_frac": 1.0})
+    asy_clean = devprof.roofline_model_bytes_multichip(
+        65536, gk, {**base, "halo_impl": "async", "dirty_frac": 0.1})
+    assert asy["ici_halo"] < pp["ici_halo"]
+    assert asy_clean["ici_halo"] < asy["ici_halo"]
+    assert pp["ici_migrate"] == asy["ici_migrate"]
+    # the audit stamps the by-impl delta
+    audit = devprof.roofline_audit_multichip(
+        1.0, None, 524288, gk, {**base, "halo_impl": "async"})
+    d = audit["ici_halo_mb_by_impl"]
+    assert d["async"] < d["ppermute"]
+    assert audit["mode"] == "multichip"
+    assert "ici_halo" in audit["phases"]

@@ -22,7 +22,14 @@ contract):
   ``{"error": ...}`` record is an accepted honest failure, a
   ``{"skipped": ...}`` record a documented deliberate skip —
   BENCH_DEVPROF=0/BENCH_SLO=0/BENCH_PHASES=0);
-* MULTICHIP files: n_devices/rc/ok/tail, with ok => rc == 0.
+* MULTICHIP files: n_devices/rc/ok/tail, with ok => rc == 0;
+* MULTICHIP rounds >= 10 (the measured-mesh era, bench.py --multichip):
+  a ``headline`` block ({entity_ticks_per_sec_mesh,
+  per_chip_efficiency, n_entities, platform}), ``gauges``,
+  ``cost_report``/``roofline_audit`` (``{"error": ...}`` accepted as
+  honest failure) and a ``phases.border_churn`` block; failed rounds
+  (rc != 0) and ``skipped`` records stay exempt, old dryrun-only
+  artifacts are grandfathered.
 
 Exit codes: 0 all valid, 1 usage/missing, 2 schema violations.
 """
@@ -53,6 +60,14 @@ SLO_KEYS = ("target_ms", "p50_ms", "p90_ms", "p99_ms", "pass",
 # landed in the r5 SESSION, so the first artifact carrying them is r6)
 KERNEL_STAMPS_SINCE = 6
 DEVICE_PLANE_SINCE = 8
+# MULTICHIP graduates from a dryrun log to a measured mesh headline
+# (bench.py --multichip, ISSUE 10): required from r10, old dryrun-only
+# artifacts grandfathered
+MULTI_HEADLINE_SINCE = 10
+MULTI_HEADLINE_KEYS = ("entity_ticks_per_sec_mesh",
+                       "per_chip_efficiency", "n_entities", "platform")
+MULTI_GAUGE_KEYS = ("halo_demand_max", "migrate_demand_max",
+                    "migrate_dropped_total")
 
 
 def _is_num(v) -> bool:
@@ -135,6 +150,37 @@ def validate_multichip(path: str, doc: dict) -> list[str]:
     if "n_devices" in doc and (not _is_num(doc["n_devices"])
                                or doc["n_devices"] <= 0):
         errs.append(f"n_devices={doc.get('n_devices')!r}")
+    rno = round_no(path)
+    if rno < MULTI_HEADLINE_SINCE or doc.get("skipped"):
+        return errs
+    # the measured-mesh era (r >= 10): a real headline block with the
+    # scan-marginal mesh number + efficiency, comms gauges, and the
+    # device-plane stamps ({"error": ...} accepted as honest failure).
+    # A FAILED round (rc != 0) is exempt like the BENCH contract —
+    # its failure is already recorded honestly.
+    if doc.get("rc", 1) != 0 and not doc.get("ok"):
+        return errs
+    hl = doc.get("headline")
+    if not isinstance(hl, dict):
+        errs.append("missing/invalid headline block "
+                    f"(required since r{MULTI_HEADLINE_SINCE:02d})")
+    elif "error" not in hl:
+        for k in MULTI_HEADLINE_KEYS:
+            if k not in hl:
+                errs.append(f"headline missing key {k!r}")
+        v = hl.get("entity_ticks_per_sec_mesh")
+        if v is not None and (not _is_num(v) or v < 0):
+            errs.append(f"entity_ticks_per_sec_mesh={v!r}")
+        if doc.get("ok") and not hl.get("entity_ticks_per_sec_mesh"):
+            errs.append("ok but headline carries no mesh number")
+    _check_block(doc, "gauges", MULTI_GAUGE_KEYS, errs)
+    _check_block(doc, "cost_report", ("name",), errs)
+    _check_block(doc, "roofline_audit", ("phases",), errs)
+    phases = doc.get("phases")
+    if not isinstance(phases, dict) \
+            or not isinstance(phases.get("border_churn"), dict):
+        errs.append("missing phases.border_churn block "
+                    f"(required since r{MULTI_HEADLINE_SINCE:02d})")
     return errs
 
 

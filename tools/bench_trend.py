@@ -20,7 +20,10 @@ regression introduced by the change under test):
   at equal entities;
 * ``slo.pass``: a true -> false transition at the same shape fails;
 * MULTICHIP: the latest record must keep ``ok`` (when any prior round
-  had it) and ``rc == 0``.
+  had it) and ``rc == 0``; measured mesh headlines (r >= 10) gate
+  ``entity_ticks_per_sec_mesh`` against the best prior at the same
+  (entities, platform, n_devices) shape and fail a
+  ``per_chip_efficiency`` drop past the threshold.
 
 Exit codes: 0 pass, 1 usage/missing file, 2 regression.
 
@@ -139,8 +142,27 @@ def check_bench(files: list[str], threshold: float,
                 f"{lslo.get('target_ms')})")
 
 
+def _multi_headline(doc: dict) -> dict | None:
+    """The measured mesh headline of one MULTICHIP record, or None
+    (dryrun-only rounds, failed rounds, error/suspect headlines)."""
+    hl = doc.get("headline")
+    if not isinstance(hl, dict) or "error" in hl \
+            or hl.get("timing_suspect"):
+        return None
+    v = hl.get("entity_ticks_per_sec_mesh")
+    if not isinstance(v, (int, float)) or v <= 0:
+        return None
+    return hl
+
+
+def _multi_shape(hl: dict) -> tuple:
+    return (hl.get("n_entities"), hl.get("platform"),
+            hl.get("n_devices"))
+
+
 def check_multichip(files: list[str], problems: list[str],
-                    notes: list[str]) -> None:
+                    notes: list[str],
+                    threshold: float = DEFAULT_THRESHOLD) -> None:
     recs = []
     for path in sorted(files, key=_round_no):
         with open(path) as fh:
@@ -160,6 +182,41 @@ def check_multichip(files: list[str], problems: list[str],
     if latest.get("ok"):
         notes.append(f"{name}: multichip ok "
                      f"(n_devices={latest.get('n_devices')})")
+    # the measured mesh headline (r >= 10): latest vs the BEST prior
+    # at the same (entities, platform, n_devices) shape, plus a
+    # dedicated per_chip_efficiency gate — a mesh that keeps its
+    # throughput by burning more chips is still a regression
+    hl = _multi_headline(latest)
+    if hl is None:
+        return
+    prior = [(p, h) for p, r in recs[:-1]
+             if (h := _multi_headline(r)) is not None
+             and _multi_shape(h) == _multi_shape(hl)]
+    if not prior:
+        notes.append(f"{name}: mesh shape {_multi_shape(hl)} has no "
+                     "prior headline — not gated")
+        return
+    best_path, best = max(
+        prior, key=lambda pr: pr[1]["entity_ticks_per_sec_mesh"])
+    floor = (1.0 - threshold) * best["entity_ticks_per_sec_mesh"]
+    v = hl["entity_ticks_per_sec_mesh"]
+    if v < floor:
+        problems.append(
+            f"{name}: mesh headline {v:.0f} < {floor:.0f} "
+            f"({(1 - threshold) * 100:.0f}% of "
+            f"{os.path.basename(best_path)}'s "
+            f"{best['entity_ticks_per_sec_mesh']:.0f})")
+    else:
+        notes.append(f"{name}: mesh headline {v:.0f} vs best prior "
+                     f"{best['entity_ticks_per_sec_mesh']:.0f} — ok")
+    eff = hl.get("per_chip_efficiency")
+    best_eff = max((h.get("per_chip_efficiency") or 0.0)
+                   for _p, h in prior)
+    if isinstance(eff, (int, float)) and best_eff > 0 \
+            and eff < (1.0 - threshold) * best_eff:
+        problems.append(
+            f"{name}: per_chip_efficiency {eff:.3f} dropped >"
+            f"{threshold * 100:.0f}% vs best prior {best_eff:.3f}")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -203,7 +260,7 @@ def main(argv: list[str] | None = None) -> int:
     if bench:
         check_bench(bench, args.threshold, problems, notes)
     if multi:
-        check_multichip(multi, problems, notes)
+        check_multichip(multi, problems, notes, args.threshold)
     for n in notes:
         print(f"  {n}")
     if problems:

@@ -13,9 +13,15 @@ dies mid-run:
      impl, the ISSUE-6 headline rows. TPU-only — interpret-mode fused
      at 1M would eat the session; off-TPU these rows print SKIP (the
      CPU fused number is recorded by bench.py's backhalf_ab instead).
-  2. back-half stage bisect (gather / +key / +topk / +final-sort).
-  3. collect-phase bisect (interest_pairs / collect_sync / attrs).
-  4. move-phase bisect (inputs scatter / random_walk / integrate).
+  2. multichip mesh A/B at the bench shape (ISSUE 10): halo_impl
+     ppermute-vs-async, a migrate_cap sweep, border_churn on/off —
+     scan-marginal mega-tick rows over the real mesh. TPU-only like
+     1c (interpret-mode async halo + an N-device mesh emulated on CPU
+     would stall the session; the tier-1 multichip marker covers the
+     small-N CPU truth).
+  3. back-half stage bisect (gather / +key / +topk / +final-sort).
+  4. collect-phase bisect (interest_pairs / collect_sync / attrs).
+  5. move-phase bisect (inputs scatter / random_walk / integrate).
 Never wrapped in `timeout`; exits cleanly on its own.
 """
 import os
@@ -251,7 +257,54 @@ else:
           "interpret-mode fused at this shape would stall the session "
           "— see bench.py backhalf_ab for the CPU record)", flush=True)
 
-# ---- 2. back-half stage bisect (table impl, no flags) ---------------
+# ---- 2. multichip mesh A/B at the bench shape (ISSUE 10) ------------
+# halo_impl ppermute-vs-async, migrate_cap sweep, border_churn on/off:
+# scan-marginal mega-tick ms over the real ICI mesh via bench.py's
+# build_mega/_mega_tick_ms (the EXACT harness the --multichip headline
+# times, so these rows transfer 1:1 to the artifact).
+
+N_MESH = int(os.environ.get("PROBE_MULTI_N", 1048576))
+if on_tpu() and len(jax.devices()) > 1:
+    import importlib.util as _ilu
+
+    _bs = _ilu.spec_from_file_location(
+        "bench", os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    _bench = _ilu.module_from_spec(_bs)
+    sys.modules.setdefault("bench", _bench)
+    _bs.loader.exec_module(_bench)
+    from goworld_tpu.parallel.megaspace import make_mega_tick
+    from goworld_tpu.scenarios.spec import get_scenario as _get_sc
+
+    def mesh_row(label, **kw):
+        try:
+            mc, mesh_, st, ins, pol = _bench.build_mega(N_MESH, **kw)
+            tick = make_mega_tick(mc, mesh_)
+            per, scale, _r = _bench._mega_tick_ms(tick, st, ins, pol, L)
+            print(f"mega@{N_MESH} {label:22s} "
+                  f"{1000.0 * per:10.3f} ms/tick   "
+                  f"(scale_2x {scale:.2f}, halo_cap {mc.halo_cap})",
+                  flush=True)
+        except Exception as exc:
+            print(f"mega@{N_MESH} {label:22s} FAILED: "
+                  f"{str(exc)[:160]}", flush=True)
+
+    for impl in ("ppermute", "async"):
+        mesh_row(f"halo={impl}", halo_impl=impl)
+    for cap in (128, 256, 512, 1024):
+        os.environ["BENCH_MIGRATE_CAP"] = str(cap)
+        mesh_row(f"migrate_cap={cap}")
+    os.environ.pop("BENCH_MIGRATE_CAP", None)
+    mesh_row("border_churn=off")
+    mesh_row("border_churn=on", scenario=_get_sc("hotspot"),
+             npc_speed=25.0)
+else:
+    print(f"mega@{N_MESH} halo/migrate/churn   SKIP (no TPU mesh; "
+          "interpret-mode async halo over emulated devices would "
+          "stall the session — the tier-1 `-m multichip` suite covers "
+          "the small-N CPU truth)", flush=True)
+
+# ---- 3. back-half stage bisect (table impl, no flags) ---------------
 
 spec = GridSpec(radius=50.0, extent_x=extent, extent_z=extent,
                 k=K, cell_cap=CC, row_block=65536)
@@ -327,7 +380,7 @@ def mk_stage(stage):
 for st in ("gather", "gather_take", "key", "topk", "all"):
     timeit(f"stage {st}", mk_stage(st))
 
-# ---- 3. collect bisect ---------------------------------------------
+# ---- 4. collect bisect ---------------------------------------------
 
 from goworld_tpu.ops.delta import interest_pairs
 from goworld_tpu.ops.sync import collect_attr_deltas, collect_sync
@@ -386,7 +439,7 @@ timeit("collect interest_pairs", mk_pairs)
 timeit("collect sync", mk_sync)
 timeit("collect attrs", mk_attrs)
 
-# ---- 4. move bisect -------------------------------------------------
+# ---- 5. move bisect -------------------------------------------------
 
 from goworld_tpu.models.random_walk import random_walk_step
 from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
