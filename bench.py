@@ -962,6 +962,12 @@ def measure(n: int, ticks: int, client_frac: float, phases: bool,
                else "phases disabled (BENCH_PHASES=0 or smoke stage)")
         result["slo"] = {"skipped": why}
         result["op_stats"] = {"skipped": why}
+    # the workload-signature block (ISSUE 11): the SAME jax-free
+    # reducer the live /workload endpoint serves, applied to the
+    # just-drained lanes — bench and serving cross-validate one
+    # signature grammar (required by bench_schema from r11)
+    result["workload_signature"] = _signature_stamp(
+        result["op_stats"], _model_grid_kw(cfg, n))
     # hand the caller what it needs to run the p99 pass AFTER the
     # headline line is safely on stdout (a hang mid-p99 must not discard
     # the already-measured result)
@@ -1053,6 +1059,26 @@ def measure_telemetry(cfg, st, inputs, policy, ticks: int,
         f"p99={slo['p99_ms']} target={target} "
         f"-> {'PASS' if slo['pass'] else 'FAIL'}")
     return op_stats, slo
+
+
+def _signature_stamp(op_stats, grid_kw: dict | None) -> dict:
+    """The artifact's ``workload_signature`` block: the jax-free
+    reducer of ops/telemetry.py over the drained lanes (the exact
+    reduction the live ``/workload`` endpoint serves, so bench rounds
+    and production processes speak one signature grammar), or an
+    honest error/skip mirroring the op_stats block's own status."""
+    from goworld_tpu.ops import telemetry
+
+    if not isinstance(op_stats, dict) \
+            or "error" in op_stats or "skipped" in op_stats:
+        src = op_stats if isinstance(op_stats, dict) else {}
+        if "skipped" in src:
+            return {"skipped": str(src["skipped"])[:200]}
+        return {"error": str(src.get("error", "no op_stats"))[:200]}
+    try:
+        return telemetry.workload_signature(op_stats, config=grid_kw)
+    except Exception as exc:
+        return {"error": str(exc)[:200]}
 
 
 def measure_p99(cfg, st, inputs, policy, samples: int | None = None) -> dict:
@@ -1675,6 +1701,11 @@ def measure_multichip(n_total: int, ticks: int) -> dict:
     except Exception as exc:
         result["gauges"] = {"error": str(exc)[:200]}
         result["op_stats"] = {"error": str(exc)[:200]}
+    # the mesh round's workload-signature block (same grammar as the
+    # BENCH stamp and the live /workload endpoint; the mega lanes add
+    # halo/migrate demand to the reduction's inputs)
+    result["workload_signature"] = _signature_stamp(
+        result["op_stats"], None)
 
     # border_churn phase: hotspot-style drift (scenarios/behaviors.py
     # kernels — megaspace honors the scenario knob now) pulls the whole
@@ -2558,6 +2589,13 @@ def selftest_main() -> int:
                 check(f"full.op_stats.{lane}", lane in ost
                       and "counts" in ost.get(lane, {}),
                       f"op_stats lanes={sorted(ost)[:10]}")
+            # the workload-signature block (ISSUE 11): with real lanes
+            # drained it must reduce to a full signature record — the
+            # same grammar the live /workload endpoint serves
+            ws = art.get("workload_signature", {})
+            check("full.workload_signature", isinstance(ws, dict)
+                  and {"sig", "churn", "density", "events",
+                       "recommendation"} <= set(ws), str(ws)[:160])
         if os.environ.get("BENCH_DEVPROF", "1") == "1":
             cr = art.get("cost_report", {})
             check("full.cost_report", isinstance(cr, dict)

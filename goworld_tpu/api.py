@@ -299,6 +299,7 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
         halo_impl=gc.halo_impl,
         pipeline_decode=gc.pipeline_decode and mesh is None
         and not gc.megaspace,
+        telemetry_live=gc.telemetry_live,
     )
     # periodic persistence cadence (reference [gameN] save_interval,
     # goworld.ini.sample:45; Entity.go:164-177)
@@ -426,6 +427,8 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
             overload_latency_ratio=gc.overload_latency_ratio,
             degraded_sync_stride=gc.degraded_sync_stride,
             degraded_event_coalesce=gc.degraded_event_coalesce,
+            flightrec_ring=gc.flightrec_ring,
+            flightrec_cooldown_secs=gc.flightrec_cooldown_secs,
         )
 
     restoring = args.restore and \

@@ -830,6 +830,13 @@ def cmd_status(server_dir: str) -> int:
                 print()
                 for line in scraper.slo_lines(costs):
                     print(line)
+            # live workload signature + incident counts per process
+            # (debug_http /workload + /incidents, ISSUE 11);
+            # 404/unreachable skipped silently like /costs
+            wl = scraper.scrape_workload(
+                [t for t in targets if t[0] in results])
+            for line in scraper.workload_lines(wl):
+                print(line)
             for e in errors:
                 print(f"metrics: {e}", file=sys.stderr)
     return 0 if all_up else 1

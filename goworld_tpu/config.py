@@ -179,6 +179,18 @@ class GameConfig:
     # per edge, dirty-only packed payload — overlap-capable; off-TPU it
     # runs interpret mode with a one-time warning, never a CPU default)
     halo_impl: str = "ppermute"
+    # live device-telemetry lanes (ops/telemetry.py; ISSUE 11): the
+    # production tick accumulates tick signals (rebuild rate, skin
+    # slack, over_k/over_cap, event volumes, per-tile occupancy) on
+    # device with zero added host syncs and serves the reduced
+    # workload signature at debug-http /workload. false = off (the
+    # flight recorder then records frames without signature marks).
+    telemetry_live: bool = True
+    # incident flight recorder (utils/flightrec.py): per-tick frame
+    # ring size (0 = off) and the per-trigger-kind dedup cooldown for
+    # frozen snapshot bundles served at /incidents
+    flightrec_ring: int = 512
+    flightrec_cooldown_secs: float = 30.0
 
 
 @dataclasses.dataclass

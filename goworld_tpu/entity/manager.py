@@ -158,6 +158,7 @@ class World:
         halo_impl: str = "ppermute",
         mega_shape: tuple[int, int] | None = None,
         pipeline_decode: bool = False,
+        telemetry_live: bool = True,
     ):
         self.cfg = cfg
         self.n_spaces = n_spaces
@@ -267,6 +268,35 @@ class World:
             return w.cost_report()
 
         devprof.register_provider("world.tick", _tick_cost_provider)
+
+        # live device-telemetry lanes (ISSUE 11; ops/telemetry.py): the
+        # bench-only in-graph histograms promoted to the PRODUCTION
+        # per-tick step — one small jitted fold per tick accumulates
+        # tick signals (rebuilt/skin_slack/over_k/over_cap/sync/enter/
+        # leave + per-shard occupancy, + halo/migrate demand on the
+        # mega mesh) on device with zero added host syncs; the drain
+        # rides the tick's EXISTING fetch-outputs transfer. Feeds the
+        # metrics registry on a cadence and the workload-signature
+        # reducer (/workload) over a rotating window.
+        self.telemetry_live = bool(telemetry_live)
+        self._telem_fn = None
+        self._telem_acc = None
+        self._telem_lanes = None    # latest drained cumulative (host)
+        self._telem_win = None      # window-start cumulative (signature)
+        self._telem_win_tick = 0
+        self._pending_telem = None  # pipelined drain: last tick's acc
+        self._telem_feed_mark = None  # last metrics-fed cumulative
+        # negative start: the FIRST drain feeds the registry (a fresh
+        # process is scrapeable right away), then the cadence holds
+        self._telem_feed_tick = -self.TELEM_FEED_TICKS
+        if self.telemetry_live:
+            try:
+                self._init_live_telemetry()
+            except Exception:
+                # observability must never take serving down: disable
+                # the lanes loudly and keep ticking
+                logger.exception("live telemetry init failed; disabled")
+                self._telem_fn = self._telem_acc = None
 
         # host object model
         self.entities: dict[str, Entity] = {}
@@ -1312,6 +1342,105 @@ class World:
         self.storage.save(e.type_name, e.id, e.get_persistent_data())
 
     # ==================================================================
+    # live device telemetry (ISSUE 11)
+    # ==================================================================
+    # cadence constants (ticks): how often the drained lanes feed the
+    # metrics registry, and how often the signature window rotates (the
+    # signature reads the delta since the last rotation, so it always
+    # covers the most recent 1-2 windows, never process-lifetime
+    # averages)
+    TELEM_FEED_TICKS = 32
+    SIG_WINDOW_TICKS = 256
+
+    def _init_live_telemetry(self) -> None:
+        from goworld_tpu.ops import telemetry as telem
+
+        cfg = self.cfg
+        mega = self.mega is not None
+        # the skin lane exists only where the Verlet cache is LIVE in
+        # the compiled step (state carries a cache and capacity is
+        # inside the packed-id bound — the tick_body use_verlet
+        # predicate; the vmapped S>1 and megaspace shapes cleared it)
+        skin_on = (not mega and cfg.grid.skin > 0
+                   and getattr(self.state, "aoi_cache", None) is not None
+                   and cfg.capacity < (1 << consts.AOI_ID_BITS))
+        self._telem_mega = mega
+        self._telem_skin_on = skin_on
+        self._telem_half_skin = cfg.grid.skin / 2.0 if skin_on else 0.0
+        self._telem_acc = telem.telemetry_init(
+            skin_on, mega=mega, occupancy=True, n_tiles=self.n_spaces)
+        half_skin = self._telem_half_skin
+
+        @jax.jit
+        def _fold(acc, outs):
+            return telem.telemetry_update_live(
+                acc, outs, mega=mega, half_skin=half_skin)
+
+        self._telem_fn = _fold
+
+    def _ingest_telemetry(self, acc_host) -> None:
+        """Host half of the live lanes (called with the accumulator
+        copy that rode the tick's fetch-outputs transfer): keep the
+        cumulative drain, feed the metrics registry and rotate the
+        signature window on their cadences."""
+        from goworld_tpu.ops import telemetry as telem
+
+        lanes = telem.telemetry_drain(
+            acc_host, self._telem_skin_on, self._telem_half_skin,
+            mega=self._telem_mega)
+        self._telem_lanes = lanes
+        if self.tick_count - self._telem_feed_tick \
+                >= self.TELEM_FEED_TICKS:
+            self._feed_telemetry_metrics(lanes)
+            self._telem_feed_tick = self.tick_count
+        if self.tick_count - self._telem_win_tick \
+                >= self.SIG_WINDOW_TICKS:
+            self._telem_win = lanes
+            self._telem_win_tick = self.tick_count
+
+    def _feed_telemetry_metrics(self, lanes: dict) -> None:
+        """Drained lanes -> metrics registry: one shared-ladder
+        histogram per lane (`telemetry_<lane>`; increment = the delta
+        since the last feed) plus per-tile occupancy gauges. The
+        tick_ms lane is skipped — the live wall latency already has
+        its own tick_latency_ms series."""
+        from goworld_tpu.ops import telemetry as telem
+
+        delta = telem.lanes_delta(lanes, self._telem_feed_mark)
+        for nm, lane in delta.items():
+            if nm == "tick_ms" or not isinstance(lane, dict) \
+                    or "counts" not in lane:
+                continue
+            metrics.histogram(
+                f"telemetry_{nm}", buckets=tuple(lane["edges"]),
+            ).add_counts(lane["counts"])
+        per_tile = (lanes.get("occupancy") or {}).get("per_tile")
+        if per_tile is not None:
+            for i, c in enumerate(per_tile):
+                metrics.gauge("telemetry_tile_occupancy",
+                              tile=str(i)).set(c)
+        self._telem_feed_mark = lanes
+
+    def workload_signature(self) -> dict | None:
+        """The live workload signature over the recent window (the
+        jax-free reducer in ops/telemetry.py applied to the drained-
+        lane delta since the last window rotation), stamped with the
+        resolved kernel-config key. None until the first tick has
+        drained (or when telemetry_live is off)."""
+        if self._telem_lanes is None:
+            return None
+        from goworld_tpu.ops import telemetry as telem
+        from goworld_tpu.utils import devprof
+
+        delta = telem.lanes_delta(self._telem_lanes, self._telem_win)
+        sig = telem.workload_signature(
+            delta, config=devprof.grid_config_key(self.cfg.grid))
+        sig["game_id"] = self.game_id
+        sig["tick"] = self.tick_count
+        sig["window_ticks"] = self.tick_count - self._telem_win_tick
+        return sig
+
+    # ==================================================================
     # the tick
     # ==================================================================
     def cost_report(self):
@@ -1377,6 +1506,20 @@ class World:
         t0 = time.perf_counter()
         with tl.span("device_step"):
             self.state, outs = self._step(self.state, inputs, self.policy)
+            if self._telem_fn is not None:
+                # fold THIS tick's outputs into the device-resident
+                # lanes — one async jitted dispatch, no host sync (the
+                # pipelined swap below only reorders the HOST decode,
+                # so the fold always sees the current tick); inside the
+                # span so its dispatch/compile time is attributed.
+                # A fold failure disables the lanes, never the tick.
+                try:
+                    self._telem_acc = self._telem_fn(
+                        self._telem_acc, outs)
+                except Exception:
+                    logger.exception(
+                        "live telemetry fold failed; disabled")
+                    self._telem_fn = self._telem_acc = None
         if self.pipeline_decode:
             # PIPELINED decode (opt-in; single-controller non-mesh
             # worlds only — mesh/mega decode has same-tick couplings
@@ -1393,9 +1536,34 @@ class World:
             # checkpoint paths call flush_pending_outputs() first.
             # outs is None on the first tick (nothing to decode yet).
             outs, self._pending_outs = self._pending_outs, outs
+        # which accumulator the fetch below drains: the pipelined path
+        # swaps it one tick back like the outputs — fetching THIS
+        # tick's acc would depend on the in-flight step and re-
+        # serialize exactly the host/device overlap pipeline_decode
+        # exists to buy
+        if self.pipeline_decode:
+            acc_fetch, self._pending_telem = \
+                self._pending_telem, self._telem_acc
+        else:
+            acc_fetch = self._telem_acc
         with tl.span("fetch_outputs"):
-            if outs is not None:
+            acc_host = None
+            if outs is not None and acc_fetch is not None:
+                # the telemetry drain rides the EXISTING fetch: one
+                # combined transfer, zero added sync points per tick
+                outs, acc_host = self._dget((outs, acc_fetch))
+            elif outs is not None:
                 outs = self._dget(outs)
+            elif acc_fetch is not None:
+                acc_host = self._dget(acc_fetch)
+            if acc_host is not None:
+                try:
+                    self._ingest_telemetry(acc_host)
+                except Exception:
+                    logger.exception(
+                        "live telemetry drain failed; disabled")
+                    self._telem_fn = self._telem_acc = None
+            if outs is not None:
                 if self._multihost:
                     # EAGER pos/yaw refresh: every controller executes
                     # these two collectives at the same point every tick.

@@ -267,7 +267,10 @@ class BotClient:
 
     async def _connect_transport(self) -> None:
         if self.ws:
-            import websockets
+            try:
+                import websockets
+            except ImportError:
+                from goworld_tpu.net import ws as websockets
 
             sock = await websockets.connect(
                 f"ws://{self.host}:{self.port}"

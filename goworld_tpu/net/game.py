@@ -30,8 +30,8 @@ from goworld_tpu.entity.manager import World
 from goworld_tpu.net import codec, proto
 from goworld_tpu.net.cluster import DispatcherCluster, DispatcherConn
 from goworld_tpu.net.packet import Packet, new_packet
-from goworld_tpu.utils import consts, faults, log, metrics, opmon, \
-    overload, tracing
+from goworld_tpu.utils import consts, faults, flightrec, log, metrics, \
+    opmon, overload, tracing
 
 logger = log.get("game")
 
@@ -102,6 +102,8 @@ class GameServer:
         overload_latency_ratio: float = consts.OVERLOAD_LATENCY_RATIO,
         degraded_sync_stride: int = consts.DEGRADED_SYNC_STRIDE,
         degraded_event_coalesce: int = consts.DEGRADED_EVENT_COALESCE_TICKS,
+        flightrec_ring: int = flightrec.DEFAULT_RING,
+        flightrec_cooldown_secs: float = flightrec.DEFAULT_COOLDOWN_SECS,
     ):
         self.game_id = game_id
         self.world = world
@@ -208,6 +210,49 @@ class GameServer:
         self._m_event_records = metrics.counter(
             "client_event_records_total",
             help="client event records flushed downstream")
+
+        # incident flight recorder + live workload signature (ISSUE 11,
+        # utils/flightrec.py): one correlated frame per tick; an SLO
+        # breach vs this process's OWN tick budget, an overload-ladder
+        # transition, an over_cap-after-quiet oracle anomaly or a
+        # signature class change freezes a ring-tail bundle served at
+        # debug-http /incidents. flightrec_ring=0 disables. Weakrefs
+        # throughout: the registries are process-global and must never
+        # pin a discarded server's World (the devprof convention).
+        import weakref
+
+        wself = weakref.ref(self)
+        self.flightrec: flightrec.FlightRecorder | None = None
+        self._last_sig: str | None = None
+        from goworld_tpu.utils import devprof as _devprof
+
+        # tolerate stub worlds (tests drive GameServer with bare
+        # namespaces that carry no device config)
+        grid = getattr(getattr(world, "cfg", None), "grid", None)
+        self._kernel_key = ",".join(
+            f"{k}={v}" for k, v in sorted(
+                _devprof.grid_config_key(grid).items())
+        ) if grid is not None else "unknown"
+        if flightrec_ring > 0:
+
+            def _ctx() -> dict:
+                s = wself()
+                return {} if s is None else s._incident_context()
+
+            self.flightrec = flightrec.register(
+                f"game{game_id}",
+                flightrec.FlightRecorder(
+                    ring=flightrec_ring,
+                    cooldown_secs=flightrec_cooldown_secs,
+                    context_fn=_ctx,
+                ),
+            )
+
+        def _workload() -> dict | None:
+            s = wself()
+            return None if s is None else s.world.workload_signature()
+
+        flightrec.set_workload_provider(_workload)
 
         # wire the world's pluggable edges to the cluster
         w = world
@@ -426,6 +471,10 @@ class GameServer:
                 return n
 
     def tick(self) -> None:
+        # wall clock measured HERE (not in serve_forever) so manual
+        # pump()/tick() loops — tests, embedded harnesses — feed the
+        # flight recorder the same SLO signal as the real serve loop
+        t0 = time.perf_counter()
         tl = metrics.timeline
         if self.world._multihost:
             # the exchange also publishes world.mh_group_ready, which
@@ -436,6 +485,77 @@ class GameServer:
         with tl.span("fan_out"):
             self._flush_sync_out()
             self._maybe_checkpoint()
+        if self.flightrec is not None:
+            # own span: frame cost stays attributed in the timeline's
+            # >=95% per-tick coverage bound
+            with tl.span("flightrec"):
+                try:
+                    self._flightrec_frame(time.perf_counter() - t0)
+                except Exception:  # must never break the tick
+                    logger.exception("flight-recorder frame failed")
+
+    # workload-signature refresh cadence (ticks): how often the tick
+    # loop re-reduces the signature for the flight-recorder frame and
+    # the [gameN] recommendation line (the /workload endpoint always
+    # reduces fresh on demand)
+    SIG_LOG_TICKS = 64
+
+    def _flightrec_frame(self, dur_s: float) -> None:
+        """One correlated flight-recorder frame per tick: measured tick
+        wall time vs this process's budget, ladder stage, AOI oracle
+        gauges and event volumes (all host-resident already — zero
+        device traffic), plus the workload-signature class string on
+        its refresh cadence. A signature class change stamps the
+        ``[gameN]`` kernel-config recommendation line — the exact input
+        ROADMAP item 2's governor will consume (recommend, not swap)."""
+        w = self.world
+        st = getattr(w, "op_stats", None) or {}
+        tick = getattr(w, "tick_count", 0)
+        frame = {
+            "tick": tick,
+            "tick_ms": round(dur_s * 1e3, 3),
+            "budget_ms": round(self.tick_interval * 1e3, 3),
+            "stage": self.overload.state_name,
+            "over_k": int(st.get("aoi_over_k_rows", 0)),
+            "over_cap": int(st.get("aoi_over_cap_cells", 0)),
+            "enter": int(st.get("aoi_enter_events", 0)),
+            "leave": int(st.get("aoi_leave_events", 0)),
+            "backlog": float(self._m_backlog.value),
+        }
+        if getattr(w, "telemetry_live", False) \
+                and tick % self.SIG_LOG_TICKS == 0:
+            sig = w.workload_signature()
+            if sig and "sig" in sig:
+                frame["signature"] = sig["sig"]
+                if sig["sig"] != self._last_sig:
+                    self._last_sig = sig["sig"]
+                    rec = " ".join(
+                        f"{k}={v}" for k, v in
+                        sig.get("recommendation", {}).items())
+                    logger.info(
+                        "[game%d] workload signature %s -> "
+                        "recommend: %s (resolved %s)",
+                        self.game_id, sig["sig"], rec or "none",
+                        self._kernel_key,
+                    )
+        self.flightrec.record(frame)
+
+    def _incident_context(self) -> dict:
+        """Correlation payload attached to a frozen incident bundle
+        (paid at freeze time only, never per tick): the resolved
+        kernel config, ladder stage, the last sampled trace ids and
+        the freshest workload signature."""
+        ctx: dict = {
+            "kernel_config": self._kernel_key,
+            "overload": self.overload.state_name,
+        }
+        tail = tracing.recorder.tail(8)
+        if tail:
+            ctx["trace_ids"] = sorted({t[2] for t in tail})
+        sig = self.world.workload_signature()
+        if sig:
+            ctx["workload_signature"] = sig
+        return ctx
 
     def _maybe_checkpoint(self) -> None:
         """Periodic crash-recovery snapshot (``checkpoint_interval`` ini

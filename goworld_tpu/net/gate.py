@@ -685,8 +685,13 @@ class GateService:
     # -- websocket listener ----------------------------------------------
     async def _serve_ws(self) -> None:
         """WebSocket edge (reference ``handleWebSocketConn`` ``:121-168``):
-        each binary WS message is one framed packet."""
-        import websockets
+        each binary WS message is one framed packet. Uses the
+        third-party ``websockets`` package when installed, else the
+        stdlib-only shim (:mod:`goworld_tpu.net.ws`). Everything that
+        can fail — the import included — sits inside the try below:
+        ``ws_started`` MUST always be set or ``serve()`` wedges the
+        whole gate boot waiting on it (the pre-existing test_ws
+        cluster-harness hang)."""
 
         async def handle(ws):
             loop = asyncio.get_event_loop()
@@ -740,6 +745,10 @@ class GateService:
                 self._drop_client(cp)
 
         try:
+            try:
+                import websockets
+            except ImportError:
+                from goworld_tpu.net import ws as websockets
             self._ws_server = await websockets.serve(
                 handle, self.host, self.ws_port
             )

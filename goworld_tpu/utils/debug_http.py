@@ -31,6 +31,14 @@ TPU-native analog exposes:
   a lower+compile costs seconds, so it is operator-triggered), and
   the freshest SLO verdict (recorded, or derived live from the
   ``tick_latency_ms`` histogram)
+* ``/workload`` — the live workload signature (:mod:`goworld_tpu.ops.
+  telemetry` reducer over the in-graph telemetry lanes the serving
+  tick accumulates on device): churn/density/event/skew classes +
+  the ``[gameN]`` kernel-config recommendation
+* ``/incidents`` — the incident flight recorder (:mod:`goworld_tpu.
+  utils.flightrec`): frozen snapshot bundles (SLO breach, overload
+  transition, oracle anomaly, signature change) with their per-tick
+  frame tails; ``?frames=1`` includes the live ring too
 * ``/faults`` — fault-injection plane state (:mod:`goworld_tpu.utils.
   faults`): seed, per-rule trial counts and the deterministic fired
   log; ``{"active": false}`` when no schedule is installed
@@ -58,7 +66,7 @@ logger = log.get("debug_http")
 
 _ENDPOINTS = ["/healthz", "/vars", "/ops", "/metrics", "/trace",
               "/tracing", "/clock", "/profile", "/faults", "/overload",
-              "/costs"]
+              "/costs", "/workload", "/incidents"]
 
 # jax.profiler capture state (one capture at a time per process)
 _profile_lock = threading.Lock()
@@ -250,6 +258,20 @@ class _Handler(BaseHTTPRequestHandler):
             analyze = "analyze" in query \
                 and query["analyze"][0] not in ("0", "false")
             self._json(devprof.snapshot(analyze=analyze))
+        elif path == "/workload":
+            # live workload signature (ops/telemetry reducer over the
+            # serving tick's device lanes; utils/flightrec registry)
+            from goworld_tpu.utils import flightrec
+
+            self._json(flightrec.workload_snapshot())
+        elif path == "/incidents":
+            # flight-recorder incident bundles (utils/flightrec);
+            # ?frames=1 adds the live per-tick frame ring
+            from goworld_tpu.utils import flightrec
+
+            frames = "frames" in query \
+                and query["frames"][0] not in ("0", "false")
+            self._json(flightrec.snapshot_all(frames=frames))
         else:
             self._json({"error": "not found",
                         "endpoints": _ENDPOINTS}, 404)

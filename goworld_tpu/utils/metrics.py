@@ -141,6 +141,29 @@ class Histogram:
             self._sum += v
             self._count += 1
 
+    def add_counts(self, counts, sum_: float = 0.0) -> None:
+        """Merge a pre-bucketed count vector (``len(uppers)+1``
+        entries, last = +Inf) — the in-graph telemetry lanes drain
+        into the live registry through this (the device accumulator
+        shares the bisect_left-on-upper-edges semantics of
+        ``observe``, so merged counts are bit-compatible). ``sum_``
+        is optional: lanes carry no per-sample sum, so quantiles stay
+        exact while the ``_sum`` series only covers host-observed
+        samples."""
+        if len(counts) != len(self._uppers) + 1:
+            raise ValueError(
+                f"count vector has {len(counts)} entries, histogram "
+                f"has {len(self._uppers) + 1} buckets"
+            )
+        with self._lock:
+            n = 0
+            for i, c in enumerate(counts):
+                c = int(c)
+                self._counts[i] += c
+                n += c
+            self._count += n
+            self._sum += float(sum_)
+
     def snapshot(self) -> dict[str, Any]:
         with self._lock:
             return {

@@ -228,6 +228,15 @@ class SpanRecorder:
         with self._lock:
             return list(self._recs)
 
+    def tail(self, n: int) -> list:
+        """The newest ``n`` records (oldest first) WITHOUT copying the
+        whole ring — the flight recorder correlates incident bundles
+        with the last sampled trace ids at freeze time."""
+        with self._lock:
+            k = min(n, len(self._recs))
+            return [self._recs[len(self._recs) - k + i]
+                    for i in range(k)]
+
     def clear(self) -> None:
         with self._lock:
             self._recs.clear()

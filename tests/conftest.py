@@ -79,6 +79,14 @@ def pytest_configure(config):
         "gates — tests/test_devprof.py, test_bench_trend.py, "
         "test_bench_schema.py); all run in tier-1 on CPU",
     )
+    config.addinivalue_line(
+        "markers",
+        "flightrec: live workload-signature + incident flight-recorder "
+        "suites (the production telemetry carry, /workload + "
+        "/incidents, trigger/dedup/replay determinism — "
+        "tests/test_flightrec.py, tests/test_telemetry_live.py); all "
+        "run in tier-1 on CPU",
+    )
 
 
 def spawn_on(states, dev, slot, **kw):

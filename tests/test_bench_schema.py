@@ -209,6 +209,38 @@ def test_multichip_r10_contract(tmp_path):
     assert _validate(tmp_path, "MULTICHIP_r11.json", skipped) == []
 
 
+def _sig_block():
+    return {"sig": "churn=skinless|density=exact|events=quiet",
+            "churn": "skinless", "density": "exact",
+            "events": "quiet", "recommendation": {}}
+
+
+def test_workload_signature_required_since_r11(tmp_path):
+    # r10 and older: grandfathered without the block
+    assert _validate(tmp_path, "BENCH_r10.json", _full_rec()) == []
+    # r11+: the block is part of the contract
+    errs = _validate(tmp_path, "BENCH_r11.json", _full_rec())
+    assert any("workload_signature" in e for e in errs)
+    rec = _full_rec(workload_signature=_sig_block())
+    assert _validate(tmp_path, "BENCH_r11.json", rec) == []
+    # honest error/skip records accepted (device-plane convention)
+    for blk in ({"error": "no op_stats"}, {"skipped": "BENCH_SLO=0"}):
+        rec = _full_rec(workload_signature=blk)
+        assert _validate(tmp_path, "BENCH_r11.json", rec) == []
+    # partial signature shapes caught
+    rec = _full_rec(workload_signature={"sig": "x"})
+    errs = _validate(tmp_path, "BENCH_r11.json", rec)
+    assert any("workload_signature missing key" in e for e in errs)
+    # MULTICHIP r11+: same rule at the document level
+    mc = _multi_rec()
+    errs = _validate(tmp_path, "MULTICHIP_r11.json", mc)
+    assert any("workload_signature" in e for e in errs)
+    mc = _multi_rec(workload_signature=_sig_block())
+    assert _validate(tmp_path, "MULTICHIP_r11.json", mc) == []
+    assert _validate(tmp_path, "MULTICHIP_r10.json",
+                     _multi_rec()) == []
+
+
 def test_unreadable_file_reported(tmp_path):
     p = tmp_path / "BENCH_r08.json"
     p.write_text("{not json")

@@ -114,6 +114,32 @@ def test_slo_pass_to_fail_transition_fails(tmp_path):
     assert TREND.main([f1b, f2b]) == 0
 
 
+def test_signature_drift_is_informational_not_gated(tmp_path):
+    """A workload-signature class change between comparable rounds is
+    surfaced as a NOTE but never fails the gate (ISSUE 11: the
+    signature describes the workload, not the implementation)."""
+    r1 = _bench_rec(1000.0)
+    r1["workload_signature"] = {"sig": "churn=flock_like|density=exact"}
+    r2 = _bench_rec(1100.0)
+    r2["workload_signature"] = {
+        "sig": "churn=teleport_like|density=over_k"}
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    problems: list = []
+    notes: list = []
+    TREND.check_bench([f1, f2], 0.30, problems, notes)
+    assert problems == []
+    assert any("workload signature drifted" in n for n in notes)
+    assert TREND.main([f1, f2]) == 0
+    # stable signature: just the informational stamp, no drift note
+    r2["workload_signature"] = dict(r1["workload_signature"])
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    problems, notes = [], []
+    TREND.check_bench([f1, f2], 0.30, problems, notes)
+    assert problems == []
+    assert not any("drifted" in n for n in notes)
+
+
 def test_scenario_value_regression_fails(tmp_path):
     sc_ok = {"hotspot": {"value": 500.0, "entities": 512,
                          "tick_ms": 1.0}}

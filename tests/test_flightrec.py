@@ -1,0 +1,357 @@
+"""Incident flight recorder (utils/flightrec.py) + workload-signature
+reducer (ops/telemetry.py): trigger grammar, dedup/cooldown, ring
+bounds, deterministic replay, and the /workload + /incidents
+endpoints. All jax-free except the endpoint smoke."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from goworld_tpu.ops import telemetry
+from goworld_tpu.utils import debug_http, flightrec
+
+pytestmark = pytest.mark.flightrec
+
+
+class FakeClock:
+    """Deterministic injectable clock (replay tests)."""
+
+    def __init__(self, step: float = 1.0):
+        self.t = 0.0
+        self.step = step
+
+    def __call__(self) -> float:
+        self.t += self.step
+        return self.t
+
+
+def _frame(tick, tick_ms=1.0, budget=16.0, stage="NORMAL",
+           over_cap=0, **kw):
+    f = {"tick": tick, "tick_ms": tick_ms, "budget_ms": budget,
+         "stage": stage, "over_cap": over_cap}
+    f.update(kw)
+    return f
+
+
+# =======================================================================
+# triggers
+# =======================================================================
+def test_slo_breach_trigger_and_bundle_shape():
+    rec = flightrec.FlightRecorder(ring=32, cooldown_secs=0.0,
+                                   clock=FakeClock())
+    for i in range(10):
+        assert rec.record(_frame(i)) == []
+    new = rec.record(_frame(10, tick_ms=40.0))
+    assert len(new) == 1
+    b = new[0]
+    assert b["trigger"] == "slo_breach"
+    assert b["tick"] == 10
+    # the bundle carries the ring tail, newest last, breach included
+    assert b["frames"][-1]["tick"] == 10
+    assert len(b["frames"]) == 11
+
+
+def test_overload_transition_trigger():
+    rec = flightrec.FlightRecorder(ring=16, cooldown_secs=0.0,
+                                   clock=FakeClock())
+    rec.record(_frame(0, stage="NORMAL"))
+    assert rec.record(_frame(1, stage="NORMAL")) == []
+    new = rec.record(_frame(2, stage="DEGRADED"))
+    assert [b["trigger"] for b in new] == ["overload_transition"]
+    assert "NORMAL>DEGRADED" in new[0]["detail"]
+    # recovery is a transition too (post-mortems need both edges)
+    new = rec.record(_frame(3, stage="NORMAL"))
+    assert [b["trigger"] for b in new] == ["overload_transition"]
+
+
+def test_over_cap_fires_only_after_quiet():
+    rec = flightrec.FlightRecorder(ring=64, cooldown_secs=0.0,
+                                   quiet_ticks=4, clock=FakeClock())
+    # steady saturation from tick 0: never "after quiet", never fires
+    for i in range(8):
+        assert rec.record(_frame(i, over_cap=3)) == []
+    # quiet run, then the anomaly
+    for i in range(8, 14):
+        assert rec.record(_frame(i, over_cap=0)) == []
+    new = rec.record(_frame(14, over_cap=2))
+    assert [b["trigger"] for b in new] == ["over_cap_after_quiet"]
+    # still overflowing next tick: quiet run was reset, no re-fire
+    assert rec.record(_frame(15, over_cap=2)) == []
+
+
+def test_signature_change_trigger():
+    rec = flightrec.FlightRecorder(ring=16, cooldown_secs=0.0,
+                                   clock=FakeClock())
+    rec.record(_frame(0, signature="churn=flock_like"))
+    assert rec.record(_frame(1, signature="churn=flock_like")) == []
+    new = rec.record(_frame(2, signature="churn=teleport_like"))
+    assert [b["trigger"] for b in new] == ["signature_change"]
+
+
+# =======================================================================
+# dedup / cooldown / bounds
+# =======================================================================
+def test_cooldown_dedups_per_kind():
+    clock = FakeClock(step=1.0)  # 1 s per observation
+    rec = flightrec.FlightRecorder(ring=16, cooldown_secs=10.0,
+                                   clock=clock)
+    fired = sum(
+        len(rec.record(_frame(i, tick_ms=40.0))) for i in range(25)
+    )
+    # ~1 fire per 10 clock-seconds over 25 seconds of breaches
+    assert fired == 3
+    snap = rec.snapshot()
+    assert snap["fired"]["slo_breach"] == 25
+    assert snap["suppressed"]["slo_breach"] == 22
+    assert snap["incident_count"] == 3
+    # cooldown is PER KIND: a transition still freezes during an
+    # slo_breach cooldown window
+    new = rec.record(_frame(26, tick_ms=40.0, stage="DEGRADED"))
+    assert [b["trigger"] for b in new] == ["overload_transition"]
+
+
+def test_ring_and_incident_bounds():
+    rec = flightrec.FlightRecorder(ring=8, cooldown_secs=0.0,
+                                   snapshot_frames=999,
+                                   max_incidents=4, clock=FakeClock())
+    for i in range(100):
+        rec.record(_frame(i, tick_ms=40.0))
+    snap = rec.snapshot(frames=True)
+    assert len(snap["live_frames"]) == 8       # ring bound holds
+    assert snap["incident_count"] == 4          # incident bound holds
+    assert snap["frames_recorded"] == 100
+    # snapshot_frames clamps to the ring
+    assert all(len(b["frames"]) <= 8 for b in snap["incidents"])
+    # bounded incidents keep the NEWEST
+    assert snap["incidents"][-1]["tick"] == 99
+
+
+def test_rejects_zero_ring():
+    with pytest.raises(ValueError, match="ring"):
+        flightrec.FlightRecorder(ring=0)
+
+
+# =======================================================================
+# deterministic replay
+# =======================================================================
+def test_replay_is_byte_identical():
+    frames = []
+    for i in range(200):
+        frames.append(_frame(
+            i,
+            tick_ms=40.0 if i % 37 == 0 else 1.0,
+            stage="DEGRADED" if 50 <= i < 80 else "NORMAL",
+            over_cap=2 if i in (120, 121) else 0,
+            signature="a" if i < 150 else "b",
+        ))
+
+    def run():
+        rec = flightrec.FlightRecorder(ring=32, cooldown_secs=13.0,
+                                       clock=FakeClock(step=0.5))
+        out = []
+        for f in frames:
+            out.extend(rec.record(f))
+        # wall_time is the one non-injected stamp; everything else is a
+        # pure function of the (frame, clock) stream
+        for b in out:
+            b.pop("wall_time", None)
+        return out
+
+    a, b = run(), run()
+    assert a  # the stream actually fires
+    assert json.dumps(a, sort_keys=True) == json.dumps(b,
+                                                       sort_keys=True)
+
+
+# =======================================================================
+# workload-signature reducer (jax-free)
+# =======================================================================
+def _lanes(ticks=100, rebuilds=10, over_k=0, over_cap=0, enter_hi=0,
+           enter_bucket=7, skin=True, occ=None):
+    """Synthetic drained lanes: `rebuilds` of `ticks` in the rebuilt
+    bucket, overflow gauges nonzero on `over_*` ticks, `enter_hi`
+    ticks with ~1000 enter events."""
+
+    def hist(edges, nonzero, hi_bucket=1):
+        counts = [0] * (len(edges) + 1)
+        counts[0] = ticks - nonzero
+        counts[hi_bucket] = nonzero
+        return {"edges": list(edges), "counts": counts}
+
+    lanes = {
+        "rebuilt": hist(telemetry.REBUILD_EDGES, rebuilds),
+        "over_k_rows": hist(telemetry.COUNT_EDGES, over_k),
+        "over_cap_cells": hist(telemetry.COUNT_EDGES, over_cap),
+        "enter_n": hist(telemetry.COUNT_EDGES, enter_hi,
+                        hi_bucket=enter_bucket),
+        "leave_n": hist(telemetry.COUNT_EDGES, enter_hi,
+                        hi_bucket=enter_bucket),
+        "sync_n": hist(telemetry.COUNT_EDGES, 0),
+        "tick_ms": hist(telemetry.TICK_MS_EDGES, 0),
+    }
+    if skin:
+        lanes["skin_slack"] = hist(telemetry.SLACK_EDGES, ticks,
+                                   hi_bucket=6)
+    if occ is not None:
+        lanes["occupancy"] = {
+            "edges": list(telemetry.COUNT_EDGES),
+            "counts": [0] * (len(telemetry.COUNT_EDGES) + 1),
+            "per_tile": occ,
+        }
+    return lanes
+
+
+def test_signature_classes():
+    sig = telemetry.workload_signature(_lanes(rebuilds=10))
+    assert sig["churn"] == "flock_like"
+    assert sig["density"] == "exact"
+    assert sig["events"] == "quiet"
+    assert sig["recommendation"]["aoi_skin"] == "keep"
+    assert sig["sig"] == "churn=flock_like|density=exact|events=quiet"
+
+    sig = telemetry.workload_signature(_lanes(rebuilds=95))
+    assert sig["churn"] == "teleport_like"
+    assert sig["recommendation"]["aoi_skin"] == 0
+
+    sig = telemetry.workload_signature(_lanes(skin=False))
+    assert sig["churn"] == "skinless"
+    assert "aoi_skin" not in sig["recommendation"]
+
+    sig = telemetry.workload_signature(_lanes(over_k=30))
+    assert sig["density"] == "over_k"
+    assert sig["recommendation"]["aoi_sort_impl"] == "counting"
+    assert sig["recommendation"]["aoi_k"] == "raise"
+
+    sig = telemetry.workload_signature(_lanes(over_k=5, over_cap=30))
+    assert sig["density"] == "over_cap"     # loudest degradation wins
+    assert sig["recommendation"]["aoi_cell_cap"] == "raise"
+
+    sig = telemetry.workload_signature(_lanes(enter_hi=95))
+    assert sig["events"] == "moderate"
+
+    sig = telemetry.workload_signature(
+        _lanes(enter_hi=95, enter_bucket=9))
+    assert sig["events"] == "heavy"
+
+
+def test_signature_tile_skew():
+    sig = telemetry.workload_signature(
+        _lanes(occ=[100, 100, 100, 100]))
+    assert sig["skew"] == "balanced"
+    assert sig["tiles"] == 4
+    sig = telemetry.workload_signature(_lanes(occ=[380, 10, 5, 5]))
+    assert sig["skew"] == "hotspot"
+    assert sig["tile_skew"] > 3.0
+    assert "skew=hotspot" in sig["sig"]
+    # one tile = no skew class (nothing to compare)
+    sig = telemetry.workload_signature(_lanes(occ=[100]))
+    assert "skew" not in sig
+
+
+def test_signature_honest_on_empty():
+    assert "error" in telemetry.workload_signature({})
+    assert "error" in telemetry.workload_signature(_lanes(ticks=0))
+
+
+def test_lanes_delta():
+    cur = _lanes(ticks=100, rebuilds=40, occ=[7, 9])
+    prev = _lanes(ticks=60, rebuilds=35)
+    d = telemetry.lanes_delta(cur, prev)
+    assert sum(d["rebuilt"]["counts"]) == 40
+    assert d["rebuilt"]["counts"][1] == 5
+    # point-in-time extras come from CUR, never differenced
+    assert d["occupancy"]["per_tile"] == [7, 9]
+    # no prior window: the cumulative IS the window
+    assert telemetry.lanes_delta(cur, None) is cur
+
+
+# =======================================================================
+# registry + endpoints
+# =======================================================================
+def test_workload_and_incidents_endpoints():
+    flightrec.reset()
+    rec = flightrec.register(
+        "game9", flightrec.FlightRecorder(ring=16, cooldown_secs=0.0,
+                                          clock=FakeClock()))
+    rec.record(_frame(0))
+    rec.record(_frame(1, tick_ms=99.0))
+    flightrec.set_workload_provider(
+        lambda: {"sig": "churn=skinless|density=exact|events=quiet",
+                 "ticks": 2})
+    srv = debug_http.start(0, process_name="game9")
+    try:
+        port = srv.server_address[1]
+
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}") as resp:
+                return json.loads(resp.read().decode())
+
+        wl = get("/workload")
+        assert wl["sig"].startswith("churn=")
+        inc = get("/incidents")
+        assert inc["game9"]["incident_count"] == 1
+        assert inc["game9"]["incidents"][0]["trigger"] == "slo_breach"
+        assert "live_frames" not in inc["game9"]
+        inc = get("/incidents?frames=1")
+        assert len(inc["game9"]["live_frames"]) == 2
+        # endpoint list advertises the new paths
+        try:
+            get("/nope")
+        except urllib.error.HTTPError as e:
+            listing = json.loads(e.read().decode())["endpoints"]
+            assert "/workload" in listing and "/incidents" in listing
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        flightrec.reset()
+
+
+def test_scrape_workload_lines_format():
+    """tools/scrape_metrics.py workload_lines: one signature +
+    incident-count line per GAME process; processes without a live
+    world (gates/dispatchers serving the endpoint, 404s) skip
+    silently — the /costs convention."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "scrape_metrics_under_test",
+        os.path.join(repo, "tools", "scrape_metrics.py"))
+    scraper = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(scraper)
+
+    scraped = {
+        "game1": {
+            "workload": {
+                "sig": "churn=flock_like|density=exact|events=low",
+                "ticks": 128,
+                "recommendation": {"aoi_skin": "keep"},
+            },
+            "incidents": {"game1": {"incident_count": 2}},
+        },
+        # a gate answering /workload with the honest no-provider error
+        "gate1": {"workload": {"error": "no live workload provider"}},
+    }
+    lines = scraper.workload_lines(scraped)
+    assert len(lines) == 1
+    assert lines[0].startswith("game1: workload churn=flock_like")
+    assert "recommend aoi_skin=keep" in lines[0]
+    assert lines[0].endswith("| incidents 2")
+
+
+def test_workload_endpoint_honest_without_provider():
+    flightrec.reset()
+    assert "error" in flightrec.workload_snapshot()
+    flightrec.set_workload_provider(lambda: None)
+    assert "error" in flightrec.workload_snapshot()
+
+    def boom():
+        raise RuntimeError("provider died")
+
+    flightrec.set_workload_provider(boom)
+    assert "provider died" in flightrec.workload_snapshot()["error"]
+    flightrec.reset()

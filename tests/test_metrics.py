@@ -199,11 +199,16 @@ def test_running_game_exposes_tick_series_and_trace():
     t.start()
     srv = debug_http.start(0, process_name="game1")
     try:
+        # tick_latency_ms is process-global: wait RELATIVE to its
+        # current count so an earlier test's serve loop can't satisfy
+        # the wait before THIS loop has recorded any tick
+        count0 = gs._m_tick_hist.count
         deadline = time.monotonic() + 10
-        while gs._m_tick_hist.count < 5 \
+        while gs._m_tick_hist.count < count0 + 5 \
                 and time.monotonic() < deadline:
             time.sleep(0.05)
-        assert gs._m_tick_hist.count >= 5, "serve loop never ticked"
+        assert gs._m_tick_hist.count >= count0 + 5, \
+            "serve loop never ticked"
 
         port = srv.server_address[1]
         with urllib.request.urlopen(

@@ -1,6 +1,7 @@
 """WebSocket client edge (reference gate's websocket listener,
 ``GateService.go:121-168``, and test_client's ``-ws`` flag)."""
 
+import os
 import threading
 import time
 
@@ -91,3 +92,44 @@ def test_ws_login_and_rpc(ws_cluster):
     fut = harness.submit(_ws_login(bot))
     fut.result(timeout=40)
     assert not bot.errors, bot.errors
+
+
+def test_ws_shim_roundtrip():
+    """The stdlib RFC6455 shim (net/ws.py — the fallback that makes
+    the gate's ws edge work without the third-party ``websockets``
+    package): handshake, binary/text echo, 16/64-bit length paths,
+    transparent ping->pong, clean close."""
+    import asyncio
+
+    from goworld_tpu.net import ws
+
+    async def main():
+        async def handler(sock):
+            async for msg in sock:
+                await sock.send(msg)  # echo, type-preserving
+
+        srv = await ws.serve(handler, "127.0.0.1", 0)
+        port = srv.sockets[0].getsockname()[1]
+        c = await ws.connect(f"ws://127.0.0.1:{port}")
+        assert c.open
+        await c.send(b"\x00\x01bin")
+        assert await c.recv() == b"\x00\x01bin"
+        await c.send("text")
+        assert await c.recv() == "text"
+        mid = os.urandom(1000)          # 16-bit length path
+        await c.send(mid)
+        assert await c.recv() == mid
+        big = os.urandom(70 * 1024)     # 64-bit length path
+        await c.send(big)
+        assert await c.recv() == big
+        # a ping is answered transparently; the next data frame still
+        # arrives in order
+        await c._send_frame(ws.OP_PING, b"hb")
+        await c.send(b"after-ping")
+        assert await c.recv() == b"after-ping"
+        await c.close()
+        assert not c.open
+        srv.close()
+        await srv.wait_closed()
+
+    asyncio.run(main())

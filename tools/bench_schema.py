@@ -29,7 +29,12 @@ contract):
   ``cost_report``/``roofline_audit`` (``{"error": ...}`` accepted as
   honest failure) and a ``phases.border_churn`` block; failed rounds
   (rc != 0) and ``skipped`` records stay exempt, old dryrun-only
-  artifacts are grandfathered.
+  artifacts are grandfathered;
+* rounds >= 11 (the workload-signature era, ISSUE 11): a
+  ``workload_signature`` block — the live ``/workload`` grammar
+  (sig/churn/density/events/recommendation) stamped by the same
+  jax-free reducer — in BENCH headlines and MULTICHIP documents alike
+  (``{"error"/"skipped": ...}`` accepted as honest failure).
 
 Exit codes: 0 all valid, 1 usage/missing, 2 schema violations.
 """
@@ -64,6 +69,14 @@ DEVICE_PLANE_SINCE = 8
 # (bench.py --multichip, ISSUE 10): required from r10, old dryrun-only
 # artifacts grandfathered
 MULTI_HEADLINE_SINCE = 10
+# the workload-signature era (ISSUE 11): every BENCH/MULTICHIP round
+# stamps the jax-free signature reduction of its drained telemetry
+# lanes — the same grammar the live /workload endpoint serves
+# ({"error"/"skipped": ...} accepted as honest failure, like every
+# device-plane block)
+WORKLOAD_SIG_SINCE = 11
+WORKLOAD_SIG_KEYS = ("sig", "churn", "density", "events",
+                     "recommendation")
 MULTI_HEADLINE_KEYS = ("entity_ticks_per_sec_mesh",
                        "per_chip_efficiency", "n_entities", "platform")
 MULTI_GAUGE_KEYS = ("halo_demand_max", "migrate_demand_max",
@@ -126,6 +139,9 @@ def validate_bench(path: str, doc: dict) -> list[str]:
         if not isinstance(ost, dict) or not (
                 {"error", "skipped"} & set(ost) or "tick_ms" in ost):
             errs.append("missing/invalid op_stats block")
+    if rno >= WORKLOAD_SIG_SINCE:
+        _check_block(rec, "workload_signature", WORKLOAD_SIG_KEYS,
+                     errs)
     # per-scenario blocks, wherever present: each needs either a
     # headline-style shape or an honest error
     for sc, blk in (rec.get("scenarios") or {}).items():
@@ -176,6 +192,9 @@ def validate_multichip(path: str, doc: dict) -> list[str]:
     _check_block(doc, "gauges", MULTI_GAUGE_KEYS, errs)
     _check_block(doc, "cost_report", ("name",), errs)
     _check_block(doc, "roofline_audit", ("phases",), errs)
+    if rno >= WORKLOAD_SIG_SINCE:
+        _check_block(doc, "workload_signature", WORKLOAD_SIG_KEYS,
+                     errs)
     phases = doc.get("phases")
     if not isinstance(phases, dict) \
             or not isinstance(phases.get("border_churn"), dict):

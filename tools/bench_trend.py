@@ -19,6 +19,10 @@ regression introduced by the change under test):
 * per-scenario block ``value``s: same rule, matched by scenario name
   at equal entities;
 * ``slo.pass``: a true -> false transition at the same shape fails;
+* ``workload_signature``: a class-string drift vs the most recent
+  comparable round is an informational NOTE, never a gate (the
+  signature describes the workload, not the implementation — but a
+  drift next to a perf swing is the first thing to read);
 * MULTICHIP: the latest record must keep ``ok`` (when any prior round
   had it) and ``rc == 0``; measured mesh headlines (r >= 10) gate
   ``entity_ticks_per_sec_mesh`` against the best prior at the same
@@ -140,6 +144,19 @@ def check_bench(files: list[str], threshold: float,
                 f"{name}: slo pass regressed true -> false "
                 f"(p99 {lslo.get('p99_ms')} vs target "
                 f"{lslo.get('target_ms')})")
+    # workload-signature drift is INFORMATIONAL, never gated: the
+    # signature classifies the measured workload, and a class change at
+    # the same shape usually means the bench mix changed on purpose —
+    # but a silent drift next to a perf swing is the first thing a
+    # reader should see, so it's surfaced as a note
+    lsig = (latest.get("workload_signature") or {}).get("sig")
+    psig = (prev.get("workload_signature") or {}).get("sig")
+    if lsig and psig and lsig != psig:
+        notes.append(
+            f"{name}: workload signature drifted vs {pname}: "
+            f"{psig} -> {lsig} (informational, not gated)")
+    elif lsig:
+        notes.append(f"{name}: workload signature {lsig}")
 
 
 def _multi_headline(doc: dict) -> dict | None:

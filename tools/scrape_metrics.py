@@ -155,6 +155,60 @@ def scrape_costs(targets: list[tuple[str, str]], timeout: float = 2.0,
     return out
 
 
+def scrape_workload(targets: list[tuple[str, str]],
+                    timeout: float = 2.0) -> dict[str, dict]:
+    """Fetch each target's ``/workload`` and ``/incidents`` (derived
+    from its /metrics url); {label: {"workload": ..., "incidents":
+    ...}}. Unreachable processes and processes predating the
+    endpoints (404) are skipped silently, matching the ``/costs``
+    convention — old processes are not noise."""
+    out: dict[str, dict] = {}
+    for label, url in targets:
+        base = url.rsplit("/", 1)[0]
+        entry: dict = {}
+        for name in ("workload", "incidents"):
+            try:
+                with urllib.request.urlopen(f"{base}/{name}",
+                                            timeout=timeout) as resp:
+                    payload = json.loads(
+                        resp.read().decode("utf-8", "replace"))
+            except (urllib.error.URLError, OSError, ValueError):
+                # 404s (old processes) arrive as HTTPError — skipped
+                # here like unreachable hosts
+                continue
+            if isinstance(payload, dict):
+                entry[name] = payload
+        if entry:
+            out[label] = entry
+    return out
+
+
+def workload_lines(scraped: dict[str, dict]) -> list[str]:
+    """One live workload-signature + incident-count line per process
+    (``cli.py status`` prints these under the SLO verdicts)."""
+    lines: list[str] = []
+    for label, entry in sorted(scraped.items()):
+        wl = entry.get("workload")
+        if not (isinstance(wl, dict) and wl.get("sig")):
+            # gates/dispatchers serve the endpoint but carry no live
+            # world — skip silently, like 404s
+            continue
+        rec = wl.get("recommendation") or {}
+        rec_s = " ".join(f"{k}={v}" for k, v in sorted(rec.items()))
+        line = (f"{label}: workload {wl['sig']} "
+                f"({wl.get('ticks', 0)} ticks in window"
+                + (f"; recommend {rec_s}" if rec_s else "") + ")")
+        inc = entry.get("incidents")
+        if isinstance(inc, dict):
+            n = sum(
+                rec.get("incident_count", 0)
+                for rec in inc.values() if isinstance(rec, dict)
+            )
+            line += f" | incidents {n}"
+        lines.append(line)
+    return lines
+
+
 def slo_lines(costs: dict[str, dict]) -> list[str]:
     """One human line per process: the SLO verdict (or its absence)."""
     lines: list[str] = []
@@ -216,6 +270,12 @@ def main(argv: list[str] | None = None) -> int:
         print()
         for line in slo_lines(costs):
             print(line)
+    # live workload signature + incident counts (debug_http /workload
+    # + /incidents; 404/unreachable skipped silently like /costs)
+    wl = scrape_workload([t for t in targets if t[0] in results],
+                         timeout=args.timeout)
+    for line in workload_lines(wl):
+        print(line)
     if args.costs:
         for label, payload in sorted(costs.items()):
             for name, rep in (payload.get("reports") or {}).items():
