@@ -104,6 +104,7 @@ GRID_ENV = {
     "sort_impl": "BENCH_SORT",
     "skin": "BENCH_SKIN",
     "verlet_cap": "BENCH_VERLET_CAP",
+    "precision": "BENCH_PRECISION",
 }
 
 # Bench-default Verlet skin (world units). The bench movers advance
@@ -253,6 +254,11 @@ def _grid_kw_from_env(n: int, overrides: dict | None = None) -> dict:
                                  _consts.DEFAULT_SORT_IMPL),
         skin=float(os.environ.get("BENCH_SKIN", BENCH_SKIN_DEFAULT)),
         verlet_cap=int(os.environ.get("BENCH_VERLET_CAP", 0)),
+        # quantized state planes (ISSUE 12): off by default — the
+        # headline stays bit-identical to prior rounds; the
+        # precision_ab block A/Bs on-vs-off every run
+        precision=os.environ.get("BENCH_PRECISION",
+                                 _consts.DEFAULT_PRECISION),
     )
     grid_kw.update(overrides or {})
     grid_kw["row_block"] = min(n, grid_kw["row_block"])
@@ -547,6 +553,76 @@ def backhalf_ab(n: int, ticks: int = 4) -> dict:
             out["error"] = f"{label}: {str(exc)[:200]}"
             break
     log(f"backhalf_ab@{n}: {out}")
+    return out
+
+
+def precision_ab(n: int, ticks: int = 4) -> dict:
+    """Precision on/off A/B (ISSUE 12): full-sweep scan-marginal
+    ms/tick with the quantized planes off vs on at the same shape and
+    workload (skin pinned 0, the front/back-half A/B convention), plus
+    the MODELED bytes/tick both ways at this shape AND the 1M
+    north-star shape — so every artifact carries the measured marginal
+    next to the roofline claim the plane exists to cash. Runs on every
+    platform (the q16 path is plain XLA — no interpret-mode caveat);
+    failures fold into {"error": ...} like backhalf_ab."""
+    import jax
+    from jax import lax
+
+    from goworld_tpu.ops.aoi import GridSpec, grid_neighbors_flags
+    from goworld_tpu.utils import devprof
+
+    extent, pos, alive, flags = _ab_world(n, seed=7)
+    out: dict = {"n": n}
+    for label, prec in (("off_ms", "off"), ("q16_ms", "q16")):
+        gk = _grid_kw_from_env(n, {"precision": prec, "skin": 0.0})
+        spec = GridSpec(radius=50.0, extent_x=extent, extent_z=extent,
+                        **gk)
+
+        def mk(length, spec=spec):
+            @jax.jit
+            def run(p):
+                def body(c, _):
+                    _nbr, cnt, fl = grid_neighbors_flags(
+                        spec, c, alive, flag_bits=flags
+                    )
+                    c = c + (cnt[:, None] % 2).astype(c.dtype) * 1e-6
+                    return c, cnt.sum() + fl.sum()
+                pp, s = lax.scan(body, p, None, length=length)
+                return s.sum() + pp.sum()
+            return run
+
+        try:
+            out[label] = round(_scan_marginal_ms(mk, pos, ticks), 3)
+        except Exception as exc:
+            out["error"] = f"{label}: {str(exc)[:200]}"
+            break
+        if prec == "q16":
+            out["pos_scale_bits"] = spec.quant_bits
+            out["quant_step"] = spec.quant_step
+    # the modeled claim, stamped both ways at this shape and at 1M
+    # (sum of the non-overlapping aoi/move/collect phase terms) —
+    # once for the RESOLVED env config, and once at the ROOFLINE
+    # headline config (fused + counting, the TPU production stack the
+    # "~1.5 GB -> under 0.8 GB" claim is made at)
+    try:
+        def _tot(nn, gk):
+            m = devprof.roofline_model_bytes(nn, gk)
+            return round(sum(m[p] for p in ("aoi", "move", "collect"))
+                         / 1e9, 3)
+
+        for tag, nn in (("", n), ("_1m", 1 << 20)):
+            for label, prec in (("model_off", "off"),
+                                ("model_q16", "q16")):
+                out[f"{label}_gb{tag}"] = _tot(
+                    nn, _grid_kw_from_env(nn, {"precision": prec}))
+        head = {"k": 32, "cell_cap": 12, "sort_impl": "counting",
+                "sweep_impl": "fused", "skin": 0.0}
+        for label, prec in (("model_off", "off"), ("model_q16", "q16")):
+            out[f"{label}_gb_1m_headline"] = _tot(
+                1 << 20, dict(head, precision=prec))
+    except Exception as exc:
+        out.setdefault("error", f"model: {str(exc)[:200]}")
+    log(f"precision_ab@{n}: {out}")
     return out
 
 
@@ -898,6 +974,18 @@ def measure(n: int, ticks: int, client_frac: float, phases: bool,
         "verlet_cap": (cfg.grid.verlet_cap_eff
                        if cfg.grid.skin > 0
                        and n < (1 << _AOI_ID_BITS) else 0),
+        # resolved quantized-plane config (ISSUE 12; bench_schema
+        # requires the block from r12): plane on/off, the lattice
+        # scale, and the delta-sync knobs a serving deploy would run
+        "precision": {
+            "plane": cfg.grid.precision,
+            "pos_scale_bits": cfg.grid.quant_bits,
+            "quant_step": cfg.grid.quant_step,
+            "sync_delta": os.environ.get("BENCH_SYNC_DELTA",
+                                         "0") == "1",
+            "sync_keyframe_every": int(os.environ.get(
+                "BENCH_SYNC_KEYFRAME_EVERY", 16)),
+        },
         "device": str(jax.devices()[0]),
         "platform": jax.devices()[0].platform,
     }
@@ -997,6 +1085,7 @@ def _model_grid_kw(cfg, n: int) -> dict:
         "sort_impl": g.sort_impl, "sweep_impl": g.sweep_impl,
         "skin": g.skin if skin_on else 0.0,
         "verlet_cap": g.verlet_cap_eff if skin_on else 0,
+        "precision": g.precision,
     }
 
 
@@ -1979,6 +2068,16 @@ def child_main(args) -> int:
                 r["backhalf_ab"] = backhalf_ab(ab_n)
             except Exception as exc:  # belt over backhalf_ab's braces
                 r["backhalf_ab"] = {"error": str(exc)[:200]}
+        if name == "full" \
+                and os.environ.get("BENCH_PRECISION_AB", "1") == "1":
+            # quantized-plane on/off A/B (ISSUE 12): measured marginal
+            # + modeled bytes both ways, every platform, every round
+            ab_n = min(n, int(os.environ.get("BENCH_PRECISION_AB_N",
+                                             131072)))
+            try:
+                r["precision_ab"] = precision_ab(ab_n)
+            except Exception as exc:
+                r["precision_ab"] = {"error": str(exc)[:200]}
         print(json.dumps(r), flush=True)
         if name == "full" and scenario_selection():
             # per-scenario headline blocks, AFTER the headline line is
@@ -2563,6 +2662,11 @@ def selftest_main() -> int:
             check(f"full.{k}", k in art, "missing")
         for k in ("sweep_impl", "topk_impl", "sort_impl", "skin"):
             check(f"full.stamp.{k}", k in art, "missing kernel stamp")
+        # the resolved precision block (ISSUE 12; r>=12 schema rule)
+        pr = art.get("precision", {})
+        check("full.stamp.precision", isinstance(pr, dict)
+              and {"plane", "pos_scale_bits", "sync_keyframe_every"}
+              <= set(pr), str(pr)[:120])
         pm = art.get("phase_ms", {})
         phase_keys = ["aoi", "aoi_sort", "aoi_build", "aoi_gather",
                       "aoi_pack", "aoi_rank", "move", "collect"]
@@ -2619,6 +2723,14 @@ def selftest_main() -> int:
             check("full.backhalf_ab",
                   "fused_ms" in ab and "split_ms" in ab
                   and "interpret" in ab, str(ab))
+        if os.environ.get("BENCH_PRECISION_AB", "1") == "1":
+            # the precision on/off A/B (ISSUE 12): measured marginal
+            # both ways + the modeled bytes claim at this shape and 1M
+            pab = art.get("precision_ab", {})
+            check("full.precision_ab",
+                  "off_ms" in pab and "q16_ms" in pab
+                  and "model_q16_gb_1m" in pab
+                  and "model_off_gb_1m" in pab, str(pab)[:160])
         # per-scenario headline blocks (ISSUE 7): present for every
         # registry scenario by default, hotspot + shrink being the
         # named worst cases, each stamped with resolved kernels,

@@ -197,11 +197,21 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
             "ranges" if gc.aoi_sweep_impl == "fused" else "table",
             gc.capacity, consts.AOI_ID_BITS,
         )
+    precision = gc.precision
+    if gc.megaspace and precision != "off":
+        # the tile grids keep f32 this round: the halo wire packing is
+        # staged behind the model's ici_halo_mb_by_impl *_q16 rows
+        # (docs/ROOFLINE.md "Quantized state planes") — say so rather
+        # than silently change the mesh's byte layout
+        logger.warning("precision=%s ignored for megaspace games "
+                       "(quantized halo packing staged)", precision)
+        precision = "off"
     kernel_kw = dict(
         sort_impl=gc.aoi_sort_impl,
         skin=aoi_skin,
         verlet_cap=gc.aoi_verlet_cap,
         rebuild_every_max=gc.aoi_rebuild_every_max,
+        precision=precision,
     )
     mega_shape = None
     if gc.megaspace:
@@ -300,6 +310,7 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
         pipeline_decode=gc.pipeline_decode and mesh is None
         and not gc.megaspace,
         telemetry_live=gc.telemetry_live,
+        snapshot_keyframe_every=gc.snapshot_keyframe_every,
     )
     # periodic persistence cadence (reference [gameN] save_interval,
     # goworld.ini.sample:45; Entity.go:164-177)
@@ -429,6 +440,8 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
             degraded_event_coalesce=gc.degraded_event_coalesce,
             flightrec_ring=gc.flightrec_ring,
             flightrec_cooldown_secs=gc.flightrec_cooldown_secs,
+            sync_delta=gc.sync_delta,
+            sync_keyframe_every=gc.sync_keyframe_every,
         )
 
     restoring = args.restore and \

@@ -191,6 +191,31 @@ class GameConfig:
     # frozen snapshot bundles served at /incidents
     flightrec_ring: int = 512
     flightrec_cooldown_secs: float = 30.0
+    # quantized state planes (ops/aoi.py GridSpec.precision; ISSUE 12):
+    # "off" (default — bit-identical to pre-r12 behavior) | "q16" —
+    # AOI-visible positions snap to a power-of-two int16 lattice and
+    # the byte-heavy paths run on narrow planes (packed sorted view,
+    # packed Verlet cache, bf16 velocity). Exact vs the oracle over the
+    # snapped world BY CONSTRUCTION (docs/ROOFLINE.md "Quantized state
+    # planes"). Rejected loudly at GridSpec build when the lattice
+    # would be coarser than radius/4 or the origin is nonzero. Ignored
+    # (warned) for megaspace games this round — the tile grids keep
+    # f32 while the halo packing is staged (the audit stamps its
+    # projected ICI win as ici_halo_mb_by_impl *_q16 rows).
+    precision: str = consts.DEFAULT_PRECISION
+    # delta-compressed client sync fan-out (net/codec.py
+    # DeltaSyncEncoder; ISSUE 12): steady-state sync bytes scale with
+    # dirty_frac * 13 B/record instead of 48 B/record. Decode at the
+    # gate is bit-deterministic (baselines/keyframes ride in-band).
+    sync_delta: bool = False
+    # full-precision keyframe cadence per (client, entity) pair for
+    # the delta sync stream (ticks)
+    sync_keyframe_every: int = 16
+    # delta-compressed snapshot chain (freeze.py SnapshotChain): every
+    # Nth periodic checkpoint is a full quantized keyframe, the writes
+    # between ship sparse int16 plane deltas with per-plane CRCs.
+    # 0 = the monolithic checkpoint format, unchanged.
+    snapshot_keyframe_every: int = 0
 
 
 @dataclasses.dataclass
@@ -500,6 +525,18 @@ extent_z = 1000.0
 # overload_latency_ratio = 1.5  # tick wall / interval that = pressure
 # degraded_sync_stride = 4 # DEGRADED: sync each entity cohort every Nth
 # degraded_event_coalesce = 2  # DEGRADED: flush bundles every Nth tick
+# precision = q16          # quantized state planes (ISSUE 12): snap
+#                          # AOI-visible positions to an int16 lattice,
+#                          # bf16 velocity, packed sweep/Verlet planes —
+#                          # halves modeled bytes/tick; off = bit-
+#                          # identical to pre-r12 (docs/ROOFLINE.md)
+# sync_delta = true        # delta-compressed sync fan-out: int16 deltas
+#                          # vs per-(client,entity) baselines, 13 B vs
+#                          # 48 B/record steady state
+# sync_keyframe_every = 16 # full-precision keyframe cadence (ticks)
+# snapshot_keyframe_every = 8  # delta-compressed checkpoint chain:
+#                          # every Nth checkpoint is a full quantized
+#                          # keyframe (0 = monolithic checkpoints)
 
 [game1]
 

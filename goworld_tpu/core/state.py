@@ -168,10 +168,17 @@ def create_state(cfg: WorldConfig, seed: int = 0) -> SpaceState:
     else:
         behavior_id = None
         aoi_radius = jnp.full((n,), jnp.inf, jnp.float32)
+    # precision=q16 (cfg.grid.precision): the carried velocity plane is
+    # bf16 — integration and behaviors read it promoted to f32 and the
+    # tick stores back rounded, halving the plane's HBM stream ("where
+    # consumers tolerate it": velocity is a behavior-internal quantity,
+    # never an oracle input — positions remain the f32 master)
+    vel_dtype = jnp.bfloat16 if cfg.grid.precision != "off" \
+        else jnp.float32
     return SpaceState(
         pos=jnp.zeros((n, 3), jnp.float32),
         yaw=jnp.zeros((n,), jnp.float32),
-        vel=jnp.zeros((n, 3), jnp.float32),
+        vel=jnp.zeros((n, 3), vel_dtype),
         alive=jnp.zeros((n,), bool),
         npc_moving=jnp.zeros((n,), bool),
         has_client=jnp.zeros((n,), bool),

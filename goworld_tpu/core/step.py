@@ -38,6 +38,7 @@ from goworld_tpu.ops.aoi import (
     _ID_BITS,
     grid_neighbors_flags,
     grid_neighbors_verlet,
+    quantize_positions,
 )
 from goworld_tpu.ops.delta import interest_pairs
 from goworld_tpu.ops.integrate import apply_pos_inputs, integrate
@@ -171,6 +172,18 @@ def tick_body(
     """Un-jitted single-Space tick (reused by the shard_map'd multi-space
     step in :mod:`goworld_tpu.parallel.step`). See :func:`make_tick`."""
     n = cfg.capacity
+    # precision=q16 (ISSUE 12): positions integrate in f32 (the master
+    # never loses sub-lattice motion) but everything AOI-visible — the
+    # sweep, the Verlet cache, sync records — runs on the SNAPPED
+    # lattice view, and the carried velocity plane is bf16 (read
+    # promoted here, stored rounded below). The dirty bit dead-bands on
+    # the lattice: sub-step jitter moves nothing a client could see, so
+    # it stops generating sync records at all (the delta-sync byte
+    # story's device half).
+    prec = cfg.grid.precision != "off"
+    vel_dtype = state.vel.dtype
+    if prec:
+        state = state.replace(vel=state.vel.astype(jnp.float32))
 
     # 1. client inputs (scatter).
     pos, yaw, touched = apply_pos_inputs(
@@ -207,6 +220,17 @@ def tick_body(
         # jump and trips the in-graph rebuild cond on this exact tick
         pos = jnp.where(tele[:, None], tele_pos, pos)
         moved = moved | tele
+    if prec:
+        # the AOI-visible view: snapped lattice positions. "moved" is
+        # re-derived IN THE LATTICE DOMAIN (y stays a raw compare) —
+        # an entity that didn't cross a lattice step is clean for
+        # sync/halo purposes, exactly because no consumer can observe
+        # the sub-step motion.
+        apos = quantize_positions(cfg.grid, pos)
+        aprev = quantize_positions(cfg.grid, state.pos)
+        moved = jnp.any(apos != aprev, axis=1)
+    else:
+        apos = pos
     # state.dirty carries host-set pending force-syncs (spawn marks the
     # new entity dirty so watchers get its position, the syncInfoFlag
     # analog — Entity.go:1189-1205); consumed here, cleared below.
@@ -231,13 +255,13 @@ def tick_body(
     if use_verlet:
         (nbr, nbr_cnt, nbr_fl, aoi_stats, aoi_cache, aoi_rebuilt,
          aoi_slack) = grid_neighbors_verlet(
-            cfg.grid, pos, state.alive, state.aoi_cache,
+            cfg.grid, apos, state.alive, state.aoi_cache,
             watch_radius=state.aoi_radius, flag_bits=flag_bits,
             with_stats=True,
         )
     else:
         nbr, nbr_cnt, nbr_fl, aoi_stats = grid_neighbors_flags(
-            cfg.grid, pos, state.alive, watch_radius=state.aoi_radius,
+            cfg.grid, apos, state.alive, watch_radius=state.aoi_radius,
             flag_bits=flag_bits,
             with_stats=True,
         )
@@ -254,9 +278,12 @@ def tick_body(
         adaptive=cfg.adaptive_extract,
     )
 
-    # 6. position sync records (CollectEntitySyncInfos analog).
+    # 6. position sync records (CollectEntitySyncInfos analog). Under
+    # precision the records carry the SNAPPED positions — the same
+    # lattice values the interest sets were computed from, and exactly
+    # what the delta-sync codec re-encodes as int16 steps.
     sync_w, sync_j, sync_vals, sync_n = collect_sync(
-        nbr, dirty, state.has_client, pos, yaw, cfg.sync_cap,
+        nbr, dirty, state.has_client, apos, yaw, cfg.sync_cap,
         nbr_dirty=(nbr_fl & 1).astype(bool),
         adaptive=cfg.adaptive_extract,
     )
@@ -270,7 +297,7 @@ def tick_body(
     new_state = state.replace(
         pos=pos,
         yaw=yaw,
-        vel=vel,
+        vel=vel.astype(vel_dtype),
         nbr=nbr,
         nbr_cnt=nbr_cnt,
         nbr_client_cnt=((nbr_fl >> 1) & 1).sum(axis=1).astype(jnp.int32),

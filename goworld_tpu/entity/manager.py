@@ -159,7 +159,13 @@ class World:
         mega_shape: tuple[int, int] | None = None,
         pipeline_decode: bool = False,
         telemetry_live: bool = True,
+        snapshot_keyframe_every: int = 0,
     ):
+        # delta-compressed snapshot cadence (ISSUE 12, freeze.py
+        # SnapshotChain): every Nth checkpoint is a full quantized
+        # keyframe, the rest ship sparse int16 plane deltas against it;
+        # 0 = today's monolithic msgpack snapshots, bit-identically
+        self.snapshot_keyframe_every = max(0, int(snapshot_keyframe_every))
         self.cfg = cfg
         self.n_spaces = n_spaces
         self.game_id = game_id

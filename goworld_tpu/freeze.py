@@ -275,6 +275,15 @@ def write_freeze_file(path: str, data: dict) -> None:
 
 
 def read_freeze_file(path: str) -> dict:
+    """Read + parse one snapshot. Version-2 (quantized/delta plane)
+    files are RESOLVED here — a delta re-reads its keyframe, verifies
+    the per-plane CRCs it recorded against the keyframe's actual
+    planes, and reconstructs a version-1 record — so every caller
+    (restore_world, has_restorable_snapshot, the candidate fallback
+    walk) keeps working on the v1 shape, and ANY chain damage
+    (truncated delta, missing/rewritten keyframe, CRC mismatch)
+    surfaces as the same CorruptSnapshotError the freshest-parseable
+    fallback already handles."""
     with open(path, "rb") as f:
         raw = f.read()
     try:
@@ -287,6 +296,8 @@ def read_freeze_file(path: str) -> dict:
         raise CorruptSnapshotError(
             f"snapshot {path!r} parsed but is not a freeze record"
         )
+    if data.get("version") == SNAPSHOT_PLANE_VERSION:
+        return _resolve_snapshot_v2(path, data)
     return data
 
 
@@ -298,13 +309,18 @@ def freeze_to_file(world: World, directory: str = ".") -> str:
 
 def snapshot_candidates(game_id: int, directory: str = ".") -> list[str]:
     """Existing snapshot files for a game, freshest (by mtime) first:
-    the freeze file (intentional reload) and the periodic crash-recovery
-    checkpoint. Mtime orders because either can be stale — a freeze file
-    left over from an old reload must not shadow hours of newer
-    checkpoints after a crash, and vice versa."""
+    the freeze file (intentional reload), the periodic crash-recovery
+    checkpoint, and the quantized/delta snapshot chain (delta first —
+    it is the newest state; a corrupt or base-mismatched delta raises
+    CorruptSnapshotError and the walk falls back to its keyframe).
+    Mtime orders because any can be stale — a freeze file left over
+    from an old reload must not shadow hours of newer checkpoints
+    after a crash, and vice versa."""
     cands = []
     for p in (os.path.join(directory, freeze_filename(game_id)),
-              os.path.join(directory, checkpoint_filename(game_id))):
+              os.path.join(directory, checkpoint_filename(game_id)),
+              os.path.join(directory, chain_delta_filename(game_id)),
+              os.path.join(directory, chain_key_filename(game_id))):
         try:
             cands.append((os.path.getmtime(p), p))
         except OSError:
@@ -449,3 +465,284 @@ def checkpoint_async(world: World, directory: str = ".") -> CheckpointHandle:
     handle._thread = t
     t.start()
     return handle
+
+
+# =======================================================================
+# quantized + delta-compressed snapshot chain (ISSUE 12)
+# =======================================================================
+# The monolithic msgpack snapshot re-serializes every entity's full
+# f32 position/yaw each cadence. The chain writes the device planes
+# QUANTIZED (int16 lattice coordinates — the same power-of-two lattice
+# the precision sweep and the delta-sync wire use, GridSpec.quant_step)
+# and DELTA-COMPRESSED: every `keyframe_every`-th write is a full
+# keyframe, the writes between ship only the rows whose quantized
+# planes changed, against the keyframe — with a per-plane CRC of the
+# base recorded in each delta so a rewritten/damaged keyframe can
+# never be silently merged (mismatch => CorruptSnapshotError => the
+# candidate walk falls back to the keyframe itself, then the legacy
+# files). Restore of a quantized snapshot is BIT-EXACT in the lattice
+# domain: lattice points re-quantize to themselves, so
+# write->restore->write produces byte-identical planes (tested in
+# tests/test_freeze.py).
+
+SNAPSHOT_PLANE_VERSION = 2
+_PLANES = ("pos_xz", "pos_y", "yaw", "moving")
+# yaw wire/plane step: full turn in 2^16 int16 steps (headings are
+# modular, so int16 wraparound IS the mod-2pi wrap)
+YAW_STEP = (2.0 * 3.141592653589793) / 65536.0
+
+
+def chain_key_filename(game_id: int) -> str:
+    return f"game{game_id}_ckpt_key.dat"
+
+
+def chain_delta_filename(game_id: int) -> str:
+    return f"game{game_id}_ckpt_delta.dat"
+
+
+def _crc(b: bytes) -> int:
+    import zlib
+
+    return zlib.crc32(b) & 0xFFFFFFFF
+
+
+def snapshot_quant_step(world: World) -> float:
+    """The chain's position lattice step — GridSpec.quant_step, i.e.
+    the EXACT step the precision sweep runs on when precision=q16
+    (those worlds roundtrip bit-for-bit against their own AOI-visible
+    positions), and the same <=2^15-points-per-axis power-of-two
+    derivation for f32 worlds."""
+    return world.cfg.grid.quant_step
+
+
+def _extract_planes(data: dict, step: float,
+                    origin: tuple = (0.0, 0.0)) -> dict:
+    """Strip pos/yaw/moving out of a v1 record's entity list into
+    quantized column planes (row i == entities[i]). ``origin`` is the
+    grid origin — lattice coordinates are ORIGIN-RELATIVE so worlds
+    with shifted/negative bounds quantize correctly (positions outside
+    [origin, origin + 2^15*step) clamp into that window, the same
+    clamp-into-bounds semantic the grid applies)."""
+    ents = data["entities"]
+    m = len(ents)
+    ox, oz = float(origin[0]), float(origin[1])
+    qxz = np.zeros((m, 2), np.int16)
+    py = np.zeros((m,), np.float32)
+    qyaw = np.zeros((m,), np.int16)
+    mov = np.zeros((m,), np.uint8)
+    hi = 32767
+    for i, e in enumerate(ents):
+        px, pyv, pz = e.pop("pos")
+        qxz[i, 0] = min(max(int(np.floor((px - ox) / step)), 0), hi)
+        qxz[i, 1] = min(max(int(np.floor((pz - oz) / step)), 0), hi)
+        py[i] = np.float32(pyv)
+        # modular wrap: int16 overflow of a heading is the 2pi wrap
+        qyaw[i] = np.int16(
+            np.uint16(int(round(e.pop("yaw") / YAW_STEP)) & 0xFFFF))
+        mov[i] = 1 if e.pop("moving") else 0
+    return {
+        "pos_xz": qxz.tobytes(), "pos_y": py.tobytes(),
+        "yaw": qyaw.tobytes(), "moving": mov.tobytes(),
+    }
+
+
+def _inject_planes(data: dict, planes: dict, step: float,
+                   origin: tuple = (0.0, 0.0)) -> dict:
+    """Inverse of :func:`_extract_planes`: dequantize the planes back
+    into the entity records (v1 shape)."""
+    ents = data["entities"]
+    m = len(ents)
+    ox, oz = float(origin[0]), float(origin[1])
+    qxz = np.frombuffer(planes["pos_xz"], np.int16).reshape(m, 2)
+    py = np.frombuffer(planes["pos_y"], np.float32)
+    qyaw = np.frombuffer(planes["yaw"], np.int16)
+    mov = np.frombuffer(planes["moving"], np.uint8)
+    for i, e in enumerate(ents):
+        e["pos"] = [float(np.float32(ox + int(qxz[i, 0]) * step)),
+                    float(py[i]),
+                    float(np.float32(oz + int(qxz[i, 1]) * step))]
+        e["yaw"] = float((int(qyaw[i]) & 0xFFFF) * YAW_STEP)
+        e["moving"] = bool(mov[i])
+    return data
+
+
+def _resolve_snapshot_v2(path: str, data: dict) -> dict:
+    """Resolve a version-2 snapshot into the v1 record shape
+    (read_freeze_file calls this; ALL failures — missing keys, wrong
+    shapes, short planes — surface as CorruptSnapshotError so the
+    freshest-parseable fallback walk handles them; a raw
+    KeyError/ValueError here would crash the -restore boot instead of
+    falling back)."""
+    try:
+        return _resolve_snapshot_v2_inner(path, data)
+    except CorruptSnapshotError:
+        raise
+    except Exception as exc:
+        raise CorruptSnapshotError(
+            f"snapshot {path!r}: malformed v2 record ({exc!r})"
+        ) from exc
+
+
+def _resolve_snapshot_v2_inner(path: str, data: dict) -> dict:
+    kind = data["kind"]
+    step = float(data["quant"]["step"])
+    origin = tuple(data["quant"].get("origin", (0.0, 0.0)))
+    host = data["host"]
+    planes = {nm: data["planes"][nm] for nm in _PLANES} \
+        if kind == "key" else None
+    if kind == "key":
+        for nm in _PLANES:
+            if _crc(planes[nm]) != data["plane_crcs"][nm]:
+                raise CorruptSnapshotError(
+                    f"snapshot {path!r}: plane {nm!r} CRC mismatch"
+                )
+    elif kind == "delta":
+        base_path = os.path.join(os.path.dirname(path) or ".",
+                                 data["base"]["file"])
+        try:
+            with open(base_path, "rb") as f:
+                base = msgpack.unpackb(f.read(), raw=False,
+                                       strict_map_key=False)
+        except Exception as exc:
+            raise CorruptSnapshotError(
+                f"snapshot {path!r}: keyframe {base_path!r} "
+                f"unreadable ({exc})"
+            ) from exc
+        if not isinstance(base, dict) or base.get("kind") != "key":
+            raise CorruptSnapshotError(
+                f"snapshot {path!r}: {base_path!r} is not a keyframe")
+        for nm in _PLANES:
+            if _crc(base["planes"][nm]) != data["base"]["plane_crcs"][nm]:
+                # the keyframe moved on (or was damaged) under this
+                # delta — merging would mix two worlds' planes
+                raise CorruptSnapshotError(
+                    f"snapshot {path!r}: base plane {nm!r} CRC "
+                    f"mismatch vs {base_path!r}"
+                )
+        # reconstruct: each delta row either references a keyframe row
+        # (by index) or ships its own values in the sparse section
+        try:
+            m = len(host["entities"])
+            rows = np.frombuffer(data["rows"], np.int32)
+            sparse = data["sparse"]
+            widths = {"pos_xz": (np.int16, 2), "pos_y": (np.float32, 1),
+                      "yaw": (np.int16, 1), "moving": (np.uint8, 1)}
+            planes = {}
+            for nm, (dt, w) in widths.items():
+                bp = np.frombuffer(base["planes"][nm], dt)
+                sp = np.frombuffer(sparse[nm], dt)
+                bp = bp.reshape(-1, w)
+                sp = sp.reshape(-1, w)
+                out = np.zeros((m, w), dt)
+                ref = rows >= 0
+                out[ref] = bp[rows[ref]]
+                out[~ref] = sp
+                planes[nm] = out.tobytes()
+        except Exception as exc:
+            raise CorruptSnapshotError(
+                f"snapshot {path!r}: delta reconstruction failed "
+                f"({exc!r})"
+            ) from exc
+    else:
+        raise CorruptSnapshotError(
+            f"snapshot {path!r}: unknown v2 kind {kind!r}")
+    return _inject_planes(dict(host), planes, step, origin)
+
+
+class SnapshotChain:
+    """Quantized/delta snapshot writer for one world (checkpoint
+    cadence). ``write()`` freezes the world synchronously; every
+    ``keyframe_every``-th write (and the first) is a full keyframe,
+    the rest are deltas against the last WRITTEN keyframe (held in
+    memory, so delta writes never re-read disk). Files are written
+    atomically via the same tmp+rename path as every snapshot.
+
+    Scope honesty: the DELTA treatment covers the DEVICE planes
+    (pos/yaw/moving — the bulk at NPC scale); the host section (ids,
+    attrs, timers, bindings) still serializes whole each write,
+    because attrs mutate outside any dirty tracking this writer can
+    see — attr-heavy worlds keep correctness but less of the byte
+    win. Writes run on the caller's (tick) thread: the delta diff
+    needs the in-memory keyframe; the knob is opt-in and its cadence
+    is the operator's latency-budget call (an off-thread plane write
+    is the staged follow-up)."""
+
+    def __init__(self, world: World, directory: str = ".",
+                 keyframe_every: int = 8):
+        if keyframe_every < 1:
+            raise ValueError(
+                f"keyframe_every must be >= 1, got {keyframe_every!r}")
+        self.world = world
+        self.directory = directory
+        self.keyframe_every = int(keyframe_every)
+        self.step = snapshot_quant_step(world)
+        # lattice coordinates are origin-relative (shifted/negative
+        # worlds must not clamp to the zero corner)
+        g = world.cfg.grid
+        self.origin = (float(g.origin_x), float(g.origin_z))
+        self._count = 0
+        self._key_planes: dict | None = None
+        self._key_crcs: dict | None = None
+        self._key_rows: dict | None = None   # eid -> keyframe row
+
+    def write(self) -> str:
+        data = freeze_world(self.world, run_hooks=False)
+        planes = _extract_planes(data, self.step,   # pops pos/yaw/moving
+                                 self.origin)
+        eids = [e["id"] for e in data["entities"]]
+        is_key = (self._key_planes is None
+                  or self._count % self.keyframe_every == 0)
+        self._count += 1
+        if is_key:
+            crcs = {nm: _crc(planes[nm]) for nm in _PLANES}
+            rec = {
+                "version": SNAPSHOT_PLANE_VERSION, "kind": "key",
+                "quant": {"step": self.step, "yaw_step": YAW_STEP,
+                          "origin": list(self.origin)},
+                "planes": planes, "plane_crcs": crcs, "host": data,
+            }
+            path = os.path.join(self.directory,
+                                chain_key_filename(self.world.game_id))
+            write_freeze_file(path, rec)
+            self._key_planes = planes
+            self._key_crcs = crcs
+            self._key_rows = {eid: i for i, eid in enumerate(eids)}
+            return path
+        # delta vs the remembered keyframe: a row is a REFERENCE when
+        # the entity existed at the keyframe with identical quantized
+        # planes, else its values ship in the sparse section
+        widths = {"pos_xz": (np.int16, 2), "pos_y": (np.float32, 1),
+                  "yaw": (np.int16, 1), "moving": (np.uint8, 1)}
+        cur = {nm: np.frombuffer(planes[nm], dt).reshape(-1, w)
+               for nm, (dt, w) in widths.items()}
+        key = {nm: np.frombuffer(self._key_planes[nm], dt)
+               .reshape(-1, w) for nm, (dt, w) in widths.items()}
+        m = len(eids)
+        # vectorized row diff: only the eid->row dict lookups loop;
+        # the 4 plane compares run as whole-array numpy equality
+        # (an O(entities) Python compare loop on the tick thread is
+        # exactly the cost this chain exists to avoid)
+        kr = np.asarray([self._key_rows.get(eid, -1) for eid in eids],
+                        np.int32)
+        same = kr >= 0
+        krc = np.maximum(kr, 0)
+        for nm in _PLANES:
+            same &= (cur[nm][np.arange(m)] ==
+                     key[nm][krc]).all(axis=1)
+        rows = np.where(same, kr, np.int32(-1))
+        sp_mask = rows < 0
+        sparse = {nm: cur[nm][sp_mask].tobytes() for nm in _PLANES}
+        rec = {
+            "version": SNAPSHOT_PLANE_VERSION, "kind": "delta",
+            "quant": {"step": self.step, "yaw_step": YAW_STEP,
+                          "origin": list(self.origin)},
+            "base": {
+                "file": chain_key_filename(self.world.game_id),
+                "plane_crcs": self._key_crcs,
+            },
+            "rows": rows.tobytes(), "sparse": sparse, "host": data,
+        }
+        path = os.path.join(self.directory,
+                            chain_delta_filename(self.world.game_id))
+        write_freeze_file(path, rec)
+        return path

@@ -331,6 +331,10 @@ class DispatcherService:
             proto.MT_NOTIFY_CLIENT_DISCONNECTED: self._h_client_disconnected,
             proto.MT_SYNC_POSITION_YAW_FROM_CLIENT: self._h_sync_upstream,
             proto.MT_SYNC_POSITION_YAW_ON_CLIENTS: self._h_sync_downstream,
+            # delta-compressed variant (ISSUE 12): same gate-routing
+            # leg, opaque payload — the gate's decoder owns the format
+            proto.MT_SYNC_POSITION_YAW_DELTA_ON_CLIENTS:
+                self._h_sync_downstream,
             # per-tick client event bundle: forward to its gate whole
             # (the gate unbundles) — same leg as the sync batch
             proto.MT_CLIENT_EVENTS_BATCH: self._h_to_gate,

@@ -104,6 +104,8 @@ class GameServer:
         degraded_event_coalesce: int = consts.DEGRADED_EVENT_COALESCE_TICKS,
         flightrec_ring: int = flightrec.DEFAULT_RING,
         flightrec_cooldown_secs: float = flightrec.DEFAULT_COOLDOWN_SECS,
+        sync_delta: bool = False,
+        sync_keyframe_every: int = 16,
     ):
         self.game_id = game_id
         self.world = world
@@ -166,6 +168,13 @@ class GameServer:
         self._migrating_out: dict[str, tuple[Entity, str, tuple]] = {}
         # per-gate downstream sync batches for the current tick
         self._sync_out: dict[int, list] = {}
+        # delta-compressed sync fan-out (ISSUE 12, [gameN] sync_delta):
+        # per-gate DeltaSyncEncoder state; step derived from the
+        # world's precision lattice when active (ONE quantizer across
+        # device, wire and snapshots), else from the world extent
+        self.sync_delta = bool(sync_delta)
+        self.sync_keyframe_every = max(1, int(sync_keyframe_every))
+        self._sync_encoders: dict[int, "codec.DeltaSyncEncoder"] = {}
         # per-gate ordered (inner_msgtype, body) client messages staged
         # this tick; flushed as ONE MT_CLIENT_EVENTS_BATCH packet per
         # gate (before syncs, so a create precedes its entity's first
@@ -598,7 +607,18 @@ class GameServer:
             return
         self._last_ckpt_mono = now
         try:
-            _freeze.checkpoint_async(w, self.freeze_dir)
+            if getattr(w, "snapshot_keyframe_every", 0) > 0:
+                # delta-compressed chain (ISSUE 12): quantized planes,
+                # sparse delta writes between keyframes — synchronous
+                # (a delta write serializes vs its in-memory keyframe)
+                if not hasattr(self, "_snap_chain"):
+                    self._snap_chain = _freeze.SnapshotChain(
+                        w, self.freeze_dir,
+                        keyframe_every=w.snapshot_keyframe_every,
+                    )
+                self._snap_chain.write()
+            else:
+                _freeze.checkpoint_async(w, self.freeze_dir)
         except Exception:
             logger.exception("game%d: periodic checkpoint failed",
                              self.game_id)
@@ -947,17 +967,56 @@ class GameServer:
                     )
             if not cids:
                 continue
-            p = new_packet(proto.MT_SYNC_POSITION_YAW_ON_CLIENTS)
-            p.append_u16(gate_id)
-            p.append_bytes(
-                codec.encode_client_sync_batch(
-                    np.concatenate(cids) if len(cids) > 1 else cids[0],
-                    np.concatenate(eids) if len(eids) > 1 else eids[0],
-                    np.concatenate(vals) if len(vals) > 1 else vals[0],
+            cid_b = np.concatenate(cids) if len(cids) > 1 else cids[0]
+            eid_b = np.concatenate(eids) if len(eids) > 1 else eids[0]
+            val_b = np.concatenate(vals) if len(vals) > 1 else vals[0]
+            if self.sync_delta:
+                # delta-compressed leg (ISSUE 12): int16 deltas against
+                # per-(client, entity) baselines with in-band keyframes
+                # — the gate's DeltaSyncDecoder reconstructs
+                # bit-deterministically and relays the same records
+                enc = self._sync_encoder(gate_id)
+                p = new_packet(
+                    proto.MT_SYNC_POSITION_YAW_DELTA_ON_CLIENTS)
+                p.append_u16(gate_id)
+                # sender id: every game runs its OWN handle space, and
+                # a gate fans in from many games — the decoder keys its
+                # state per sender so handles can never collide
+                p.append_u16(self.game_id & 0xFFFF)
+                p.append_bytes(enc.encode_batch(
+                    cid_b, eid_b, val_b, self._fanout_tick))
+            else:
+                p = new_packet(proto.MT_SYNC_POSITION_YAW_ON_CLIENTS)
+                p.append_u16(gate_id)
+                p.append_bytes(
+                    codec.encode_client_sync_batch(cid_b, eid_b, val_b)
                 )
-            )
             self._send(self.cluster.select_by_gate_id(gate_id), p)
+        if self.sync_delta and self._sync_encoders:
+            # byte-saving gauges (scraped next to the SLO line) —
+            # summed across ALL per-gate encoders, exposed once, so a
+            # multi-gate deployment never reports just the last gate
+            opmon.expose("sync_delta_wire_bytes", sum(
+                e.stats["wire_bytes"]
+                for e in self._sync_encoders.values()))
+            opmon.expose("sync_delta_full_bytes", sum(
+                e.stats["full_bytes"]
+                for e in self._sync_encoders.values()))
         self._sync_out.clear()
+
+    def _sync_encoder(self, gate_id: int) -> "codec.DeltaSyncEncoder":
+        enc = self._sync_encoders.get(gate_id)
+        if enc is None:
+            # the step IS the world's precision lattice step (GridSpec.
+            # quant_step is defined for every grid — precision=q16
+            # worlds ship exact lattice deltas, f32 worlds get the same
+            # power-of-two step as a sub-resolution wire quantization)
+            grid = self.world.cfg.grid
+            enc = self._sync_encoders[gate_id] = codec.DeltaSyncEncoder(
+                grid.quant_step,
+                keyframe_every=self.sync_keyframe_every,
+            )
+        return enc
 
     def _remote_call(self, eid: str, method: str, args: tuple,
                      from_client: str | None) -> None:
@@ -1178,6 +1237,13 @@ class GameServer:
         if msgtype == proto.MT_NOTIFY_CLIENT_DISCONNECTED:
             client_id = pkt.read_entity_id()
             owner = pkt.read_var_str()
+            if self.sync_delta:
+                # forget the departed client's delta-sync baselines
+                # (its pairs simply re-keyframe if it reconnects;
+                # bounds encoder state without waiting for the
+                # max_entries hard reset)
+                for enc in self._sync_encoders.values():
+                    enc.drop_client(client_id)
             targets = (
                 [w.entities.get(owner)] if owner else list(w.entities.values())
             )

@@ -160,6 +160,10 @@ class GateService:
         self.sync_interval = position_sync_interval_ms / 1000.0
         self.clients: dict[str, ClientProxy] = {}
         self.filter_index = FilterIndex()
+        # delta-compressed sync decoders (ISSUE 12), one PER SENDING
+        # GAME: pure functions of each game's byte stream —
+        # baselines/handles all arrive in-band
+        self._sync_delta_dec: dict[int, codec.DeltaSyncDecoder] = {}
         self.cluster = DispatcherCluster(
             dispatcher_addrs, self._on_dispatcher_packet, self._handshake,
             edge="gate->dispatcher",
@@ -501,6 +505,28 @@ class GateService:
             pkt.read_u16()  # gate_id routing prefix (ours)
             self._handle_sync_on_clients(pkt)
             return
+        if msgtype == proto.MT_SYNC_POSITION_YAW_DELTA_ON_CLIENTS:
+            # delta-compressed sync leg (ISSUE 12): reconstruct full
+            # records bit-deterministically from the in-band keyframed
+            # baselines, then relay exactly like the full-record path.
+            # Decoder state is PER SENDING GAME — each game assigns
+            # handles from its own counter, so one shared table would
+            # collide (and one game's reset would wipe the others)
+            pkt.read_u16()
+            sender = pkt.read_u16()
+            dec = self._sync_delta_dec.get(sender)
+            if dec is None:
+                dec = self._sync_delta_dec[sender] = \
+                    codec.DeltaSyncDecoder()
+            try:
+                cids, eids, vals = dec.decode_batch(
+                    memoryview(pkt.buf)[pkt.rpos:])
+            except ConnectionError as exc:
+                logger.warning("gate%d: bad delta-sync batch from "
+                               "game%d: %s", self.gate_id, sender, exc)
+                return
+            self._relay_sync_records(cids, eids, vals)
+            return
         if msgtype == proto.MT_SET_CLIENT_FILTER_PROP:
             pkt.read_u16()
             client_id = pkt.read_entity_id()
@@ -614,6 +640,11 @@ class GateService:
         scales with CLIENTS, not records."""
         buf = memoryview(pkt.buf)[pkt.rpos:]
         cids, eids, vals = codec.decode_client_sync_batch(buf)
+        self._relay_sync_records(cids, eids, vals)
+
+    def _relay_sync_records(self, cids, eids, vals) -> None:
+        """The shared back half of both sync legs (full-record and
+        delta-decoded): per-client regroup + relay."""
         n = len(cids)
         self._m_down_batch.observe(n)
         if n == 0:

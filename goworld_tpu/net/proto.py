@@ -81,6 +81,12 @@ MT_SYNC_POSITION_YAW_ON_CLIENTS = 1503  # batched [16B cid + 32B record]
 # O(client messages) to O(gates) per tick (churn-heavy AOI ticks emit
 # thousands — docs/R5_MEASUREMENTS.md).
 MT_CLIENT_EVENTS_BATCH = 1504
+# delta-compressed sync fan-out (ISSUE 12, [gameN] sync_delta): same
+# game -> gate leg as 1503, payload = net/codec.py DeltaSyncEncoder
+# wire format ([u8 kind][u32 handle][4 x i16] deltas against in-band
+# keyframed baselines) — steady-state bytes scale with
+# dirty_frac * 13 B/record instead of 48 B/record
+MT_SYNC_POSITION_YAW_DELTA_ON_CLIENTS = 1505
 MT_GATE_SERVICE_MSG_TYPE_STOP = 1999
 
 # --- client-direct (2000+) ----------------------------------------------

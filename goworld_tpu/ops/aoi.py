@@ -51,6 +51,7 @@ fit on one chip).
 from __future__ import annotations
 
 import dataclasses
+import math
 from functools import partial
 
 import jax
@@ -78,6 +79,103 @@ _ID_BITS = consts.AOI_ID_BITS
 _ID_MASK = (1 << _ID_BITS) - 1
 _WORD_MASK = (1 << 23) - 1
 _QD_MAX = 254
+
+
+def _log2_ceil(x: float) -> int:
+    """Exact ceil(log2(x)) for positive floats (frexp, no log
+    rounding): x = m * 2^e with 0.5 <= m < 1, so 2^e >= x with
+    equality iff m == 0.5."""
+    m, e = math.frexp(x)
+    return e - 1 if m == 0.5 else e
+
+
+# =======================================================================
+# precision=q16 lattice quantizer (shared by the sweep, the Verlet
+# reuse re-rank, core/step.py's snap, the sync codec and the snapshot
+# planes — ONE quantizer so the domains can never disagree)
+# =======================================================================
+def quantize_positions(spec: GridSpec, pos: jax.Array) -> jax.Array:
+    """Snap x/z onto the precision lattice (f32 values ON the lattice;
+    y passes through untouched — AOI is XZ). Identity when precision
+    is off. Idempotent: lattice points snap to themselves, so
+    double-snapping along any path is harmless. All arithmetic is
+    exact (multiply by a power of two, floor, multiply back)."""
+    if spec.precision == "off":
+        return pos
+    step = spec.quant_step
+    hi = float((1 << consts.PRECISION_POS_BITS) - 1)
+    qx = jnp.clip(jnp.floor(pos[:, 0] * (1.0 / step)), 0.0, hi)
+    qz = jnp.clip(jnp.floor(pos[:, 2] * (1.0 / step)), 0.0, hi)
+    return jnp.stack([qx * step, pos[:, 1], qz * step], axis=1)
+
+
+def quantize_xz_i32(spec: GridSpec, pos: jax.Array) -> jax.Array:
+    """The packed int16-pair position mirror: ``(qx << 16) | qz`` as
+    ONE nonnegative i32 per entity (qx, qz < 2^15). The byte-heavy
+    paths gather/stream THIS plane instead of two f32 lanes."""
+    step = spec.quant_step
+    hi = (1 << consts.PRECISION_POS_BITS) - 1
+    qx = jnp.clip(jnp.floor(pos[:, 0] * (1.0 / step)), 0, hi) \
+        .astype(jnp.int32)
+    qz = jnp.clip(jnp.floor(pos[:, 2] * (1.0 / step)), 0, hi) \
+        .astype(jnp.int32)
+    return (qx << 16) | qz
+
+
+def _q16_dist(spec: GridSpec, qxz_a, qxz_b):
+    """Chebyshev distance between packed lattice coordinates, as the
+    EXACT f32 value ``int_diff * quant_step`` — bit-identical to
+    ``max(|ax-bx|, |az-bz|)`` over the snapped f32 positions (lattice
+    values and their differences are exact f32 integers times a power
+    of two), so ranking and reach comparisons cannot diverge from the
+    f32 path."""
+    dq = jnp.maximum(
+        jnp.abs((qxz_a >> 16) - (qxz_b >> 16)),
+        jnp.abs((qxz_a & 0xFFFF) - (qxz_b & 0xFFFF)),
+    )
+    return dq.astype(jnp.float32) * spec.quant_step
+
+
+# 21-bit candidate-id triplet packing (the Verlet cache's cand plane
+# under precision=q16): 3 ids of <= 21 bits in 2 u32 words — the
+# [N, V] i32 cache becomes [N, 2*ceil(V/3)] (33% fewer bytes streamed
+# every reuse tick), losslessly (ids < 2^21 by the packed-id bound).
+_ID21_MASK = (1 << 21) - 1
+
+
+def packed_cand_words(v: int) -> int:
+    """u32 words per row for a packed V-lane candidate cache."""
+    return 2 * ((v + 2) // 3)
+
+
+def pack_ids21(ids: jax.Array, pad_value: int) -> jax.Array:
+    """[..., V] i32 ids -> [..., 2*ceil(V/3)] u32 (pad lanes filled
+    with ``pad_value``, normally the sweep sentinel so they stay
+    invalid after unpack)."""
+    *lead, v = ids.shape
+    pad = (-v) % 3
+    if pad:
+        ids = jnp.concatenate(
+            [ids, jnp.full((*lead, pad), pad_value, ids.dtype)],
+            axis=-1)
+    t = ids.reshape(*lead, -1, 3).astype(jnp.uint32)
+    a, b, c = t[..., 0], t[..., 1], t[..., 2]
+    w0 = a | ((b & 0x7FF) << 21)
+    w1 = (b >> 11) | (c << 10)
+    return jnp.stack([w0, w1], axis=-1).reshape(*lead, -1)
+
+
+def unpack_ids21(words: jax.Array) -> jax.Array:
+    """Inverse of :func:`pack_ids21` (keeps the pad lanes — they carry
+    the sentinel and rank as invalid, so callers never reslice)."""
+    *lead, _w = words.shape
+    t = words.reshape(*lead, -1, 2)
+    w0, w1 = t[..., 0], t[..., 1]
+    a = w0 & _ID21_MASK
+    b = ((w0 >> 21) | ((w1 & 0x3FF) << 11)) & _ID21_MASK
+    c = (w1 >> 10) & _ID21_MASK
+    return jnp.stack([a, b, c], axis=-1).reshape(*lead, -1) \
+        .astype(jnp.int32)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -240,6 +338,26 @@ class GridSpec:
     # (staleness backstop for float-drift paranoia and for bounding the
     # cache's worst-case age in traces); 0 = displacement-driven only
     rebuild_every_max: int = 0
+    # Quantized state planes (ISSUE 12 / ROADMAP 3): "off" = today's
+    # all-f32 streams, bit-identical; "q16" = AOI-visible positions
+    # snap to a POWER-OF-TWO lattice (quant_step = the smallest 2^e
+    # with <= 2^15 lattice points across the larger extent) and the
+    # byte-heavy paths run on narrow planes — the "ranges" sorted view
+    # packs (qx, qz) into ONE i32 lane (8 B/row instead of 12), the
+    # Verlet reuse re-ranks int16 coordinate diffs over a 21-bit-packed
+    # candidate cache, and sync/snapshot streams ship int16 deltas
+    # (ops/sync.py, freeze.py). EXACTNESS IS BY CONSTRUCTION, not by
+    # tolerance: the step is a power of two (scaling never rounds), the
+    # cell size is rounded UP to a power-of-two multiple of the step
+    # (cell index == qx >> quant_cell_shift, exactly floor(x/cell) on
+    # the snapped value), and every lattice coordinate/difference is an
+    # exact f32 integer — so the int16-domain sweep is BIT-IDENTICAL to
+    # the f32 sweep over the snapped positions, and the brute-force
+    # oracle over snapped positions gates exactness like every other
+    # parity suite. The quantization itself bounds position fidelity at
+    # quant_step (validated <= radius/4 below; the interest semantics
+    # are then "Chebyshev over the lattice world").
+    precision: str = consts.DEFAULT_PRECISION
 
     def __post_init__(self):
         # a typo'd knob would otherwise silently fall through every
@@ -278,6 +396,44 @@ class GridSpec:
                 f"rebuild_every_max must be >= 0 (0 = displacement-"
                 f"driven only), got {self.rebuild_every_max!r}"
             )
+        if self.precision not in ("off", "q16"):
+            raise ValueError(
+                f"precision must be off|q16, got {self.precision!r}"
+            )
+        if self.precision != "off":
+            # the lattice proofs (snap/bin/distance exactness) are
+            # origin-free: qx*step must BE the coordinate, not an
+            # offset a rounded f32 add would smear
+            if self.origin_x != 0.0 or self.origin_z != 0.0:
+                raise ValueError(
+                    "precision=q16 requires origin_x == origin_z == 0 "
+                    "(lattice arithmetic is origin-free; shift the "
+                    f"world), got ({self.origin_x!r}, {self.origin_z!r})"
+                )
+            step = self.quant_step
+            if not step > 0.0 or not math.isfinite(step):
+                raise ValueError(
+                    f"precision=q16 rejected: degenerate lattice step "
+                    f"{step!r} from extents ({self.extent_x!r}, "
+                    f"{self.extent_z!r})"
+                )
+            if step > self.radius / 4.0:
+                # the sweep over the lattice is exact BY CONSTRUCTION,
+                # but the snap itself moves entities by up to one step;
+                # past radius/4 that slop could flip a cell assignment
+                # or a reach comparison RELATIVE TO THE F32 WORLD by a
+                # gameplay-visible margin — reject loudly, same style
+                # as the impl-name validations above
+                raise ValueError(
+                    f"precision=q16 rejected: int16 lattice step "
+                    f"{step!r} over extent "
+                    f"{max(self.extent_x, self.extent_z)!r} exceeds "
+                    f"radius/4 ({self.radius / 4.0!r}) — at 2^"
+                    f"{consts.PRECISION_POS_BITS} points/axis this "
+                    "resolution could flip a cell assignment or reach "
+                    "comparison vs the f32 world; shrink the extent or "
+                    "raise the radius"
+                )
         if self.skin > 0 and self.verlet_cap_eff > 9 * self.cell_cap:
             # the rebuild sweep can admit at most the 3x3 window's
             # 9*cell_cap candidate lanes per row; asking it to keep
@@ -293,8 +449,38 @@ class GridSpec:
     def cell_size(self) -> float:
         """Grid cell edge. With a Verlet skin the cells grow by it so
         the 3x3 window still covers ``reach + skin`` from any query
-        position (Chebyshev coverage needs reach <= cell edge)."""
+        position (Chebyshev coverage needs reach <= cell edge). Under
+        precision=q16 the edge rounds UP to a power-of-two multiple of
+        the lattice step so the cell index of a snapped position is
+        exactly ``qx >> quant_cell_shift`` — slightly bigger cells
+        (denser occupancy; re-provision cell_cap from the gauges), same
+        coverage guarantee."""
+        if self.precision != "off":
+            return self.quant_step * (1 << self.quant_cell_shift)
         return self.radius + self.skin
+
+    @property
+    def quant_step(self) -> float:
+        """precision=q16 lattice step: the smallest power of two with
+        <= 2^PRECISION_POS_BITS lattice points across the larger
+        extent (power of two => scaling f32 coordinates by 1/step and
+        back never rounds)."""
+        ext = max(self.extent_x, self.extent_z)
+        return 2.0 ** (_log2_ceil(ext) - consts.PRECISION_POS_BITS)
+
+    @property
+    def quant_cell_shift(self) -> int:
+        """log2(cell edge / lattice step) under precision=q16: cell
+        index = lattice coordinate >> this."""
+        return max(0, _log2_ceil(
+            (self.radius + self.skin) / self.quant_step))
+
+    @property
+    def quant_bits(self) -> int:
+        """Lattice points/axis as bits (0 when precision is off) —
+        the ``pos_scale_bits`` every artifact stamp records."""
+        return consts.PRECISION_POS_BITS if self.precision != "off" \
+            else 0
 
     @property
     def verlet_cap_eff(self) -> int:
@@ -392,11 +578,13 @@ def _sorted_src(spec: GridSpec, pos, flag_bits, order):
     return src, table_sentinel, sentinel_bits
 
 
-def _build_ranges(cc: int, n_rows: int, srow, src, sentinel_bits):
+def _build_ranges(cc: int, n_rows: int, srow, src, pad_vals):
     """Front half, stage 4 (ranges impl): row_start offsets + padded
     component-major sorted view. row_start[r] = first sorted position of
     cell row r, from a bincount + exclusive cumsum (dead entities land
-    in the n_rows bin, excluded)."""
+    in the n_rows bin, excluded). ``pad_vals`` gives each src component
+    its sentinel-column value (f32 scalars/bit patterns; the precision
+    path's 2-component packed view passes 2)."""
     counts = jnp.zeros(n_rows + 1, jnp.int32).at[srow].add(
         1, mode="drop"
     )
@@ -406,11 +594,10 @@ def _build_ranges(cc: int, n_rows: int, srow, src, sentinel_bits):
     ])
     # padded with 3cc sentinel columns so every window slice is in bounds
     pad = jnp.stack([
-        jnp.full((3 * cc,), jnp.inf, jnp.float32),
-        jnp.full((3 * cc,), jnp.inf, jnp.float32),
-        jnp.full((3 * cc,), sentinel_bits, jnp.float32),
+        jnp.full((3 * cc,), jnp.asarray(v, jnp.float32))
+        for v in pad_vals
     ])
-    s_t = jnp.concatenate([src.T, pad], axis=1)       # [3, n + 3cc]
+    s_t = jnp.concatenate([src.T, pad], axis=1)       # [C, n + 3cc]
     return row_start, s_t
 
 
@@ -791,7 +978,8 @@ def _sweep_fused(
     src, table_sentinel, sentinel_bits = _sorted_src(
         spec, pos, flag_bits, order
     )
-    row_start, s_t = _build_ranges(cc, n_rows, srow, src, sentinel_bits)
+    row_start, s_t = _build_ranges(cc, n_rows, srow, src,
+                                   (jnp.inf, jnp.inf, sentinel_bits))
 
     # query-side scalars ([N]-sized, trivial next to the back half)
     dxs = jnp.array([-1, 0, 1], jnp.int32)
@@ -938,6 +1126,14 @@ def _sweep(
     # not a reimplementation. Entity-major impls only (the caller maps
     # shift/fused onto their split siblings).
     n = pos.shape[0]
+    # precision=q16: EVERY impl sweeps the snapped world (so results
+    # are identical across impls by the same argument as today); the
+    # "ranges" impl additionally streams the PACKED int16-pair sorted
+    # view instead of two f32 position lanes — bit-identical outputs
+    # (lattice arithmetic is exact in both domains; see GridSpec.
+    # precision), strictly fewer bytes. The _upto probes keep the f32
+    # view (they time the split stages, like fused probing ranges).
+    pos = quantize_positions(spec, pos)
     if spec.sweep_impl == "shift" and n < (1 << _ID_BITS):
         return _sweep_shift(
             spec, pos, alive, query_rows, watch_radius, flag_bits,
@@ -969,13 +1165,32 @@ def _sweep(
     # sibling "ranges" (the fused kernel packs ids into key words)
     ranges_impl = spec.sweep_impl in ("ranges", "fused")
     cellrow_impl = spec.sweep_impl == "cellrow"
+    # the packed int16-pair fast path: "ranges" only (the default /
+    # production impl; the fused kernel already keeps its window in
+    # VMEM, the table impls keep the shared f32 table layout), real
+    # sweeps only (_upto probes time the split f32 stages)
+    q16 = (spec.precision != "off" and ranges_impl and packed_path
+           and _upto is None)
+    qxz_plane = quantize_xz_i32(spec, pos) if q16 else None
     merged = None
     if ranges_impl:
         # TABLELESS (see GridSpec.sweep_impl): candidates come straight
         # out of the sorted array.
-        row_start, s_t = _build_ranges(
-            cc, n_rows, srow, src, sentinel_bits
-        )
+        if q16:
+            # 2-component sorted view: packed (qx, qz) lattice pair +
+            # flag word — 8 B/row streamed instead of 12
+            src = jnp.stack(
+                [lax.bitcast_convert_type(
+                    qxz_plane, jnp.float32)[order], src[:, 2]],
+                axis=1)
+            row_start, s_t = _build_ranges(
+                cc, n_rows, srow, src, (0.0, sentinel_bits)
+            )
+        else:
+            row_start, s_t = _build_ranges(
+                cc, n_rows, srow, src,
+                (jnp.inf, jnp.inf, sentinel_bits)
+            )
         table = None
     else:
         table = _build_table(cc, n_rows, sorted_row, src,
@@ -1037,18 +1252,28 @@ def _sweep(
         elif ranges_impl:
             lo = row_start[starts]                   # [B, 3]
             hi = row_start[starts + 3]
+            ncmp = 2 if q16 else 3
             win = jax.vmap(
                 jax.vmap(
                     lambda s: lax.dynamic_slice(
-                        s_t, (0, s), (3, 3 * cc)
+                        s_t, (0, s), (ncmp, 3 * cc)
                     ),
                 )
-            )(lo)                                    # [B, 3, 3, 3cc]
-            cand_px = win[:, :, 0, :].reshape(b, 9 * cc)
-            cand_pz = win[:, :, 1, :].reshape(b, 9 * cc)
-            cand_w = lax.bitcast_convert_type(
-                win[:, :, 2, :], jnp.int32
-            ).reshape(b, 9 * cc)
+            )(lo)                                    # [B, 3, C, 3cc]
+            if q16:
+                cand_qxz = lax.bitcast_convert_type(
+                    win[:, :, 0, :], jnp.int32
+                ).reshape(b, 9 * cc)
+                cand_px = cand_pz = None
+                cand_w = lax.bitcast_convert_type(
+                    win[:, :, 1, :], jnp.int32
+                ).reshape(b, 9 * cc)
+            else:
+                cand_px = win[:, :, 0, :].reshape(b, 9 * cc)
+                cand_pz = win[:, :, 1, :].reshape(b, 9 * cc)
+                cand_w = lax.bitcast_convert_type(
+                    win[:, :, 2, :], jnp.int32
+                ).reshape(b, 9 * cc)
             lanes3 = jnp.arange(3 * cc, dtype=jnp.int32)
             in_range = (
                 lanes3[None, None, :] < (hi - lo)[:, :, None]
@@ -1056,8 +1281,10 @@ def _sweep(
             # out-of-range lanes may hold entities of OTHER cells (the
             # sorted array is dense): hard-invalidate them — admitting
             # one for some watchers but not others would make interest
-            # asymmetric
-            cand_px = jnp.where(in_range, cand_px, jnp.inf)
+            # asymmetric. (The q16 path needs only the word kill: its
+            # validity never consults coordinates.)
+            if not q16:
+                cand_px = jnp.where(in_range, cand_px, jnp.inf)
             cand_w = jnp.where(in_range, cand_w, table_sentinel)
         else:
             win = jax.vmap(
@@ -1080,9 +1307,16 @@ def _sweep(
                 + jnp.where(jnp.isfinite(cand_pz), cand_pz, 0.0).sum()
                 + cand_w.sum().astype(jnp.float32)
             )
-        ddx = jnp.abs(cand_px - px[rows][:, None])
-        ddz = jnp.abs(cand_pz - pz[rows][:, None])
-        dist = jnp.maximum(ddx, ddz)                 # Chebyshev XZ
+        if q16:
+            # int16-pair domain: |int diff| * step is the EXACT f32
+            # distance over lattice positions (see _q16_dist), so
+            # everything downstream — reach compare, key pack, top-k —
+            # is bit-identical to the f32 branch below
+            dist = _q16_dist(spec, cand_qxz, qxz_plane[rows][:, None])
+        else:
+            ddx = jnp.abs(cand_px - px[rows][:, None])
+            ddz = jnp.abs(cand_pz - pz[rows][:, None])
+            dist = jnp.maximum(ddx, ddz)             # Chebyshev XZ
         if watch_radius is None:
             reach = spec.radius + reach_pad
         else:  # per-watcher view distance, bounded by the cell size
@@ -1288,7 +1522,8 @@ def sweep_phase_checksum(spec: GridSpec, pos, alive, phase: str):
     src, _ts, sentinel_bits = _sorted_src(spec, pos, None, order)
     if spec.sweep_impl in ("ranges", "fused"):
         row_start, s_t = _build_ranges(cc, n_rows, srow, src,
-                                       sentinel_bits)
+                                       (jnp.inf, jnp.inf,
+                                        sentinel_bits))
         return row_start.sum().astype(jnp.float32) \
             + jnp.where(jnp.isfinite(s_t), s_t, 0.0).sum()
     table = _build_table(cc, n_rows, sorted_row, src,
@@ -1329,9 +1564,25 @@ class VerletCache:
 
 
 def init_verlet_cache(spec: GridSpec, n: int) -> VerletCache:
-    """Empty (invalid) cache: the first tick always rebuilds."""
+    """Empty (invalid) cache: the first tick always rebuilds. Under
+    precision=q16 the cand plane is 21-bit-triplet packed
+    (:func:`pack_ids21`) — [n, 2*ceil(V/3)] u32 instead of [n, V] i32,
+    33% fewer bytes streamed every reuse tick, losslessly."""
     v = spec.verlet_cap_eff
     zi = jnp.zeros((), jnp.int32)
+    if spec.precision != "off":
+        return VerletCache(
+            cand=pack_ids21(jnp.full((n, v), n, jnp.int32), n),
+            ref_x=jnp.zeros((n,), jnp.float32),
+            ref_z=jnp.zeros((n,), jnp.float32),
+            ref_alive=jnp.zeros((n,), bool),
+            ref_radius=jnp.zeros((n,), jnp.float32),
+            age=zi,
+            valid=jnp.zeros((), bool),
+            cell_max=zi,
+            over_cap_cells=zi,
+            over_v_rows=zi,
+        )
     return VerletCache(
         cand=jnp.full((n, v), n, jnp.int32),
         ref_x=jnp.zeros((n,), jnp.float32),
@@ -1367,14 +1618,29 @@ def _rank_candidates(
     want_flags = flag_bits is not None
     px = pos[:, 0]
     pz = pos[:, 2]
+    # precision=q16 reuse path: ONE packed (qx, qz) i32 gather per
+    # candidate instead of two f32 gathers, candidate ids unpacked
+    # from the 21-bit-triplet cache rows — the two byte levers of the
+    # steady-state AOI term (docs/ROOFLINE.md "Quantized state
+    # planes"). Distances are exact (_q16_dist), so ranking is
+    # bit-identical to the f32 gathers over the snapped world.
+    q16 = spec.precision != "off"
+    qxz_plane = quantize_xz_i32(spec, pos) if q16 else None
 
     def row_block(rows: jax.Array):
-        cb = cand[rows]                            # [B, V]
+        if q16:
+            cb = unpack_ids21(cand[rows])          # [B, >=V]
+        else:
+            cb = cand[rows]                        # [B, V]
         cbc = jnp.minimum(cb, n - 1)
-        dist = jnp.maximum(
-            jnp.abs(px[cbc] - px[rows][:, None]),
-            jnp.abs(pz[cbc] - pz[rows][:, None]),
-        )
+        if q16:
+            dist = _q16_dist(spec, qxz_plane[cbc],
+                             qxz_plane[rows][:, None])
+        else:
+            dist = jnp.maximum(
+                jnp.abs(px[cbc] - px[rows][:, None]),
+                jnp.abs(pz[cbc] - pz[rows][:, None]),
+            )
         if watch_radius is None:
             reach = spec.radius
         else:
@@ -1466,6 +1732,11 @@ def grid_neighbors_verlet(
             f"got n={n}"
         )
     want_flags = flag_bits is not None
+    # precision=q16: the whole Verlet machinery (displacement check,
+    # refs, rebuild sweep, reuse re-rank) runs in the snapped domain —
+    # the standard Verlet bound holds verbatim there (movement,
+    # candidates and reach all measured on the same lattice)
+    pos = quantize_positions(spec, pos)
 
     disp = jnp.max(
         jnp.where(
@@ -1506,7 +1777,8 @@ def grid_neighbors_verlet(
             with_stats=True, reach_pad=spec.skin,
         )
         return VerletCache(
-            cand=cand,
+            cand=(pack_ids21(cand, n) if spec.precision != "off"
+                  else cand),
             ref_x=pos[:, 0],
             ref_z=pos[:, 2],
             ref_alive=alive,

@@ -18,6 +18,15 @@ Attr deltas ride the same shape: hot attrs are an f32[N, A] SoA block with a
 per-entity dirty bitmask; changed (entity, attr) cells flatten into a second
 bounded record array (the reference instead walks the MapAttr tree per
 mutation and packs per-client packets, ``Entity.go:814-917``).
+
+Quantized-plane contract (ISSUE 12, ``GridSpec.precision="q16"``): the
+tick hands this collector the SNAPPED lattice positions (the exact
+values the interest sets were computed from) and a ``dirty`` mask that
+DEAD-BANDS on the lattice — an entity whose quantized coordinates did
+not change this tick is clean, so sub-step jitter generates no sync
+records at all. Record values are therefore lattice-exact, which is
+what lets the host-side delta codec (net/codec.py DeltaSyncEncoder)
+ship int16 deltas that reconstruct bit-for-bit.
 """
 
 from __future__ import annotations

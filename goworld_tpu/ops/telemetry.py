@@ -468,6 +468,20 @@ def workload_signature(lanes: dict, config: dict | None = None) -> dict:
         rec["aoi_cell_cap"] = "raise"
     if out["density"] in ("over_k", "over_cap") and over_k > 0:
         rec["aoi_k"] = "raise"
+    # delta-compressed sync fan-out (ISSUE 12, [gameN] sync_delta):
+    # pays off exactly where the dirty fraction is low — quiet worlds
+    # and flock-like motion (the skin holds, few rows churn) ship
+    # mostly int16 deltas against stable baselines. Gate on the sync
+    # lane's p50 when it exists (the direct dirty-volume proxy).
+    low_dirty = True
+    if out.get("sync_p50") is not None:
+        low_dirty = out["sync_p50"] <= 64.0
+    if low_dirty and out["churn"] != "teleport_like" \
+            and (out["churn"] == "flock_like"
+                 or out["events"] == "quiet"):
+        # teleport-like churn excluded: every jump overflows the int16
+        # delta range, so the stream would be all keyframes anyway
+        rec["sync_delta"] = 1
     out["recommendation"] = rec
 
     parts = [f"churn={out['churn']}", f"density={out['density']}",

@@ -191,6 +191,14 @@ def check_oracle(w, clients: dict | None = None,
     pos = np.asarray(w.state.pos[0])
     alive = np.asarray(w.state.alive[0])
     wr = np.asarray(w.state.aoi_radius[0])
+    if w.cfg.grid.precision != "off":
+        # precision=q16: interest is defined over the SNAPPED lattice
+        # world (the exact positions the sweep ran on and sync records
+        # carried) — the oracle evaluates the same domain, and
+        # exactness there is the construction's guarantee
+        from goworld_tpu.ops.aoi import quantize_positions
+
+        pos = np.asarray(quantize_positions(w.cfg.grid, pos))
     oracle = neighbors_oracle(pos, alive, w.cfg.grid.radius,
                               watch_radius=wr)
     owner = w._slot_owner[0]

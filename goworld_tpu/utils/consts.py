@@ -46,6 +46,22 @@ DEFAULT_SORT_IMPL = "argsort"
 # [gameN] aoi_skin or BENCH_SKIN with a value matched to their movement
 # speed (rebuild cadence ~ skin / (2 * speed * dt)).
 DEFAULT_AOI_SKIN = 0.0
+# Quantized state planes (GridSpec.precision, ISSUE 12 / ROADMAP 3):
+# "off" keeps today's all-f32 streams bit-identically; "q16" snaps the
+# AOI-visible positions to a POWER-OF-TWO lattice sized so one axis
+# fits int16 (<= 2^PRECISION_POS_BITS lattice points) and threads
+# int16/bf16 planes through the byte-heavy paths (packed sorted view,
+# packed Verlet candidate cache, bf16 velocity, delta sync, delta
+# snapshots). Exactness is by construction, not by tolerance: the
+# lattice step is a power of two and the cell size a power-of-two
+# multiple of it, so every quantized coordinate, difference and cell
+# index is EXACT in both the int16 and f32 domains — the quantized
+# sweep is bit-identical to the f32 sweep over the snapped positions,
+# and the oracle over snapped positions gates exactness like every
+# other parity suite (docs/ROOFLINE.md "Quantized state planes").
+DEFAULT_PRECISION = "off"
+PRECISION_POS_BITS = 15
+
 # Packed-key id width (ops/aoi.py _ID_BITS draws from here): slot ids
 # share an int32 with the quantized distance, so the packed fast paths
 # (single-array front sort, shift sweep, Verlet reuse) require

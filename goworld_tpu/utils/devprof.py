@@ -63,6 +63,14 @@ V5E_ICI_GBPS = 400.0
 # unless the row is dirty, so the model charges it at dirty duty
 HALO_ROW_BYTES = {"ppermute": 22.0, "async": 16.0}
 HALO_ASYNC_YAW_BYTES = 4.0
+# ... and under the quantized planes (precision=q16, ISSUE 12): the
+# xz pair ships as ONE packed i32 lane (4 B) + y f32 (4 B), yaw as
+# int16 (2 B) — ppermute 4+4+2+2+4 = 16 B/row, async packed 4+4+4
+# = 12 B/row + 2 B dirty-only yaw. The wire change itself is staged
+# for a relay window (the model arbitrates first, the audit stamps
+# both projections via ici_halo_mb_by_impl).
+HALO_ROW_BYTES_Q = {"ppermute": 16.0, "async": 12.0}
+HALO_ASYNC_YAW_BYTES_Q = 2.0
 
 # the paper's AOI-sync latency target (BASELINE.md: p99 < 16 ms at the
 # 1M/60 Hz headline shape) — the default SLO budget everywhere
@@ -124,6 +132,7 @@ def grid_config_key(grid) -> dict:
         "skin": grid.skin,
         "k": grid.k,
         "cell_cap": grid.cell_cap,
+        "precision": getattr(grid, "precision", "off"),
     }
 
 
@@ -206,6 +215,13 @@ def roofline_model_bytes(n: int, grid_kw: dict) -> dict[str, float]:
     sweep = grid_kw.get("sweep_impl", "ranges")
     skin = float(grid_kw.get("skin", 0.0))
     vcap = int(grid_kw.get("verlet_cap", 0)) or (k + k // 2)
+    # quantized state planes (precision=q16, ISSUE 12): the per-term
+    # narrowings below mirror exactly what ops/aoi.py ships — the
+    # packed 2-lane "ranges" sorted view, the packed-qxz reuse gather,
+    # the 21-bit-triplet cand cache, bf16 velocity, and the
+    # deadbanded-dirty delta prefilter. Keep in lockstep with
+    # docs/ROOFLINE.md "Quantized state planes".
+    q16 = grid_kw.get("precision", "off") != "off"
     cells = _padded_cells(grid_kw)
     win = 9 * cc                      # candidate-window lanes per query
 
@@ -222,6 +238,9 @@ def roofline_model_bytes(n: int, grid_kw: dict) -> dict[str, float]:
     if sweep in ("table", "cellrow", "shift"):
         # dense per-cell table init + 3x scatter in/out
         out["aoi_build"] = 4.0 * (3 * cc) * cells + 24.0 * n
+    elif sweep == "ranges" and q16:
+        # packed 2-lane sorted view ((qx,qz) pair + word = 8 B/row)
+        out["aoi_build"] = 8.0 * n
     else:
         # tableless ranges/fused front half: sorted [n, 3] view write
         out["aoi_build"] = 12.0 * n
@@ -229,9 +248,18 @@ def roofline_model_bytes(n: int, grid_kw: dict) -> dict[str, float]:
         # the whole back half is ONE VMEM-resident kernel: sorted view
         # streamed once + query scalars in, ranked keys + demand out —
         # the [n, 108] window and packed keys never round-trip HBM
+        # (under q16 the fused kernel keeps its f32 view — its window
+        # already never touches HBM, so there is nothing left to
+        # narrow)
         out["aoi_gather"] = 12.0 * n + 44.0 * n
         out["aoi_pack"] = 0.0
         out["aoi_rank"] = 4.0 * k * n + 4.0 * n
+    elif sweep == "ranges" and q16:
+        # 3 dynamic-slices of (2, 3*cell_cap) lanes per query — the
+        # position pair rides ONE i32 lane instead of two f32 lanes
+        out["aoi_gather"] = 3 * 2 * (3 * cc) * 4.0 * n
+        out["aoi_pack"] = 2 * 4.0 * win * n
+        out["aoi_rank"] = 4.0 * win * n + 4.0 * k * n
     else:
         # 3 dynamic-slices of (3, 3*cell_cap) f32 per query
         out["aoi_gather"] = 3 * 3 * (3 * cc) * 4.0 * n
@@ -242,7 +270,14 @@ def roofline_model_bytes(n: int, grid_kw: dict) -> dict[str, float]:
         # measures): candidate ids + positions + flags re-gathers plus
         # the shared ranking — front half + window fetch amortize to
         # ~1/cadence duty (cadence is workload speed, not modeled here)
-        out["aoi_reuse"] = (3 * 4.0 * vcap + 4.0 * k) * n
+        if q16:
+            # 21-bit-packed cand rows (2*ceil(V/3) u32 words) + ONE
+            # packed-qxz i32 gather per lane + ranked [n, k] out
+            cand_words = 2 * ((vcap + 2) // 3)
+            out["aoi_reuse"] = (4.0 * cand_words + 4.0 * vcap
+                                + 4.0 * k) * n
+        else:
+            out["aoi_reuse"] = (3 * 4.0 * vcap + 4.0 * k) * n
         out["aoi_rebuild"] = (out["cell_ids"] + out["aoi_sort"]
                               + out["aoi_build"] + out["aoi_gather"]
                               + out["aoi_pack"] + out["aoi_rank"])
@@ -251,9 +286,20 @@ def roofline_model_bytes(n: int, grid_kw: dict) -> dict[str, float]:
         out["aoi"] = (out["cell_ids"] + out["aoi_sort"]
                       + out["aoi_build"] + out["aoi_gather"]
                       + out["aoi_pack"] + out["aoi_rank"])
-    out["move"] = 96.0 * n            # pos/vel/yaw streams x ~4
-    # interest delta (prev/new nbr reads x2) + sync/attr collection
-    out["collect"] = 16.0 * k * n + (4.0 * k + 64.0) * n
+    if q16:
+        # pos r/w 24 + prev re-snap read 12 (the deadband compare) +
+        # bf16 velocity streams 24 (half of f32's 48) + qxz mirror 4
+        out["move"] = 64.0 * n
+        # interest delta streams prev+new ONCE each (8k): the changed-
+        # row prefilter rides the deadbanded quantized dirty lanes the
+        # sweep already delivers, and the k^2 membership compare only
+        # gathers the bounded changed-row set (ops/delta two_tier);
+        # sync/attr masks + cap-scale value gathers ~= 24 B/row
+        out["collect"] = 8.0 * k * n + 24.0 * n
+    else:
+        out["move"] = 96.0 * n        # pos/vel/yaw streams x ~4
+        # interest delta (prev/new nbr reads x2) + sync/attr collection
+        out["collect"] = 16.0 * k * n + (4.0 * k + 64.0) * n
     return out
 
 
@@ -343,10 +389,16 @@ def roofline_model_bytes_multichip(n_per_chip: int, grid_kw: dict,
     strips = 4 if shape[1] > 1 else 2
     ghost_rows = strips * halo_cap
     out = roofline_model_bytes(n_per_chip + ghost_rows, grid_kw)
-    # ICI halo: every inward-facing strip ships halo_cap rows each way
-    row_b = HALO_ROW_BYTES[halo_impl]
+    # ICI halo: every inward-facing strip ships halo_cap rows each
+    # way. Under the quantized planes (grid_kw precision=q16) the row
+    # narrows to the packed-xz/int16-yaw layout (HALO_ROW_BYTES_Q) —
+    # the halo interplay term of ISSUE 12 (wire change staged; the
+    # audit stamps both projections so the relay can arbitrate).
+    q16 = grid_kw.get("precision", "off") != "off"
+    row_b = (HALO_ROW_BYTES_Q if q16 else HALO_ROW_BYTES)[halo_impl]
     if halo_impl == "async":
-        row_b = row_b + HALO_ASYNC_YAW_BYTES * dirty_frac
+        row_b = row_b + (HALO_ASYNC_YAW_BYTES_Q if q16
+                         else HALO_ASYNC_YAW_BYTES) * dirty_frac
     out["ici_halo"] = float(strips * halo_cap) * row_b
     # ICI migrate: the all_to_all ships [n_dev, cap] rows of
     # (8 + attrs) f32 + 6 i32 each, both directions ~= one buffer out
@@ -407,7 +459,9 @@ def roofline_audit_multichip(tick_ms: float | None, cost, n_total: int,
         if crd.get("error"):
             out["cost_error"] = crd["error"]
     # the dirty-only packing delta, made visible: ICI halo bytes under
-    # both impls at this config's dirty fraction
+    # both impls at this config's dirty fraction — and under both
+    # precision domains (the "<impl>_q16" rows are the quantized-plane
+    # projection, ISSUE 12's staged halo win)
     deltas = {}
     for impl in HALO_ROW_BYTES:
         mk = dict(mega_kw)
@@ -415,6 +469,11 @@ def roofline_audit_multichip(tick_ms: float | None, cost, n_total: int,
         deltas[impl] = round(
             roofline_model_bytes_multichip(
                 n_per_chip, grid_kw, mk)["ici_halo"] / 1e6, 3)
+        gq = dict(grid_kw)
+        gq["precision"] = "q16"
+        deltas[impl + "_q16"] = round(
+            roofline_model_bytes_multichip(
+                n_per_chip, gq, mk)["ici_halo"] / 1e6, 3)
     out["ici_halo_mb_by_impl"] = deltas
     return out
 

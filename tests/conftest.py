@@ -81,6 +81,15 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "precision: quantized state-plane suites (the q16 lattice "
+        "sweep/Verlet parity vs the snapped oracle, the delta-sync "
+        "codec, the delta snapshot chain — tests/test_precision.py + "
+        "the precision rows in test_aoi_parity.py); all run in tier-1 "
+        "on CPU — the marker selects exactly the quantized-plane set "
+        "before/after a relay window",
+    )
+    config.addinivalue_line(
+        "markers",
         "flightrec: live workload-signature + incident flight-recorder "
         "suites (the production telemetry carry, /workload + "
         "/incidents, trigger/dedup/replay determinism — "

@@ -229,6 +229,34 @@ def test_pallas_impls_fall_back_to_interpret_off_tpu(monkeypatch,
     assert any("counting_sort_fill" in m for m in warns)
 
 
+@pytest.mark.precision
+@pytest.mark.parametrize("sweep_impl", ["table", "ranges", "cellrow",
+                                        "shift"])
+def test_precision_q16_matrix_matches_snapped_oracle(sweep_impl):
+    """precision=q16 rows of the parity matrix (ISSUE 12): every impl
+    sweeps the SNAPPED lattice world, so the oracle over the snapped
+    positions must hold exactly, and the packed-int16 "ranges" fast
+    path must match the f32 impls bit-for-bit (deep coverage incl.
+    Verlet reuse lives in tests/test_precision.py)."""
+    from goworld_tpu.ops.aoi import quantize_positions
+
+    spec = _spec(sweep_impl, "argsort", 0.0)
+    import dataclasses as _dc
+
+    spec = _dc.replace(spec, precision="q16")
+    spos = np.asarray(quantize_positions(spec, jnp.asarray(POS)))
+    oracle_q = neighbors_oracle(spos, ALIVE, RADIUS)
+    nbr, cnt, fl = grid_neighbors_flags(
+        spec, jnp.asarray(POS), jnp.asarray(ALIVE),
+        flag_bits=jnp.asarray(FB),
+    )
+    got = _sets(nbr)
+    for i in range(N):
+        want = oracle_q[i] if ALIVE[i] else set()
+        assert got[i] == want, (sweep_impl, i)
+    _check_flags(nbr, fl, FB)
+
+
 def test_new_knob_validation_mirrors_existing_messages():
     """GridSpec.__post_init__ rejects bad values for the r5 knobs with
     the same shape as the topk_impl/sweep_impl errors: the named

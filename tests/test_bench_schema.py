@@ -241,6 +241,48 @@ def test_workload_signature_required_since_r11(tmp_path):
                      _multi_rec()) == []
 
 
+def _prec_blocks():
+    return {
+        "precision": {"plane": "off", "pos_scale_bits": 0,
+                      "quant_step": 0.03125, "sync_delta": False,
+                      "sync_keyframe_every": 16},
+        "precision_ab": {"n": 131072, "off_ms": 10.0, "q16_ms": 9.0,
+                         "model_off_gb_1m": 1.09,
+                         "model_q16_gb_1m": 0.61},
+    }
+
+
+def test_precision_stamp_required_since_r12(tmp_path):
+    """ISSUE 12 satellite: r>=12 headlines must stamp the resolved
+    precision config + the on/off A/B next to the kernel stamps;
+    honest error/skip records accepted; r11 grandfathered."""
+    rec = _full_rec(workload_signature=_sig_block())
+    # r11: grandfathered without the blocks
+    assert _validate(tmp_path, "BENCH_r11.json", rec) == []
+    # r12: both blocks required
+    errs = _validate(tmp_path, "BENCH_r12.json", rec)
+    assert any("precision block" in e or "precision" in e
+               for e in errs)
+    assert any("precision_ab" in e for e in errs)
+    rec = _full_rec(workload_signature=_sig_block(), **_prec_blocks())
+    assert _validate(tmp_path, "BENCH_r12.json", rec) == []
+    # partial precision shapes caught
+    bad = _full_rec(workload_signature=_sig_block(), **_prec_blocks())
+    del bad["precision"]["pos_scale_bits"]
+    errs = _validate(tmp_path, "BENCH_r12.json", bad)
+    assert any("precision missing key 'pos_scale_bits'" in e
+               for e in errs)
+    bad = _full_rec(workload_signature=_sig_block(), **_prec_blocks())
+    del bad["precision_ab"]["model_q16_gb_1m"]
+    errs = _validate(tmp_path, "BENCH_r12.json", bad)
+    assert any("precision_ab missing key" in e for e in errs)
+    # honest error/skip records accepted (device-plane convention)
+    rec = _full_rec(workload_signature=_sig_block(),
+                    precision={"error": "stamp failed"},
+                    precision_ab={"skipped": "BENCH_PRECISION_AB=0"})
+    assert _validate(tmp_path, "BENCH_r12.json", rec) == []
+
+
 def test_unreadable_file_reported(tmp_path):
     p = tmp_path / "BENCH_r08.json"
     p.write_text("{not json")

@@ -91,6 +91,23 @@ def test_phase_regression_fails(tmp_path):
     assert TREND.main([f1, f2]) == 2
 
 
+def test_phase_regression_demoted_when_headline_improved(tmp_path):
+    """The split gate catches a phase rotting UNDER a flat headline;
+    when the headline itself improved past the threshold vs the same
+    predecessor (r12 vs r05: different hardware, 1.9x faster headline,
+    slower collect split), the split flags demote to NOTES — recorded,
+    never gated. A flat headline keeps the hard gate (test above)."""
+    f1 = _write(tmp_path, "BENCH_r01.json",
+                _bench_rec(1000.0, phase_ms={"aoi": 5.0}))
+    f2 = _write(tmp_path, "BENCH_r02.json",
+                _bench_rec(1900.0, phase_ms={"aoi": 9.0}))
+    assert TREND.main([f1, f2]) == 0
+    # just-under-threshold improvement still gates the split
+    f2b = _write(tmp_path, "BENCH_r03.json",
+                 _bench_rec(1200.0, phase_ms={"aoi": 20.0}))
+    assert TREND.main([f1, f2b]) == 2
+
+
 def test_shape_change_is_not_compared(tmp_path):
     f1 = _write(tmp_path, "BENCH_r01.json",
                 _bench_rec(1000.0, entities=1000))

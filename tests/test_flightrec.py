@@ -236,6 +236,35 @@ def test_signature_classes():
     assert sig["events"] == "heavy"
 
 
+def test_signature_recommends_delta_sync_when_dirty_is_low():
+    """ISSUE 12 satellite: under quiet/flock_like windows with a low
+    sync-record duty the reducer recommends `[gameN] sync_delta = 1`
+    (the int16-delta fan-out pays off exactly there); teleport-like
+    churn never recommends it (every jump overflows the int16 delta
+    range — the stream would be all keyframes)."""
+    sig = telemetry.workload_signature(_lanes(rebuilds=10))
+    assert sig["churn"] == "flock_like" and sig["events"] == "quiet"
+    assert sig["recommendation"]["sync_delta"] == 1
+
+    # quiet + skinless: still recommended (dirty volume is the gate)
+    sig = telemetry.workload_signature(_lanes(skin=False))
+    assert sig["events"] == "quiet"
+    assert sig["recommendation"]["sync_delta"] == 1
+
+    # teleport-like churn: excluded even when quiet
+    sig = telemetry.workload_signature(_lanes(rebuilds=95))
+    assert sig["churn"] == "teleport_like"
+    assert "sync_delta" not in sig["recommendation"]
+
+    # heavy sync volume: the p50 gate holds it back
+    lanes = _lanes(rebuilds=10)
+    lanes["sync_n"]["counts"] = [0] * len(lanes["sync_n"]["counts"])
+    lanes["sync_n"]["counts"][9] = 100     # p50 in a high bucket
+    sig = telemetry.workload_signature(lanes)
+    assert sig.get("sync_p50", 0) > 64
+    assert "sync_delta" not in sig["recommendation"]
+
+
 def test_signature_tile_skew():
     sig = telemetry.workload_signature(
         _lanes(occ=[100, 100, 100, 100]))

@@ -390,3 +390,115 @@ class TestSnapshotCorruption:
         w2 = _make_world()
         with pytest.raises(FileNotFoundError):
             freeze.restore_from_file(w2, str(tmp_path))
+
+
+@pytest.mark.precision
+class TestSnapshotChain:
+    """Quantized + delta-compressed snapshot chain (ISSUE 12,
+    freeze.SnapshotChain): keyframe cadence, bit-exact roundtrip in
+    the lattice domain, and corrupt/mismatched deltas falling back to
+    the keyframe through the existing CorruptSnapshotError path."""
+
+    def _world_with_npcs(self, n=8):
+        w = _make_world()
+        sp = w.create_space("Arena")
+        ents = [w.create_entity("Npc", space=sp,
+                                pos=(3.0 * i, 0.0, 5.0 * i))
+                for i in range(n)]
+        w.tick()
+        return w, sp, ents
+
+    def test_keyframe_cadence_honored(self, tmp_path):
+        w, _sp, _es = self._world_with_npcs()
+        chain = freeze.SnapshotChain(w, str(tmp_path), keyframe_every=3)
+        kinds = []
+        for _ in range(7):
+            kinds.append("K" if chain.write().endswith("_ckpt_key.dat")
+                         else "D")
+        assert kinds == ["K", "D", "D", "K", "D", "D", "K"]
+
+    def test_roundtrip_bit_exact_on_restore(self, tmp_path):
+        import msgpack
+
+        w, _sp, ents = self._world_with_npcs()
+        chain = freeze.SnapshotChain(w, str(tmp_path), keyframe_every=4)
+        pk = chain.write()
+        pd = chain.write()
+        data = freeze.read_freeze_file(pd)   # delta resolves via key
+        assert data["version"] == 1
+        w2 = _make_world()
+        freeze.restore_world(w2, data)
+        assert len([e for e in w2.entities.values()
+                    if isinstance(e, Npc)]) == len(ents)
+        # restored positions are lattice points; a SECOND chain write
+        # of the restored world produces BYTE-IDENTICAL planes
+        # (lattice points re-quantize to themselves)
+        w2.tick()
+        chain2 = freeze.SnapshotChain(w2, str(tmp_path / "b"),
+                                      keyframe_every=4)
+        import os as _os
+
+        _os.makedirs(tmp_path / "b", exist_ok=True)
+        pk2 = chain2.write()
+        a = msgpack.unpackb(open(pk, "rb").read(), raw=False)
+        b = msgpack.unpackb(open(pk2, "rb").read(), raw=False)
+        for nm in ("pos_xz", "pos_y", "yaw", "moving"):
+            assert a["planes"][nm] == b["planes"][nm], nm
+
+    def test_delta_ships_only_changed_rows(self, tmp_path):
+        import msgpack
+        import numpy as np
+
+        w, _sp, ents = self._world_with_npcs()
+        chain = freeze.SnapshotChain(w, str(tmp_path), keyframe_every=8)
+        chain.write()
+        # move ONE entity by a super-lattice amount
+        ents[3].set_position((100.0, 0.0, 100.0))
+        w.tick()
+        pd = chain.write()
+        rec = msgpack.unpackb(open(pd, "rb").read(), raw=False)
+        rows = np.frombuffer(rec["rows"], np.int32)
+        assert (rows < 0).sum() <= 2     # the mover (+jitter slack)
+        data = freeze.read_freeze_file(pd)
+        by_id = {e["id"]: e for e in data["entities"]}
+        got = by_id[ents[3].id]["pos"]
+        step = freeze.snapshot_quant_step(w)
+        assert abs(got[0] - 100.0) <= step
+        assert abs(got[2] - 100.0) <= step
+
+    def test_corrupt_delta_falls_back_to_keyframe(self, tmp_path):
+        w, _sp, ents = self._world_with_npcs()
+        chain = freeze.SnapshotChain(w, str(tmp_path), keyframe_every=4)
+        chain.write()
+        pd = chain.write()
+        with open(pd, "r+b") as f:
+            f.seek(24)
+            f.write(b"\xff" * 16)
+        with pytest.raises(freeze.CorruptSnapshotError):
+            freeze.read_freeze_file(pd)
+        # the candidate walk lands on the keyframe instead
+        w2 = _make_world()
+        freeze.restore_from_file(w2, str(tmp_path))
+        assert len([e for e in w2.entities.values()
+                    if isinstance(e, Npc)]) == len(ents)
+
+    def test_rewritten_keyframe_fails_delta_crc(self, tmp_path):
+        """A delta whose keyframe was REPLACED (CRCs mismatch) must be
+        rejected whole — merging planes across two worlds' keyframes
+        would silently mix states."""
+        w, _sp, ents = self._world_with_npcs()
+        chain = freeze.SnapshotChain(w, str(tmp_path), keyframe_every=4)
+        chain.write()
+        pd = chain.write()
+        # a different world rewrites the keyframe under the delta
+        w3 = _make_world()
+        sp3 = w3.create_space("Arena")
+        w3.create_entity("Npc", space=sp3, pos=(99.0, 0.0, 99.0))
+        w3.tick()
+        freeze.SnapshotChain(w3, str(tmp_path), keyframe_every=4).write()
+        with pytest.raises(freeze.CorruptSnapshotError,
+                           match="CRC mismatch"):
+            freeze.read_freeze_file(pd)
+        # ...and recovery still restores (the fresh keyframe parses)
+        w2 = _make_world()
+        freeze.restore_from_file(w2, str(tmp_path))

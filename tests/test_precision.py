@@ -1,0 +1,619 @@
+"""Quantized state planes (ISSUE 12, GridSpec.precision="q16").
+
+The exactness story is BY CONSTRUCTION, so the tests assert it as
+equalities, not tolerances: the lattice step is a power of two and the
+cell edge a power-of-two multiple of it, so (1) snapping is idempotent,
+(2) the int16-pair distance math equals the f32 math over snapped
+positions BIT-FOR-BIT, (3) every sweep impl with precision on equals
+the brute-force oracle over the SNAPPED world, and (4) the packed
+fast paths (the 2-lane ranges sorted view, the 21-bit-triplet Verlet
+cand cache) are bit-identical to the f32 paths over the same snapped
+positions. Plus the two delta companions: the sync codec
+(net/codec.py) and the snapshot chain (freeze.py — tested in
+tests/test_freeze.py)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from goworld_tpu.net.codec import DeltaSyncDecoder, DeltaSyncEncoder
+from goworld_tpu.ops.aoi import (
+    GridSpec,
+    grid_neighbors_flags,
+    grid_neighbors_verlet,
+    init_verlet_cache,
+    neighbors_oracle,
+    pack_ids21,
+    quantize_positions,
+    quantize_xz_i32,
+    unpack_ids21,
+)
+
+pytestmark = pytest.mark.precision
+
+N = 500
+EXTENT = 300.0
+RADIUS = 25.0
+SKIN = 7.5
+
+
+def _world(seed=5):
+    rng = np.random.default_rng(seed)
+    pos = np.zeros((N, 3), np.float32)
+    pos[:, 0] = rng.random(N) * EXTENT
+    pos[:, 2] = rng.random(N) * EXTENT
+    alive = rng.random(N) < 0.92
+    fb = rng.integers(0, 4, N).astype(np.int32)
+    pos2 = pos.copy()
+    step = rng.normal(0.0, 1.0, (N, 2)).astype(np.float32)
+    step = np.clip(step, -SKIN / 2 + 0.1, SKIN / 2 - 0.1)
+    pos2[:, 0] = np.clip(pos[:, 0] + step[:, 0], 0, EXTENT - 1e-3)
+    pos2[:, 2] = np.clip(pos[:, 2] + step[:, 1], 0, EXTENT - 1e-3)
+    return pos, pos2, alive, fb
+
+
+POS, POS2, ALIVE, FB = _world()
+
+
+def _spec(sweep_impl, precision="q16", skin=0.0, **kw):
+    return GridSpec(
+        radius=RADIUS, extent_x=EXTENT, extent_z=EXTENT,
+        k=64, cell_cap=64, row_block=256, sweep_impl=sweep_impl,
+        skin=skin, verlet_cap=128, precision=precision, **kw,
+    )
+
+
+def _sets(nbr):
+    nbr = np.asarray(nbr)
+    return [set(r[r < N].tolist()) for r in nbr]
+
+
+SPEC_Q = _spec("ranges")
+SPOS = np.asarray(quantize_positions(SPEC_Q, jnp.asarray(POS)))
+SPOS2 = np.asarray(quantize_positions(SPEC_Q, jnp.asarray(POS2)))
+ORACLE_Q = neighbors_oracle(SPOS, ALIVE, RADIUS)
+ORACLE_Q2 = neighbors_oracle(SPOS2, ALIVE, RADIUS)
+
+
+# =======================================================================
+# the lattice quantizer itself
+# =======================================================================
+def test_quant_step_is_power_of_two_and_covers_extent():
+    sp = SPEC_Q
+    import math
+
+    m, _e = math.frexp(sp.quant_step)
+    assert m == 0.5                      # exact power of two
+    assert sp.quant_step * (1 << 15) >= EXTENT
+    assert sp.quant_step <= RADIUS / 4.0
+    # the cell edge is a power-of-two multiple of the step and still
+    # covers the reach (the 3x3-window coverage invariant)
+    assert sp.cell_size == sp.quant_step * (1 << sp.quant_cell_shift)
+    assert sp.cell_size >= sp.radius + sp.skin
+    assert sp.quant_bits == 15
+    assert _spec("ranges", precision="off").quant_bits == 0
+
+
+def test_snap_is_idempotent_and_exact():
+    snapped = quantize_positions(SPEC_Q, jnp.asarray(POS))
+    twice = quantize_positions(SPEC_Q, snapped)
+    assert np.array_equal(np.asarray(snapped), np.asarray(twice))
+    # y passes through untouched
+    assert np.array_equal(np.asarray(snapped)[:, 1], POS[:, 1])
+    # every snapped coordinate is an exact lattice multiple
+    q = np.asarray(snapped)[:, 0] / SPEC_Q.quant_step
+    assert np.array_equal(q, np.round(q))
+
+
+def test_packed_xz_mirror_distance_equals_f32_over_snapped():
+    """The heart of the construction: int16-pair Chebyshev distances
+    times the step EQUAL the f32 distances over snapped positions,
+    bitwise, for every pair in the world."""
+    qxz = np.asarray(quantize_xz_i32(SPEC_Q, jnp.asarray(POS)))
+    qx = (qxz >> 16).astype(np.int64)
+    qz = (qxz & 0xFFFF).astype(np.int64)
+    dint = np.maximum(np.abs(qx[:, None] - qx[None, :]),
+                      np.abs(qz[:, None] - qz[None, :]))
+    d_from_int = (dint.astype(np.float32)
+                  * np.float32(SPEC_Q.quant_step))
+    d_f32 = np.maximum(
+        np.abs(SPOS[:, 0][:, None] - SPOS[:, 0][None, :]),
+        np.abs(SPOS[:, 2][:, None] - SPOS[:, 2][None, :]),
+    ).astype(np.float32)
+    assert np.array_equal(d_from_int, d_f32)
+
+
+def test_pack_ids21_roundtrip_lossless():
+    rng = np.random.default_rng(0)
+    for v in (1, 2, 3, 7, 48, 128):
+        ids = rng.integers(0, (1 << 21) - 1, (5, v)).astype(np.int32)
+        up = np.asarray(unpack_ids21(pack_ids21(jnp.asarray(ids), N)))
+        assert np.array_equal(up[:, :v], ids), v
+        assert np.all(up[:, v:] == N)    # pads carry the sentinel
+
+
+# =======================================================================
+# GridSpec validation (loud, construction-time — GridSpec style)
+# =======================================================================
+def test_precision_validation_messages():
+    with pytest.raises(ValueError, match=r"off\|q16"):
+        _spec("ranges", precision="fp8")
+    with pytest.raises(ValueError, match=r"origin-free"):
+        GridSpec(radius=RADIUS, origin_x=10.0, extent_x=EXTENT,
+                 extent_z=EXTENT, precision="q16")
+    # a lattice coarser than radius/4 (tiny radius over a huge extent)
+    # is rejected with the named bound
+    with pytest.raises(ValueError, match=r"radius/4"):
+        GridSpec(radius=2.0, extent_x=1 << 18, extent_z=1 << 18,
+                 precision="q16")
+    # the off default constructs exactly as before
+    GridSpec(radius=2.0, extent_x=1 << 18, extent_z=1 << 18)
+
+
+# =======================================================================
+# oracle exactness + cross-impl bit parity, precision ON
+# =======================================================================
+@pytest.mark.parametrize("sort_impl", ["argsort", "counting"])
+@pytest.mark.parametrize("sweep_impl", ["table", "ranges", "cellrow",
+                                        "shift"])
+def test_q16_matrix_matches_snapped_oracle(sweep_impl, sort_impl):
+    spec = _spec(sweep_impl, sort_impl=sort_impl)
+    nbr, cnt, fl = grid_neighbors_flags(
+        spec, jnp.asarray(POS), jnp.asarray(ALIVE),
+        flag_bits=jnp.asarray(FB),
+    )
+    got = _sets(nbr)
+    for i in range(N):
+        want = ORACLE_Q[i] if ALIVE[i] else set()
+        assert got[i] == want, (sweep_impl, sort_impl, i)
+
+
+def test_q16_ranges_packed_bit_identical_to_table():
+    """The packed 2-lane sorted view ("ranges" under q16) must produce
+    the same raw arrays as the f32 table impl over the same snapped
+    world — same candidates, same exact distances, same keys."""
+    outs = {}
+    for sweep in ("ranges", "table"):
+        nbr, cnt, fl = grid_neighbors_flags(
+            _spec(sweep), jnp.asarray(POS), jnp.asarray(ALIVE),
+            flag_bits=jnp.asarray(FB),
+        )
+        outs[sweep] = (np.asarray(nbr), np.asarray(cnt),
+                       np.asarray(fl))
+    for a, b in zip(outs["ranges"], outs["table"]):
+        assert np.array_equal(a, b)
+
+
+def test_q16_equals_f32_sweep_over_snapped_positions():
+    """precision=q16 on raw positions == precision=off on the SNAPPED
+    positions, bit-for-bit (same grid geometry pinned via the same
+    spec family) — the construction's central equality."""
+    sp_q = _spec("ranges")
+    nbr_q, cnt_q, fl_q = grid_neighbors_flags(
+        sp_q, jnp.asarray(POS), jnp.asarray(ALIVE),
+        flag_bits=jnp.asarray(FB),
+    )
+    # off-spec with the SAME cell geometry: radius grown to the
+    # quantized cell edge would change reach; instead run the q16 spec
+    # on pre-snapped input — the internal snap is idempotent, so this
+    # isolates "who snaps" from "what is computed"
+    nbr_s, cnt_s, fl_s = grid_neighbors_flags(
+        sp_q, jnp.asarray(SPOS), jnp.asarray(ALIVE),
+        flag_bits=jnp.asarray(FB),
+    )
+    assert np.array_equal(np.asarray(nbr_q), np.asarray(nbr_s))
+    assert np.array_equal(np.asarray(cnt_q), np.asarray(cnt_s))
+    assert np.array_equal(np.asarray(fl_q), np.asarray(fl_s))
+
+
+@pytest.mark.parametrize("sort_impl", ["argsort", "counting"])
+def test_q16_verlet_rebuild_and_reuse_exact(sort_impl):
+    """The packed-cand Verlet path under q16: cold rebuild and a
+    legal reuse tick both match the snapped oracle; the reuse tick
+    really skipped the front half; gauges stay zero."""
+    spec = _spec("ranges", skin=SKIN, sort_impl=sort_impl)
+    cache = init_verlet_cache(spec, N)
+    assert cache.cand.dtype == jnp.uint32      # 21-bit-packed plane
+    nbr, cnt, fl, st, cache, reb, _sl = grid_neighbors_verlet(
+        spec, jnp.asarray(POS), jnp.asarray(ALIVE), cache,
+        flag_bits=jnp.asarray(FB), with_stats=True,
+    )
+    assert int(reb) == 1
+    got = _sets(nbr)
+    for i in range(N):
+        want = ORACLE_Q[i] if ALIVE[i] else set()
+        assert got[i] == want, ("rebuild", i)
+    nbr2, cnt2, fl2, st2, cache, reb2, _sl = grid_neighbors_verlet(
+        spec, jnp.asarray(POS2), jnp.asarray(ALIVE), cache,
+        flag_bits=jnp.asarray(FB), with_stats=True,
+    )
+    assert int(reb2) == 0                      # under skin/2: reused
+    got2 = _sets(nbr2)
+    for i in range(N):
+        want = ORACLE_Q2[i] if ALIVE[i] else set()
+        assert got2[i] == want, ("reuse", i)
+    assert int(st2[1]) == 0 and int(st2[3]) == 0  # both gauges zero
+
+
+def test_q16_verlet_rebuild_triggers_still_fire():
+    """The rebuild cond runs in the snapped domain — alive-set change
+    and a past-skin/2 jump must still trip it on the exact tick."""
+    spec = _spec("ranges", skin=SKIN)
+    cache = init_verlet_cache(spec, N)
+    out = grid_neighbors_verlet(spec, jnp.asarray(POS),
+                                jnp.asarray(ALIVE), cache,
+                                flag_bits=jnp.asarray(FB))
+    cache = out[4]
+    # alive flip
+    alive2 = ALIVE.copy()
+    alive2[int(np.flatnonzero(ALIVE)[0])] = False
+    out = grid_neighbors_verlet(spec, jnp.asarray(POS),
+                                jnp.asarray(alive2), cache,
+                                flag_bits=jnp.asarray(FB))
+    assert int(out[5]) == 1
+    cache = out[4]
+    # a teleport-sized jump
+    pos3 = POS.copy()
+    j = int(np.flatnonzero(alive2)[0])
+    pos3[j, 0] = (pos3[j, 0] + EXTENT / 2) % EXTENT
+    out = grid_neighbors_verlet(spec, jnp.asarray(pos3),
+                                jnp.asarray(alive2), cache,
+                                flag_bits=jnp.asarray(FB))
+    assert int(out[5]) == 1
+
+
+# =======================================================================
+# whole-tick / World-level exactness (scenario oracle incl. mirrors)
+# =======================================================================
+@pytest.mark.scenarios
+@pytest.mark.parametrize("name", ["flock", "teleport"])
+def test_q16_world_passes_scenario_oracle(name):
+    """run_scenario's full-contract check (interest == snapped-domain
+    brute force, interested_by mirrors, client mirrors from drained
+    create/destroy messages) with the precision plane ON — the skin's
+    best case (flock) and its worst (teleport) both must hold, with
+    the exactness precondition (both overflow gauges zero) intact."""
+    from goworld_tpu.scenarios.runner import run_scenario
+
+    rep = run_scenario(
+        name, n=96, ticks=12, seed=3, oracle_every=3,
+        client_frac=0.2, skin=4.0 if name == "flock" else 0.0,
+        grid_kw={"precision": "q16"}, raise_on_mismatch=True,
+    )
+    assert rep.oracle_ticks_checked > 0
+    assert not rep.mismatches
+
+
+def test_q16_tick_deadbands_sub_step_motion():
+    """An entity moving less than one lattice step per tick is CLEAN
+    under q16 — no sync records (the delta-sync byte story's device
+    half) — while a multi-step mover still syncs."""
+    import jax
+
+    from goworld_tpu.core.state import WorldConfig, create_state, spawn
+    from goworld_tpu.core.step import TickInputs, make_tick
+
+    grid = GridSpec(radius=30.0, extent_x=256.0, extent_z=256.0,
+                    k=16, cell_cap=32, precision="q16")
+    cfg = WorldConfig(capacity=64, grid=grid, dt=1.0,
+                      adaptive_extract=True)
+    st = create_state(cfg, seed=0)
+    assert st.vel.dtype == jnp.bfloat16       # the narrow plane
+    # two watchers with clients near two movers
+    st = spawn(st, 0, pos=(100.0, 0.0, 100.0), has_client=True,
+               client_gate=1)
+    st = spawn(st, 1, pos=(105.0, 0.0, 100.0), npc_moving=True)
+    st = spawn(st, 2, pos=(200.0, 0.0, 200.0), has_client=True,
+               client_gate=1)
+    st = spawn(st, 3, pos=(205.0, 0.0, 200.0), npc_moving=True)
+    # slot 1 crawls at 1/8 lattice step per tick, slot 3 at 4 steps
+    step = grid.quant_step
+    vel = np.zeros((64, 3), np.float32)
+    vel[1, 0] = step / 8.0
+    vel[3, 0] = step * 4.0
+    st = st.replace(vel=jnp.asarray(vel).astype(st.vel.dtype),
+                    npc_moving=st.npc_moving.at[1].set(True)
+                    .at[3].set(True))
+    tick = make_tick(cfg)
+    ins = TickInputs.empty(cfg)
+    st, out = tick(st, ins, None)             # spawn-dirty tick
+    st, out = jax.jit(tick)(st, ins, None)
+    subs = set(np.asarray(out.sync_j)[:int(out.sync_n)].tolist())
+    assert 3 in subs                          # the striding mover syncs
+    assert 1 not in subs                      # sub-step jitter is clean
+
+
+# =======================================================================
+# the delta-sync codec (wire half)
+# =======================================================================
+STEP = 2.0 ** -5
+_BASE_RNG = np.random.default_rng(11)
+_BASE_VALS = (_BASE_RNG.random((16, 4)) * 900).astype(np.float32)
+
+
+def _lattice_vals(rng, n, t=0):
+    """Smooth motion: a fixed base drifting ~3 lattice steps/tick —
+    the steady state the delta encoder exists for (a fresh random
+    position every tick would be a teleport storm: all keyframes)."""
+    vals = _BASE_VALS[:n] + np.float32(t) * np.float32(3 * STEP)
+    vals = vals.astype(np.float32)
+    vals[:, 0] = np.floor(vals[:, 0] / STEP) * STEP
+    vals[:, 2] = np.floor(vals[:, 2] / STEP) * STEP
+    return vals
+
+
+def test_delta_sync_roundtrip_bit_exact_on_lattice():
+    rng = np.random.default_rng(1)
+    enc = DeltaSyncEncoder(STEP, keyframe_every=8)
+    dec = DeltaSyncDecoder()
+    cids = np.array([b"c%03d" % (i % 4) for i in range(12)], "S16")
+    eids = np.array([b"e%03d" % i for i in range(12)], "S16")
+    for t in range(20):
+        vals = _lattice_vals(rng, 12, t)
+        c2, e2, v2 = dec.decode_batch(
+            enc.encode_batch(cids, eids, vals, t))
+        assert np.array_equal(c2, cids)
+        assert np.array_equal(e2, eids)
+        # lattice lanes reconstruct EXACTLY; y/yaw within step/2
+        assert np.array_equal(v2[:, 0], vals[:, 0]), t
+        assert np.array_equal(v2[:, 2], vals[:, 2]), t
+        assert np.max(np.abs(v2[:, 1] - vals[:, 1])) <= STEP / 2 + 1e-5
+    # steady state is delta-dominated: wire bytes well under full
+    assert enc.stats["wire_bytes"] < 0.55 * enc.stats["full_bytes"]
+    assert enc.stats["deltas"] > enc.stats["keyframes"]
+
+
+def test_delta_sync_keyframe_cadence_and_threshold():
+    enc = DeltaSyncEncoder(STEP, keyframe_every=4)
+    dec = DeltaSyncDecoder()
+    cids = np.array([b"c"], "S16")
+    eids = np.array([b"e"], "S16")
+    kinds = []
+    v = np.zeros((1, 4), np.float32)
+    for t in range(9):
+        before = enc.stats["keyframes"]
+        dec.decode_batch(enc.encode_batch(cids, eids, v, t))
+        kinds.append("K" if enc.stats["keyframes"] > before else "D")
+    # keyframe at t=0 then every 4 ticks (cadence honored)
+    assert kinds == ["K", "D", "D", "D", "K", "D", "D", "D", "K"]
+    # an int16-overflow jump forces a keyframe regardless of cadence
+    big = v.copy()
+    big[0, 0] = 40000.0 * STEP
+    before = enc.stats["keyframes"]
+    _c, _e, v2 = dec.decode_batch(enc.encode_batch(cids, eids, big, 9))
+    assert enc.stats["keyframes"] == before + 1
+    assert v2[0, 0] == big[0, 0]
+
+
+def test_delta_sync_decoder_is_pure_function_of_stream():
+    """Two decoders fed the same byte stream agree bit-for-bit; a
+    late-joining decoder drops unknown-handle deltas and self-heals
+    at the pair's next keyframe."""
+    rng = np.random.default_rng(2)
+    enc = DeltaSyncEncoder(STEP, keyframe_every=3)
+    d1, d2 = DeltaSyncDecoder(), DeltaSyncDecoder()
+    cids = np.array([b"c%d" % (i % 2) for i in range(6)], "S16")
+    eids = np.array([b"e%d" % i for i in range(6)], "S16")
+    stream = [enc.encode_batch(cids, eids, _lattice_vals(rng, 6, t), t)
+              for t in range(6)]
+    for p in stream:
+        o1 = d1.decode_batch(p)
+        o2 = d2.decode_batch(p)
+        for a, b in zip(o1, o2):
+            assert np.array_equal(a, b)
+    late = DeltaSyncDecoder()
+    n_out = [len(late.decode_batch(p)[0]) for p in stream[4:]]
+    assert late.stats["dropped_unknown"] > 0 or n_out[0] == 6
+    # after one full cadence every pair has re-keyframed
+    p = enc.encode_batch(cids, eids, _lattice_vals(rng, 6, 40), 40)
+    assert len(late.decode_batch(p)[0]) == 6
+
+
+def test_delta_sync_reset_rides_in_band():
+    enc = DeltaSyncEncoder(STEP, keyframe_every=64, max_entries=4)
+    dec = DeltaSyncDecoder()
+    rng = np.random.default_rng(3)
+    cids = np.array([b"c%02d" % i for i in range(8)], "S16")
+    eids = np.array([b"e%02d" % i for i in range(8)], "S16")
+    dec.decode_batch(enc.encode_batch(cids, eids,
+                                      _lattice_vals(rng, 8), 0))
+    # over max_entries: the next batch resets BOTH sides in-band
+    dec.decode_batch(enc.encode_batch(cids, eids,
+                                      _lattice_vals(rng, 8, 1), 1))
+    assert enc.stats["resets"] == 1
+    assert dec.stats["resets"] == 1
+    assert dec.stats["dropped_unknown"] == 0   # all re-keyframed
+
+
+# =======================================================================
+# roofline model: the byte claim (acceptance criterion)
+# =======================================================================
+def test_model_precision_terms_hit_the_byte_target():
+    from goworld_tpu.utils.devprof import (
+        roofline_model_bytes,
+        roofline_model_bytes_multichip,
+    )
+
+    def total(kw, n=1 << 20):
+        m = roofline_model_bytes(n, kw)
+        return sum(m[p] for p in ("aoi", "move", "collect"))
+
+    head = dict(k=32, cell_cap=12, sort_impl="counting",
+                sweep_impl="fused", skin=0.0)
+    # the ROOFLINE headline config (fused + counting) at 1M: the
+    # "~1.5 GB with margin" baseline models ~1.1 GB arithmetic and
+    # must drop under 0.8 GB with precision on
+    assert total(head) > 1.0e9
+    assert total(dict(head, precision="q16")) < 0.8e9
+    # the skin-on steady state (~1.5 GB arithmetic) nearly halves
+    skin = dict(head, sweep_impl="ranges", skin=4.0)
+    assert total(skin) > 1.5e9
+    assert total(dict(skin, precision="q16")) < 0.55 * total(skin)
+    # modeled ICI halo bytes drop proportionally under q16
+    mk = dict(n_dev=8, halo_cap=4096, migrate_cap=256,
+              mesh_shape=(4, 2))
+    for impl in ("ppermute", "async"):
+        mk["halo_impl"] = impl
+        off = roofline_model_bytes_multichip(131072, head, mk)
+        q = roofline_model_bytes_multichip(
+            131072, dict(head, precision="q16"), mk)
+        assert q["ici_halo"] < 0.8 * off["ici_halo"], impl
+        # the audit stamps both projections
+    from goworld_tpu.utils.devprof import roofline_audit_multichip
+
+    audit = roofline_audit_multichip(None, None, 1 << 20, head,
+                                     dict(mk, halo_impl="async"))
+    byimpl = audit["ici_halo_mb_by_impl"]
+    assert {"ppermute", "async", "ppermute_q16", "async_q16"} \
+        <= set(byimpl)
+    assert byimpl["async_q16"] < byimpl["async"]
+
+
+def test_bench_precision_ab_smoke():
+    """The bench A/B block lands with both measured marginals and the
+    modeled 1M claim (the r12 schema's precision_ab contract)."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_for_precision_test",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    out = bench.precision_ab(4096, ticks=2)
+    assert "error" not in out, out
+    for k in ("off_ms", "q16_ms", "model_off_gb_1m",
+              "model_q16_gb_1m", "pos_scale_bits", "quant_step"):
+        assert k in out, (k, out)
+    # the resolved-config rows drop; the ROOFLINE headline config
+    # (fused + counting) lands the acceptance target at 1M
+    assert out["model_q16_gb_1m"] < out["model_off_gb_1m"]
+    assert out["model_q16_gb_1m_headline"] < 0.8
+    assert out["model_off_gb_1m_headline"] > 1.0
+    assert out["pos_scale_bits"] == 15
+
+
+def test_delta_sync_game_to_gate_wire(monkeypatch):
+    """The game flush really ships MT_SYNC_POSITION_YAW_DELTA_ON_
+    CLIENTS with a payload the gate-side decoder reconstructs to the
+    exact staged records (x/z are lattice values under q16, so the
+    roundtrip is bit-exact end to end)."""
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.entity import World
+    from goworld_tpu.net import proto
+    from goworld_tpu.net.game import GameServer
+
+    grid = GridSpec(radius=30.0, extent_x=120.0, extent_z=120.0,
+                    precision="q16")
+    w = World(WorldConfig(capacity=64, grid=grid, input_cap=64),
+              n_spaces=1)
+    w.create_nil_space()
+    gs = GameServer(1, w, [], sync_delta=True, sync_keyframe_every=4)
+    sent = []
+    monkeypatch.setattr(gs, "_send",
+                        lambda conn, p: sent.append(p))
+    monkeypatch.setattr(gs.cluster, "select_by_gate_id",
+                        lambda gid: None)
+    step = grid.quant_step
+    dec = DeltaSyncDecoder()
+    for t in range(6):
+        x = np.float32(np.floor((10.0 + t) / step) * step)
+        z = np.float32(np.floor((20.0 + 2 * t) / step) * step)
+        vals = np.array([[x, 1.5, z, 0.25]], np.float32)
+        gs._sync_sink(3, [b"c1"], [b"e1"], vals)
+        sent.clear()
+        gs._flush_sync_out()
+        assert len(sent) == 1
+        p = sent[0]
+        mt = int.from_bytes(bytes(p.buf[0:2]), "little")
+        assert mt == proto.MT_SYNC_POSITION_YAW_DELTA_ON_CLIENTS
+        gate_id = int.from_bytes(bytes(p.buf[2:4]), "little")
+        assert gate_id == 3
+        sender = int.from_bytes(bytes(p.buf[4:6]), "little")
+        assert sender == 1       # per-game handle space on the wire
+        cids, eids, v2 = dec.decode_batch(bytes(memoryview(p.buf)[6:]))
+        assert cids[0] == b"c1" and eids[0] == b"e1"
+        assert v2[0, 0] == x and v2[0, 2] == z
+    enc = gs._sync_encoders[3]
+    assert enc.stats["deltas"] > 0 and enc.stats["keyframes"] >= 2
+
+
+def test_delta_sync_truncated_payload_raises_connection_error():
+    """A truncated 1505 payload must surface as ConnectionError (the
+    gate handler's drop-one-batch guard), never a raw struct.error
+    into the dispatcher read loop."""
+    rng = np.random.default_rng(5)
+    enc = DeltaSyncEncoder(STEP, keyframe_every=4)
+    cids = np.array([b"c"], "S16")
+    eids = np.array([b"e"], "S16")
+    p = enc.encode_batch(cids, eids, _lattice_vals(rng, 1), 0)
+    for cut in (3, len(p) - 5, len(p) - 1):
+        with pytest.raises(ConnectionError):
+            DeltaSyncDecoder().decode_batch(p[:cut])
+
+
+def test_snapshot_planes_handle_nonzero_origin():
+    """Chain planes are origin-relative: a shifted world's positions
+    must roundtrip near themselves, not clamp to the zero corner."""
+    from goworld_tpu.freeze import _extract_planes, _inject_planes
+
+    step = 2.0 ** -5
+    origin = (-1000.0, -500.0)
+    data = {"entities": [
+        {"pos": [-900.0, 1.0, -250.0], "yaw": 0.5, "moving": True},
+        {"pos": [-1000.0, 0.0, -500.0], "yaw": 0.0, "moving": False},
+    ]}
+    planes = _extract_planes(data, step, origin)
+    out = _inject_planes(data, planes, step, origin)
+    assert abs(out["entities"][0]["pos"][0] - (-900.0)) <= step
+    assert abs(out["entities"][0]["pos"][2] - (-250.0)) <= step
+    assert out["entities"][1]["pos"][0] == -1000.0
+
+
+def test_malformed_v2_snapshot_is_corrupt_not_keyerror(tmp_path):
+    """A v2 record whose msgpack parses but lacks required keys (or
+    whose planes are the wrong length) must raise CorruptSnapshotError
+    so the restore walk falls back — never a raw KeyError."""
+    import msgpack
+
+    from goworld_tpu import freeze
+
+    p = tmp_path / "game1_ckpt_delta.dat"
+    p.write_bytes(msgpack.packb(
+        {"version": freeze.SNAPSHOT_PLANE_VERSION, "kind": "delta"},
+        use_bin_type=True))
+    with pytest.raises(freeze.CorruptSnapshotError):
+        freeze.read_freeze_file(str(p))
+    # a keyframe whose plane bytes don't match its entity count
+    p2 = tmp_path / "game1_ckpt_key.dat"
+    p2.write_bytes(msgpack.packb({
+        "version": freeze.SNAPSHOT_PLANE_VERSION, "kind": "key",
+        "quant": {"step": 0.5, "yaw_step": freeze.YAW_STEP},
+        "planes": {nm: b"" for nm in
+                   ("pos_xz", "pos_y", "yaw", "moving")},
+        "plane_crcs": {nm: 0 for nm in
+                       ("pos_xz", "pos_y", "yaw", "moving")},
+        "host": {"version": 1, "entities": [
+            {"id": "x", "attrs": {}}]},
+    }, use_bin_type=True))
+    with pytest.raises(freeze.CorruptSnapshotError):
+        freeze.read_freeze_file(str(p2))
+
+
+def test_delta_sync_decoder_bounded_under_handle_churn():
+    """Decoder state is bounded even though wire handles are never
+    reused: past max_entries the oldest-inserted baselines evict, and
+    an evicted-but-live pair self-heals at its next keyframe."""
+    enc = DeltaSyncEncoder(STEP, keyframe_every=2)
+    dec = DeltaSyncDecoder(max_entries=8)
+    for t in range(6):
+        cids = np.array([b"c%02d_%d" % (i, t) for i in range(4)],
+                        "S16")
+        eids = np.array([b"e%02d_%d" % (i, t) for i in range(4)],
+                        "S16")
+        vals = np.zeros((4, 4), np.float32)
+        dec.decode_batch(enc.encode_batch(cids, eids, vals, t))
+    assert len(dec._base) <= 8
+    assert dec.stats["evicted"] > 0

@@ -76,6 +76,9 @@ def test_range_markers_bracket_their_constants():
                 "MT_SET_CLIENT_FILTER_PROP",
                 "MT_CALL_FILTERED_CLIENTS",
                 "MT_SYNC_POSITION_YAW_ON_CLIENTS",
+                # the delta-compressed sync leg (ISSUE 12): handled by
+                # the gate itself like its full-record sibling
+                "MT_SYNC_POSITION_YAW_DELTA_ON_CLIENTS",
                 "MT_CLIENT_EVENTS_BATCH",
             ), f"{name}={val} squats in the gate-service range"
 

@@ -34,7 +34,12 @@ contract):
   ``workload_signature`` block — the live ``/workload`` grammar
   (sig/churn/density/events/recommendation) stamped by the same
   jax-free reducer — in BENCH headlines and MULTICHIP documents alike
-  (``{"error"/"skipped": ...}`` accepted as honest failure).
+  (``{"error"/"skipped": ...}`` accepted as honest failure);
+* rounds >= 12 (the quantized-plane era, ISSUE 12): a ``precision``
+  block (resolved plane on/off, pos scale bits, delta-sync keyframe
+  cadence) next to the kernel stamps, plus the ``precision_ab``
+  on/off A/B record (measured marginal both ways + modeled bytes at
+  the shape and at 1M; honest error/skip records accepted).
 
 Exit codes: 0 all valid, 1 usage/missing, 2 schema violations.
 """
@@ -77,6 +82,13 @@ MULTI_HEADLINE_SINCE = 10
 WORKLOAD_SIG_SINCE = 11
 WORKLOAD_SIG_KEYS = ("sig", "churn", "density", "events",
                      "recommendation")
+# the quantized-plane era (ISSUE 12): every BENCH headline stamps the
+# resolved `precision` block (plane on/off, pos scale bits, delta-sync
+# keyframe cadence) next to the kernel stamps, plus the precision
+# on/off A/B record ({"error"/"skipped": ...} accepted as honest
+# failure, the device-plane convention)
+PRECISION_SINCE = 12
+PRECISION_KEYS = ("plane", "pos_scale_bits", "sync_keyframe_every")
 MULTI_HEADLINE_KEYS = ("entity_ticks_per_sec_mesh",
                        "per_chip_efficiency", "n_entities", "platform")
 MULTI_GAUGE_KEYS = ("halo_demand_max", "migrate_demand_max",
@@ -142,6 +154,11 @@ def validate_bench(path: str, doc: dict) -> list[str]:
     if rno >= WORKLOAD_SIG_SINCE:
         _check_block(rec, "workload_signature", WORKLOAD_SIG_KEYS,
                      errs)
+    if rno >= PRECISION_SINCE:
+        _check_block(rec, "precision", PRECISION_KEYS, errs)
+        _check_block(rec, "precision_ab",
+                     ("off_ms", "q16_ms", "model_off_gb_1m",
+                      "model_q16_gb_1m"), errs)
     # per-scenario blocks, wherever present: each needs either a
     # headline-style shape or an honest error
     for sc, blk in (rec.get("scenarios") or {}).items():

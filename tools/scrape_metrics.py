@@ -195,8 +195,13 @@ def workload_lines(scraped: dict[str, dict]) -> list[str]:
             continue
         rec = wl.get("recommendation") or {}
         rec_s = " ".join(f"{k}={v}" for k, v in sorted(rec.items()))
+        # the resolved precision plane rides the signature's config
+        # stamp (ISSUE 12) — surface it so a recommend sync_delta=1
+        # line is readable next to what the process already runs
+        prec = (wl.get("config") or {}).get("precision", "off")
         line = (f"{label}: workload {wl['sig']} "
-                f"({wl.get('ticks', 0)} ticks in window"
+                + (f"[{prec}] " if prec != "off" else "")
+                + f"({wl.get('ticks', 0)} ticks in window"
                 + (f"; recommend {rec_s}" if rec_s else "") + ")")
         inc = entry.get("incidents")
         if isinstance(inc, dict):

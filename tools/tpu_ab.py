@@ -257,6 +257,74 @@ else:
           "interpret-mode fused at this shape would stall the session "
           "— see bench.py backhalf_ab for the CPU record)", flush=True)
 
+# ---- 1d. precision (quantized planes) on/off A/B (ISSUE 12) ---------
+# The q16 lattice sweep at 131K AND the second shape (default 1M):
+# the packed sorted view + int16-pair distance math against the f32
+# baseline, same workload/layout, skin off then on (the reuse re-rank
+# is where the packed cand cache pays). TPU-only like 1c: the CPU
+# marginal is recorded by bench.py's precision_ab every round.
+
+if on_tpu():
+    def mk_prec(impl, prec, skin, nq, ext_q, pos_q, alive_q, flags_q):
+        sp = GridSpec(radius=50.0, extent_x=ext_q, extent_z=ext_q,
+                      k=K, cell_cap=CC, row_block=65536,
+                      sweep_impl=impl, topk_impl="sort", skin=skin,
+                      precision=prec)
+        if skin > 0:
+            cache0 = init_verlet_cache(sp, nq)
+
+        def make(length):
+            def run(p0):
+                if skin > 0:
+                    def body(carry, _):
+                        p, cache = carry
+                        nbr, cnt, fl, _s, cache2, _rb, _sl = \
+                            grid_neighbors_verlet(
+                                sp, p, alive_q, cache,
+                                flag_bits=flags_q)
+                        p = p + (cnt[:, None] % 2).astype(p.dtype) \
+                            * 1e-6
+                        return (p, cache2), cnt.sum() + fl.sum()
+                    (pp, _c), ss = lax.scan(body, (p0, cache0), None,
+                                            length=length)
+                    return ss.sum().astype(jnp.float32) + pp.sum()
+
+                def body(p, _):
+                    nbr, cnt, fl = grid_neighbors_flags(
+                        sp, p, alive_q, flag_bits=flags_q)
+                    p = p + (cnt[:, None] % 2).astype(p.dtype) * 1e-6
+                    return p, cnt.sum() + fl.sum()
+                pp, ss = lax.scan(body, p0, None, length=length)
+                return ss.sum().astype(jnp.float32) + pp.sum()
+            return run
+        return make
+
+    shapes = [(N, extent, pos, alive, flags)]
+    if on_tpu():
+        N2p = int(os.environ.get("PROBE_N2",
+                                 1048576 if N <= 262144 else 131072))
+        ext2p = float(int((N2p * 10000 / 12) ** 0.5))
+        pk1, pk2, pk3 = jax.random.split(jax.random.PRNGKey(4), 3)
+        pos2p = jnp.stack([
+            jax.random.uniform(pk1, (N2p,), maxval=ext2p),
+            jnp.zeros(N2p),
+            jax.random.uniform(pk2, (N2p,), maxval=ext2p)], axis=1)
+        shapes.append((N2p, ext2p, pos2p, jnp.ones(N2p, bool),
+                       (jax.random.uniform(pk3, (N2p,)) < 0.5)
+                       .astype(jnp.int32)))
+    for nq, ext_q, pos_q, alive_q, flags_q in shapes:
+        for prec in ("off", "q16"):
+            timeit(f"prec@{nq} ranges/{prec} skin=0",
+                   mk_prec("ranges", prec, 0.0, nq, ext_q, pos_q,
+                           alive_q, flags_q), arg=pos_q)
+            timeit(f"prec@{nq} ranges/{prec} skin=4",
+                   mk_prec("ranges", prec, 4.0, nq, ext_q, pos_q,
+                           alive_q, flags_q), arg=pos_q)
+else:
+    print("prec@131K/1M q16-vs-off          SKIP (no TPU backend; "
+          "bench.py precision_ab records the CPU marginal + modeled "
+          "bytes every round)", flush=True)
+
 # ---- 2. multichip mesh A/B at the bench shape (ISSUE 10) ------------
 # halo_impl ppermute-vs-async, migrate_cap sweep, border_churn on/off:
 # scan-marginal mega-tick ms over the real ICI mesh via bench.py's
@@ -291,6 +359,30 @@ if on_tpu() and len(jax.devices()) > 1:
 
     for impl in ("ppermute", "async"):
         mesh_row(f"halo={impl}", halo_impl=impl)
+    # modeled ICI halo bytes under the quantized planes (ISSUE 12):
+    # the packing itself is staged — these rows are what the relay
+    # arbitrates against the measured halo marginals above
+    try:
+        from goworld_tpu.utils.devprof import (
+            roofline_model_bytes_multichip as _rmm,
+        )
+
+        n_dev_m = len(jax.devices())
+        mk_m = {"n_dev": n_dev_m,
+                "halo_cap": int(os.environ.get("BENCH_HALO_CAP", 4096)),
+                "migrate_cap": int(os.environ.get("BENCH_MIGRATE_CAP",
+                                                  256))}
+        for prec in ("off", "q16"):
+            gk_m = {"k": K, "cell_cap": CC, "precision": prec}
+            for impl in ("ppermute", "async"):
+                mk_m["halo_impl"] = impl
+                mb = _rmm(N_MESH // n_dev_m, gk_m, mk_m)["ici_halo"] \
+                    / 1e6
+                print(f"mega model ici_halo {impl}/{prec:4s}"
+                      f"{mb:10.3f} MB/chip/tick", flush=True)
+    except Exception as exc:
+        print(f"mega model ici_halo FAILED: {str(exc)[:120]}",
+              flush=True)
     for cap in (128, 256, 512, 1024):
         os.environ["BENCH_MIGRATE_CAP"] = str(cap)
         mesh_row(f"migrate_cap={cap}")
