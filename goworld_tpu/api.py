@@ -159,6 +159,34 @@ def _grid_caps(gc: config_mod.GameConfig) -> dict:
     return caps
 
 
+def _governor_eligible(gc: config_mod.GameConfig, gid: int) -> bool:
+    """[gameN] governor = true, gated to the shapes the swap machinery
+    serves (single-shard, non-mesh, non-megaspace, telemetry on) — an
+    ineligible config warns loudly and boots static, never crashes.
+    The governor_table override is validated HERE, at boot, so a typo
+    fails before the process serves (the GridSpec convention)."""
+    if not gc.governor:
+        return False
+    why = None
+    if gc.megaspace:
+        why = "megaspace games keep their static tile config"
+    elif gc.mesh_devices > 1:
+        why = "mesh games keep their static config"
+    elif gc.n_spaces > 1:
+        why = ("the vmapped n_spaces > 1 step carries no skin "
+               "branches to swap")
+    elif not gc.telemetry_live:
+        why = "telemetry_live = false leaves it no signature input"
+    if why is not None:
+        logger.warning("game%d: governor = true ignored (%s)", gid, why)
+        return False
+    if gc.governor_table:
+        from goworld_tpu.autotune import parse_table
+
+        parse_table(gc.governor_table)  # raises loudly on typos
+    return True
+
+
 def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
     from goworld_tpu.core.state import WorldConfig
     from goworld_tpu.ops.aoi import GridSpec
@@ -442,6 +470,16 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
             flightrec_cooldown_secs=gc.flightrec_cooldown_secs,
             sync_delta=gc.sync_delta,
             sync_keyframe_every=gc.sync_keyframe_every,
+            # online kernel governor (goworld_tpu/autotune): eligible
+            # shapes only — megaspace/mesh kernel choice stays the TPU
+            # A/B plane's job, said loudly instead of silently ignored
+            governor_enabled=_governor_eligible(gc, gid),
+            governor_window_ticks=gc.governor_window_ticks,
+            governor_up_windows=gc.governor_up_windows,
+            governor_down_windows=gc.governor_down_windows,
+            governor_cooldown_windows=gc.governor_cooldown_windows,
+            governor_regret_pct=gc.governor_regret_pct,
+            governor_table=gc.governor_table,
         )
 
     restoring = args.restore and \

@@ -1,0 +1,43 @@
+"""Online kernel autotuning: the governor that closes ROADMAP item 2.
+
+Three layers, one per module:
+
+* :mod:`goworld_tpu.autotune.policy` — jax-free decisions. A pure
+  function of the workload-signature stream (the reducer PR 11's live
+  telemetry lanes already rotate) picks a kernel-config candidate with
+  overload-ladder-style hysteresis and a deterministic transition log.
+* :mod:`goworld_tpu.autotune.warmset` — AOT executable cache. Candidate
+  tick configs are ``lower().compile()``d OFF the tick thread (the
+  devprof executable-reuse path); a swap only commits when the target
+  executable is warm, so a live game never pays a mid-serving compile.
+* :mod:`goworld_tpu.autotune.governor` — the :class:`KernelGovernor`
+  that wires both to a live :class:`~goworld_tpu.entity.manager.World`:
+  per-window decisions, warm-gated commits, the post-swap regret guard
+  (measured truth beats the table), metrics/flight-recorder/endpoint
+  surfacing (debug-http ``/governor``).
+
+See docs/AUTOTUNE.md for the decision grammar and knob reference.
+"""
+
+from goworld_tpu.autotune.governor import (
+    KernelGovernor,
+    register,
+    snapshot,
+    unregister,
+)
+from goworld_tpu.autotune.policy import (
+    DEFAULT_CANDIDATES,
+    GovernorPolicy,
+    candidate_overrides,
+    classify_signature,
+    parse_table,
+    seed_table,
+)
+from goworld_tpu.autotune.warmset import WarmSet, candidate_config, carry_state
+
+__all__ = [
+    "DEFAULT_CANDIDATES", "GovernorPolicy", "candidate_overrides",
+    "classify_signature", "parse_table", "seed_table",
+    "WarmSet", "candidate_config", "carry_state",
+    "KernelGovernor", "register", "unregister", "snapshot",
+]

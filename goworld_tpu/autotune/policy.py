@@ -1,0 +1,343 @@
+"""Governor decision plane — jax-free, deterministic, replayable.
+
+The inputs are the workload-signature records the live telemetry plane
+already reduces (``ops/telemetry.workload_signature`` over the rotating
+drained-lane windows): rebuild rate, skin-slack p50, over_k/over_cap
+duty cycles, enter/leave volume. The output is a **config key** — one
+of the candidate labels over the scenario matrix's kernel A/B pool
+(``SCENARIO_KERNEL_CANDIDATES``; the same labels every BENCH artifact
+stamps per-scenario ``kernels_ms`` tables and ``best_kernel`` under).
+
+Decisions are a **pure function of the signature stream** with the same
+contract as :class:`goworld_tpu.utils.overload.OverloadGovernor`:
+
+* **hysteresis** — a target config must win ``up_windows`` consecutive
+  windows before a swap is decided (``down_windows`` for returning to
+  the table default), a signature inside the hold band (rebuild rate
+  near the churn-class boundary) holds the current config and resets
+  the run, and every committed swap starts a ``cooldown_windows``
+  refractory period;
+* **determinism** — no wall clock, no RNG: equal signature streams
+  replay byte-identical transition logs (``log_lines()``), asserted by
+  tests/test_governor.py exactly like the overload ladder's seeded
+  replay.
+
+The class→candidate **mapping table** seeds from the checked-in
+per-scenario ``best_kernel`` stamps (the measured CPU truth of the
+flock-vs-teleport skin inversion, :func:`seed_table`) with built-in
+fallbacks, and is overridable per ``[gameN]`` via ``governor_table``
+(:func:`parse_table`). Until the TPU relay answers, the tables are
+CPU-derived — which is exactly why the runtime regret guard
+(:mod:`goworld_tpu.autotune.governor`) outranks them.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+__all__ = [
+    "DEFAULT_CANDIDATES", "CANDIDATE_GRID_KEYS", "DEFAULT_TABLE",
+    "SCENARIO_CLASS_MAP", "classify_signature", "candidate_overrides",
+    "seed_table", "parse_table", "GovernorPolicy",
+]
+
+# The candidate pool: (label, GridSpec overrides). ONE home for the
+# per-scenario kernel A/B pool — bench.py's SCENARIO_KERNEL_CANDIDATES
+# re-exports this list, so the labels the policy decides between are
+# exactly the labels the checked-in `kernels_ms` tables and
+# `best_kernel` stamps are keyed by. Every override key must be a
+# GridSpec field (contract-tested), and every candidate is EXACT at
+# provisioned capacity — the pool deliberately excludes approx/shift
+# style fidelity trades (the autotune "selectable" convention).
+DEFAULT_CANDIDATES: tuple[tuple[str, dict], ...] = (
+    ("default", {}),
+    ("skin=0", {"skin": 0.0}),
+    ("sweep=table,skin=0", {"sweep_impl": "table", "skin": 0.0}),
+    ("sort=counting,skin=0", {"sort_impl": "counting", "skin": 0.0}),
+)
+
+# the GridSpec knob families a candidate override may touch (the
+# recommendation-key contract test holds candidates to this set)
+CANDIDATE_GRID_KEYS = ("skin", "sweep_impl", "sort_impl", "topk_impl",
+                       "verlet_cap")
+
+# signature class -> candidate label, the built-in fallback mapping.
+# Grounded in the measured per-scenario tables (BENCH_r12 CPU):
+#   flock      -> the skin holds (reuse ticks win)      -> default
+#   teleport   -> every jump defeats the skin           -> skin=0
+#   hotspot    -> density pressure, structure churn     -> counting
+# `skinless` worlds (no skin lane) and ambiguous windows keep default.
+DEFAULT_TABLE: dict[str, str] = {
+    "flock_like": "default",
+    "teleport_like": "skin=0",
+    "density": "sort=counting,skin=0",
+    "default": "default",
+}
+
+# which signature class each checked-in per-scenario best_kernel stamp
+# seeds (the scenario IS the class's adversarial exemplar)
+SCENARIO_CLASS_MAP = {
+    "flock": "flock_like",
+    "teleport": "teleport_like",
+    "hotspot": "density",
+}
+
+# hold band half-width on the rebuild-rate churn boundary (the reducer
+# classifies at 0.5; inside 0.5 +- band the policy holds its config)
+CHURN_HOLD_BAND = 0.1
+# minimum over_k duty cycle (fraction of ticks with truncated rows)
+# before the density class outranks churn — see classify_signature
+DENSITY_DUTY_MIN = 0.1
+
+
+def candidate_overrides(
+    label: str,
+    candidates=DEFAULT_CANDIDATES,
+) -> dict:
+    """GridSpec overrides for a candidate label (KeyError lists the
+    pool — a typo'd table entry must fail loudly at build time)."""
+    for lbl, ov in candidates:
+        if lbl == label:
+            return dict(ov)
+    raise KeyError(
+        f"unknown kernel candidate {label!r}; pool: "
+        f"{[lbl for lbl, _ in candidates]}"
+    )
+
+
+def classify_signature(sig: dict) -> str | None:
+    """Reduce one workload-signature record to the policy's class key:
+    ``teleport_like`` / ``flock_like`` / ``density`` / ``default``, or
+    ``None`` inside a hold band (ambiguous window — hold the rung).
+
+    Density pressure outranks the churn classes: a sustained over_k/
+    over_cap duty cycle means interest sets are DEGRADING, and the
+    counting-sort front half is the structure-churn lever regardless of
+    how the population moves.
+
+    ``skinless`` windows (the world currently runs skin=0, so the
+    rebuild-rate signal does not exist) classify by the enter/leave
+    event volume instead — interest-set churn is the observable proxy
+    that survives the skin being off. Heavy/moderate volume keeps the
+    teleport-like verdict (the skin would thrash), quiet volume says
+    the skin would hold (flock-like), and ``low`` is the hold band.
+    Without this, swapping to skin=0 would blind the policy and flap
+    it straight back."""
+    if not isinstance(sig, dict) or "error" in sig:
+        return None
+    # density keys on ROWS ACTUALLY TRUNCATED to nearest-k (over_k
+    # duty cycle), not on bare over_cap ticks: at production density a
+    # uniform world's Poisson tail puts the occasional cell past
+    # cell_cap (~1 cell in thousands) without truncating any row —
+    # the ranges sweep's pooled 3*cell_cap absorbs it — and a policy
+    # that swapped on that noise would chase ghosts. A real density
+    # collapse (hotspot) truncates rows at 100% duty.
+    ok = sig.get("over_k_frac")
+    if sig.get("density") in ("over_k", "over_cap") \
+            and isinstance(ok, (int, float)) and ok > DENSITY_DUTY_MIN:
+        return "density"
+    churn = sig.get("churn")
+    rr = sig.get("rebuild_rate")
+    if churn in ("flock_like", "teleport_like") and rr is not None:
+        if abs(float(rr) - 0.5) < CHURN_HOLD_BAND:
+            return None  # hold band: too close to call
+        return churn
+    if churn == "skinless":
+        ev = sig.get("events")
+        if ev in ("moderate", "heavy"):
+            return "teleport_like"
+        if ev == "quiet":
+            return "flock_like"
+        return None  # "low": ambiguous without the skin lane
+    return "default"
+
+
+def seed_table(repo_dir: str | None = None,
+               candidates=DEFAULT_CANDIDATES) -> dict[str, str]:
+    """The class->label mapping table, seeded from the checked-in
+    BENCH artifacts' per-scenario ``best_kernel`` stamps (latest round
+    carrying one wins) over the :data:`DEFAULT_TABLE` fallbacks.
+
+    jax-free and failure-proof: unreadable artifacts, missing blocks or
+    best_kernel labels outside the candidate pool leave the fallback in
+    place — the table must never be worse than the built-in defaults
+    because an artifact rotted."""
+    table = dict(DEFAULT_TABLE)
+    if repo_dir is None:
+        repo_dir = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    labels = {lbl for lbl, _ in candidates}
+    for path in sorted(glob.glob(os.path.join(repo_dir,
+                                              "BENCH_r*.json"))):
+        if "_interim" in os.path.basename(path):
+            continue
+        try:
+            with open(path) as fh:
+                doc = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        # the ONE headline definition shared with bench_schema/trend
+        # (driver wrapper or bare artifact both resolve)
+        from goworld_tpu.utils.devprof import artifact_headline
+
+        rec = artifact_headline(doc) if isinstance(doc, dict) else None
+        scenarios = (rec or {}).get("scenarios")
+        if not isinstance(scenarios, dict):
+            continue
+        for scen, cls in SCENARIO_CLASS_MAP.items():
+            blk = scenarios.get(scen)
+            if not isinstance(blk, dict):
+                continue
+            best = blk.get("best_kernel")
+            if isinstance(best, str) and best in labels:
+                table[cls] = best
+    return table
+
+
+def parse_table(spec: str,
+                candidates=DEFAULT_CANDIDATES) -> dict[str, str]:
+    """Parse the ``[gameN] governor_table`` override string:
+    ``class:label;class:label`` (labels may contain ``,``/``=``, so the
+    separators are ``;`` and the FIRST ``:``). Unknown classes or
+    labels outside the candidate pool are rejected loudly at config
+    time, never silently at decision time."""
+    out: dict[str, str] = {}
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        cls, sep, label = part.partition(":")
+        cls, label = cls.strip(), label.strip()
+        if not sep or not label:
+            raise ValueError(
+                f"governor_table entry {part!r} must be class:label")
+        if cls not in DEFAULT_TABLE:
+            raise ValueError(
+                f"governor_table class {cls!r} unknown; classes: "
+                f"{sorted(DEFAULT_TABLE)}")
+        candidate_overrides(label, candidates)  # KeyError -> loud
+        out[cls] = label
+    return out
+
+
+class GovernorPolicy:
+    """The per-process kernel-config decision machine.
+
+    ``observe(sig)`` is called once per signature window with the
+    drained workload-signature record and returns the candidate label
+    to swap to when a swap is DECIDED this window (``None`` otherwise
+    — the common case). The caller (:class:`KernelGovernor`) commits
+    the swap when the target executable is warm; the policy itself
+    never touches jax.
+
+    State machine (per window):
+
+    * want = table[classify_signature(sig)] (hold band -> keep);
+    * want == current resets the run; a changed want resets it too
+      (a flapping signature never accumulates);
+    * the run must reach ``up_windows`` (``down_windows`` when want is
+      the default label) before a swap is decided;
+    * a decided swap arms ``cooldown_windows`` of refractory windows;
+    * ``pin(label, windows, reason)`` (the regret guard's revert path)
+      forces ``current`` and suppresses decisions for ``windows``.
+
+    Everything is a pure function of the observation sequence —
+    equal signature streams replay byte-identical ``log_lines()``.
+    """
+
+    def __init__(
+        self,
+        *,
+        table: dict[str, str] | None = None,
+        candidates=DEFAULT_CANDIDATES,
+        up_windows: int = 2,
+        down_windows: int = 2,
+        cooldown_windows: int = 4,
+        initial: str = "default",
+    ):
+        self.candidates = tuple(candidates)
+        self.table = dict(table if table is not None else DEFAULT_TABLE)
+        for cls, lbl in self.table.items():
+            candidate_overrides(lbl, self.candidates)  # loud on typos
+        self.up_windows = max(1, int(up_windows))
+        self.down_windows = max(1, int(down_windows))
+        self.cooldown_windows = max(0, int(cooldown_windows))
+        self.default_label = self.table.get("default", "default")
+        self.current = initial
+        self.window = 0           # observation index
+        self._want: str | None = None
+        self._run = 0
+        self._cooldown_until = 0  # window index the refractory ends at
+        self._pin_until = 0
+        # (window, from, to, reason) — the deterministic transition log
+        self.transitions: list[tuple[int, str, str, str]] = []
+
+    # -- per-window observation -----------------------------------------
+    def observe(self, sig: dict) -> str | None:
+        """Feed one window's signature; returns the label to swap to
+        when a swap is decided NOW, else None."""
+        w = self.window
+        self.window = w + 1
+        cls = classify_signature(sig)
+        if cls is None:
+            # hold band: keep the rung, reset the run (the overload
+            # ladder's hysteresis-band semantics)
+            self._want, self._run = None, 0
+            return None
+        want = self.table.get(cls, self.default_label)
+        if want == self.current:
+            self._want, self._run = None, 0
+            return None
+        if want != self._want:
+            self._want, self._run = want, 1
+        else:
+            self._run += 1
+        needed = (self.down_windows if want == self.default_label
+                  else self.up_windows)
+        if self._run < needed:
+            return None
+        if w < self._pin_until:
+            return None  # regret pin: measured truth beat the table
+        if w < self._cooldown_until:
+            return None  # per-swap cooldown
+        self._log(w, self.current, want,
+                  f"class={cls} run={self._run}/{needed}")
+        self.current = want
+        self._want, self._run = None, 0
+        self._cooldown_until = self.window + self.cooldown_windows
+        return want
+
+    def pin(self, label: str, windows: int, reason: str) -> None:
+        """Regret-guard revert: force ``label`` as current and suppress
+        decisions for ``windows`` (the table was wrong for this
+        workload on this hardware — stop re-trying it)."""
+        w = self.window
+        if label != self.current:
+            self._log(w, self.current, label, f"revert {reason}")
+            self.current = label
+        self._pin_until = w + max(0, int(windows))
+        self._want, self._run = None, 0
+
+    def _log(self, window: int, frm: str, to: str, reason: str) -> None:
+        self.transitions.append((window, frm, to, reason))
+
+    # -- queries ---------------------------------------------------------
+    def log_lines(self) -> list[str]:
+        """One line per transition; equal signature streams produce
+        byte-identical logs (the determinism contract)."""
+        return [f"#{w} {frm}->{to} {reason}"
+                for w, frm, to, reason in self.transitions]
+
+    def snapshot(self) -> dict:
+        return {
+            "current": self.current,
+            "window": self.window,
+            "run": self._run,
+            "want": self._want,
+            "cooldown_until": self._cooldown_until,
+            "pin_until": self._pin_until,
+            "table": dict(self.table),
+            "transitions": self.log_lines(),
+        }

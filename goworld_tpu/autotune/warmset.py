@@ -1,0 +1,330 @@
+"""Warm-set: AOT-compiled executables for candidate tick configs.
+
+The whole point of the governor is a swap with ZERO mid-serving compile
+stalls, so the target config's executable must exist BEFORE the swap
+commits. Two facts make that cheap:
+
+* the production tick signature has **fixed shapes** — staging is
+  applied by eager scatters in ``_flush_staging``, so the compiled step
+  always sees ``(state[S,...], TickInputs[S,ic], policy)`` at the same
+  avals every tick;
+* devprof already proved the **executable-reuse path**: an AOT
+  ``jit(...).lower(...).compile()`` product is directly callable with
+  the live pytrees (and ``cost_report`` accepts it with zero extra
+  compiles), so the World can run the compiled object itself instead
+  of re-entering the jit cache.
+
+Each :class:`WarmEntry` therefore carries the candidate's resolved
+``WorldConfig``, the AOT-compiled step, the matching AOT-compiled live
+telemetry fold (the lane set changes when the skin toggles) and its
+zeroed accumulator — everything a swap needs to commit atomically
+between ticks. Compiles run on ONE daemon worker thread (the
+``/costs?analyze=1`` precedent: lower+compile off the logic thread is
+safe), never on the tick thread.
+
+State carry-over lives here too (:func:`carry_state`): flipping the
+Verlet skin on allocates a fresh INVALID cache (the next tick rebuilds
+— exact by construction), flipping it off drops the cache arrays, and
+any cache-shape-affecting knob change (verlet_cap, precision, skin
+width) reallocates. Everything else in ``SpaceState`` is
+config-independent and carries through untouched — the oracle suite
+asserts a swap mid-churn stays exact on the very next tick.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any
+
+from goworld_tpu.autotune.policy import (
+    DEFAULT_CANDIDATES,
+    candidate_overrides,
+)
+from goworld_tpu.utils import consts, log
+
+logger = log.get("autotune")
+
+__all__ = ["WarmEntry", "WarmSet", "candidate_config", "carry_state"]
+
+
+def candidate_config(cfg, overrides: dict):
+    """Resolve a candidate's ``WorldConfig`` from the base config +
+    GridSpec overrides. Validation rides ``GridSpec.__post_init__``
+    (typo'd impls fail loudly at build, never at trace time); the
+    packed-id capacity bound clears a requested skin exactly like
+    ``api._build_world`` does."""
+    kw = dict(overrides)
+    if kw.get("skin", cfg.grid.skin) > 0 \
+            and cfg.capacity >= (1 << consts.AOI_ID_BITS):
+        kw["skin"] = 0.0  # the Verlet reuse rides the packed-id path
+    grid = dataclasses.replace(cfg.grid, **kw)
+    return dataclasses.replace(cfg, grid=grid)
+
+
+def _cache_shape_key(grid) -> tuple:
+    """The knobs that decide the Verlet cache's existence and layout —
+    equal keys mean a carried cache stays VALID across the swap (the
+    candidate superset bound is impl-independent)."""
+    return (grid.skin > 0, grid.verlet_cap, grid.precision, grid.skin,
+            grid.radius)
+
+
+def carry_state(state, old_cfg, new_cfg, *, stacked: bool = True):
+    """Carry a live ``SpaceState`` across a config flip.
+
+    Only the Verlet cache is config-shaped; everything else carries
+    bit-identically. A fresh cache is allocated INVALID, so the first
+    tick under the new config rebuilds the front half — the swap is
+    exact from its very first tick."""
+    import jax
+    import jax.numpy as jnp
+
+    from goworld_tpu.ops.aoi import init_verlet_cache
+
+    old_key = _cache_shape_key(old_cfg.grid)
+    new_key = _cache_shape_key(new_cfg.grid)
+    if old_key == new_key:
+        return state
+    if new_cfg.grid.skin <= 0:
+        return state.replace(aoi_cache=None)
+    cache = init_verlet_cache(new_cfg.grid, new_cfg.capacity)
+    if stacked:
+        # the stacked [S=1] production shape (the governor only serves
+        # single-shard worlds; the vmapped S>1 step clears the skin)
+        cache = jax.tree.map(lambda x: jnp.expand_dims(x, 0), cache)
+    return state.replace(aoi_cache=cache)
+
+
+@dataclasses.dataclass
+class WarmEntry:
+    """One candidate's compiled artifacts (immutable once warm)."""
+
+    label: str
+    cfg: Any                      # resolved WorldConfig
+    exe: Any = None               # AOT-compiled step executable
+    fold_exe: Any = None          # AOT-compiled telemetry fold (or None)
+    acc0: Any = None              # zeroed telemetry accumulator
+    skin_on: bool = False
+    half_skin: float = 0.0
+    error: str | None = None
+    compile_s: float = 0.0
+
+    @property
+    def warm(self) -> bool:
+        return self.exe is not None and self.error is None
+
+
+class WarmSet:
+    """Candidate-config executable cache for ONE World shape.
+
+    ``ensure(label)`` schedules an off-thread compile (idempotent);
+    ``is_warm(label)`` gates the swap commit; ``entry(label)`` hands
+    the governor the compiled artifacts. ``block=True`` compiles
+    synchronously (tests, bench prewarm)."""
+
+    def __init__(self, cfg, n_spaces: int, policy=None, *,
+                 candidates=DEFAULT_CANDIDATES,
+                 telemetry: bool = True):
+        if n_spaces != 1:
+            raise ValueError(
+                "WarmSet serves the single-shard production shape "
+                f"(n_spaces=1), got n_spaces={n_spaces}"
+            )
+        self.base_cfg = cfg
+        self.n_spaces = n_spaces
+        self.policy = policy
+        self.candidates = tuple(candidates)
+        self.telemetry = telemetry
+        self._entries: dict[str, WarmEntry] = {}
+        self._lock = threading.Lock()
+        self._inflight: set[str] = set()
+        self._worker: threading.Thread | None = None
+        self._queue: list[str] = []
+        self._wake = threading.Condition(self._lock)
+        self.compile_count = 0  # tests assert no re-compiles on re-swap
+
+    # -- public ----------------------------------------------------------
+    def labels(self) -> list[str]:
+        return [lbl for lbl, _ in self.candidates]
+
+    def is_warm(self, label: str) -> bool:
+        with self._lock:
+            e = self._entries.get(label)
+            return e is not None and e.warm
+
+    def entry(self, label: str) -> WarmEntry | None:
+        with self._lock:
+            return self._entries.get(label)
+
+    def ensure(self, label: str, block: bool = False) -> bool:
+        """Schedule (or synchronously run) the candidate's compile;
+        returns True when it is warm on return. ``block=True`` with
+        the same label already compiling on the worker thread WAITS
+        for that compile instead of duplicating it (two concurrent XLA
+        compiles of one config would double-count compile_count and
+        race the entry slot)."""
+        candidate_overrides(label, self.candidates)  # loud on typos
+        with self._lock:
+            e = self._entries.get(label)
+            if e is not None and (e.warm or e.error):
+                return e.warm
+            inflight = label in self._inflight
+            if not block:
+                if not inflight:
+                    self._inflight.add(label)
+                    self._queue.append(label)
+                    self._wake.notify()
+                if self._worker is None or not self._worker.is_alive():
+                    self._worker = threading.Thread(
+                        target=self._worker_loop,
+                        name="autotune-warmset", daemon=True)
+                    self._worker.start()
+                return False
+            if not inflight:
+                # claim the label so a concurrent async ensure() can
+                # never queue a duplicate while we compile inline
+                self._inflight.add(label)
+        if inflight:
+            # the worker owns this compile; wait it out (it clears
+            # _inflight in its finally)
+            import time as _time
+
+            while True:
+                with self._lock:
+                    done = label not in self._inflight
+                if done:
+                    # outside the lock: is_warm() re-acquires it (the
+                    # Lock is non-reentrant)
+                    return self.is_warm(label)
+                _time.sleep(0.05)
+        try:
+            self._compile(label)
+        finally:
+            with self._lock:
+                self._inflight.discard(label)
+        return self.is_warm(label)
+
+    def warm_all(self) -> None:
+        """Synchronously compile every candidate (bench prewarm)."""
+        for lbl in self.labels():
+            self.ensure(lbl, block=True)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                lbl: {
+                    "warm": e.warm,
+                    "error": e.error,
+                    "compile_s": round(e.compile_s, 3),
+                    "config": {
+                        "sweep_impl": e.cfg.grid.sweep_impl,
+                        "sort_impl": e.cfg.grid.sort_impl,
+                        "topk_impl": e.cfg.grid.topk_impl,
+                        "skin": e.cfg.grid.skin,
+                    },
+                }
+                for lbl, e in self._entries.items()
+            } | {"inflight": sorted(self._inflight),
+                 "compiles": self.compile_count}
+
+    # -- worker ----------------------------------------------------------
+    def _worker_loop(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue:
+                    self._wake.wait(timeout=60.0)
+                    if not self._queue:
+                        # idle worker retires. Clear the handle UNDER
+                        # THE LOCK before returning: ensure() checks
+                        # `self._worker is None or not is_alive()`,
+                        # and a retiring-but-not-yet-dead thread would
+                        # otherwise swallow a notify and wedge the
+                        # pending swap forever (lost-wakeup race).
+                        if self._worker is threading.current_thread():
+                            self._worker = None
+                        return
+                label = self._queue.pop(0)
+            try:
+                self._compile(label)
+            finally:
+                with self._lock:
+                    self._inflight.discard(label)
+
+    def _compile(self, label: str) -> None:
+        import time
+
+        import jax
+
+        from goworld_tpu.core.step import TickInputs
+        from goworld_tpu.entity.manager import _make_local_tick
+        from goworld_tpu.parallel.mesh import create_multi_state
+
+        t0 = time.perf_counter()
+        try:
+            cfg2 = candidate_config(
+                self.base_cfg, candidate_overrides(label,
+                                                   self.candidates))
+            entry = WarmEntry(label=label, cfg=cfg2)
+            step = _make_local_tick(cfg2, self.n_spaces)
+            # templates, never real arrays: eval_shape gives the exact
+            # avals the live tick passes (fixed shapes by construction)
+            tstate = jax.eval_shape(
+                lambda: create_multi_state(cfg2, self.n_spaces))
+            tinputs = jax.eval_shape(
+                lambda: jax.tree.map(
+                    lambda x: jax.numpy.broadcast_to(
+                        x, (self.n_spaces,) + x.shape),
+                    TickInputs.empty(cfg2)))
+            tpolicy = (None if self.policy is None
+                       else jax.eval_shape(lambda: self.policy))
+            entry.exe = step.lower(tstate, tinputs, tpolicy).compile()
+            if self.telemetry:
+                self._compile_fold(entry, step, tstate, tinputs,
+                                   tpolicy)
+            entry.compile_s = time.perf_counter() - t0
+            with self._lock:
+                self._entries[label] = entry
+                self.compile_count += 1
+            logger.info("warmset: %s compiled in %.2fs", label,
+                        entry.compile_s)
+        except Exception as exc:
+            logger.exception("warmset: compiling %s failed", label)
+            with self._lock:
+                self._entries[label] = WarmEntry(
+                    label=label,
+                    cfg=self.base_cfg,
+                    error=f"{type(exc).__name__}: {str(exc)[:200]}",
+                    compile_s=time.perf_counter() - t0,
+                )
+
+    def _compile_fold(self, entry: WarmEntry, step, tstate, tinputs,
+                      tpolicy) -> None:
+        """AOT-compile the candidate's live telemetry fold: its lane
+        set follows the skin (skin_slack lane exists only when the
+        Verlet cache is live in the compiled step), so a skin flip
+        needs a matching fold + fresh accumulator, pre-warmed with the
+        step so a swap never traces anything."""
+        import jax
+
+        from goworld_tpu.ops import telemetry as telem
+
+        cfg2 = entry.cfg
+        skin_on = (cfg2.grid.skin > 0
+                   and cfg2.capacity < (1 << consts.AOI_ID_BITS))
+        entry.skin_on = skin_on
+        entry.half_skin = cfg2.grid.skin / 2.0 if skin_on else 0.0
+        entry.acc0 = telem.telemetry_init(
+            skin_on, mega=False, occupancy=True,
+            n_tiles=self.n_spaces)
+        half_skin = entry.half_skin
+
+        @jax.jit
+        def _fold(acc, outs):
+            return telem.telemetry_update_live(
+                acc, outs, mega=False, half_skin=half_skin)
+
+        # the fold's outs aval is the step's own output template
+        _, touts = jax.eval_shape(step, tstate, tinputs, tpolicy)
+        tacc = jax.eval_shape(lambda: entry.acc0)
+        entry.fold_exe = _fold.lower(tacc, touts).compile()

@@ -837,6 +837,13 @@ def cmd_status(server_dir: str) -> int:
                 [t for t in targets if t[0] in results])
             for line in scraper.workload_lines(wl):
                 print(line)
+            # online kernel-governor one-liner per game running one
+            # (debug_http /governor, goworld_tpu/autotune): current
+            # config key, warming target, swap count, regret state
+            gv = scraper.scrape_governor(
+                [t for t in targets if t[0] in results])
+            for line in scraper.governor_lines(gv):
+                print(line)
             for e in errors:
                 print(f"metrics: {e}", file=sys.stderr)
     return 0 if all_up else 1

@@ -216,6 +216,30 @@ class GameConfig:
     # between ship sparse int16 plane deltas with per-plane CRCs.
     # 0 = the monolithic checkpoint format, unchanged.
     snapshot_keyframe_every: int = 0
+    # online kernel governor (goworld_tpu/autotune; docs/AUTOTUNE.md):
+    # the live workload signature hot-swaps the resolved tick config
+    # (aoi_skin on/off, sort/sweep impl) between ticks with AOT-warmed
+    # executables (zero mid-serving compile stalls), a deterministic
+    # decision log (/governor endpoint) and a post-swap regret guard.
+    # Single-shard non-mesh games only; requires telemetry_live.
+    governor: bool = False
+    # signature-window length in ticks (one governor decision per
+    # window; also sets the live signature rotation cadence)
+    governor_window_ticks: int = 64
+    # hysteresis: consecutive windows a target config must win before
+    # a swap is decided (down = returning to the table default), plus
+    # the per-swap cooldown in windows
+    governor_up_windows: int = 2
+    governor_down_windows: int = 2
+    governor_cooldown_windows: int = 4
+    # regret guard: revert + pin when the post-swap tick-ms p90
+    # worsens past this fraction vs the pre-swap window
+    governor_regret_pct: float = 0.25
+    # mapping-table override, "class:label;class:label" over the
+    # candidate pool (classes: flock_like/teleport_like/density/
+    # default; labels: the SCENARIO_KERNEL_CANDIDATES keys). Default:
+    # seeded from the checked-in per-scenario best_kernel stamps.
+    governor_table: str = ""
 
 
 @dataclasses.dataclass
@@ -537,6 +561,17 @@ extent_z = 1000.0
 # snapshot_keyframe_every = 8  # delta-compressed checkpoint chain:
 #                          # every Nth checkpoint is a full quantized
 #                          # keyframe (0 = monolithic checkpoints)
+# governor = true          # online kernel governor (docs/AUTOTUNE.md):
+#                          # the live workload signature hot-swaps the
+#                          # tick config (skin on/off, counting sort)
+#                          # between ticks — warm-gated, regret-guarded
+# governor_window_ticks = 64   # one decision per signature window
+# governor_up_windows = 2  # windows a target must win before a swap
+# governor_down_windows = 2    # same, returning to the default config
+# governor_cooldown_windows = 4  # refractory windows after a swap
+# governor_regret_pct = 0.25   # post-swap p90 worsening that reverts
+# governor_table = teleport_like:skin=0;density:sort=counting,skin=0
+#                          # mapping override (class:label;...)
 
 [game1]
 

@@ -290,6 +290,7 @@ class World:
         self._telem_lanes = None    # latest drained cumulative (host)
         self._telem_win = None      # window-start cumulative (signature)
         self._telem_win_tick = 0
+        self._telem_last_window = None  # last COMPLETED window's delta
         self._pending_telem = None  # pipelined drain: last tick's acc
         self._telem_feed_mark = None  # last metrics-fed cumulative
         # negative start: the FIRST drain feeds the registry (a fresh
@@ -1401,6 +1402,13 @@ class World:
             self._telem_feed_tick = self.tick_count
         if self.tick_count - self._telem_win_tick \
                 >= self.SIG_WINDOW_TICKS:
+            # stash the just-COMPLETED window's delta before rotating:
+            # the governor judges whole windows (reading the running
+            # delta right after a rotation would see ~1 tick of
+            # samples); the live /workload endpoint keeps serving the
+            # running delta below
+            self._telem_last_window = telem.lanes_delta(
+                lanes, self._telem_win)
             self._telem_win = lanes
             self._telem_win_tick = self.tick_count
 
@@ -1445,6 +1453,84 @@ class World:
         sig["tick"] = self.tick_count
         sig["window_ticks"] = self.tick_count - self._telem_win_tick
         return sig
+
+    def window_signature(self) -> dict | None:
+        """The signature of the last COMPLETED rotation window (the
+        governor's decision input — a whole window every time, never
+        the thin running delta right after a rotation). None until the
+        first window has rotated."""
+        if self._telem_last_window is None:
+            return None
+        from goworld_tpu.ops import telemetry as telem
+        from goworld_tpu.utils import devprof
+
+        sig = telem.workload_signature(
+            self._telem_last_window,
+            config=devprof.grid_config_key(self.cfg.grid))
+        sig["game_id"] = self.game_id
+        sig["tick"] = self.tick_count
+        sig["window_ticks"] = self.SIG_WINDOW_TICKS
+        return sig
+
+    # ==================================================================
+    # live tick-config swap (autotune governor, ROADMAP item 2)
+    # ==================================================================
+    def apply_tick_config(self, cfg2, step, *, telem_fold=None,
+                          telem_acc0=None, telem_skin_on: bool = False,
+                          telem_half_skin: float = 0.0) -> None:
+        """Swap the resolved tick config BETWEEN ticks — the autotune
+        governor's commit path (goworld_tpu/autotune). ``step`` is the
+        candidate's pre-compiled executable (warmset AOT product; the
+        tick signature has fixed shapes, so the compiled object serves
+        every subsequent tick with zero retraces), ``cfg2`` its
+        resolved WorldConfig. State carries over bit-identically except
+        the Verlet cache, which is dropped/reallocated-invalid when the
+        skin (or any cache-shaping knob) flips — the next tick rebuilds
+        the front half, so the swap is exact from its first tick
+        (oracle-asserted in tests/test_governor.py).
+
+        The live telemetry lanes follow the new config's lane set: a
+        pre-warmed fold executable + zeroed accumulator swap in when
+        provided (the warmset compiles them next to the step), else the
+        lanes re-initialize; either way the signature window restarts —
+        a window must never straddle two configs."""
+        if self.mega is not None or self.mesh is not None \
+                or self.n_spaces != 1:
+            raise ValueError(
+                "apply_tick_config serves single-shard non-mesh worlds"
+            )
+        from goworld_tpu.autotune.warmset import carry_state
+
+        # a pipelined decode holding last tick's outputs/acc must drain
+        # first: their pytree structure belongs to the OLD config
+        self.flush_pending_outputs()
+        self._pending_telem = None
+        self.state = carry_state(self.state, self.cfg, cfg2,
+                                 stacked=True)
+        self.cfg = cfg2
+        self._step = step
+        if self._telem_fn is not None or telem_fold is not None:
+            if telem_fold is not None and telem_acc0 is not None:
+                self._telem_fn = telem_fold
+                self._telem_acc = telem_acc0
+                self._telem_skin_on = bool(telem_skin_on)
+                self._telem_half_skin = float(telem_half_skin)
+                self._telem_mega = False
+            elif self.telemetry_live:
+                try:
+                    self._init_live_telemetry()
+                except Exception:
+                    logger.exception(
+                        "live telemetry re-init failed on swap; "
+                        "disabled")
+                    self._telem_fn = self._telem_acc = None
+            # fresh window: drained lanes/marks of the old lane set
+            # must never delta against the new accumulator
+            self._telem_lanes = None
+            self._telem_win = None
+            self._telem_win_tick = self.tick_count
+            self._telem_last_window = None
+            self._telem_feed_mark = None
 
     # ==================================================================
     # the tick

@@ -106,6 +106,13 @@ class GameServer:
         flightrec_cooldown_secs: float = flightrec.DEFAULT_COOLDOWN_SECS,
         sync_delta: bool = False,
         sync_keyframe_every: int = 16,
+        governor_enabled: bool = False,
+        governor_window_ticks: int = 64,
+        governor_up_windows: int = 2,
+        governor_down_windows: int = 2,
+        governor_cooldown_windows: int = 4,
+        governor_regret_pct: float = 0.25,
+        governor_table: str = "",
     ):
         self.game_id = game_id
         self.world = world
@@ -262,6 +269,48 @@ class GameServer:
             return None if s is None else s.world.workload_signature()
 
         flightrec.set_workload_provider(_workload)
+
+        # online kernel governor (ISSUE 13, goworld_tpu/autotune): the
+        # workload signature hot-swaps the resolved tick config between
+        # ticks. Only the single-shard non-mesh shape qualifies (the
+        # candidates toggle the skin's runtime branches); ineligible or
+        # telemetry-less worlds get a loud warning, never a crash.
+        self.governor = None
+        self._gov_last_win = -1
+        self._gov_hist_mark: list | None = None
+        if governor_enabled:
+            try:
+                from goworld_tpu import autotune
+
+                table = dict(autotune.seed_table())
+                if governor_table:
+                    table.update(autotune.parse_table(governor_table))
+                self.governor = autotune.register(
+                    f"game{game_id}",
+                    autotune.KernelGovernor(
+                        world, name=f"game{game_id}", table=table,
+                        up_windows=governor_up_windows,
+                        down_windows=governor_down_windows,
+                        cooldown_windows=governor_cooldown_windows,
+                        regret_pct=governor_regret_pct,
+                    ),
+                )
+                # the governor's window IS the signature rotation: one
+                # decision per drained window (instance attr shadows
+                # the class default)
+                world.SIG_WINDOW_TICKS = max(8,
+                                             int(governor_window_ticks))
+                if not getattr(world, "telemetry_live", False):
+                    logger.warning(
+                        "game%d: governor enabled but telemetry_live "
+                        "is off — no signature windows will arrive, "
+                        "the config stays static", game_id,
+                    )
+            except Exception as exc:
+                logger.warning(
+                    "game%d: kernel governor disabled (%s)", game_id,
+                    exc,
+                )
 
         # wire the world's pluggable edges to the cluster
         w = world
@@ -494,12 +543,22 @@ class GameServer:
         with tl.span("fan_out"):
             self._flush_sync_out()
             self._maybe_checkpoint()
+        gov_ev = None
+        if self.governor is not None:
+            # between-ticks commit point: the world's device step for
+            # this tick is done, the next tick runs the (possibly)
+            # swapped executable
+            try:
+                gov_ev = self._drive_governor()
+            except Exception:  # the governor must never break a tick
+                logger.exception("kernel governor window failed")
         if self.flightrec is not None:
             # own span: frame cost stays attributed in the timeline's
             # >=95% per-tick coverage bound
             with tl.span("flightrec"):
                 try:
-                    self._flightrec_frame(time.perf_counter() - t0)
+                    self._flightrec_frame(time.perf_counter() - t0,
+                                          gov_ev)
                 except Exception:  # must never break the tick
                     logger.exception("flight-recorder frame failed")
 
@@ -509,7 +568,65 @@ class GameServer:
     # reduces fresh on demand)
     SIG_LOG_TICKS = 64
 
-    def _flightrec_frame(self, dur_s: float) -> None:
+    def _drive_governor(self):
+        """One governor observation per rotated signature window: hand
+        it the freshest signature + this window's measured tick-ms p90
+        (from the tick_latency_ms histogram delta — wall truth, not the
+        modeled device lane), and commit/revert whatever it decides.
+        Returns the swap event (stamped into the flight-recorder frame
+        as the ``governor_swap`` trigger) or None."""
+        w = self.world
+        win_tick = getattr(w, "_telem_win_tick", 0)
+        if win_tick == self._gov_last_win:
+            return None
+        self._gov_last_win = win_tick
+        from goworld_tpu.utils import devprof
+
+        snap = self._m_tick_hist.snapshot()
+        counts = [c for _u, c in snap["buckets"]] + [snap["inf"]]
+        p90 = None
+        if self._gov_hist_mark is not None \
+                and len(self._gov_hist_mark) == len(counts):
+            delta = [max(0, a - b) for a, b in
+                     zip(counts, self._gov_hist_mark)]
+            if sum(delta) > 0:
+                # INTERPOLATED quantile: the regret guard compares two
+                # p90s, and 2x-spaced bucket UPPER edges would make
+                # regret_pct unenforceable (any cross-bucket move reads
+                # as >= 2x, any within-bucket regression as 0). An inf
+                # p90 (mass beyond the top bucket) is KEPT — it is the
+                # strongest possible regression signal and must revert,
+                # not disarm; only NaN (impossible here) drops.
+                p90 = devprof.hist_quantile_interp(
+                    [u for u, _c in snap["buckets"]], delta, 0.9)
+                if p90 != p90:
+                    p90 = None
+        self._gov_hist_mark = counts
+        ev = self.governor.on_window(w.window_signature(),
+                                     tick_ms_p90=p90)
+        if ev is not None:
+            # the commit itself RESET the world's signature window
+            # (apply_tick_config): resync so the reset is never
+            # misread as a rotation one tick later — a 1-sample
+            # "window" of pre-swap latency would feed the regret
+            # guard garbage. The hist mark drops too: the next
+            # genuine window judges a full post-swap distribution.
+            self._gov_last_win = getattr(w, "_telem_win_tick",
+                                         win_tick)
+            self._gov_hist_mark = None
+            # the resolved kernel key follows the swap (incident
+            # context + the recommendation log line read it)
+            self._kernel_key = ",".join(
+                f"{k}={v}" for k, v in sorted(
+                    devprof.grid_config_key(w.cfg.grid).items()))
+            logger.info(
+                "[game%d] governor swap %s -> %s (%s); resolved %s",
+                self.world.game_id, ev["from"], ev["to"],
+                ev["reason"], self._kernel_key,
+            )
+        return ev
+
+    def _flightrec_frame(self, dur_s: float, gov_ev=None) -> None:
         """One correlated flight-recorder frame per tick: measured tick
         wall time vs this process's budget, ladder stage, AOI oracle
         gauges and event volumes (all host-resident already — zero
@@ -531,6 +648,13 @@ class GameServer:
             "leave": int(st.get("aoi_leave_events", 0)),
             "backlog": float(self._m_backlog.value),
         }
+        if gov_ev is not None:
+            # fires the flight recorder's governor_swap trigger: the
+            # decision context (signature, from/to, reason, regret
+            # numbers) freezes into the incident bundle
+            frame["governor"] = (
+                f"{gov_ev['from']}->{gov_ev['to']} ({gov_ev['reason']})"
+            )
         if getattr(w, "telemetry_live", False) \
                 and tick % self.SIG_LOG_TICKS == 0:
             sig = w.workload_signature()
@@ -564,6 +688,18 @@ class GameServer:
         sig = self.world.workload_signature()
         if sig:
             ctx["workload_signature"] = sig
+        if self.governor is not None:
+            # the governor's decision context, frozen with the bundle
+            # (a governor_swap incident answers "why did it swap" from
+            # the bundle alone)
+            g = self.governor.snapshot()
+            ctx["governor"] = {
+                "current": g["current"],
+                "pending": g["pending"],
+                "swaps": g["swaps"][-8:],
+                "decisions": g["policy"]["transitions"][-8:],
+                "regret_guard": g["regret_guard"],
+            }
         return ctx
 
     def _maybe_checkpoint(self) -> None:

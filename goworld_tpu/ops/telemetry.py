@@ -63,8 +63,17 @@ __all__ = [
     "telemetry_drain", "host_histogram", "TRACE_COUNTS",
     "mega_signals", "telemetry_update_mega",
     "live_signals", "telemetry_update_live",
-    "lanes_delta", "workload_signature",
+    "lanes_delta", "workload_signature", "RECOMMENDATION_KEYS",
 ]
+
+# Every [gameN] ini knob name a workload_signature recommendation can
+# emit. CONTRACT (tests/test_governor.py): each of these must be a
+# GameConfig field accepted by api._build_world — the strings were
+# convention-only before, so a knob rename would silently break the
+# autotune governor's input grammar. Extend this tuple when the
+# reducer learns a new recommendation key.
+RECOMMENDATION_KEYS = ("aoi_skin", "aoi_sort_impl", "aoi_cell_cap",
+                       "aoi_k", "sync_delta")
 
 # one ladder with the live metrics plane: a bench SLO and a serve-loop
 # SLO bucket identically

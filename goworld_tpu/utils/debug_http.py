@@ -45,6 +45,10 @@ TPU-native analog exposes:
 * ``/overload`` — overload-protection plane state (:mod:`goworld_tpu.
   utils.overload`): every registered governor's ladder state and
   transition log, circuit breaker states, per-class shed counters
+* ``/governor`` — online kernel-governor state (:mod:`goworld_tpu.
+  autotune`): current/pending config key, the deterministic swap +
+  decision logs, warm-set compile states, regret-guard status and the
+  freshest signature the policy judged
 
 Stdlib-only (http.server on a daemon thread), one call to :func:`start`.
 """
@@ -66,7 +70,7 @@ logger = log.get("debug_http")
 
 _ENDPOINTS = ["/healthz", "/vars", "/ops", "/metrics", "/trace",
               "/tracing", "/clock", "/profile", "/faults", "/overload",
-              "/costs", "/workload", "/incidents"]
+              "/costs", "/workload", "/incidents", "/governor"]
 
 # jax.profiler capture state (one capture at a time per process)
 _profile_lock = threading.Lock()
@@ -264,6 +268,12 @@ class _Handler(BaseHTTPRequestHandler):
             from goworld_tpu.utils import flightrec
 
             self._json(flightrec.workload_snapshot())
+        elif path == "/governor":
+            # online kernel-governor state (goworld_tpu/autotune):
+            # swap/decision logs, warm-set states, regret guard
+            from goworld_tpu.autotune import governor as autotune_gov
+
+            self._json(autotune_gov.snapshot())
         elif path == "/incidents":
             # flight-recorder incident bundles (utils/flightrec);
             # ?frames=1 adds the live per-tick frame ring

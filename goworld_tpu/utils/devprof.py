@@ -529,6 +529,35 @@ def hist_quantile(edges, counts, q: float) -> float:
     return float("inf")
 
 
+def hist_quantile_interp(edges, counts, q: float) -> float:
+    """Quantile with LINEAR INTERPOLATION inside the containing bucket
+    (the Prometheus histogram_quantile estimator). The upper-edge form
+    above is right for conservative SLO verdicts, but a COMPARISON of
+    two quantiles (the autotune regret guard: post-swap p90 vs
+    pre-swap p90) cannot live on 2x-spaced bucket edges — any
+    detectable change would read as >= 2x while a within-bucket
+    regression reads as 0. Interpolation keeps the estimate continuous
+    as mass shifts between buckets. Still ``inf`` when the q-th sample
+    sits in the +Inf bucket, NaN on an empty histogram."""
+    total = sum(counts)
+    if total <= 0:
+        return float("nan")
+    rank = q * total
+    cum = 0.0
+    for i, c in enumerate(counts):
+        prev_cum = cum
+        cum += c
+        if cum >= rank:
+            if i >= len(edges):
+                return float("inf")
+            lo = float(edges[i - 1]) if i > 0 else 0.0
+            hi = float(edges[i])
+            if c <= 0:
+                return hi
+            return lo + (hi - lo) * (rank - prev_cum) / c
+    return float("inf")
+
+
 def slo_from_histogram(edges, counts, target_ms: float | None = None,
                        source: str = "histogram") -> dict:
     """{target_ms, p50/p90/p99_ms, samples, pass} from a fixed-bucket

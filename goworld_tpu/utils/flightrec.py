@@ -21,8 +21,12 @@ Triggers (the grammar — docs/OBSERVABILITY.md):
   after at least ``quiet_ticks`` silent frames (a density anomaly,
   not steady-state saturation — steady overflow alarms elsewhere);
 * ``signature_change`` — the live workload signature's class string
-  changed (the autotuning governor's future input; recorded so a
-  post-mortem can correlate a breach with a workload shift).
+  changed (the autotuning governor's input; recorded so a post-mortem
+  can correlate a breach with a workload shift);
+* ``governor_swap`` — the autotune governor committed a kernel-config
+  swap or regret revert this tick (``goworld_tpu/autotune``); the
+  frame carries ``from->to (reason)`` and the incident context freezes
+  the full decision state (policy log, regret numbers, signature).
 
 Every trigger kind is deduped with a per-kind cooldown so one bad
 minute yields a handful of bundles, not thousands. Determinism: the
@@ -134,6 +138,12 @@ class FlightRecorder:
                     fired.append(("signature_change",
                                   f"{self._prev_sig}>{sig}"))
                 self._prev_sig = sig
+            gov = frame.get("governor")
+            if gov is not None:
+                # the autotune governor committed a kernel-config swap
+                # this tick (goworld_tpu/autotune); context_fn freezes
+                # the decision context into the bundle
+                fired.append(("governor_swap", str(gov)))
             self._frames.append(dict(frame))
             self._frames_total += 1
             new = [self._freeze(kind, detail, frame)

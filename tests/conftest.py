@@ -96,6 +96,15 @@ def pytest_configure(config):
         "tests/test_flightrec.py, tests/test_telemetry_live.py); all "
         "run in tier-1 on CPU",
     )
+    config.addinivalue_line(
+        "markers",
+        "governor: online kernel-governor suites (goworld_tpu/autotune "
+        "— policy hysteresis/replay determinism, warm-set AOT "
+        "executables, live mid-churn swap oracle exactness, the "
+        "regret guard, /governor, the recommendation-key contract — "
+        "tests/test_governor.py); all run in tier-1 on CPU "
+        "(docs/AUTOTUNE.md)",
+    )
 
 
 def spawn_on(states, dev, slot, **kw):

@@ -283,6 +283,56 @@ def test_precision_stamp_required_since_r12(tmp_path):
     assert _validate(tmp_path, "BENCH_r12.json", rec) == []
 
 
+def _r13_rec(**extra):
+    """A valid r13 record: r12's contract + the governor block."""
+    rec = _full_rec(
+        workload_signature={"sig": "x", "churn": "flock_like",
+                            "density": "exact", "events": "quiet",
+                            "recommendation": {}},
+        precision={"plane": "off", "pos_scale_bits": 0,
+                   "sync_keyframe_every": 16},
+        precision_ab={"off_ms": 1.0, "q16_ms": 0.9,
+                      "model_off_gb_1m": 1.0, "model_q16_gb_1m": 0.6},
+        governor={"schedule": ["flock", "teleport", "hotspot"],
+                  "phases": [{"scenario": "flock", "chosen": "default",
+                              "expected": "default",
+                              "swap_latency_ticks": 8}],
+                  "throughput": 1000.0,
+                  "static_wall_s": {"default": 1.0}},
+    )
+    rec.update(extra)
+    return rec
+
+
+def test_governor_block_required_since_r13(tmp_path):
+    rec = _r13_rec()
+    assert _validate(tmp_path, "BENCH_r13.json", rec) == []
+    # missing entirely -> caught at r13, grandfathered at r12
+    rec2 = _r13_rec()
+    del rec2["governor"]
+    errs = _validate(tmp_path, "BENCH_r13.json", rec2)
+    assert any("governor" in e for e in errs)
+    assert _validate(tmp_path, "BENCH_r12.json", rec2) == []
+    # honest skip/error records accepted (the --governor-not-requested
+    # round and the stage-failed round are both valid artifacts)
+    for blk in ({"skipped": "--governor not requested"},
+                {"error": "governor stage never completed"}):
+        rec3 = _r13_rec(governor=blk)
+        assert _validate(tmp_path, "BENCH_r13.json", rec3) == []
+
+
+def test_governor_block_shape_caught(tmp_path):
+    # a present-but-gutted block is malformation, not an honest skip
+    rec = _r13_rec(governor={"schedule": ["flock"]})
+    errs = _validate(tmp_path, "BENCH_r13.json", rec)
+    assert any("governor" in e and "phases" in e for e in errs)
+    # malformed phase records inside an otherwise-complete block
+    rec2 = _r13_rec()
+    rec2["governor"]["phases"] = [{"scenario": "flock"}]
+    errs = _validate(tmp_path, "BENCH_r13.json", rec2)
+    assert any("governor phase" in e for e in errs)
+
+
 def test_unreadable_file_reported(tmp_path):
     p = tmp_path / "BENCH_r08.json"
     p.write_text("{not json")

@@ -157,6 +157,82 @@ def test_signature_drift_is_informational_not_gated(tmp_path):
     assert not any("drifted" in n for n in notes)
 
 
+def _gov_block(throughput, n=1024, schedule=("flock", "teleport",
+                                             "hotspot")):
+    return {"schedule": list(schedule), "n": n,
+            "throughput": throughput,
+            "phases": [], "static_wall_s": {"default": 1.0}}
+
+
+def test_governor_mode_headline_is_its_own_anchor_series(tmp_path):
+    """A headline stamped bench_mode=governor never gates against (or
+    anchors) static rounds — the (entities, platform, mode) shape key
+    (ISSUE 13): the governor number includes swap dynamics and a
+    scenario schedule, a different experiment entirely."""
+    f1 = _write(tmp_path, "BENCH_r01.json", _bench_rec(1000.0))
+    gov_rec = _bench_rec(300.0)  # 70% "down" vs r1 — but governor-mode
+    gov_rec["bench_mode"] = "governor"
+    f2 = _write(tmp_path, "BENCH_r02.json", gov_rec)
+    assert TREND.main([f1, f2]) == 0
+    # and a static round after it gates against r1, not the governor
+    f3 = _write(tmp_path, "BENCH_r03.json", _bench_rec(950.0))
+    assert TREND.main([f1, f2, f3]) == 0
+    f3b = _write(tmp_path, "BENCH_r03.json", _bench_rec(500.0))
+    assert TREND.main([f1, f2, f3b]) == 2
+
+
+def test_governor_block_series_gated_and_regression_fails(tmp_path):
+    """The governor schedule block's throughput is its own series:
+    same schedule shape gates vs the best prior; a skipped round
+    neither gates nor anchors; an injected regression fails."""
+    r1 = _bench_rec(1000.0)
+    r1["governor"] = _gov_block(2000.0)
+    r2 = _bench_rec(1000.0)
+    r2["governor"] = _gov_block(1900.0)
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 0
+    # injected governor regression: static headline flat, governor
+    # throughput down 60% -> gate fails
+    r3 = _bench_rec(1000.0)
+    r3["governor"] = _gov_block(800.0)
+    f3 = _write(tmp_path, "BENCH_r03.json", r3)
+    assert TREND.main([f1, f2, f3]) == 2
+    # a skipped-governor round between them is not a gate or an anchor
+    r3b = _bench_rec(1000.0)
+    r3b["governor"] = {"skipped": "--governor not requested"}
+    f3b = _write(tmp_path, "BENCH_r03.json", r3b)
+    assert TREND.main([f1, f2, f3b]) == 0
+    # a different schedule shape is a different series
+    r3c = _bench_rec(1000.0)
+    r3c["governor"] = _gov_block(800.0, schedule=("flock", "shrink"))
+    f3c = _write(tmp_path, "BENCH_r03.json", r3c)
+    assert TREND.main([f1, f2, f3c]) == 0
+
+
+def test_governor_gate_survives_headline_shape_change(tmp_path):
+    """The governor series is keyed by its OWN (n, platform, schedule)
+    shape: a round that changes the HEADLINE entity count (so the
+    headline has no prior and is not gated) must still gate its
+    governor block against the prior rounds' — the early headline
+    return must not swallow the governor comparison (review
+    finding)."""
+    r1 = _bench_rec(1000.0, entities=1000)
+    r1["governor"] = _gov_block(2000.0)
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    # headline shape changes (no prior -> headline ungated) while the
+    # governor block regresses 60% at the SAME governor shape
+    r2 = _bench_rec(5000.0, entities=4096)
+    r2["governor"] = _gov_block(800.0)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 2
+    # same headline-shape change with a healthy governor block passes
+    r2b = _bench_rec(5000.0, entities=4096)
+    r2b["governor"] = _gov_block(1950.0)
+    f2b = _write(tmp_path, "BENCH_r02.json", r2b)
+    assert TREND.main([f1, f2b]) == 0
+
+
 def test_scenario_value_regression_fails(tmp_path):
     sc_ok = {"hotspot": {"value": 500.0, "entities": 512,
                          "tick_ms": 1.0}}

@@ -1,0 +1,819 @@
+"""Online kernel governor (goworld_tpu/autotune) — ISSUE 13.
+
+Covers the full stack: the jax-free policy (table mapping, hysteresis,
+hold bands, cooldown, regret pin, byte-identical replay — the
+determinism acceptance criterion), the recommendation-key contract
+(every knob name the workload-signature reducer can emit must resolve
+through the accepted ``[gameN]`` set), the warm-set AOT executables
+(bit-parity vs the jit path, no retrace on re-commit), the LIVE swap
+(mid-churn oracle exactness on the very next tick, zero entity loss,
+telemetry lane-set follow), the KernelGovernor runtime (warm-gated
+commits, the regret guard, metrics counters, /governor), and the
+flight-recorder ``governor_swap`` trigger.
+"""
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from goworld_tpu.autotune import (
+    DEFAULT_CANDIDATES,
+    GovernorPolicy,
+    KernelGovernor,
+    WarmSet,
+    candidate_overrides,
+    classify_signature,
+    parse_table,
+    seed_table,
+)
+from goworld_tpu.autotune import governor as gov_mod
+from goworld_tpu.autotune.policy import (
+    CANDIDATE_GRID_KEYS,
+    DEFAULT_TABLE,
+    SCENARIO_CLASS_MAP,
+)
+from goworld_tpu.autotune.warmset import candidate_config, carry_state
+
+pytestmark = pytest.mark.governor
+
+
+# ----------------------------------------------------------------------
+# synthetic signatures (the reducer's output grammar)
+# ----------------------------------------------------------------------
+def sig(churn="flock_like", rebuild_rate=0.1, density="exact",
+        events="quiet", **extra):
+    s = {"churn": churn, "rebuild_rate": rebuild_rate,
+         "density": density, "events": events, "sig": f"churn={churn}"}
+    s.update(extra)
+    return s
+
+
+TELE = sig(churn="teleport_like", rebuild_rate=0.95)
+FLOCK = sig(churn="flock_like", rebuild_rate=0.05)
+DENSE = sig(churn="flock_like", rebuild_rate=0.05, density="over_cap",
+            over_k_frac=1.0)
+
+
+# ----------------------------------------------------------------------
+# policy: mapping, hysteresis, determinism
+# ----------------------------------------------------------------------
+class TestPolicy:
+    def test_classification_grammar(self):
+        assert classify_signature(TELE) == "teleport_like"
+        assert classify_signature(FLOCK) == "flock_like"
+        assert classify_signature(DENSE) == "density"
+        # density outranks churn — but only at a real over_k duty
+        # cycle (rows actually truncated)
+        assert classify_signature(
+            sig(churn="teleport_like", rebuild_rate=0.95,
+                density="over_k", over_k_frac=0.8)) == "density"
+        # bare over_cap ticks with no row truncation are the uniform
+        # Poisson cell tail, not density pressure — churn wins
+        assert classify_signature(
+            sig(churn="teleport_like", rebuild_rate=0.95,
+                density="over_cap", over_k_frac=0.0)) \
+            == "teleport_like"
+        assert classify_signature(
+            sig(churn="flock_like", rebuild_rate=0.05,
+                density="over_k", over_k_frac=0.02)) == "flock_like"
+        # hold band on the churn boundary
+        assert classify_signature(
+            sig(churn="teleport_like", rebuild_rate=0.55)) is None
+        # skinless: the event-volume proxy
+        assert classify_signature(
+            sig(churn="skinless", events="heavy")) == "teleport_like"
+        assert classify_signature(
+            sig(churn="skinless", events="quiet")) == "flock_like"
+        assert classify_signature(
+            sig(churn="skinless", events="low")) is None
+        # honest absences never decide
+        assert classify_signature({"error": "no samples"}) is None
+        assert classify_signature(None) is None
+
+    def test_hysteresis_up_windows(self):
+        p = GovernorPolicy(up_windows=3, cooldown_windows=0)
+        assert p.observe(TELE) is None
+        assert p.observe(TELE) is None
+        assert p.observe(TELE) == "skin=0"
+        assert p.current == "skin=0"
+
+    def test_changed_want_resets_the_run(self):
+        p = GovernorPolicy(up_windows=2, cooldown_windows=0,
+                           table={**DEFAULT_TABLE,
+                                  "density": "sort=counting,skin=0"})
+        assert p.observe(TELE) is None
+        assert p.observe(DENSE) is None   # different target: run=1
+        assert p.observe(TELE) is None    # back: run=1 again
+        assert p.observe(TELE) == "skin=0"
+
+    def test_hold_band_holds_and_resets(self):
+        p = GovernorPolicy(up_windows=2, cooldown_windows=0)
+        assert p.observe(TELE) is None
+        assert p.observe(sig(churn="teleport_like",
+                             rebuild_rate=0.52)) is None  # band
+        assert p.observe(TELE) is None    # run restarted
+        assert p.observe(TELE) == "skin=0"
+
+    def test_cooldown_blocks_the_next_swap(self):
+        p = GovernorPolicy(up_windows=1, down_windows=1,
+                           cooldown_windows=3)
+        assert p.observe(TELE) == "skin=0"
+        # wants default immediately, but the cooldown holds for 3
+        # refractory windows after the deciding one
+        assert p.observe(FLOCK) is None
+        assert p.observe(FLOCK) is None
+        assert p.observe(FLOCK) is None
+        assert p.observe(FLOCK) == "default"
+
+    def test_pin_suppresses_decisions(self):
+        p = GovernorPolicy(up_windows=1, cooldown_windows=0)
+        assert p.observe(TELE) == "skin=0"
+        p.pin("default", windows=3, reason="regret(test)")
+        assert p.current == "default"
+        assert p.observe(TELE) is None
+        assert p.observe(TELE) is None
+        assert p.observe(TELE) is None
+        assert p.observe(TELE) == "skin=0"  # pin expired
+        assert any("revert regret(test)" in ln for ln in p.log_lines())
+
+    def test_replay_is_byte_identical(self):
+        """The determinism acceptance criterion: replaying a recorded
+        signature stream yields a byte-identical transition log."""
+        rng = np.random.default_rng(3)
+        stream = []
+        for _ in range(200):
+            stream.append(sig(
+                churn=rng.choice(["flock_like", "teleport_like",
+                                  "skinless"]),
+                rebuild_rate=float(rng.uniform()),
+                density=rng.choice(["exact", "over_k", "over_cap"]),
+                events=rng.choice(["quiet", "low", "moderate",
+                                   "heavy"]),
+            ))
+        mk = lambda: GovernorPolicy(up_windows=2, down_windows=2,  # noqa: E731
+                                    cooldown_windows=3)
+        a, b = mk(), mk()
+        for s in stream:
+            a.observe(s)
+        for s in stream:
+            b.observe(s)
+        assert a.log_lines() == b.log_lines()
+        assert a.log_lines()  # the stream must actually transition
+
+    def test_table_override_parsing(self):
+        t = parse_table("teleport_like:sort=counting,skin=0")
+        assert t == {"teleport_like": "sort=counting,skin=0"}
+        with pytest.raises(ValueError, match="unknown"):
+            parse_table("nonsense_class:skin=0")
+        with pytest.raises(KeyError):
+            parse_table("teleport_like:not_a_candidate")
+        with pytest.raises(ValueError, match="class:label"):
+            parse_table("justaword")
+
+    def test_seed_table_reads_checked_in_best_kernels(self):
+        """The mapping seeds from the repo's own measured per-scenario
+        stamps: BENCH_r12's teleport best_kernel is skin=0 (the CPU
+        skin inversion) and every seeded label is in the pool."""
+        t = seed_table()
+        assert set(t) == set(DEFAULT_TABLE)
+        labels = {lbl for lbl, _ in DEFAULT_CANDIDATES}
+        assert set(t.values()) <= labels
+        assert t["teleport_like"] == "skin=0"
+
+
+# ----------------------------------------------------------------------
+# contracts: recommendation keys + candidate pool
+# ----------------------------------------------------------------------
+class TestContracts:
+    def test_recommendation_keys_resolve_through_gameconfig(self):
+        """ISSUE-13 satellite: every knob name a workload_signature
+        recommendation can emit must be a GameConfig field (the set
+        api._build_world consumes) — a rename breaks HERE, not the
+        governor's input grammar in production."""
+        from goworld_tpu.config import GameConfig
+        from goworld_tpu.ops.telemetry import RECOMMENDATION_KEYS
+
+        fields = {f.name for f in dataclasses.fields(GameConfig)}
+        missing = set(RECOMMENDATION_KEYS) - fields
+        assert not missing, (
+            f"recommendation keys {missing} are not [gameN] knobs — "
+            "update RECOMMENDATION_KEYS and the reducer together")
+
+    def test_reducer_only_emits_contract_keys(self):
+        """Probe the reducer across every class combination and assert
+        the emitted recommendation keys stay inside the contract."""
+        from goworld_tpu.ops import telemetry as telem
+
+        def lanes(rebuild_frac, over_k, over_cap, ev, sync_p50):
+            n = 100
+
+            def lane(edges, counts):
+                return {"edges": list(edges), "counts": counts}
+
+            rb = [n - int(n * rebuild_frac), int(n * rebuild_frac)]
+            return {
+                "rebuilt": lane(telem.REBUILD_EDGES, rb + [0]),
+                "skin_slack": lane(telem.SLACK_EDGES,
+                                   [0] * 4 + [n] + [0] * 5),
+                "over_k_rows": lane(
+                    telem.COUNT_EDGES,
+                    [n - over_k, over_k] + [0] * 11),
+                "over_cap_cells": lane(
+                    telem.COUNT_EDGES,
+                    [n - over_cap, over_cap] + [0] * 11),
+                "enter_n": lane(telem.COUNT_EDGES,
+                                [0] * ev + [n] + [0] * (12 - ev)),
+                "leave_n": lane(telem.COUNT_EDGES,
+                                [0] * ev + [n] + [0] * (12 - ev)),
+                "sync_n": lane(telem.COUNT_EDGES,
+                               [0] * sync_p50 + [n]
+                               + [0] * (12 - sync_p50)),
+            }
+
+        from goworld_tpu.ops.telemetry import RECOMMENDATION_KEYS
+
+        seen = set()
+        for rf in (0.0, 0.2, 1.0):
+            for ok in (0, 50):
+                for oc in (0, 50):
+                    for ev in (0, 3, 6, 9):
+                        for sp in (1, 8):
+                            s = telem.workload_signature(
+                                lanes(rf, ok, oc, ev, sp))
+                            rec = s.get("recommendation") or {}
+                            seen |= set(rec)
+        assert seen <= set(RECOMMENDATION_KEYS), (
+            f"reducer emitted {seen - set(RECOMMENDATION_KEYS)} "
+            "outside RECOMMENDATION_KEYS")
+
+    def test_candidate_pool_contract(self):
+        """Candidate override keys are GridSpec fields (the warm set
+        builds configs from them), the bench pool IS the policy pool,
+        and every table label resolves."""
+        from goworld_tpu.ops.aoi import GridSpec
+
+        grid_fields = {f.name for f in dataclasses.fields(GridSpec)}
+        for lbl, ov in DEFAULT_CANDIDATES:
+            assert set(ov) <= set(CANDIDATE_GRID_KEYS)
+            assert set(ov) <= grid_fields
+        import bench
+
+        assert [(lbl, ov) for lbl, ov in
+                bench.SCENARIO_KERNEL_CANDIDATES] \
+            == [(lbl, dict(ov)) for lbl, ov in DEFAULT_CANDIDATES]
+        for cls in DEFAULT_TABLE:
+            candidate_overrides(DEFAULT_TABLE[cls])
+        assert set(SCENARIO_CLASS_MAP.values()) <= set(DEFAULT_TABLE)
+
+    def test_candidate_config_respects_packed_id_bound(self):
+        from goworld_tpu.core.state import WorldConfig
+        from goworld_tpu.ops.aoi import GridSpec
+        from goworld_tpu.utils import consts
+
+        cfg = WorldConfig(capacity=1 << consts.AOI_ID_BITS,
+                          grid=GridSpec(radius=50.0))
+        c2 = candidate_config(cfg, {"skin": 4.0})
+        assert c2.grid.skin == 0.0  # the api._build_world gate
+
+
+# ----------------------------------------------------------------------
+# live world fixtures (shared across the jax-heavy classes)
+# ----------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def flock_world():
+    from goworld_tpu.scenarios.runner import build_world
+    from goworld_tpu.scenarios.spec import get_scenario
+
+    w, ents, clients = build_world(
+        get_scenario("flock"), n=40, skin=4.0, client_frac=0.15,
+        seed=11)
+    w.tick()
+    return w, ents, clients
+
+
+@pytest.fixture(scope="module")
+def warmset(flock_world):
+    w, _ents, _clients = flock_world
+    ws = WarmSet(w.cfg, 1, w.policy, telemetry=True)
+    ws.ensure("skin=0", block=True)
+    ws.ensure("sort=counting,skin=0", block=True)
+    return ws
+
+
+def _commit(w, entry):
+    w.apply_tick_config(
+        entry.cfg, entry.exe, telem_fold=entry.fold_exe,
+        telem_acc0=entry.acc0, telem_skin_on=entry.skin_on,
+        telem_half_skin=entry.half_skin)
+
+
+# ----------------------------------------------------------------------
+# warm set
+# ----------------------------------------------------------------------
+class TestWarmSet:
+    def test_entries_warm_with_matching_structure(self, warmset):
+        e = warmset.entry("skin=0")
+        assert e.warm and e.error is None
+        assert e.cfg.grid.skin == 0.0
+        assert not e.skin_on
+        e2 = warmset.entry("sort=counting,skin=0")
+        assert e2.warm and e2.cfg.grid.sort_impl == "counting"
+
+    def test_re_ensure_never_recompiles(self, warmset):
+        n = warmset.compile_count
+        assert warmset.ensure("skin=0") is True
+        assert warmset.ensure("sort=counting,skin=0", block=True)
+        assert warmset.compile_count == n
+
+    def test_exe_bit_parity_with_jit_path(self, flock_world, warmset):
+        """The AOT executable must produce the SAME state/outputs as a
+        fresh jit of the same candidate config — the swap changes the
+        dispatch mechanism, never the math."""
+        import jax
+
+        from goworld_tpu.entity.manager import _make_local_tick
+
+        w, _ents, _clients = flock_world
+        e = warmset.entry("skin=0")
+        state = carry_state(w.state, w.cfg, e.cfg, stacked=True)
+        inputs = jax.tree.map(
+            lambda x: np.broadcast_to(np.asarray(x),
+                                      (1,) + np.asarray(x).shape),
+            __import__("goworld_tpu.core.step",
+                       fromlist=["TickInputs"]).TickInputs.empty(e.cfg))
+        s_aot, o_aot = e.exe(state, inputs, w.policy)
+        s_jit, o_jit = _make_local_tick(e.cfg, 1)(state, inputs,
+                                                  w.policy)
+        np.testing.assert_array_equal(np.asarray(s_aot.pos),
+                                      np.asarray(s_jit.pos))
+        np.testing.assert_array_equal(np.asarray(o_aot.sync_n),
+                                      np.asarray(o_jit.sync_n))
+        np.testing.assert_array_equal(np.asarray(o_aot.enter_n),
+                                      np.asarray(o_jit.enter_n))
+
+    def test_unknown_label_rejected_loudly(self, warmset):
+        with pytest.raises(KeyError):
+            warmset.ensure("not_a_candidate")
+
+    def test_blocking_ensure_waits_out_inflight_compile(self,
+                                                       flock_world):
+        """ensure(label) async followed by ensure(label, block=True)
+        must yield exactly ONE compile — the blocking call waits for
+        the worker instead of duplicating the XLA work (review
+        finding)."""
+        w, _e, _c = flock_world
+        ws = WarmSet(w.cfg, 1, w.policy, telemetry=False)
+        assert ws.ensure("skin=0") is False      # queued on the worker
+        assert ws.ensure("skin=0", block=True)   # waits, never doubles
+        assert ws.compile_count == 1
+
+    def test_hist_quantile_interp_resolution(self):
+        """The regret guard's estimator: continuous inside a bucket
+        (2x-spaced upper edges alone cannot express a 25% threshold),
+        inf when the quantile sits past the top bucket."""
+        from goworld_tpu.utils.devprof import hist_quantile_interp
+
+        edges = [1.0, 2.0, 4.0, 8.0]
+        lo = hist_quantile_interp(edges, [0, 0, 10, 0, 0], 0.5)
+        assert 2.0 < lo < 4.0
+        # mass shifting toward the bucket top moves the estimate up
+        hi = hist_quantile_interp(edges, [0, 0, 10, 2, 0], 0.9)
+        assert hi > lo
+        assert hist_quantile_interp(edges, [0, 0, 0, 0, 5], 0.9) \
+            == float("inf")
+        assert hist_quantile_interp(edges, [0] * 5, 0.9) \
+            != hist_quantile_interp(edges, [0] * 5, 0.9)  # NaN
+
+    def test_multi_shard_worlds_rejected(self, flock_world):
+        w, _e, _c = flock_world
+        with pytest.raises(ValueError, match="single-shard"):
+            WarmSet(w.cfg, 2, None)
+
+
+# ----------------------------------------------------------------------
+# the live swap (oracle exactness, entity retention, no retraces)
+# ----------------------------------------------------------------------
+class TestLiveSwap:
+    def test_swap_mid_churn_stays_oracle_exact(self, flock_world,
+                                               warmset):
+        """The acceptance criterion: a live swap mid-churn keeps
+        check_oracle exact (both overflow gauges zero) on the VERY
+        NEXT tick — both directions, with host churn riding through
+        the production create/destroy API across the swaps."""
+        from goworld_tpu.scenarios.runner import check_oracle
+
+        w, ents, clients = flock_world
+        space = next(iter(w.spaces.values()))
+        rng = np.random.default_rng(5)
+        live = [e for e in w.entities.values()
+                if not e.destroyed and not e.is_space]
+        n0 = len(live)
+
+        def churn():
+            victim = live.pop(int(rng.integers(len(live))))
+            tname = victim.type_name
+            victim.destroy()
+            live.append(w.create_entity(
+                tname, space=space,
+                pos=(float(rng.uniform(1, 199)), 0.0,
+                     float(rng.uniform(1, 199))),
+                moving=True))
+
+        for label in ("skin=0", "sort=counting,skin=0", "skin=0"):
+            churn()
+            _commit(w, warmset.entry(label))
+            w.tick()  # the very next tick after the swap
+            bad = check_oracle(w, clients)
+            assert bad == [], f"swap to {label}: {bad[:3]}"
+            assert w.op_stats["aoi_over_k_rows"] == 0
+            assert w.op_stats["aoi_over_cap_cells"] == 0
+            churn()
+            w.tick()
+            assert check_oracle(w, clients) == []
+        assert len([e for e in w.entities.values()
+                    if not e.destroyed and not e.is_space]) == n0
+
+    def test_swap_between_warm_configs_never_retraces(self, flock_world,
+                                                      warmset):
+        """Trace-count assertion: once the candidates are warm,
+        swapping back and forth (and ticking) adds ZERO traces — the
+        AOT executables and pre-warmed folds serve every tick."""
+        from goworld_tpu.ops import telemetry as telem
+
+        w, _ents, _clients = flock_world
+        for label in ("skin=0", "sort=counting,skin=0"):
+            _commit(w, warmset.entry(label))
+            w.tick()
+        before = dict(telem.TRACE_COUNTS)
+        for _ in range(3):
+            for label in ("sort=counting,skin=0", "skin=0"):
+                _commit(w, warmset.entry(label))
+                w.tick()
+                w.tick()
+        assert dict(telem.TRACE_COUNTS) == before
+        assert warmset.compile_count == 2  # still just the prewarm
+
+    def test_telemetry_lane_set_follows_the_swap(self, flock_world,
+                                                 warmset):
+        w, _e, _c = flock_world
+        _commit(w, warmset.entry("skin=0"))
+        for _ in range(3):
+            w.tick()
+        s = w.workload_signature()
+        assert s is not None and s["churn"] == "skinless"
+        assert s["config"]["skin"] == 0.0
+
+    def test_mesh_and_multi_shard_swaps_rejected(self):
+        from goworld_tpu.core.state import WorldConfig
+        from goworld_tpu.entity.manager import World
+        from goworld_tpu.ops.aoi import GridSpec
+
+        w = World(WorldConfig(capacity=32, grid=GridSpec(radius=25.0)),
+                  n_spaces=2)
+        with pytest.raises(ValueError, match="single-shard"):
+            w.apply_tick_config(w.cfg, w._step)
+
+
+# ----------------------------------------------------------------------
+# the governor runtime
+# ----------------------------------------------------------------------
+class TestKernelGovernor:
+    @pytest.fixture()
+    def gov(self, flock_world, warmset):
+        w, _e, _c = flock_world
+        # restore the boot-ish default config before each test (the
+        # module-scoped world is shared)
+        g = KernelGovernor(w, name="gtest", up_windows=1,
+                           cooldown_windows=0, regret_pct=0.25,
+                           regret_pin_windows=4)
+        # share the module warm set (already compiled) so tests never
+        # pay a second compile
+        g.warmset = warmset
+        return g
+
+    def test_decide_warm_commit_and_counter(self, gov, flock_world):
+        from goworld_tpu.utils import metrics
+
+        w, _e, _c = flock_world
+        ev = gov.on_window(TELE, tick_ms_p90=5.0)
+        assert ev is not None and ev["to"] == "skin=0"
+        assert gov.current == "skin=0"
+        assert w.cfg.grid.skin == 0.0
+        c = metrics.counter("governor_swaps_total",
+                            **{"from": ev["from"], "to": "skin=0",
+                               "reason": "policy"})
+        assert c.value >= 1
+        assert gov.log_lines()
+
+    def test_pending_until_warm_then_commit(self, flock_world):
+        """A cold candidate never commits mid-window: the world keeps
+        its config until the off-thread compile lands."""
+        w, _e, _c = flock_world
+        g = KernelGovernor(w, name="gcold", up_windows=1,
+                           cooldown_windows=0)
+        ev = g.on_window(TELE, tick_ms_p90=5.0)
+        # either the async compile already finished (slow box margin)
+        # or the decision is pending — never a half-committed state
+        if ev is None:
+            assert g.pending == "skin=0"
+            deadline = time.monotonic() + 120
+            while not g.warmset.is_warm("skin=0") \
+                    and time.monotonic() < deadline:
+                time.sleep(0.1)
+            ev = g.on_window(TELE, tick_ms_p90=5.0)
+        assert ev is not None and ev["to"] == "skin=0"
+        assert g.current == "skin=0"
+
+    def test_policy_walkback_clears_stale_pending(self, flock_world):
+        """A pending target whose compile is still in flight must be
+        DROPPED when the policy re-decides back to the serving config
+        — otherwise the stale target commits (unwanted) the moment it
+        warms, and the policy (whose decision state already walked
+        back) never issues a corrective decision (review finding)."""
+        w, _e, _c = flock_world
+
+        class _ColdSet:
+            """Never warms: models a long in-flight compile."""
+
+            def __init__(self):
+                self.ensured = []
+
+            def ensure(self, label, block=False):
+                self.ensured.append(label)
+                return False
+
+            def entry(self, label):
+                return None
+
+        g = KernelGovernor(w, name="gstale", up_windows=1,
+                           down_windows=1, cooldown_windows=0)
+        g.warmset = _ColdSet()
+        # teleport burst: decided, but the target is cold -> pending
+        assert g.on_window(TELE, tick_ms_p90=5.0) is None
+        assert g.pending == "skin=0"
+        # workload reverts before the compile lands: the policy walks
+        # back to the serving config -> the stale pending must clear
+        assert g.on_window(FLOCK, tick_ms_p90=5.0) is None
+        assert g.pending is None
+        # later windows (compile could land any time) commit nothing:
+        # the world keeps serving its config
+        assert g.on_window(FLOCK, tick_ms_p90=5.0) is None
+        assert g.current == "default"
+        assert g.swaps == []
+
+    def test_regret_guard_reverts_and_pins(self, gov):
+        ev = gov.on_window(TELE, tick_ms_p90=5.0)
+        assert ev is not None and ev["to"] == "skin=0"
+        ev2 = gov.on_window(TELE, tick_ms_p90=50.0)  # 10x worse p90
+        assert ev2 is not None and ev2["reason"] == "regret"
+        assert ev2["to"] == ev["from"]
+        assert "regret" in ev2 and ev2["regret"]["pre_p90_ms"] == 5.0
+        assert gov.current == ev["from"]
+        # pinned: the same teleport signature cannot re-swap yet
+        assert gov.on_window(TELE, tick_ms_p90=5.0) is None
+
+    def test_revert_installs_zeroed_boot_accumulator(self, gov,
+                                                     flock_world):
+        """The boot 'default' WarmEntry must carry a ZEROED telemetry
+        accumulator: capturing the live cumulative one would re-feed
+        every boot-era sample into the metrics registry (and classify
+        the first post-revert window on process-lifetime averages)
+        when a swap commits back to the boot config (review
+        finding)."""
+        import jax
+
+        w, _e, _c = flock_world
+        assert w._telem_fn is not None  # telemetry-live world
+        for _ in range(3):
+            w.tick()
+        w.flush_pending_outputs()
+        # the live accumulator has real boot-era mass
+        assert any(float(np.asarray(x).sum()) > 0
+                   for x in jax.tree.leaves(w._telem_acc))
+        ev = gov.on_window(TELE, tick_ms_p90=5.0)
+        assert ev is not None and ev["to"] == "skin=0"
+        ev2 = gov.on_window(TELE, tick_ms_p90=50.0)  # regret revert
+        assert ev2 is not None and ev2["reason"] == "regret"
+        leaves = jax.tree.leaves(w._telem_acc)
+        assert leaves and all(float(np.asarray(x).sum()) == 0
+                              for x in leaves)
+
+    def test_regret_fires_on_inf_p90(self, gov):
+        """An inf p90 (latency mass beyond the top histogram bucket)
+        is the STRONGEST regression signal — it must revert, never
+        disarm as 'unmeasurable' (review finding)."""
+        ev = gov.on_window(TELE, tick_ms_p90=5.0)
+        assert ev is not None and ev["to"] == "skin=0"
+        ev2 = gov.on_window(TELE, tick_ms_p90=float("inf"))
+        assert ev2 is not None and ev2["reason"] == "regret"
+        assert gov.current == ev["from"]
+
+    def test_regret_without_baseline_disarms(self, gov):
+        """A swap committed with no measured pre-swap p90 must not
+        leave the guard armed (and displayed) forever."""
+        ev = gov.on_window(TELE, tick_ms_p90=None)
+        if ev is None:  # warm race margin: commit on the next window
+            ev = gov.on_window(TELE, tick_ms_p90=None)
+        assert ev is not None
+        gov.on_window(TELE, tick_ms_p90=8.0)
+        assert gov._regret is None  # disarmed, not stuck
+
+    def test_swap_vindicated_when_p90_holds(self, gov):
+        ev = gov.on_window(TELE, tick_ms_p90=5.0)
+        assert ev is not None
+        assert gov.on_window(TELE, tick_ms_p90=5.2) is None
+        assert gov.on_window(TELE, tick_ms_p90=5.1) is None
+        assert gov._regret is None  # disarmed after the judge windows
+        assert gov.current == "skin=0"
+
+    def test_snapshot_and_registry(self, gov):
+        gov_mod.register("gtest", gov)
+        try:
+            gov.on_window(TELE, tick_ms_p90=5.0)
+            snap = gov_mod.snapshot()
+            assert "gtest" in snap
+            g = snap["gtest"]
+            assert {"current", "pending", "swaps", "policy",
+                    "warmset", "regret_guard"} <= set(g)
+            json.dumps(snap)  # endpoint-serializable
+        finally:
+            gov_mod.unregister("gtest")
+
+    def test_governor_endpoint(self, gov):
+        from goworld_tpu.utils import debug_http
+
+        gov_mod.register("gep", gov)
+        srv = debug_http.start(0)
+        try:
+            port = srv.server_address[1]
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/governor",
+                    timeout=5) as r:
+                payload = json.loads(r.read())
+            assert "gep" in payload
+            assert "current" in payload["gep"]
+        finally:
+            gov_mod.unregister("gep")
+            srv.shutdown()
+
+    def test_empty_registry_is_honest(self):
+        gov_mod.reset()
+        assert "error" in gov_mod.snapshot()
+
+
+# ----------------------------------------------------------------------
+# flight recorder trigger
+# ----------------------------------------------------------------------
+class TestFlightRecTrigger:
+    def test_governor_swap_trigger_freezes_context(self):
+        from goworld_tpu.utils import flightrec
+
+        ctx = {"governor": {"current": "skin=0", "swaps": ["#1 ..."]}}
+        rec = flightrec.FlightRecorder(
+            ring=16, cooldown_secs=0.0, context_fn=lambda: dict(ctx))
+        for t in range(4):
+            assert rec.record({"tick": t, "tick_ms": 1.0,
+                               "budget_ms": 10.0}) == []
+        out = rec.record({"tick": 4, "tick_ms": 1.0, "budget_ms": 10.0,
+                          "governor": "default->skin=0 (policy)"})
+        assert len(out) == 1
+        b = out[0]
+        assert b["trigger"] == "governor_swap"
+        assert "default->skin=0" in b["detail"]
+        assert b["context"]["governor"]["current"] == "skin=0"
+        assert len(b["frames"]) == 5
+
+    def test_no_governor_mark_no_trigger(self):
+        from goworld_tpu.utils import flightrec
+
+        rec = flightrec.FlightRecorder(ring=16, cooldown_secs=0.0)
+        for t in range(8):
+            assert rec.record({"tick": t, "tick_ms": 1.0,
+                               "budget_ms": 10.0}) == []
+
+
+# ----------------------------------------------------------------------
+# config / api plumbing
+# ----------------------------------------------------------------------
+class TestConfigPlumbing:
+    def test_governor_knobs_parse(self, tmp_path):
+        from goworld_tpu import config as config_mod
+
+        ini = tmp_path / "goworld_tpu.ini"
+        ini.write_text(
+            "[game1]\ngovernor = true\ngovernor_window_ticks = 32\n"
+            "governor_regret_pct = 0.5\n"
+            "governor_table = teleport_like:skin=0\n"
+        )
+        cfg = config_mod.load(str(ini))
+        gc = cfg.games[1]
+        assert gc.governor is True
+        assert gc.governor_window_ticks == 32
+        assert gc.governor_regret_pct == 0.5
+        assert gc.governor_table == "teleport_like:skin=0"
+
+    def test_eligibility_gate(self):
+        from goworld_tpu import config as config_mod
+        from goworld_tpu.api import _governor_eligible
+
+        gc = config_mod.GameConfig(governor=True)
+        assert _governor_eligible(gc, 1) is True
+        assert _governor_eligible(
+            config_mod.GameConfig(governor=False), 1) is False
+        for bad in (dict(n_spaces=2), dict(mesh_devices=4),
+                    dict(megaspace=True, mesh_devices=4),
+                    dict(telemetry_live=False)):
+            gc = config_mod.GameConfig(governor=True, **bad)
+            assert _governor_eligible(gc, 1) is False
+        with pytest.raises(ValueError):
+            _governor_eligible(
+                config_mod.GameConfig(
+                    governor=True, governor_table="bogus:skin=0"), 1)
+
+    def test_scraper_governor_lines(self):
+        import importlib.util
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        spec = importlib.util.spec_from_file_location(
+            "scrape_metrics_under_test",
+            os.path.join(repo, "tools", "scrape_metrics.py"))
+        scraper = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(scraper)
+        lines = scraper.governor_lines({
+            "game1": {"game1": {
+                "current": "skin=0", "pending": "default",
+                "windows": 9, "swaps": ["#3 default->skin=0 policy"],
+                "regret_guard": None,
+            }}})
+        assert len(lines) == 1
+        assert "governor skin=0" in lines[0]
+        assert "-> default (warming)" in lines[0]
+        assert "swaps 1 over 9 windows" in lines[0]
+
+
+# ----------------------------------------------------------------------
+# the chaos-soak governor scenario (slow: ~8 synchronous compiles)
+# ----------------------------------------------------------------------
+@pytest.mark.slow
+def test_chaos_soak_governor_scenario_converges():
+    """tools/chaos_soak.py --scenario governor end-to-end: >= 3 live
+    swaps on one world, zero oracle divergence, zero entity loss, and
+    the decision log replay-verified — the ISSUE-13 soak satellite."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "chaos_soak_under_test",
+        os.path.join(repo, "tools", "chaos_soak.py"))
+    soak = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(soak)
+    report = soak.run_governor(seed=7)
+    assert report.get("error") is None, report
+    assert len(report["swaps"]) >= 3, report["swaps"]
+    assert report["mismatches"] == []
+    assert report["entity_ids_stable"]
+    assert report["replay_matches"]
+    assert report["converged"], report
+
+
+# ----------------------------------------------------------------------
+# GameServer window drive (stub-light: the real wiring, no cluster)
+# ----------------------------------------------------------------------
+class TestGameServerDrive:
+    def test_drive_commits_on_rotated_windows(self, flock_world,
+                                              warmset, monkeypatch):
+        from goworld_tpu.net.game import GameServer
+
+        w, _e, _c = flock_world
+        gs = GameServer(97, w, [], governor_enabled=True,
+                        governor_up_windows=1,
+                        governor_cooldown_windows=0,
+                        governor_window_ticks=8,
+                        flightrec_ring=32,
+                        overload_enabled=False)
+        assert gs.governor is not None
+        gs.governor.warmset = warmset  # pre-compiled candidates
+        assert w.SIG_WINDOW_TICKS == 8
+        # simulate a rotated window carrying a teleport-like signature
+        monkeypatch.setattr(w, "window_signature", lambda: dict(TELE))
+        w._telem_win_tick = 123  # "a rotation happened"
+        ev = gs._drive_governor()
+        assert ev is not None and ev["to"] == "skin=0"
+        assert "skin=0.0" in gs._kernel_key
+        # same window tick: no double drive
+        assert gs._drive_governor() is None
+        # the frame stamp fires the flight-recorder trigger
+        gs._flightrec_frame(0.001, ev)
+        incidents = gs.flightrec.incidents()
+        assert any(i["trigger"] == "governor_swap" for i in incidents)
+        ctx = [i for i in incidents
+               if i["trigger"] == "governor_swap"][-1]["context"]
+        assert ctx["governor"]["current"] == "skin=0"

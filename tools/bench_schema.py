@@ -39,7 +39,12 @@ contract):
   block (resolved plane on/off, pos scale bits, delta-sync keyframe
   cadence) next to the kernel stamps, plus the ``precision_ab``
   on/off A/B record (measured marginal both ways + modeled bytes at
-  the shape and at 1M; honest error/skip records accepted).
+  the shape and at 1M; honest error/skip records accepted);
+* rounds >= 13 (the kernel-governor era, ISSUE 13): a ``governor``
+  block — the ``bench.py --governor`` phase-switching schedule
+  (per-phase chosen config + swap latency, throughput vs best/worst
+  static) when it ran, or an honest ``{"skipped": "--governor not
+  requested"}`` / ``{"error": ...}`` record otherwise.
 
 Exit codes: 0 all valid, 1 usage/missing, 2 schema violations.
 """
@@ -89,6 +94,11 @@ WORKLOAD_SIG_KEYS = ("sig", "churn", "density", "events",
 # failure, the device-plane convention)
 PRECISION_SINCE = 12
 PRECISION_KEYS = ("plane", "pos_scale_bits", "sync_keyframe_every")
+# the kernel-governor era (ISSUE 13): bench.py --governor stamps the
+# phase-switching schedule block; rounds that didn't run it must say
+# so honestly ({"skipped"/"error": ...} — the device-plane convention)
+GOVERNOR_SINCE = 13
+GOVERNOR_KEYS = ("schedule", "phases", "throughput", "static_wall_s")
 MULTI_HEADLINE_KEYS = ("entity_ticks_per_sec_mesh",
                        "per_chip_efficiency", "n_entities", "platform")
 MULTI_GAUGE_KEYS = ("halo_demand_max", "migrate_demand_max",
@@ -159,6 +169,16 @@ def validate_bench(path: str, doc: dict) -> list[str]:
         _check_block(rec, "precision_ab",
                      ("off_ms", "q16_ms", "model_off_gb_1m",
                       "model_q16_gb_1m"), errs)
+    if rno >= GOVERNOR_SINCE:
+        _check_block(rec, "governor", GOVERNOR_KEYS, errs)
+        gv = rec.get("governor")
+        if isinstance(gv, dict) and "error" not in gv \
+                and "skipped" not in gv:
+            for ph in gv.get("phases") or []:
+                if not isinstance(ph, dict) or not (
+                        {"scenario", "chosen", "expected"} <= set(ph)):
+                    errs.append(
+                        f"governor phase record malformed: {ph!r:.120}")
     # per-scenario blocks, wherever present: each needs either a
     # headline-style shape or an honest error
     for sc, blk in (rec.get("scenarios") or {}).items():

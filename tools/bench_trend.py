@@ -75,7 +75,63 @@ def load_headline(path: str) -> dict | None:
 
 
 def _shape(rec: dict) -> tuple:
-    return (rec.get("entities"), rec.get("platform"))
+    """(entities, platform, mode): a headline measured under a
+    governor schedule (``bench_mode = "governor"``) anchors its OWN
+    series — its number includes swap dynamics and a scenario
+    schedule, so gating it against a static-workload round (or vice
+    versa) would compare different experiments. NOTE: today's
+    ``bench.py --governor`` keeps the headline static and stamps the
+    schedule as a separate ``governor`` block (gated by its own
+    series below) — no current round stamps ``bench_mode``; this
+    component is the enforcement hook for a future round whose
+    HEADLINE runs governed, kept so such an artifact can never
+    silently gate against the static history."""
+    return (rec.get("entities"), rec.get("platform"),
+            rec.get("bench_mode", "static"))
+
+
+def _check_governor_series(rounds: list, latest: dict, name: str,
+                           threshold: float, problems: list[str],
+                           notes: list[str]) -> None:
+    """The governor schedule block (ISSUE 13): its throughput is a
+    series of its own, gated against the best prior round that ran
+    the SAME (n, platform, schedule) shape — never against static
+    headlines (and static headlines never gate against it).
+    Skipped/error records neither gate nor anchor."""
+    def _gov_ok(g) -> bool:
+        return (isinstance(g, dict)
+                and isinstance(g.get("throughput"), (int, float))
+                and g["throughput"] > 0)
+
+    lgov = latest.get("governor")
+    if not _gov_ok(lgov):
+        return
+    gshape = (lgov.get("n"), latest.get("platform"),
+              tuple(lgov.get("schedule") or ()))
+    gprior = [
+        (p, r["governor"]) for p, r in rounds[:-1]
+        if _gov_ok(r.get("governor"))
+        and (r["governor"].get("n"), r.get("platform"),
+             tuple(r["governor"].get("schedule") or ())) == gshape
+    ]
+    if not gprior:
+        notes.append(f"{name}: governor shape {gshape} has no "
+                     "prior round — not gated")
+        return
+    gbest_path, gbest = max(gprior, key=lambda pr: pr[1]["throughput"])
+    gfloor = (1.0 - threshold) * gbest["throughput"]
+    if lgov["throughput"] < gfloor:
+        problems.append(
+            f"{name}: governor throughput "
+            f"{lgov['throughput']:.0f} < {gfloor:.0f} "
+            f"({(1 - threshold) * 100:.0f}% of "
+            f"{os.path.basename(gbest_path)}'s "
+            f"{gbest['throughput']:.0f})")
+    else:
+        notes.append(
+            f"{name}: governor throughput "
+            f"{lgov['throughput']:.0f} vs best prior "
+            f"{gbest['throughput']:.0f} — ok")
 
 
 def check_bench(files: list[str], threshold: float,
@@ -93,6 +149,13 @@ def check_bench(files: list[str], threshold: float,
         return
     latest_path, latest = rounds[-1]
     name = os.path.basename(latest_path)
+    # the governor schedule block (ISSUE 13) gates FIRST: its series
+    # is keyed by its own (n, platform, schedule) shape, independent
+    # of the headline's — a round that changes the headline shape
+    # (no headline prior -> early return below) must not silently
+    # skip the governor comparison
+    _check_governor_series(rounds, latest, name, threshold,
+                           problems, notes)
     prior = [(p, r) for p, r in rounds[:-1]
              if _shape(r) == _shape(latest)]
     if not prior:

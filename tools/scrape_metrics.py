@@ -214,6 +214,49 @@ def workload_lines(scraped: dict[str, dict]) -> list[str]:
     return lines
 
 
+def scrape_governor(targets: list[tuple[str, str]],
+                    timeout: float = 2.0) -> dict[str, dict]:
+    """Fetch each target's ``/governor`` (goworld_tpu/autotune);
+    {label: payload}. Unreachable/404/provider-less processes are
+    skipped silently — the ``/costs`` convention."""
+    out: dict[str, dict] = {}
+    for label, url in targets:
+        gov_url = url.rsplit("/", 1)[0] + "/governor"
+        try:
+            with urllib.request.urlopen(gov_url,
+                                        timeout=timeout) as resp:
+                payload = json.loads(
+                    resp.read().decode("utf-8", "replace"))
+        except (urllib.error.URLError, OSError, ValueError):
+            continue
+        if isinstance(payload, dict) and "error" not in payload:
+            out[label] = payload
+    return out
+
+
+def governor_lines(scraped: dict[str, dict]) -> list[str]:
+    """One kernel-governor line per process with a live governor
+    (``cli.py status`` prints these under the workload lines):
+    current config key, pending warm target, swap count, regret
+    state."""
+    lines: list[str] = []
+    for label, payload in sorted(scraped.items()):
+        for name, g in sorted(payload.items()):
+            if not isinstance(g, dict) or "current" not in g:
+                continue
+            line = (f"{label}: governor {g['current']}"
+                    + (f" -> {g['pending']} (warming)"
+                       if g.get("pending") else "")
+                    + f" | swaps {len(g.get('swaps', []))}"
+                    + f" over {g.get('windows', 0)} windows")
+            reg = g.get("regret_guard")
+            if isinstance(reg, dict):
+                line += (f" | regret watch (revert to "
+                         f"{reg.get('revert_to')})")
+            lines.append(line)
+    return lines
+
+
 def slo_lines(costs: dict[str, dict]) -> list[str]:
     """One human line per process: the SLO verdict (or its absence)."""
     lines: list[str] = []

@@ -19,6 +19,10 @@ dies mid-run:
      1c (interpret-mode async halo + an N-device mesh emulated on CPU
      would stall the session; the tier-1 multichip marker covers the
      small-N CPU truth).
+  2b. governor-vs-best-static A/B (ISSUE 13): the full phase-switching
+     schedule through bench.measure_governor at the probe shape, so a
+     relay window audits the CPU-derived kernel mapping tables against
+     silicon. TPU-only; `bench.py --governor` records the CPU truth.
   3. back-half stage bisect (gather / +key / +topk / +final-sort).
   4. collect-phase bisect (interest_pairs / collect_sync / attrs).
   5. move-phase bisect (inputs scatter / random_walk / integrate).
@@ -395,6 +399,52 @@ else:
           "interpret-mode async halo over emulated devices would "
           "stall the session — the tier-1 `-m multichip` suite covers "
           "the small-N CPU truth)", flush=True)
+
+# ---- 2b. governor-vs-best-static A/B at the bench shape (ISSUE 13) --
+# The full phase-switching schedule (flock -> teleport -> hotspot)
+# through bench.measure_governor: the governor's end-to-end throughput
+# vs every static candidate pin, per-phase chosen configs and swap
+# latencies — ON HARDWARE, so ROADMAP item 1's relay window audits the
+# CPU-derived mapping tables (and the regret thresholds) against
+# silicon. TPU-only: the CPU truth is recorded by `bench.py
+# --governor` into every round artifact.
+if on_tpu():
+    try:
+        if "bench" not in sys.modules:
+            import importlib.util as _ilu2
+
+            _bs2 = _ilu2.spec_from_file_location(
+                "bench", os.path.join(os.path.dirname(os.path.dirname(
+                    os.path.abspath(__file__))), "bench.py"))
+            _bench2 = _ilu2.module_from_spec(_bs2)
+            sys.modules["bench"] = _bench2
+            _bs2.loader.exec_module(_bench2)
+        _bench_g = sys.modules["bench"]
+        g = _bench_g.measure_governor(N)
+        print(f"governor@{g['n']} schedule {'->'.join(g['schedule'])} "
+              f"{g['throughput']:12.0f} et/s over {g['ticks']} ticks "
+              f"({g['swaps_total']} swaps, prewarm {g['prewarm_s']}s)",
+              flush=True)
+        for ph in g.get("phases", []):
+            print(f"governor phase {ph['scenario']:10s} chosen="
+                  f"{ph['chosen']:22s} expected={ph['expected']:22s} "
+                  f"swap_latency={ph['swap_latency_ticks']} ticks",
+                  flush=True)
+        for lbl, s in sorted((g.get("static_wall_s") or {}).items()):
+            print(f"governor static {lbl:24s} {s!s:>10} s", flush=True)
+        print(f"governor vs_best_static {g.get('vs_best_static')} "
+              f"(best {str((g.get('best_static') or {}).get('label'))}"
+              f", worst "
+              f"{str((g.get('worst_static') or {}).get('label'))}; "
+              f"compile-free={g.get('trace_counts_stable')})",
+              flush=True)
+    except Exception as exc:
+        print(f"governor@{N} schedule            FAILED: "
+              f"{str(exc)[:200]}", flush=True)
+else:
+    print(f"governor@{N} vs-best-static      SKIP (no TPU backend; "
+          "the CPU schedule truth is stamped by `bench.py --governor` "
+          "into every round artifact)", flush=True)
 
 # ---- 3. back-half stage bisect (table impl, no flags) ---------------
 
