@@ -63,6 +63,16 @@ in-graph telemetry scan + slo stamp; BENCH_SLO_MS (default 16.0, the
 paper's p99 target) sets the budget; BENCH_SLO_TICKS (default 64) the
 histogram scan length. `--check-slo` turns the stamped verdict into
 the exit code.
+
+End-to-end sync-age block (ISSUE 15): every round stamps a
+``sync_age`` block — the device-tick-epoch -> gate-delivery age
+measured through a REAL game -> dispatcher -> gate loopback over
+localhost sockets (utils/syncage.py), per-hop p50/p90/p99 + an e2e
+verdict vs BENCH_SLO_MS, plus the micro-measured overhead of the
+always-on stamp (< 1% of the 60 Hz budget is the criterion).
+BENCH_SYNC_AGE=0 skips (recorded honestly); BENCH_SYNC_AGE_RECORDS
+(default 32768) / _CLIENTS (16) / _TICKS (64) / _HZ (50) shape it;
+BENCH_SYNC_AGE_DELTA=1 runs the 1505 delta-codec leg instead.
 """
 
 import argparse
@@ -1153,6 +1163,189 @@ def measure_governor(n: int, grid_overrides: dict | None = None) -> dict:
         f"ticks, {out['swaps_total']} swaps, vs_best_static="
         f"{out.get('vs_best_static')}")
     return out
+
+
+def measure_sync_age() -> dict:
+    """End-to-end sync-age block (ISSUE 15): the paper's REAL SLO —
+    device-tick epoch to gate delivery — measured through a live
+    game -> dispatcher -> gate loopback over real localhost sockets
+    (the production wire, codec, stamp and flush paths; nothing
+    simulated). Per tick the game fans out BENCH_SYNC_AGE_RECORDS
+    stamped records (default 32768 — the sync volume scale of the
+    131K bench shape at the default client fraction, shape stamped
+    honestly) to BENCH_SYNC_AGE_CLIENTS connected bot clients; the
+    gate ages every delivered record (utils/syncage.py) and this
+    block reduces the histograms to per-hop p50/p90/p99 plus ONE e2e
+    verdict vs BENCH_SLO_MS.
+
+    Also stamps the measured overhead of the always-on stamp: the
+    per-tick work the plane adds (wall reads + 45 B trailer pack on
+    the game, unpack + 6 weighted histogram inserts on the gate) is
+    micro-timed and reported as a fraction of the 1/60 s tick budget
+    — the acceptance criterion is < 1%."""
+    import threading as _threading
+
+    import numpy as np
+
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.entity.entity import Entity
+    from goworld_tpu.entity.manager import World
+    from goworld_tpu.net.botclient import BotClient
+    from goworld_tpu.net.game import GameServer
+    from goworld_tpu.net.standalone import ClusterHarness
+    from goworld_tpu.ops.aoi import GridSpec
+    from goworld_tpu.utils import syncage
+
+    records = int(os.environ.get("BENCH_SYNC_AGE_RECORDS", 32768))
+    n_clients = int(os.environ.get("BENCH_SYNC_AGE_CLIENTS", 16))
+    ticks = int(os.environ.get("BENCH_SYNC_AGE_TICKS", 64))
+    target_ms = float(os.environ.get("BENCH_SLO_MS", 16.0))
+    tick_hz = float(os.environ.get("BENCH_SYNC_AGE_HZ", 50.0))
+    use_delta = os.environ.get("BENCH_SYNC_AGE_DELTA") == "1"
+
+    class _BenchAccount(Entity):
+        ATTRS: dict = {}
+
+    harness = ClusterHarness(n_dispatchers=1, n_gates=1,
+                             desired_games=1)
+    harness.start()
+    gs = None
+    stop = _threading.Event()
+    loop_thread = None
+    try:
+        cfg = WorldConfig(
+            capacity=256,
+            grid=GridSpec(radius=50.0, extent_x=200.0,
+                          extent_z=200.0),
+            input_cap=256,
+        )
+        world = World(cfg, n_spaces=1)
+        world.register_entity("Account", _BenchAccount)
+        world.create_nil_space()
+        gs = GameServer(1, world, list(harness.dispatcher_addrs),
+                        boot_entity="Account",
+                        gc_freeze_on_boot=False,
+                        tick_interval=1.0 / tick_hz,
+                        sync_delta=use_delta)
+        gs.start_network()
+        # injection armed by the main thread once the bots are in;
+        # the fan-out is staged ON the logic thread (the production
+        # threading model — _sync_sink is a logic-thread edge)
+        inject: dict = {"batch": None, "ticks_left": 0}
+
+        def run_loop() -> None:
+            while not stop.is_set():
+                gs.pump()
+                if inject["ticks_left"] > 0 and \
+                        inject["batch"] is not None:
+                    gs._sync_sink(1, *inject["batch"])
+                    inject["ticks_left"] -= 1
+                gs.tick()
+                time.sleep(1.0 / tick_hz)
+
+        loop_thread = _threading.Thread(target=run_loop, daemon=True)
+        loop_thread.start()
+        if not gs.ready_event.wait(30):
+            return {"error": "loopback deployment never became ready"}
+
+        host, port = harness.gate_addrs[0]
+        bots = [BotClient(host, port, bot_id=i)
+                for i in range(n_clients)]
+
+        async def drain(bot) -> None:
+            await bot.connect()
+            try:
+                await bot._recv_loop()
+            except Exception:
+                pass
+
+        for b in bots:
+            harness.submit(drain(b))
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            live = [e for e in world.entities.values()
+                    if e.client is not None]
+            if len(live) >= n_clients:
+                break
+            time.sleep(0.05)
+        live = [e for e in world.entities.values()
+                if e.client is not None]
+        if not live:
+            return {"error": "no bot client reached the game"}
+        # synthetic fan-out at the bench record volume through the
+        # REAL flush: cids resolve to the live bot connections, so
+        # every record travels game -> dispatcher -> gate -> socket
+        per_client = max(1, records // len(live))
+        cids = np.repeat(
+            np.asarray([e.client.client_id for e in live], "S16"),
+            per_client)
+        eids = np.asarray(
+            [(b"E%015d" % (i % 1000)) for i in range(len(cids))],
+            "S16")
+        rng = np.random.default_rng(0)
+        vals = rng.random((len(cids), 4), dtype=np.float32)
+        tracker = harness.gates[0].syncage
+        base_batches = int(tracker.snapshot()["batches"])
+        inject["batch"] = (cids, eids, vals)
+        inject["ticks_left"] = ticks
+        deadline = time.monotonic() + max(30.0, 4.0 * ticks / tick_hz)
+        while time.monotonic() < deadline and (
+                inject["ticks_left"] > 0
+                or int(tracker.snapshot()["batches"])
+                < base_batches + ticks // 2):
+            time.sleep(0.1)
+        snap = tracker.snapshot()
+        if not snap["e2e"].get("samples"):
+            # every degraded path records an honest error (the schema
+            # contract): a zero-delivery run must not stamp a block
+            # with no percentile shape
+            return {"error": "no stamped deliveries reached the gate "
+                             f"({len(live)} clients, {ticks} ticks)"}
+        out: dict = {
+            "target_ms": target_ms,
+            "records_per_tick": int(len(cids)),
+            "clients": len(live),
+            "ticks": ticks,
+            "tick_hz": tick_hz,
+            "sync_delta": use_delta,
+            "e2e": snap["e2e"],
+            "hops": {h: snap["hops"][h] for h in syncage.HOPS},
+            "clock_warp_total": snap["clock_warp_total"],
+        }
+        p99 = snap["e2e"].get("p99_ms")
+        out["pass"] = bool(isinstance(p99, (int, float))
+                           and p99 <= target_ms)
+        # measured overhead of the always-on stamp: everything the
+        # plane adds per tick (game-side wall reads + pack, the
+        # dispatcher patch, gate-side unpack + 6 weighted inserts),
+        # micro-timed over the REAL tracker at this batch size
+        stamp = syncage.SyncAgeStamp(1, syncage.now_us(),
+                                     syncage.now_us())
+        reps = 2000
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            stamp.t_stage_us = syncage.now_us()
+            stamp.t_send_us = syncage.now_us()
+            wire = stamp.pack()
+            back = syncage.SyncAgeStamp.unpack(wire)
+            back.t_disp_us = syncage.now_us()
+            tracker.observe(back, syncage.now_us(), len(cids))
+        per_tick_us = (time.perf_counter() - t0) / reps * 1e6
+        budget_us = 1e6 / 60.0  # the paper's 60 Hz frame
+        out["stamp_overhead_us_per_tick"] = round(per_tick_us, 2)
+        out["stamp_overhead_pct_of_budget"] = round(
+            100.0 * per_tick_us / budget_us, 4)
+        log(f"sync_age: e2e {snap['e2e']} over {len(cids)} rec/tick "
+            f"x {ticks} ticks, stamp overhead "
+            f"{out['stamp_overhead_pct_of_budget']}% of 16.7 ms")
+        return out
+    finally:
+        stop.set()
+        if loop_thread is not None:
+            loop_thread.join(timeout=5)
+        if gs is not None:
+            gs.stop()
+        harness.stop()
 
 
 def measure(n: int, ticks: int, client_frac: float, phases: bool,
@@ -2413,6 +2606,18 @@ def child_main(args) -> int:
                 g = {"error": str(exc)[:300]}
             g["stage"] = "governor"
             print(json.dumps(g), flush=True)
+        if name == "full" \
+                and os.environ.get("BENCH_SYNC_AGE", "1") == "1":
+            # the end-to-end sync-age loopback (ISSUE 15), AFTER the
+            # headline line is safely on stdout (the p99/scenario
+            # contract: a host-harness wedge must never zero the round)
+            try:
+                sa = measure_sync_age()
+            except Exception as exc:
+                log(f"sync_age stage failed: {exc}")
+                sa = {"error": str(exc)[:300]}
+            sa["stage"] = "sync_age"
+            print(json.dumps(sa), flush=True)
         if name == "full" and p99_args is not None \
                 and os.environ.get("BENCH_SKIP_P99") != "1":
             # separate stage AFTER the headline line is on stdout: a
@@ -2572,6 +2777,7 @@ def parent_main() -> int:
     p99_shard = None     # same, at the 131K north-star per-chip shard
     scen = None          # the per-scenario headline blocks (ISSUE 7)
     gov = None           # the governor schedule block (ISSUE 13)
+    sage = None          # the sync-age loopback block (ISSUE 15)
     variants = {}        # config-5 behavior variants (btree/mlp)
 
     live_stages: list = []   # current child's streamed stages
@@ -2583,7 +2789,7 @@ def parent_main() -> int:
         has OFFICIALLY completed, stages streamed from the in-flight
         child count too (they are per-line complete results)."""
         b, sb, pt = best, suspect_best, partial
-        cp99, cp99s, csc, cgov = p99, p99_shard, scen, gov
+        cp99, cp99s, csc, cgov, csage = p99, p99_shard, scen, gov, sage
         if b is None:
             for s in list(live_stages):
                 st = s.get("stage")
@@ -2600,6 +2806,8 @@ def parent_main() -> int:
                     csc = s
                 elif st == "governor":
                     cgov = s
+                elif st == "sync_age":
+                    csage = s
                 elif pt is None:
                     pt = s
         chosen = b or sb or pt
@@ -2611,6 +2819,7 @@ def parent_main() -> int:
             cp99s = None
             csc = None
             cgov = None
+            csage = None
         if chosen is not None and cp99 is not None:
             chosen = dict(chosen)
             for k in ("tick_p50_ms", "tick_p99_ms",
@@ -2663,6 +2872,19 @@ def parent_main() -> int:
                 chosen["governor"] = {
                     "skipped": "--governor not requested"
                 }
+            # the sync-age block is ALWAYS stamped from r15 on (the
+            # bench_schema contract): the measured game->gate loopback
+            # when the stage ran, an honest skip/error record otherwise
+            if csage is not None:
+                chosen["sync_age"] = {
+                    k: v for k, v in csage.items() if k != "stage"
+                }
+            elif os.environ.get("BENCH_SYNC_AGE", "1") == "1":
+                chosen["sync_age"] = {
+                    "error": "sync_age stage never completed"
+                }
+            else:
+                chosen["sync_age"] = {"skipped": "BENCH_SYNC_AGE=0"}
         result = {
             "metric": "entity_ticks_per_sec_per_chip",
             "value": 0.0,
@@ -2742,6 +2964,7 @@ def parent_main() -> int:
         child_p99_shard = None
         child_scen = None
         child_gov = None
+        child_sage = None
         got_best = False
         for s in stages:
             if s.get("stage") == "p99":
@@ -2755,6 +2978,9 @@ def parent_main() -> int:
                 continue
             if s.get("stage") == "governor":
                 child_gov = s
+                continue
+            if s.get("stage") == "sync_age":
+                child_sage = s
                 continue
             partial = s
             if s.get("stage") == "full":
@@ -2776,6 +3002,7 @@ def parent_main() -> int:
             p99_shard = child_p99_shard
             scen = child_scen
             gov = child_gov
+            sage = child_sage
         attempts_log.append({
             "attempt": i + 1, "env": {},
             "stages": [s.get("stage") for s in stages],
@@ -2822,6 +3049,7 @@ def parent_main() -> int:
         child_p99_shard = None
         child_scen = None
         child_gov = None
+        child_sage = None
         got_best = False
         for s in stages:
             if s.get("stage") == "p99":
@@ -2832,6 +3060,8 @@ def parent_main() -> int:
                 child_scen = s
             elif s.get("stage") == "governor":
                 child_gov = s
+            elif s.get("stage") == "sync_age":
+                child_sage = s
             elif s.get("stage") == "full":
                 # same rule as the TPU loop: a full stage that failed its
                 # 2x-scale self-check never becomes the headline
@@ -2846,6 +3076,7 @@ def parent_main() -> int:
         p99_shard = child_p99_shard if got_best else None
         scen = child_scen if got_best else None
         gov = child_gov if got_best else None
+        sage = child_sage if got_best else None
 
     # BASELINE config 5 (fused NPC behavior kernels): once a TPU headline
     # is in hand, time the btree and mlp behaviors at the same N so the
@@ -2944,6 +3175,8 @@ def selftest_main() -> int:
         "BENCH_P99_SHARD_N": "1024", "BENCH_N_CPU": "2048",
         "BENCH_CHILD_TIMEOUT": "420", "BENCH_TIME_REPEATS": "2",
         "BENCH_SCENARIO_N": "512", "BENCH_SCENARIO_TICKS": "2",
+        "BENCH_SYNC_AGE_RECORDS": "2048",
+        "BENCH_SYNC_AGE_CLIENTS": "4", "BENCH_SYNC_AGE_TICKS": "24",
     }
     failures: list[str] = []
     report: dict = {}
@@ -3137,6 +3370,27 @@ def selftest_main() -> int:
                 check(f"full.governor.phase.{ph.get('scenario')}",
                       {"chosen", "expected", "swap_latency_ticks",
                        "window_ms"} <= set(ph), str(ph)[:160])
+        # the sync-age loopback block (ISSUE 15; r>=15 schema rule):
+        # on the selftest shape the real game->gate harness must land
+        # — an {"error": ...} record here IS harness rot
+        sa = art.get("sync_age", {})
+        check("full.sync_age", isinstance(sa, dict)
+              and {"target_ms", "e2e", "hops", "records_per_tick",
+                   "pass"} <= set(sa), str(sa)[:200])
+        if "hops" in sa:
+            from goworld_tpu.utils.syncage import HOPS as _HOPS
+
+            for hop in _HOPS:
+                check(f"full.sync_age.hop.{hop}",
+                      hop in sa["hops"]
+                      and sa["hops"][hop].get("samples", 0) > 0,
+                      str(sa["hops"].get(hop))[:120])
+            check("full.sync_age.samples",
+                  sa.get("e2e", {}).get("samples", 0) > 0,
+                  str(sa.get("e2e"))[:120])
+            check("full.sync_age.overhead",
+                  sa.get("stamp_overhead_pct_of_budget", 100.0) < 1.0,
+                  str(sa.get("stamp_overhead_pct_of_budget")))
         check("full.p99", "tick_p99_ms" in art, "missing p99 keys")
         check("full.p99_gate", "p99_suspect" not in art,
               art.get("p99_suspect", ""))

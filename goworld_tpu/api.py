@@ -470,6 +470,7 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
             flightrec_cooldown_secs=gc.flightrec_cooldown_secs,
             sync_delta=gc.sync_delta,
             sync_keyframe_every=gc.sync_keyframe_every,
+            sync_age=gc.sync_age,
             # online kernel governor (goworld_tpu/autotune): eligible
             # shapes only — megaspace/mesh kernel choice stays the TPU
             # A/B plane's job, said loudly instead of silently ignored

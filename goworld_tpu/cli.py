@@ -844,9 +844,42 @@ def cmd_status(server_dir: str) -> int:
                 [t for t in targets if t[0] in results])
             for line in scraper.governor_lines(gv):
                 print(line)
+            # ONE deployment-wide sync-age verdict: the merged
+            # end-to-end age-at-delivery vs the paper's 16 ms target
+            # (tools/obs_aggregate.py; unreachable/old processes
+            # skipped silently, the /costs convention)
+            agg_tool = _load_tool("obs_aggregate")
+            if agg_tool is not None:
+                bases = [(label, url.rsplit("/", 1)[0])
+                         for label, url in targets
+                         if label in results]
+                if bases:
+                    try:
+                        # tick_contrast off: status already scraped
+                        # /metrics; the verdict line never prints it
+                        print(agg_tool.verdict_line(agg_tool.aggregate(
+                            bases, tick_contrast=False)))
+                    except Exception:
+                        pass  # the verdict must never break status
             for e in errors:
                 print(f"metrics: {e}", file=sys.stderr)
     return 0 if all_up else 1
+
+
+def cmd_watch(server_dir: str, interval: float = 2.0,
+              once: bool = False) -> int:
+    """Live deployment sync-age dashboard: the merged e2e verdict +
+    per-hop lane table (tools/obs_aggregate.py), refreshed every
+    ``interval`` seconds until interrupted."""
+    agg_tool = _load_tool("obs_aggregate")
+    if agg_tool is None:
+        print("tools/obs_aggregate.py not available in this install",
+              file=sys.stderr)
+        return 1
+    argv = [server_dir]
+    if not once:
+        argv += ["--watch", str(interval)]
+    return agg_tool.main(argv)
 
 
 # =======================================================================
@@ -1028,6 +1061,7 @@ def cmd_run_gate(gateid: int, configfile: str | None,
             rate_limit_bps=gc.rate_limit_bps,
             downstream_max_bytes=gc.downstream_max_bytes,
             downstream_kick_secs=gc.downstream_kick_secs,
+            sync_age_target_ms=gc.sync_age_target_ms,
         )
         task = asyncio.ensure_future(svc.serve())
         await svc.started.wait()
@@ -1084,6 +1118,15 @@ def main(argv: list[str] | None = None) -> int:
     pw.add_argument("server_dir")
     pw.add_argument("--interval", type=float, default=2.0)
     pw.add_argument("--once", action="store_true")
+    pwa = sub.add_parser(
+        "watch",
+        help="live deployment sync-age verdict: merged e2e "
+             "age-at-delivery vs the 16 ms target, per-hop lanes "
+             "(tools/obs_aggregate.py)",
+    )
+    pwa.add_argument("server_dir")
+    pwa.add_argument("--interval", type=float, default=2.0)
+    pwa.add_argument("--once", action="store_true")
     ps = sub.add_parser(
         "supervise",
         help="start the cluster and keep it healthy: restart-on-crash "
@@ -1134,6 +1177,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "watchdog":
         return cmd_watchdog(args.server_dir, interval=args.interval,
                             once=args.once)
+    if args.cmd == "watch":
+        return cmd_watch(args.server_dir, interval=args.interval,
+                         once=args.once)
     if args.cmd == "supervise":
         return cmd_supervise(args.server_dir, interval=args.interval,
                              backoff_base=args.backoff_base,

@@ -211,6 +211,14 @@ class GameConfig:
     # full-precision keyframe cadence per (client, entity) pair for
     # the delta sync stream (ticks)
     sync_keyframe_every: int = 16
+    # end-to-end sync-age stamping (utils/syncage.py; docs/
+    # OBSERVABILITY.md "End-to-end sync age"): every sync fan-out
+    # batch carries the device-tick epoch that produced it as a 45 B
+    # flagged trailer; the gate ages records at delivery into
+    # sync_age_ms histograms and the deployment aggregator prints one
+    # SLO verdict against the paper's 16 ms target. false = the legacy
+    # byte-identical wire.
+    sync_age: bool = True
     # delta-compressed snapshot chain (freeze.py SnapshotChain): every
     # Nth periodic checkpoint is a full quantized keyframe, the writes
     # between ship sparse int16 plane deltas with per-plane CRCs.
@@ -281,6 +289,11 @@ class GateConfig:
     downstream_max_bytes: int = consts.GATE_DOWNSTREAM_MAX_BYTES
     downstream_kick_secs: float = consts.GATE_DOWNSTREAM_KICK_SECS
     position_sync_interval_ms: int = 100
+    # delivery target for the end-to-end sync-age verdict (ms): the
+    # paper's 16 ms AOI-sync SLO by default. Ages are measured at this
+    # gate's per-client flush (utils/syncage.py); a flush window whose
+    # e2e p99 blows the target freezes a sync_age_breach incident.
+    sync_age_target_ms: float = 16.0
     # reconnect pend queue budget (net/cluster.py; drop-oldest beyond)
     pend_max_packets: int = MAX_RECONNECT_PEND_PACKETS
     pend_max_bytes: int = MAX_RECONNECT_PEND_BYTES
@@ -558,6 +571,11 @@ extent_z = 1000.0
 #                          # vs per-(client,entity) baselines, 13 B vs
 #                          # 48 B/record steady state
 # sync_keyframe_every = 16 # full-precision keyframe cadence (ticks)
+# sync_age = false         # drop the 45 B per-batch sync-age stamp
+#                          # (default ON: gates age every record at
+#                          # delivery vs the paper's 16 ms target —
+#                          # docs/OBSERVABILITY.md "End-to-end sync
+#                          # age"; off = legacy byte-identical wire)
 # snapshot_keyframe_every = 8  # delta-compressed checkpoint chain:
 #                          # every Nth checkpoint is a full quantized
 #                          # keyframe (0 = monolithic checkpoints)

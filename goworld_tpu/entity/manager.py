@@ -292,6 +292,15 @@ class World:
         self._telem_win_tick = 0
         self._telem_last_window = None  # last COMPLETED window's delta
         self._pending_telem = None  # pipelined drain: last tick's acc
+        # sync-age provenance (utils/syncage.py): the device-tick epoch
+        # whose outputs the host is currently fanning out — (seq,
+        # tick-start wall us, outputs-host-visible wall us), captured at
+        # the EXISTING fetch-outputs transfer (two time.time() calls per
+        # tick, zero extra device syncs). Under pipeline_decode the mark
+        # swaps one tick back alongside the outputs, so the anchor
+        # always describes the epoch the staged sync records came from.
+        self.sync_age_anchor: tuple[int, int, int] | None = None
+        self._age_pending_mark: tuple[int, int] | None = None
         self._telem_feed_mark = None  # last metrics-fed cumulative
         # negative start: the FIRST drain feeds the registry (a fresh
         # process is scrapeable right away), then the cadence holds
@@ -1578,6 +1587,10 @@ class World:
 
     def _tick_phases(self, tl) -> None:
         t_start = time.perf_counter()
+        # sync-age epoch: this tick's state is decided by the inputs
+        # flushed below, so the age of everything it produces is
+        # measured from HERE (utils/syncage.py lane table)
+        age_mark = (self.tick_count, int(time.time() * 1e6))
         with tl.span("flush_staging"):
             if self._multihost and self.service_mgr is not None \
                     and self.mh_group_ready \
@@ -1638,6 +1651,12 @@ class World:
                 self._pending_telem, self._telem_acc
         else:
             acc_fetch = self._telem_acc
+        if self.pipeline_decode:
+            # the outputs fetched below are the PREVIOUS tick's: the
+            # age anchor follows them (same swap as _pending_outs), so
+            # the device_tick lane honestly includes the pipeline skew
+            age_mark, self._age_pending_mark = \
+                self._age_pending_mark, age_mark
         with tl.span("fetch_outputs"):
             acc_host = None
             if outs is not None and acc_fetch is not None:
@@ -1666,6 +1685,11 @@ class World:
                     # client enter message, or a user OnEnterAOI hook)
                     self._pos_cache = self._dget(self.state.pos)
                     self._yaw_cache = self._dget(self.state.yaw)
+        if outs is not None and age_mark is not None:
+            # outputs are host-visible NOW: close the device_tick lane
+            # (the GameServer's fan-out flush consumes this anchor)
+            self.sync_age_anchor = (age_mark[0], age_mark[1],
+                                    int(time.time() * 1e6))
         # under pipelining this measures dispatch + the blocking fetch
         # of the PREVIOUS tick's outputs — i.e. how long this frame
         # actually waited on the device, the number the 16 ms budget

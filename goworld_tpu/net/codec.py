@@ -335,8 +335,13 @@ class DeltaSyncEncoder:
         # key (32B cid+eid) -> [handle, base_tick, bx, by, bz, byaw]
         self._base: dict[bytes, list] = {}
         self._next_handle = 0
+        # keyframe_bytes/delta_bytes split the wire bytes BY RECORD
+        # KIND (wire_bytes additionally counts the 16 B batch headers):
+        # the sync-age plane correlates delivery staleness against
+        # wire mode through sync_bytes_out{kind} (net/game.py)
         self.stats = {"keyframes": 0, "deltas": 0, "wire_bytes": 0,
-                      "full_bytes": 0, "resets": 0}
+                      "full_bytes": 0, "resets": 0,
+                      "keyframe_bytes": 0, "delta_bytes": 0}
 
     def encode_batch(self, cids, eids, vals, tick: int) -> bytes:
         """(S16 cids, S16 eids, f32[N,4] vals) -> delta wire payload."""
@@ -381,11 +386,13 @@ class DeltaSyncEncoder:
                 out += struct.pack("<B", 0) + key \
                     + struct.pack("<Iffff", e[0], *e[2:6])
                 self.stats["keyframes"] += 1
+                self.stats["keyframe_bytes"] += 53
             else:
                 for j in range(4):     # decoder-identical chaining
                     e[2 + j] += dq[j] * steps[j]
                 out += struct.pack("<BIhhhh", 1, e[0], *dq)
                 self.stats["deltas"] += 1
+                self.stats["delta_bytes"] += 13
         self.stats["wire_bytes"] += len(out)
         self.stats["full_bytes"] += 48 * len(cids)
         return bytes(out)

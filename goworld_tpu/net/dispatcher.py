@@ -697,6 +697,12 @@ class DispatcherService:
         gate_id = pkt.read_u16()
         g = self.gates.get(gate_id)
         if g is not None:
+            if pkt.age is not None:
+                # close the dispatcher lane of the sync-age stamp: the
+                # forward instant separates game->dispatcher residence
+                # from dispatcher->gate (utils/syncage.py); the trailer
+                # is re-applied by wire_payload with this value
+                pkt.age.t_disp_us = int(time.time() * 1e6)
             g.send(pkt, release=False)
 
     def _h_to_gate(self, conn, role, msgtype, pkt: Packet) -> None:

@@ -31,7 +31,7 @@ from goworld_tpu.net import codec, proto
 from goworld_tpu.net.cluster import DispatcherCluster, DispatcherConn
 from goworld_tpu.net.packet import Packet, new_packet
 from goworld_tpu.utils import consts, faults, flightrec, log, metrics, \
-    opmon, overload, tracing
+    opmon, overload, syncage, tracing
 
 logger = log.get("game")
 
@@ -106,6 +106,7 @@ class GameServer:
         flightrec_cooldown_secs: float = flightrec.DEFAULT_COOLDOWN_SECS,
         sync_delta: bool = False,
         sync_keyframe_every: int = 16,
+        sync_age: bool = True,
         governor_enabled: bool = False,
         governor_window_ticks: int = 64,
         governor_up_windows: int = 2,
@@ -182,6 +183,23 @@ class GameServer:
         self.sync_delta = bool(sync_delta)
         self.sync_keyframe_every = max(1, int(sync_keyframe_every))
         self._sync_encoders: dict[int, "codec.DeltaSyncEncoder"] = {}
+        # end-to-end sync-age stamping (utils/syncage.py, [gameN]
+        # sync_age, default ON): every sync fan-out batch carries the
+        # device-tick epoch that produced it as a 45 B flagged trailer;
+        # the gate turns it into age-at-delivery histograms. Off =
+        # byte-identical legacy wire.
+        self.sync_age = bool(sync_age)
+        # downstream sync bytes split BY WIRE MODE so the age plane can
+        # correlate staleness with what actually went on the wire:
+        # full 48 B records vs delta-codec keyframe vs delta records
+        self._m_sync_bytes = {
+            kind: metrics.counter(
+                "sync_bytes_out",
+                help="downstream sync payload bytes by wire mode",
+                kind=kind)
+            for kind in ("full", "keyframe", "delta")
+        }
+        self._sync_bytes_mark = {"keyframe": 0, "delta": 0}
         # per-gate ordered (inner_msgtype, body) client messages staged
         # this tick; flushed as ONE MT_CLIENT_EVENTS_BATCH packet per
         # gate (before syncs, so a create precedes its entity's first
@@ -1078,6 +1096,16 @@ class GameServer:
         if self._event_recs_flushed:
             self._m_event_records.inc(self._event_recs_flushed)
         self._event_recs_flushed = 0
+        # sync-age stamp base for this flush: the world's device-tick
+        # anchor (epoch seq + tick-start + fetch instants) plus the
+        # flush-start instant closing the drain_decode lane. One
+        # time.time() per flush + 45 B per gate packet — the always-on
+        # budget (utils/syncage.py; bench stamps the measured overhead)
+        age_anchor = (
+            getattr(self.world, "sync_age_anchor", None)
+            if self.sync_age else None
+        )
+        t_stage_us = syncage.now_us() if age_anchor is not None else 0
         for gate_id, chunks in self._sync_out.items():
             # per-chunk ARRAYS concatenated once — never element-wise
             # Python appends (the world's mirror path hands us S16
@@ -1124,9 +1152,14 @@ class GameServer:
             else:
                 p = new_packet(proto.MT_SYNC_POSITION_YAW_ON_CLIENTS)
                 p.append_u16(gate_id)
-                p.append_bytes(
-                    codec.encode_client_sync_batch(cid_b, eid_b, val_b)
-                )
+                body = codec.encode_client_sync_batch(cid_b, eid_b,
+                                                      val_b)
+                p.append_bytes(body)
+                self._m_sync_bytes["full"].inc(len(body))
+            if age_anchor is not None:
+                p.age = syncage.SyncAgeStamp(
+                    age_anchor[0], age_anchor[1], age_anchor[2],
+                    t_stage_us, syncage.now_us())
             self._send(self.cluster.select_by_gate_id(gate_id), p)
         if self.sync_delta and self._sync_encoders:
             # byte-saving gauges (scraped next to the SLO line) —
@@ -1138,6 +1171,17 @@ class GameServer:
             opmon.expose("sync_delta_full_bytes", sum(
                 e.stats["full_bytes"]
                 for e in self._sync_encoders.values()))
+            # keyframe vs delta wire bytes split into their own series
+            # (sync_bytes_out{kind}): the old single wire-bytes gauge
+            # hid which mode the bytes travelled as — the age plane
+            # correlates staleness against exactly this split
+            for kind in ("keyframe", "delta"):
+                total = sum(e.stats[f"{kind}_bytes"]
+                            for e in self._sync_encoders.values())
+                d = total - self._sync_bytes_mark[kind]
+                if d > 0:
+                    self._m_sync_bytes[kind].inc(d)
+                self._sync_bytes_mark[kind] = total
         self._sync_out.clear()
 
     def _sync_encoder(self, gate_id: int) -> "codec.DeltaSyncEncoder":

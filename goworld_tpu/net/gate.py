@@ -31,8 +31,8 @@ from goworld_tpu.net.packet import (
     frame,
     new_packet,
 )
-from goworld_tpu.utils import consts, faults, ids, log, metrics, opmon, \
-    overload, tracing
+from goworld_tpu.utils import consts, faults, flightrec, ids, log, \
+    metrics, opmon, overload, syncage, tracing
 
 logger = log.get("gate")
 
@@ -135,6 +135,9 @@ class GateService:
         rate_limit_bps: float = 0.0,
         downstream_max_bytes: int = consts.GATE_DOWNSTREAM_MAX_BYTES,
         downstream_kick_secs: float = consts.GATE_DOWNSTREAM_KICK_SECS,
+        sync_age_target_ms: float = syncage.DEFAULT_TARGET_MS,
+        flightrec_ring: int = 256,
+        flightrec_cooldown_secs: float = flightrec.DEFAULT_COOLDOWN_SECS,
     ):
         self.gate_id = gate_id
         self.host = host
@@ -232,6 +235,54 @@ class GateService:
         # not read as gate-wide pressure, and a per-flush O(clients)
         # buffer scan would itself be load at 1M clients
         self._down_full: set[str] = set()
+        # end-to-end sync-age plane (utils/syncage.py): every STAMPED
+        # sync batch from a game is aged HERE, at the per-client flush
+        # — the instant a position update actually leaves toward a
+        # client — into the sync_age_ms / sync_age_hop_ms{hop}
+        # histograms, record-weighted. The paper's 16 ms target is the
+        # default verdict line ([gateN] sync_age_target_ms overrides).
+        self.syncage = syncage.register(
+            f"gate{gate_id}",
+            syncage.AgeTracker(sync_age_target_ms,
+                               name=f"gate{gate_id}"))
+        # gate-side incident flight recorder: one frame per flush-loop
+        # window carrying the window's e2e p99 + per-hop breakdown;
+        # a window whose p99 blows the target freezes a
+        # ``sync_age_breach`` bundle at /incidents (per-kind cooldown,
+        # like every game-side trigger). flightrec_ring=0 disables.
+        self.flightrec: flightrec.FlightRecorder | None = None
+        if flightrec_ring > 0:
+            import weakref
+
+            wself = weakref.ref(self)
+
+            def _ctx() -> dict:
+                s = wself()
+                if s is None:
+                    return {}
+                # the full per-hop p50/p90/p99 table, frozen with the
+                # bundle (paid at freeze time only)
+                snap = s.syncage.snapshot()
+                return {
+                    "sync_age": {
+                        "target_ms": snap["target_ms"],
+                        "e2e": snap["e2e"],
+                        "hops": snap["hops"],
+                        "clock_warp_total": snap["clock_warp_total"],
+                    },
+                    "overload": s.overload.state_name,
+                    "clients": len(s.clients),
+                }
+
+            self.flightrec = flightrec.register(
+                f"gate{gate_id}",
+                flightrec.FlightRecorder(
+                    ring=flightrec_ring,
+                    cooldown_secs=flightrec_cooldown_secs,
+                    context_fn=_ctx,
+                ),
+            )
+        self._flush_count = 0
 
     # ------------------------------------------------------------------
     async def _handshake(self, conn: DispatcherConn) -> None:
@@ -503,7 +554,7 @@ class GateService:
             return
         if msgtype == proto.MT_SYNC_POSITION_YAW_ON_CLIENTS:
             pkt.read_u16()  # gate_id routing prefix (ours)
-            self._handle_sync_on_clients(pkt)
+            self._handle_sync_on_clients(pkt, age=pkt.age)
             return
         if msgtype == proto.MT_SYNC_POSITION_YAW_DELTA_ON_CLIENTS:
             # delta-compressed sync leg (ISSUE 12): reconstruct full
@@ -525,7 +576,7 @@ class GateService:
                 logger.warning("gate%d: bad delta-sync batch from "
                                "game%d: %s", self.gate_id, sender, exc)
                 return
-            self._relay_sync_records(cids, eids, vals)
+            self._relay_sync_records(cids, eids, vals, age=pkt.age)
             return
         if msgtype == proto.MT_SET_CLIENT_FILTER_PROP:
             pkt.read_u16()
@@ -557,7 +608,7 @@ class GateService:
         logger.warning("gate%d: dispatcher sent unhandled msgtype %d",
                        self.gate_id, msgtype)
 
-    def _send_to_client(self, cp: ClientProxy, p: Packet) -> None:
+    def _send_to_client(self, cp: ClientProxy, p: Packet) -> bool:
         """Downstream send with a per-client byte bound: a consumer
         whose socket buffer is full gets SELF-HEALING packets (sync
         records — the next tick re-sends current state) dropped,
@@ -568,17 +619,20 @@ class GateService:
         destroy/RPC — nothing ever re-sends those) would have to drop,
         because a silently desynced world view is worse than a
         reconnect — kick, never wedge (a 1M-user gate cannot carry
-        dead weight)."""
+        dead weight). Returns True iff the packet was actually handed
+        to the socket — the sync-age plane must weight by DELIVERED
+        records, and a dropped-under-overload batch is precisely the
+        case the breach trigger exists for."""
         if self.downstream_max_bytes <= 0:
             cp.send(p)
-            return
+            return True
         buffered = cp.downstream_buffered()
         if buffered + len(p.buf) <= self.downstream_max_bytes:
             if cp.down_full_since is not None:
                 cp.down_full_since = None
                 self._down_full.discard(cp.client_id)
             cp.send(p)
-            return
+            return True
         self._m_down_dropped.inc()
         now = asyncio.get_event_loop().time()
         mt = (int.from_bytes(bytes(p.buf[:2]), "little") & MSGTYPE_MASK
@@ -587,7 +641,7 @@ class GateService:
         if overload.classify(mt) < overload.CLASS_SYNC:
             self._kick_stalled(cp, buffered,
                                f"cannot take msgtype {mt}")
-            return
+            return False
         if cp.down_full_since is None:
             cp.down_full_since = now
             self._down_full.add(cp.client_id)
@@ -601,6 +655,7 @@ class GateService:
             self._kick_stalled(
                 cp, buffered,
                 f"full for {now - cp.down_full_since:.1f}s")
+        return False
 
     def _kick_stalled(self, cp: ClientProxy, buffered: int,
                       why: str) -> None:
@@ -633,18 +688,21 @@ class GateService:
         out.append_bytes(bytes(memoryview(pkt.buf)[pkt.rpos:]))
         self._send_to_client(cp, out)
 
-    def _handle_sync_on_clients(self, pkt: Packet) -> None:
+    def _handle_sync_on_clients(self, pkt: Packet, age=None) -> None:
         """Regroup 48B (cid+eid+pos) records per client and send each its
         own 32B-record bundle (reference ``:350-375``). Grouping is a
         vectorized unique+argsort over the 16B client ids — Python work
         scales with CLIENTS, not records."""
         buf = memoryview(pkt.buf)[pkt.rpos:]
         cids, eids, vals = codec.decode_client_sync_batch(buf)
-        self._relay_sync_records(cids, eids, vals)
+        self._relay_sync_records(cids, eids, vals, age=age)
 
-    def _relay_sync_records(self, cids, eids, vals) -> None:
+    def _relay_sync_records(self, cids, eids, vals, age=None) -> None:
         """The shared back half of both sync legs (full-record and
-        delta-decoded): per-client regroup + relay."""
+        delta-decoded): per-client regroup + relay. ``age`` is the
+        batch's sync-age stamp (or None): delivered records are aged
+        ONCE per batch at the flush instant, weighted by how many
+        records actually left toward clients."""
         n = len(cids)
         self._m_down_batch.observe(n)
         if n == 0:
@@ -654,6 +712,7 @@ class GateService:
         order = np.argsort(inv, kind="stable")
         bounds = np.cumsum(np.bincount(inv, minlength=len(uniq)))
         start = 0
+        delivered = 0
         for u, stop in zip(uniq, bounds):
             idxs = order[start:start + (stop - start)]
             start = stop
@@ -664,7 +723,15 @@ class GateService:
             out.append_bytes(
                 codec.encode_sync_batch(eids[idxs], vals[idxs])
             )
-            self._send_to_client(cp, out)
+            # only records the socket actually took count as delivered
+            # (a full-buffer drop under overload must not pollute the
+            # age-at-delivery SLO it exists to trip)
+            if self._send_to_client(cp, out):
+                delivered += len(idxs)
+        if age is not None and delivered:
+            # age-at-delivery: the whole point of the stamp — measured
+            # HERE, after the last per-client send of the batch
+            self.syncage.observe(age, syncage.now_us(), delivered)
 
     # -- periodic work ----------------------------------------------------
     async def _flush_loop(self) -> None:
@@ -681,6 +748,39 @@ class GateService:
                 self.cluster.conns[didx].send(p)
                 buf.clear()
             self._observe_overload()
+            self._flightrec_window()
+
+    def _flightrec_window(self) -> None:
+        """One flight-recorder frame per flush window: the window's
+        sync-age e2e p99 vs target plus the freshest per-hop lanes —
+        a window over target fires the ``sync_age_breach`` trigger
+        (utils/flightrec.py) with the breakdown frozen in the bundle."""
+        if self.flightrec is None:
+            return
+        self._flush_count += 1
+        try:
+            p99, n = self.syncage.window_verdict()
+            frame: dict = {
+                "tick": self._flush_count,
+                "stage": self.overload.state_name,
+                "clients": len(self.clients),
+            }
+            if p99 is not None:
+                # non-finite stringifies as "inf" (the syncage.ptiles
+                # convention): a raw float('inf') would serialize as
+                # the non-standard JSON token Infinity at /incidents
+                frame["sync_age_p99_ms"] = round(p99, 3) \
+                    if p99 != float("inf") else "inf"
+                frame["sync_age_target_ms"] = self.syncage.target_ms
+                frame["sync_age_samples"] = n
+                if self.syncage.last_lanes_ms:
+                    frame["sync_age_hops"] = {
+                        h: round(v, 3) for h, v in
+                        self.syncage.last_lanes_ms.items()}
+            self.flightrec.record(frame)
+        except Exception:  # observability must never kill the flush
+            logger.exception("gate%d: flight-recorder window failed",
+                             self.gate_id)
 
     def _observe_overload(self) -> None:
         """Feed the gate governor: the FRACTION of clients whose

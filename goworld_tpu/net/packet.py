@@ -42,6 +42,15 @@ HEADER_SIZE = 4  # the u32 size prefix; msgtype counts into payload_size
 # can never collide — and untraced packets pay zero bytes (the framed
 # stream is byte-identical to the pre-tracing wire).
 TRACE_FLAG = 0x8000
+# bit 14 marks a sync-age stamp trailer (utils/syncage.py): the 45-byte
+# per-batch provenance record the sync fan-out legs carry from game to
+# gate. Same contract as TRACE_FLAG — the routing ranges stop at 2047,
+# so the bit never collides, unstamped packets are byte-identical to
+# the pre-stamp wire, and the trailer is stripped into ``packet.age``
+# before any handler sees the payload. When both trailers ride one
+# packet the trace context is OUTERMOST (appended last, stripped
+# first) — tracing wraps every other plane.
+AGE_FLAG = 0x4000
 MSGTYPE_MASK = 0x7FFF
 
 _pool: list["Packet"] = []
@@ -56,7 +65,7 @@ class Packet:
     hot paths; plain construction also works.
     """
 
-    __slots__ = ("buf", "rpos", "trace")
+    __slots__ = ("buf", "rpos", "trace", "age")
 
     def __init__(self, data: bytes | bytearray | None = None):
         self.buf = bytearray(data) if data is not None else bytearray()
@@ -65,6 +74,11 @@ class Packet:
         # traced inbound packets and by hops/new_packet on outbound ones;
         # applied to the wire as a flagged trailer by wire_payload
         self.trace = None
+        # attached syncage.SyncAgeStamp (or None): set by decode_wire on
+        # stamped inbound sync batches and by the game's fan-out flush
+        # on outbound ones; the dispatcher patches its forward instant
+        # into it before relaying (utils/syncage.py)
+        self.age = None
 
     # -- lifecycle -------------------------------------------------------
     @staticmethod
@@ -78,6 +92,7 @@ class Packet:
 
     def release(self) -> None:
         self.trace = None  # never leak a context into a pooled reuse
+        self.age = None
         if len(_pool) < _POOL_MAX:
             self.buf.clear()
             self.rpos = 0
@@ -192,14 +207,20 @@ def new_packet(msgtype: int) -> Packet:
 
 
 def wire_payload(p: Packet) -> bytes:
-    """Payload bytes as they go on the wire: verbatim when untraced;
-    with TRACE_FLAG set on the msgtype and the packed 25B context
-    appended as a trailer when a trace context is attached."""
-    if p.trace is None:
+    """Payload bytes as they go on the wire: verbatim when untraced and
+    unstamped; with TRACE_FLAG / AGE_FLAG set on the msgtype and the
+    packed trailer(s) appended when attached. The age stamp goes on
+    FIRST so the trace context stays outermost (decode strips in
+    reverse)."""
+    if p.trace is None and p.age is None:
         return bytes(p.buf)
     buf = bytearray(p.buf)
-    buf[1] |= 0x80  # little-endian u16 msgtype: bit 15 lives in byte 1
-    buf += p.trace.pack()
+    if p.age is not None:
+        buf[1] |= 0x40  # little-endian u16 msgtype: bit 14 in byte 1
+        buf += p.age.pack()
+    if p.trace is not None:
+        buf[1] |= 0x80  # bit 15 lives in byte 1
+        buf += p.trace.pack()
     return bytes(buf)
 
 
@@ -223,6 +244,20 @@ def decode_wire(body: bytes | bytearray) -> tuple[int, Packet]:
         # see payload bytes identical to an untraced packet's — the
         # flag is re-applied by wire_payload iff a context is attached
         p.buf[1] &= 0x7F
+    if msgtype & AGE_FLAG:
+        from goworld_tpu.utils import syncage
+
+        msgtype &= ~AGE_FLAG
+        if len(p.buf) < 2 + syncage.STAMP_WIRE_SIZE:
+            raise ConnectionError("stamped packet too short for trailer")
+        try:
+            p.age = syncage.SyncAgeStamp.unpack(
+                bytes(p.buf[-syncage.STAMP_WIRE_SIZE:])
+            )
+        except ValueError as exc:
+            raise ConnectionError(f"bad sync-age stamp: {exc}") from exc
+        del p.buf[-syncage.STAMP_WIRE_SIZE:]
+        p.buf[1] &= 0xBF  # same re-apply contract as the trace flag
     return msgtype, p
 
 

@@ -49,6 +49,11 @@ TPU-native analog exposes:
   autotune`): current/pending config key, the deterministic swap +
   decision logs, warm-set compile states, regret-guard status and the
   freshest signature the policy judged
+* ``/syncage`` — the end-to-end sync-age plane (:mod:`goworld_tpu.
+  utils.syncage`): per-gate age-at-delivery percentiles (e2e + per
+  hop) AND the raw bucket count vectors so the deployment aggregator
+  (``tools/obs_aggregate.py`` / ``cli.py watch``) can merge
+  histograms exactly; an honest error on processes that age nothing
 
 Stdlib-only (http.server on a daemon thread), one call to :func:`start`.
 """
@@ -70,7 +75,8 @@ logger = log.get("debug_http")
 
 _ENDPOINTS = ["/healthz", "/vars", "/ops", "/metrics", "/trace",
               "/tracing", "/clock", "/profile", "/faults", "/overload",
-              "/costs", "/workload", "/incidents", "/governor"]
+              "/costs", "/workload", "/incidents", "/governor",
+              "/syncage"]
 
 # jax.profiler capture state (one capture at a time per process)
 _profile_lock = threading.Lock()
@@ -274,6 +280,12 @@ class _Handler(BaseHTTPRequestHandler):
             from goworld_tpu.autotune import governor as autotune_gov
 
             self._json(autotune_gov.snapshot())
+        elif path == "/syncage":
+            # end-to-end sync-age plane (utils/syncage registry):
+            # percentiles + mergeable raw count vectors per tracker
+            from goworld_tpu.utils import syncage
+
+            self._json(syncage.snapshot_all())
         elif path == "/incidents":
             # flight-recorder incident bundles (utils/flightrec);
             # ?frames=1 adds the live per-tick frame ring

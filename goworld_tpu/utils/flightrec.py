@@ -26,7 +26,13 @@ Triggers (the grammar — docs/OBSERVABILITY.md):
 * ``governor_swap`` — the autotune governor committed a kernel-config
   swap or regret revert this tick (``goworld_tpu/autotune``); the
   frame carries ``from->to (reason)`` and the incident context freezes
-  the full decision state (policy log, regret numbers, signature).
+  the full decision state (policy log, regret numbers, signature);
+* ``sync_age_breach`` — the end-to-end sync-age p99 of a window
+  exceeded its delivery target (``sync_age_p99_ms`` >
+  ``sync_age_target_ms``; gate frames, utils/syncage.py) — a client
+  saw stale positions even if every device tick made its budget; the
+  frame carries the per-hop breakdown (``sync_age_hops``) so the
+  bundle says WHICH hop ate the budget.
 
 Every trigger kind is deduped with a per-kind cooldown so one bad
 minute yields a handful of bundles, not thousands. Determinism: the
@@ -138,6 +144,17 @@ class FlightRecorder:
                     fired.append(("signature_change",
                                   f"{self._prev_sig}>{sig}"))
                 self._prev_sig = sig
+            sa_p99 = frame.get("sync_age_p99_ms")
+            sa_target = frame.get("sync_age_target_ms")
+            if sa_p99 == "inf":
+                # the JSON-safe non-finite convention (syncage.ptiles):
+                # mass past the last bucket is the strongest breach
+                sa_p99 = float("inf")
+            if sa_p99 is not None and sa_target is not None \
+                    and sa_p99 > sa_target:
+                fired.append((
+                    "sync_age_breach",
+                    f"e2e p99 {sa_p99:g} ms > {sa_target:g} ms"))
             gov = frame.get("governor")
             if gov is not None:
                 # the autotune governor committed a kernel-config swap

@@ -141,6 +141,18 @@ class Histogram:
             self._sum += v
             self._count += 1
 
+    def observe_n(self, v: float, n: int) -> None:
+        """``n`` samples of the same value in one locked update — the
+        record-weighted sync-age lanes observe one value per BATCH but
+        must weight it by the records delivered (one bisect, not n)."""
+        if n <= 0:
+            return
+        i = bisect.bisect_left(self._uppers, v)
+        with self._lock:
+            self._counts[i] += n
+            self._sum += v * n
+            self._count += n
+
     def add_counts(self, counts, sum_: float = 0.0) -> None:
         """Merge a pre-bucketed count vector (``len(uppers)+1``
         entries, last = +Inf) — the in-graph telemetry lanes drain

@@ -105,6 +105,14 @@ def pytest_configure(config):
         "tests/test_governor.py); all run in tier-1 on CPU "
         "(docs/AUTOTUNE.md)",
     )
+    config.addinivalue_line(
+        "markers",
+        "syncage: end-to-end sync-age plane suites (the per-batch "
+        "stamp trailer, gate age-at-delivery histograms, the "
+        "deployment aggregator, the sync_age_breach trigger — "
+        "tests/test_syncage.py); all run in tier-1 on CPU "
+        "(docs/OBSERVABILITY.md \"End-to-end sync age\")",
+    )
 
 
 def spawn_on(states, dev, slot, **kw):

@@ -338,3 +338,66 @@ def test_unreadable_file_reported(tmp_path):
     p.write_text("{not json")
     errs = SCHEMA.validate_file(str(p))
     assert errs and "unreadable" in errs[0]
+
+
+# =======================================================================
+# r>=15: the sync-age block (ISSUE 15)
+# =======================================================================
+def _sync_age_block(**extra):
+    hops = {h: {"samples": 100, "p50_ms": 1.0, "p90_ms": 2.0,
+                "p99_ms": 3.0}
+            for h in ("device_tick", "drain_decode", "encode",
+                      "dispatcher", "gate_flush")}
+    blk = {
+        "target_ms": 16.0,
+        "e2e": {"samples": 100, "p50_ms": 4.0, "p90_ms": 8.0,
+                "p99_ms": 12.0},
+        "hops": hops,
+        "records_per_tick": 2048,
+        "clients": 4,
+        "pass": True,
+        "stamp_overhead_pct_of_budget": 0.05,
+    }
+    blk.update(extra)
+    return blk
+
+
+def _r15_rec(**extra):
+    """A valid r15 record: r13's contract + the sync_age block."""
+    rec = _r13_rec(sync_age=_sync_age_block())
+    rec.update(extra)
+    return rec
+
+
+def test_sync_age_block_required_since_r15(tmp_path):
+    rec = _r15_rec()
+    assert _validate(tmp_path, "BENCH_r15.json", rec) == []
+    # missing entirely -> caught at r15, grandfathered at r13
+    rec2 = _r15_rec()
+    del rec2["sync_age"]
+    errs = _validate(tmp_path, "BENCH_r15.json", rec2)
+    assert any("sync_age" in e for e in errs)
+    assert _validate(tmp_path, "BENCH_r13.json", rec2) == []
+    # honest skip/error records accepted (the BENCH_SYNC_AGE=0 round
+    # and the stage-failed round are both valid artifacts)
+    for blk in ({"skipped": "BENCH_SYNC_AGE=0"},
+                {"error": "sync_age stage never completed"}):
+        rec3 = _r15_rec(sync_age=blk)
+        assert _validate(tmp_path, "BENCH_r15.json", rec3) == []
+
+
+def test_sync_age_block_shape_caught(tmp_path):
+    # a present-but-gutted block is malformation, not an honest skip
+    rec = _r15_rec(sync_age={"target_ms": 16.0})
+    errs = _validate(tmp_path, "BENCH_r15.json", rec)
+    assert any("sync_age" in e for e in errs)
+    # a missing hop lane inside an otherwise-complete block
+    rec2 = _r15_rec()
+    del rec2["sync_age"]["hops"]["dispatcher"]
+    errs = _validate(tmp_path, "BENCH_r15.json", rec2)
+    assert any("dispatcher" in e for e in errs)
+    # e2e percentiles must be the full p50/p90/p99 + samples shape
+    rec3 = _r15_rec()
+    rec3["sync_age"]["e2e"] = {"p99_ms": 3.0}
+    errs = _validate(tmp_path, "BENCH_r15.json", rec3)
+    assert any("e2e" in e for e in errs)

@@ -334,3 +334,79 @@ def test_multichip_dryrun_rounds_not_headline_gated(tmp_path):
                  "skipped": False})
     f2 = _write(tmp_path, "MULTICHIP_r10.json", _multi_rec(100.0))
     assert TREND.main([f1, f2]) == 0
+
+
+def _sa_block(p99, records=2048, clients=4, passed=None):
+    return {"target_ms": 16.0, "records_per_tick": records,
+            "clients": clients,
+            "e2e": {"samples": 1000, "p50_ms": p99 / 3,
+                    "p90_ms": p99 / 2, "p99_ms": p99},
+            "hops": {}, "pass": (p99 <= 16.0 if passed is None
+                                 else passed),
+            "stamp_overhead_pct_of_budget": 0.05}
+
+
+def test_sync_age_series_gated_and_regression_fails(tmp_path):
+    """The sync-age loopback block's e2e p99 is its own
+    lower-is-better series at the same (records, clients, platform)
+    shape (ISSUE 15): a >30% p99 regression fails, skip/error rounds
+    neither gate nor anchor, shape changes are new series."""
+    r1 = _bench_rec(1000.0)
+    r1["sync_age"] = _sa_block(10.0)
+    r2 = _bench_rec(1000.0)
+    r2["sync_age"] = _sa_block(11.0)
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 0
+    # injected regression: headline flat, e2e p99 up 3x -> gate fails
+    r3 = _bench_rec(1000.0)
+    r3["sync_age"] = _sa_block(30.0, passed=False)
+    f3 = _write(tmp_path, "BENCH_r03.json", r3)
+    assert TREND.main([f1, f2, f3]) == 2
+    # an honest skip neither gates nor anchors
+    r3b = _bench_rec(1000.0)
+    r3b["sync_age"] = {"skipped": "BENCH_SYNC_AGE=0"}
+    f3b = _write(tmp_path, "BENCH_r03.json", r3b)
+    assert TREND.main([f1, f2, f3b]) == 0
+    # a different harness shape is a different series
+    r3c = _bench_rec(1000.0)
+    r3c["sync_age"] = _sa_block(30.0, records=32768, passed=False)
+    f3c = _write(tmp_path, "BENCH_r03.json", r3c)
+    assert TREND.main([f1, f2, f3c]) == 0
+
+
+def test_sync_age_pass_to_fail_transition_fails(tmp_path):
+    """A verdict flip pass -> fail at the same shape always fails,
+    even inside the 30% p99 band (the slo-flip rule)."""
+    r1 = _bench_rec(1000.0)
+    r1["sync_age"] = _sa_block(15.0)           # pass, close to target
+    r2 = _bench_rec(1000.0)
+    r2["sync_age"] = _sa_block(17.0, passed=False)  # +13%, but a flip
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 2
+    # fail -> fail within the band is the recorded status quo
+    r1b = _bench_rec(1000.0)
+    r1b["sync_age"] = _sa_block(20.0, passed=False)
+    r2b = _bench_rec(1000.0)
+    r2b["sync_age"] = _sa_block(22.0, passed=False)
+    f1b = _write(tmp_path, "BENCH_r03.json", r1b)
+    f2b = _write(tmp_path, "BENCH_r04.json", r2b)
+    assert TREND.main([f1b, f2b]) == 0
+
+
+def test_sync_age_gate_survives_headline_shape_change(tmp_path):
+    """Like the governor series: a round that changes the headline
+    entity count must still gate its sync_age block against prior
+    rounds' — the early headline return must not swallow it."""
+    r1 = _bench_rec(1000.0, entities=1000)
+    r1["sync_age"] = _sa_block(10.0)
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    r2 = _bench_rec(5000.0, entities=4096)
+    r2["sync_age"] = _sa_block(30.0, passed=False)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 2
+    r2b = _bench_rec(5000.0, entities=4096)
+    r2b["sync_age"] = _sa_block(10.5)
+    f2b = _write(tmp_path, "BENCH_r02.json", r2b)
+    assert TREND.main([f1, f2b]) == 0

@@ -42,6 +42,23 @@ def test_msgtypes_never_collide_with_trace_flag():
         assert (val | packet.TRACE_FLAG) & packet.MSGTYPE_MASK == val
 
 
+def test_msgtypes_never_collide_with_age_flag():
+    """Bit 14 is the sync-age-stamp trailer marker (net/packet.py
+    AGE_FLAG, utils/syncage.py): every real msgtype must keep it clear
+    so setting and masking the flag is reversible, exactly like the
+    trace flag above."""
+    for name, val in _mt_constants().items():
+        assert val & packet.AGE_FLAG == 0, \
+            f"{name}={val} collides with AGE_FLAG"
+    # bit 14 sits INSIDE MSGTYPE_MASK: masking a raw wire msgtype with
+    # MSGTYPE_MASK strips the trace flag but NOT the age flag, so
+    # decode_wire's explicit AGE_FLAG strip is load-bearing — any
+    # routing shortcut that only applies MSGTYPE_MASK would misroute
+    # stamped packets (this pins the fact the strip code relies on)
+    assert packet.AGE_FLAG & packet.MSGTYPE_MASK == packet.AGE_FLAG
+    assert packet.TRACE_FLAG & packet.MSGTYPE_MASK == 0
+
+
 def test_msgtypes_are_unique():
     consts = _mt_constants()
     by_val: dict[int, list[str]] = {}

@@ -44,7 +44,12 @@ contract):
   block — the ``bench.py --governor`` phase-switching schedule
   (per-phase chosen config + swap latency, throughput vs best/worst
   static) when it ran, or an honest ``{"skipped": "--governor not
-  requested"}`` / ``{"error": ...}`` record otherwise.
+  requested"}`` / ``{"error": ...}`` record otherwise;
+* rounds >= 15 (the sync-age era, ISSUE 15): a ``sync_age`` block —
+  the end-to-end device-tick-epoch -> gate-delivery age measured
+  through the real game->gate loopback (per-hop + e2e p50/p90/p99,
+  the verdict vs the 16 ms target, the measured stamp overhead) —
+  honest ``{"error"/"skipped": ...}`` records accepted.
 
 Exit codes: 0 all valid, 1 usage/missing, 2 schema violations.
 """
@@ -99,6 +104,15 @@ PRECISION_KEYS = ("plane", "pos_scale_bits", "sync_keyframe_every")
 # so honestly ({"skipped"/"error": ...} — the device-plane convention)
 GOVERNOR_SINCE = 13
 GOVERNOR_KEYS = ("schedule", "phases", "throughput", "static_wall_s")
+# the sync-age era (ISSUE 15): every BENCH round stamps the
+# game->gate loopback's age-at-delivery block — per-hop + e2e
+# percentiles, the verdict vs the paper's 16 ms target, and the
+# measured overhead of the always-on stamp (the <1% criterion)
+SYNC_AGE_SINCE = 15
+SYNC_AGE_KEYS = ("target_ms", "e2e", "hops", "records_per_tick",
+                 "pass", "stamp_overhead_pct_of_budget")
+SYNC_AGE_HOPS = ("device_tick", "drain_decode", "encode",
+                 "dispatcher", "gate_flush")
 MULTI_HEADLINE_KEYS = ("entity_ticks_per_sec_mesh",
                        "per_chip_efficiency", "n_entities", "platform")
 MULTI_GAUGE_KEYS = ("halo_demand_max", "migrate_demand_max",
@@ -179,6 +193,23 @@ def validate_bench(path: str, doc: dict) -> list[str]:
                         {"scenario", "chosen", "expected"} <= set(ph)):
                     errs.append(
                         f"governor phase record malformed: {ph!r:.120}")
+    if rno >= SYNC_AGE_SINCE:
+        _check_block(rec, "sync_age", SYNC_AGE_KEYS, errs)
+        sa = rec.get("sync_age")
+        if isinstance(sa, dict) and "error" not in sa \
+                and "skipped" not in sa:
+            e2e = sa.get("e2e")
+            if not (isinstance(e2e, dict)
+                    and {"p50_ms", "p90_ms", "p99_ms", "samples"}
+                    <= set(e2e)):
+                errs.append(f"sync_age e2e malformed: {e2e!r:.120}")
+            hops = sa.get("hops")
+            if isinstance(hops, dict):
+                for hop in SYNC_AGE_HOPS:
+                    if hop not in hops:
+                        errs.append(f"sync_age missing hop {hop!r}")
+            else:
+                errs.append(f"sync_age hops malformed: {hops!r:.120}")
     # per-scenario blocks, wherever present: each needs either a
     # headline-style shape or an honest error
     for sc, blk in (rec.get("scenarios") or {}).items():

@@ -134,6 +134,60 @@ def _check_governor_series(rounds: list, latest: dict, name: str,
             f"{gbest['throughput']:.0f} — ok")
 
 
+def _check_sync_age_series(rounds: list, latest: dict, name: str,
+                           threshold: float, problems: list[str],
+                           notes: list[str]) -> None:
+    """The sync-age loopback block (ISSUE 15): its e2e p99 is a
+    delivery-latency series of its own, gated LOWER-IS-BETTER against
+    the best (lowest-p99) prior round at the SAME (records_per_tick,
+    clients, platform) shape. Skipped/error rounds and rounds whose
+    p99 never resolved to a number neither gate nor anchor; a
+    pass->fail flip at the same shape is always a problem (the slo
+    rule)."""
+    def _sa_ok(s) -> bool:
+        return (isinstance(s, dict) and "error" not in s
+                and "skipped" not in s
+                and isinstance((s.get("e2e") or {}).get("p99_ms"),
+                               (int, float)))
+
+    lsa = latest.get("sync_age")
+    if not _sa_ok(lsa):
+        return
+    sshape = (lsa.get("records_per_tick"), lsa.get("clients"),
+              latest.get("platform"))
+    sprior = [
+        (p, r["sync_age"]) for p, r in rounds[:-1]
+        if _sa_ok(r.get("sync_age"))
+        and (r["sync_age"].get("records_per_tick"),
+             r["sync_age"].get("clients"),
+             r.get("platform")) == sshape
+    ]
+    if not sprior:
+        notes.append(f"{name}: sync_age shape {sshape} has no prior "
+                     "round — not gated")
+        return
+    lp99 = lsa["e2e"]["p99_ms"]
+    best_path, best = min(sprior,
+                          key=lambda pr: pr[1]["e2e"]["p99_ms"])
+    ceil = (1.0 + threshold) * best["e2e"]["p99_ms"]
+    if lp99 > ceil:
+        problems.append(
+            f"{name}: sync_age e2e p99 {lp99} ms > "
+            f"{(1 + threshold) * 100:.0f}% of "
+            f"{os.path.basename(best_path)}'s "
+            f"{best['e2e']['p99_ms']} ms")
+    else:
+        notes.append(
+            f"{name}: sync_age e2e p99 {lp99} ms vs best prior "
+            f"{best['e2e']['p99_ms']} ms — ok")
+    prev_path, prev = sprior[-1]
+    if prev.get("pass") and not lsa.get("pass"):
+        problems.append(
+            f"{name}: sync_age verdict regressed pass -> fail "
+            f"(e2e p99 {lp99} vs target {lsa.get('target_ms')} ms, "
+            f"prior {os.path.basename(prev_path)})")
+
+
 def check_bench(files: list[str], threshold: float,
                 problems: list[str], notes: list[str]) -> None:
     rounds = []
@@ -155,6 +209,11 @@ def check_bench(files: list[str], threshold: float,
     # (no headline prior -> early return below) must not silently
     # skip the governor comparison
     _check_governor_series(rounds, latest, name, threshold,
+                           problems, notes)
+    # the sync-age delivery series (ISSUE 15) likewise gates above the
+    # headline-prior early return: its shape is independent of the
+    # headline's
+    _check_sync_age_series(rounds, latest, name, threshold,
                            problems, notes)
     prior = [(p, r) for p, r in rounds[:-1]
              if _shape(r) == _shape(latest)]

@@ -1,0 +1,361 @@
+#!/usr/bin/env python
+"""Deployment-wide observability aggregator: ONE SLO verdict for the
+metric the paper is graded on.
+
+Every process's ``/costs`` verdict covers its own device tick; the
+sync-age plane (utils/syncage.py) measures what a CLIENT observes —
+device-tick epoch to gate delivery. This tool scrapes every process's
+``/syncage``, ``/metrics``, ``/clock``, ``/workload``, ``/governor``
+and ``/incidents`` endpoints, merges the fixed-bucket histograms
+exactly (``metrics.Histogram.add_counts`` over the raw count vectors
+— never re-derived from percentiles), and prints one deployment
+verdict::
+
+    python tools/obs_aggregate.py <server_dir>
+    python tools/obs_aggregate.py --url http://127.0.0.1:16000/metrics
+    python tools/obs_aggregate.py <server_dir> --watch 2   # refresh
+    goworld_tpu watch <server_dir>                         # same loop
+
+Output: the merged end-to-end sync-age p50/p90/p99 vs the 16 ms
+target (the deployment PASS/FAIL), a per-hop lane table attributing
+the age (device_tick / drain_decode / encode / dispatcher /
+gate_flush), the merged device-tick latency for contrast, and the
+measured cross-process wall-clock skew (from the existing ``/clock``
+anchors — cross-process ages are only honest up to this number, so it
+is printed next to the verdict, never assumed away).
+
+Convention: unreachable processes and processes predating the
+endpoints are skipped silently (the ``/costs`` convention — old
+processes are not noise); the verdict line reports how many gates
+actually contributed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+_TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+for _p in (os.path.dirname(_TOOLS_DIR), _TOOLS_DIR):
+    # inserted ONCE at import (not per call): --watch mode refreshes
+    # forever and must not grow sys.path by a duplicate per cycle
+    if _p not in sys.path:
+        sys.path.insert(0, _p)
+
+from goworld_tpu.utils import metrics  # noqa: E402
+from goworld_tpu.utils.syncage import (  # noqa: E402
+    DEFAULT_TARGET_MS,
+    HOPS,
+    ptiles as _ptiles,
+)
+
+
+def _fetch_json(url: str, timeout: float = 2.0):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read().decode("utf-8", "replace"))
+
+
+def _targets(server_dir: str | None, urls: list[str]) -> list[tuple]:
+    """(label, base url) pairs — reuses the scraper's ini discovery."""
+    out = [
+        (u.split("//", 1)[-1].split("/", 1)[0],
+         u.rsplit("/metrics", 1)[0].rstrip("/"))
+        for u in urls
+    ]
+    if server_dir:
+        from goworld_tpu import config as config_mod
+
+        import scrape_metrics
+
+        for name in config_mod.DEFAULT_CONFIG_PATHS:
+            p = os.path.join(server_dir, name)
+            if os.path.exists(p):
+                out += [
+                    (label, url.rsplit("/", 1)[0])
+                    for label, url in scrape_metrics.targets_from_config(
+                        config_mod.load(p))
+                ]
+                break
+        else:
+            raise FileNotFoundError(
+                f"no cluster ini under {server_dir}")
+    return out
+
+
+def _merge_counts(hist: metrics.Histogram | None, edges, counts):
+    """Merge one raw count vector into the running histogram; builds it
+    from the first contributor's edges, skips mismatched edge sets
+    (a process running different buckets cannot merge exactly —
+    ``add_counts`` only checks the vector LENGTH, so the edges are
+    compared here)."""
+    if hist is None:
+        hist = metrics.Histogram(buckets=edges)
+    if list(edges) != list(hist._uppers):
+        return hist, False
+    try:
+        hist.add_counts(counts)
+    except ValueError:
+        return hist, False
+    return hist, True
+
+
+def scrape_clock_skew(targets: list[tuple],
+                      timeout: float = 2.0) -> dict:
+    """Cross-process wall-clock offsets via the existing ``/clock``
+    anchors: each offset is remote ``wall_us`` minus the local request
+    midpoint; the SPREAD between processes bounds how honest
+    cross-process age lanes are. (merge_traces.py uses the same
+    estimator to align cluster traces.)"""
+    offsets: dict[str, float] = {}
+    for label, base in targets:
+        t0 = time.time()
+        try:
+            payload = _fetch_json(f"{base}/clock", timeout=timeout)
+        except (urllib.error.URLError, OSError, ValueError):
+            continue
+        mid_us = (t0 + time.time()) / 2.0 * 1e6
+        if isinstance(payload, dict) and "wall_us" in payload:
+            offsets[label] = payload["wall_us"] - mid_us
+    out: dict = {"offsets_us": {k: round(v, 1)
+                                for k, v in offsets.items()}}
+    if len(offsets) >= 2:
+        spread = max(offsets.values()) - min(offsets.values())
+        out["max_skew_ms"] = round(spread / 1e3, 3)
+    return out
+
+
+def aggregate(targets: list[tuple], timeout: float = 2.0,
+              tick_contrast: bool = True) -> dict:
+    """Scrape + merge the whole deployment into one record.
+    ``tick_contrast=False`` skips the merged device-tick /metrics
+    scrape (one extra round-trip per process that only the hop table
+    prints — ``cli.py status`` already scraped /metrics itself)."""
+    e2e_hist: metrics.Histogram | None = None
+    hop_hists: dict[str, metrics.Histogram | None] = \
+        {h: None for h in HOPS}
+    edges = None
+    gates: list[str] = []
+    skipped: list[str] = []
+    targets_ms: list[float] = []
+    warp_total = 0
+    for label, base in targets:
+        try:
+            payload = _fetch_json(f"{base}/syncage", timeout=timeout)
+        except (urllib.error.URLError, OSError, ValueError):
+            skipped.append(label)
+            continue
+        if not isinstance(payload, dict) or "error" in payload:
+            skipped.append(label)  # a process that ages nothing
+            continue
+        for name, snap in sorted(payload.items()):
+            if not isinstance(snap, dict) or "e2e_counts" not in snap:
+                continue
+            sedges = snap.get("edges_ms")
+            e2e_hist, ok = _merge_counts(e2e_hist, sedges,
+                                         snap["e2e_counts"])
+            if not ok:
+                skipped.append(f"{label}:{name} (bucket mismatch)")
+                continue
+            edges = edges or sedges
+            for hop in HOPS:
+                hc = (snap.get("hop_counts") or {}).get(hop)
+                if hc is not None:
+                    hop_hists[hop], _ = _merge_counts(
+                        hop_hists[hop], sedges, hc)
+            gates.append(f"{label}:{name}")
+            warp_total += int(snap.get("clock_warp_total", 0))
+            if isinstance(snap.get("target_ms"), (int, float)):
+                targets_ms.append(float(snap["target_ms"]))
+    out: dict = {
+        # gates may run different targets; the deployment verdict is
+        # judged against the STRICTEST one (and the spread is visible)
+        "target_ms": min(targets_ms) if targets_ms
+        else DEFAULT_TARGET_MS,
+        "gates": gates,
+        "skipped": skipped,
+        "clock_warp_total": warp_total,
+    }
+    if targets_ms and min(targets_ms) != max(targets_ms):
+        out["target_ms_max"] = max(targets_ms)
+    if e2e_hist is not None and edges is not None:
+        snap = e2e_hist.snapshot()
+        counts = [c for _u, c in snap["buckets"]] + [snap["inf"]]
+        out["e2e"] = _ptiles(edges, counts)
+        p99 = out["e2e"].get("p99_ms")
+        if isinstance(p99, (int, float)):
+            out["pass"] = bool(p99 <= out["target_ms"])
+        elif p99 == "inf":
+            out["pass"] = False
+        hops = {}
+        for hop in HOPS:
+            h = hop_hists[hop]
+            if h is None:
+                continue
+            hs = h.snapshot()
+            hops[hop] = _ptiles(
+                edges, [c for _u, c in hs["buckets"]] + [hs["inf"]])
+        out["hops"] = hops
+    # contrast line: the merged DEVICE-tick latency (what every verdict
+    # before this plane measured) from each process's /metrics buckets
+    if tick_contrast:
+        out["tick_latency"] = _merged_metric_hist(
+            targets, "tick_latency_ms", timeout=timeout)
+    out["clock"] = scrape_clock_skew(targets, timeout=timeout)
+    return out
+
+
+def _merged_metric_hist(targets: list[tuple], name: str,
+                        timeout: float = 2.0) -> dict:
+    """Merge one unlabeled histogram family across every /metrics
+    endpoint (cumulative Prometheus buckets de-cumulated per process,
+    then vector-added)."""
+    merged: metrics.Histogram | None = None
+    edges_out = None
+    for _label, base in targets:
+        try:
+            with urllib.request.urlopen(f"{base}/metrics",
+                                        timeout=timeout) as resp:
+                series = metrics.parse_prometheus_text(
+                    resp.read().decode("utf-8", "replace"))
+        except (urllib.error.URLError, OSError, ValueError):
+            continue
+        buckets: list[tuple[float, float]] = []
+        for key, v in series.items():
+            if not key.startswith(f"{name}_bucket{{"):
+                continue
+            le = key.split('le="', 1)[-1].rstrip('"}')
+            buckets.append(
+                (float("inf") if le == "+Inf" else float(le), v))
+        if not buckets:
+            continue
+        buckets.sort()
+        edges = [u for u, _c in buckets if u != float("inf")]
+        cum = [c for _u, c in buckets]
+        counts = [cum[0]] + [cum[i] - cum[i - 1]
+                             for i in range(1, len(cum))]
+        counts = [max(0, int(c)) for c in counts]
+        if merged is None:
+            merged = metrics.Histogram(buckets=edges)
+            edges_out = edges
+        try:
+            merged.add_counts(counts)
+        except ValueError:
+            continue
+    if merged is None or edges_out is None:
+        return {"samples": 0}
+    snap = merged.snapshot()
+    return _ptiles(edges_out,
+                   [c for _u, c in snap["buckets"]] + [snap["inf"]])
+
+
+def scrape_process_lines(targets: list[tuple],
+                         timeout: float = 2.0) -> list[str]:
+    """Per-process context lines under the verdict (workload signature,
+    governor, incident counts) — ONE copy of the scrape plumbing,
+    shared with ``cli.py status``."""
+    import scrape_metrics
+
+    mtargets = [(label, f"{base}/metrics") for label, base in targets]
+    wl = scrape_metrics.scrape_workload(mtargets, timeout=timeout)
+    gv = scrape_metrics.scrape_governor(mtargets, timeout=timeout)
+    return (scrape_metrics.workload_lines(wl)
+            + scrape_metrics.governor_lines(gv))
+
+
+def verdict_line(agg: dict) -> str:
+    """The ONE deployment line: merged e2e sync-age percentiles vs the
+    target, contributor count, and the measured clock-skew bound."""
+    e2e = agg.get("e2e")
+    if not e2e or not e2e.get("samples"):
+        return ("deployment sync-age: no stamped deliveries yet "
+                f"({len(agg.get('gates', []))} gates answered, "
+                f"{len(agg.get('skipped', []))} processes skipped)")
+    verdict = "PASS" if agg.get("pass") else "FAIL"
+    line = (f"deployment sync-age {verdict} "
+            f"e2e p50={e2e.get('p50_ms')} p90={e2e.get('p90_ms')} "
+            f"p99={e2e.get('p99_ms')} ms vs target "
+            f"{agg.get('target_ms')} ms "
+            f"({e2e['samples']} records via {len(agg.get('gates', []))}"
+            f" gates)")
+    skew = (agg.get("clock") or {}).get("max_skew_ms")
+    if skew is not None:
+        line += f" | clock skew <= {skew} ms"
+    if agg.get("clock_warp_total"):
+        line += f" | {agg['clock_warp_total']} warped boundaries"
+    return line
+
+
+def hop_table(agg: dict) -> list[str]:
+    hops = agg.get("hops") or {}
+    if not hops:
+        return []
+    lines = [f"{'hop':<14}{'p50_ms':>10}{'p90_ms':>10}{'p99_ms':>10}"]
+    for hop in HOPS:
+        h = hops.get(hop)
+        if not h or not h.get("samples"):
+            continue
+        lines.append(f"{hop:<14}{h.get('p50_ms', '-'):>10}"
+                     f"{h.get('p90_ms', '-'):>10}"
+                     f"{h.get('p99_ms', '-'):>10}")
+    tick = agg.get("tick_latency") or {}
+    if tick.get("samples"):
+        lines.append(f"{'(device tick)':<14}{tick.get('p50_ms', '-'):>10}"
+                     f"{tick.get('p90_ms', '-'):>10}"
+                     f"{tick.get('p99_ms', '-'):>10}")
+    return lines
+
+
+def render(agg: dict) -> str:
+    return "\n".join([verdict_line(agg)] + hop_table(agg))
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge every process's sync-age plane into one "
+                    "deployment SLO verdict")
+    ap.add_argument("server_dir", nargs="?", default=None)
+    ap.add_argument("--url", action="append", default=[],
+                    help="a process /metrics url (repeatable)")
+    ap.add_argument("--watch", type=float, default=0.0, metavar="SECS",
+                    help="refresh every SECS seconds until interrupted")
+    ap.add_argument("--timeout", type=float, default=2.0)
+    ap.add_argument("--json", action="store_true",
+                    help="emit the raw merged record instead of text")
+    args = ap.parse_args(argv)
+
+    try:
+        targets = _targets(args.server_dir, args.url)
+    except FileNotFoundError as exc:
+        print(exc, file=sys.stderr)
+        return 1
+    if not targets:
+        print("nothing to scrape: pass a server dir with http_port "
+              "configured, or --url", file=sys.stderr)
+        return 1
+
+    while True:
+        agg = aggregate(targets, timeout=args.timeout)
+        if args.json:
+            print(json.dumps(agg, indent=2, default=str))
+        else:
+            print(render(agg))
+            for line in scrape_process_lines(targets,
+                                             timeout=args.timeout):
+                print(line)
+        if not args.watch:
+            break
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            break
+        print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
