@@ -339,6 +339,8 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
         and not gc.megaspace,
         telemetry_live=gc.telemetry_live,
         snapshot_keyframe_every=gc.snapshot_keyframe_every,
+        residency=gc.residency,
+        residency_sample_every=gc.residency_sample_every,
     )
     # periodic persistence cadence (reference [gameN] save_interval,
     # goworld.ini.sample:45; Entity.go:164-177)

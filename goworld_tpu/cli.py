@@ -844,6 +844,14 @@ def cmd_status(server_dir: str) -> int:
                 [t for t in targets if t[0] in results])
             for line in scraper.governor_lines(gv):
                 print(line)
+            # serve-loop residency verdict per tracked world
+            # (debug_http /residency, goworld_tpu/utils/residency):
+            # bubble p99 vs budget, alloc churn, serve_gap over the
+            # scan marginal; tracker-less processes skipped silently
+            rs = scraper.scrape_residency(
+                [t for t in targets if t[0] in results])
+            for line in scraper.residency_lines(rs):
+                print(line)
             # ONE deployment-wide sync-age verdict: the merged
             # end-to-end age-at-delivery vs the paper's 16 ms target
             # (tools/obs_aggregate.py; unreachable/old processes
@@ -857,8 +865,12 @@ def cmd_status(server_dir: str) -> int:
                     try:
                         # tick_contrast off: status already scraped
                         # /metrics; the verdict line never prints it
-                        print(agg_tool.verdict_line(agg_tool.aggregate(
-                            bases, tick_contrast=False)))
+                        agg = agg_tool.aggregate(
+                            bases, tick_contrast=False)
+                        print(agg_tool.verdict_line(agg))
+                        rline = agg_tool.residency_line(agg)
+                        if rline:
+                            print(rline)
                     except Exception:
                         pass  # the verdict must never break status
             for e in errors:

@@ -224,6 +224,17 @@ class GameConfig:
     # between ship sparse int16 plane deltas with per-plane CRCs.
     # 0 = the monolithic checkpoint format, unchanged.
     snapshot_keyframe_every: int = 0
+    # serve-loop residency plane (utils/residency.py; docs/
+    # OBSERVABILITY.md "Serve-loop residency"): host-bubble/phase
+    # timing from perf_counter marks on the tick's existing structure
+    # (zero added device syncs), the sampled alloc-churn probes and
+    # the donation-readiness buffer census, served at /residency and
+    # merged into the deployment verdict. false = off.
+    residency: bool = True
+    # cadence (ticks) of the sampled probes — the buffer census and
+    # device.memory_stats() deltas; the timing lanes are always-on.
+    # Must be >= 1 (validated loudly at World build).
+    residency_sample_every: int = 16
     # online kernel governor (goworld_tpu/autotune; docs/AUTOTUNE.md):
     # the live workload signature hot-swaps the resolved tick config
     # (aoi_skin on/off, sort/sweep impl) between ticks with AOT-warmed
@@ -579,6 +590,13 @@ extent_z = 1000.0
 # snapshot_keyframe_every = 8  # delta-compressed checkpoint chain:
 #                          # every Nth checkpoint is a full quantized
 #                          # keyframe (0 = monolithic checkpoints)
+# residency = false        # drop the serve-loop residency plane
+#                          # (default ON: host-bubble/alloc-churn/
+#                          # serve-gap verdicts at /residency —
+#                          # docs/OBSERVABILITY.md "Serve-loop
+#                          # residency"; timing only, no device syncs)
+# residency_sample_every = 16  # cadence (ticks) of the buffer census
+#                          # + memory_stats probes; must be >= 1
 # governor = true          # online kernel governor (docs/AUTOTUNE.md):
 #                          # the live workload signature hot-swaps the
 #                          # tick config (skin on/off, counting sort)

@@ -160,6 +160,8 @@ class World:
         pipeline_decode: bool = False,
         telemetry_live: bool = True,
         snapshot_keyframe_every: int = 0,
+        residency: bool = True,
+        residency_sample_every: int = 16,
     ):
         # delta-compressed snapshot cadence (ISSUE 12, freeze.py
         # SnapshotChain): every Nth checkpoint is a full quantized
@@ -313,6 +315,22 @@ class World:
                 # the lanes loudly and keep ticking
                 logger.exception("live telemetry init failed; disabled")
                 self._telem_fn = self._telem_acc = None
+
+        # serve-loop residency plane (utils/residency.py, ISSUE 16):
+        # host-sync bubble / alloc-churn / serve-gap verdicts from
+        # perf_counter marks riding this tick's existing structure —
+        # zero added device syncs. Constructed OUTSIDE a try block: a
+        # bad residency_sample_every must fail loudly (the GridSpec
+        # convention), only runtime sampling degrades gracefully.
+        self.residency = None
+        if residency:
+            from goworld_tpu.utils import residency as residency_mod
+
+            self.residency = residency_mod.register(
+                f"game{game_id}",
+                residency_mod.ResidencyTracker(
+                    f"game{game_id}",
+                    sample_every=residency_sample_every))
 
         # host object model
         self.entities: dict[str, Entity] = {}
@@ -1587,6 +1605,12 @@ class World:
 
     def _tick_phases(self, tl) -> None:
         t_start = time.perf_counter()
+        # serve-loop residency marks (utils/residency.py): perf_counter
+        # instants at the phase boundaries this method already has —
+        # nothing here touches the device
+        rt = self.residency
+        if rt is not None:
+            rt.tick_begin()
         # sync-age epoch: this tick's state is decided by the inputs
         # flushed below, so the age of everything it produces is
         # measured from HERE (utils/syncage.py lane table)
@@ -1625,6 +1649,10 @@ class World:
                     logger.exception(
                         "live telemetry fold failed; disabled")
                     self._telem_fn = self._telem_acc = None
+        if rt is not None:
+            # the device has work from HERE: closes the previous
+            # inter-dispatch gap, so the bubble verdict lands now
+            rt.mark_dispatch()
         if self.pipeline_decode:
             # PIPELINED decode (opt-in; single-controller non-mesh
             # worlds only — mesh/mega decode has same-tick couplings
@@ -1659,6 +1687,8 @@ class World:
                 self._age_pending_mark, age_mark
         with tl.span("fetch_outputs"):
             acc_host = None
+            if rt is not None:
+                rt.mark_fetch()
             if outs is not None and acc_fetch is not None:
                 # the telemetry drain rides the EXISTING fetch: one
                 # combined transfer, zero added sync points per tick
@@ -1667,6 +1697,9 @@ class World:
                 outs = self._dget(outs)
             elif acc_fetch is not None:
                 acc_host = self._dget(acc_fetch)
+            if rt is not None:
+                # outputs are host-visible: the device_wait lane ends
+                rt.mark_visible()
             if acc_host is not None:
                 try:
                     self._ingest_telemetry(acc_host)
@@ -1697,12 +1730,30 @@ class World:
         # host-observable without a sync)
         dt = time.perf_counter() - t0
         self.op_stats["device_step_s"] = dt
+        if rt is not None:
+            rt.observe_device_step(dt)
         tl.set_tick_args(device_step_ms=round(dt * 1e3, 3),
                          tick=self.tick_count)
         with tl.span("decode_fanout"):
             if outs is not None:
                 self._decode_outputs(outs)
             self.post_q.tick()
+        if rt is not None:
+            rt.mark_decode_done()
+            if rt.should_sample(self.tick_count):
+                # sampled churn probes (census pointer reads + local
+                # allocator stats — still no device sync). A probe
+                # failure disables the plane, never the tick.
+                try:
+                    rt.sample_census(self.state)
+                    dev = getattr(self.state.pos, "devices", None)
+                    if dev is not None:
+                        rt.sample_memory(next(iter(dev())),
+                                         self.tick_count)
+                except Exception:
+                    logger.exception(
+                        "residency sampling failed; disabled")
+                    self.residency = None
         self.tick_count += 1
         opmon.monitor.record("world.tick", time.perf_counter() - t_start)
 

@@ -396,6 +396,12 @@ class GameServer:
             # spans land in the same trace row as the World's phases
             tl.begin_tick()
             self._m_queue_depth.set(self._packet_q.qsize())
+            # residency accounting (utils/residency.py): the pump below
+            # is useful host work between device dispatches, the pacing
+            # sleep at the bottom is idle by design — declare both so
+            # neither reads as a bubble
+            rt = getattr(self.world, "residency", None)
+            t_pump = time.perf_counter()
             with tl.span("drain_inputs"):
                 # 1.5 frames of handler work per tick keeps the loop
                 # observing (and the p99 near 2x the interval) under a
@@ -404,6 +410,8 @@ class GameServer:
                     budget=1.5 * self.tick_interval
                     if self.overload_enabled else None
                 )
+            if rt is not None:
+                rt.add_host(time.perf_counter() - t_pump)
             self.tick()
             dur = tl.end_tick()
             if dur is not None:
@@ -419,6 +427,8 @@ class GameServer:
                 self._observe_overload(dur, backlog)
             if delay > 0:
                 time.sleep(delay)
+                if rt is not None:
+                    rt.add_idle(delay)
             else:
                 next_tick = time.monotonic()  # fell behind; don't spiral
 
@@ -552,12 +562,19 @@ class GameServer:
         # flight recorder the same SLO signal as the real serve loop
         t0 = time.perf_counter()
         tl = metrics.timeline
+        rt = getattr(self.world, "residency", None)
         if self.world._multihost:
             # the exchange also publishes world.mh_group_ready, which
             # gates the World's own tick-cadence service reconcile
             with tl.span("mh_exchange"):
                 self._mh_exchange_mutations()
+            if rt is not None:
+                rt.add_host(time.perf_counter() - t0)
         self.world.tick()
+        # everything from here to the end of tick() is useful host work
+        # between device dispatches — declared to the residency plane
+        # so the bubble verdict only counts genuinely idle time
+        t_host = time.perf_counter()
         with tl.span("fan_out"):
             self._flush_sync_out()
             self._maybe_checkpoint()
@@ -579,12 +596,18 @@ class GameServer:
                                           gov_ev)
                 except Exception:  # must never break the tick
                     logger.exception("flight-recorder frame failed")
+        if rt is not None:
+            rt.add_host(time.perf_counter() - t_host)
 
     # workload-signature refresh cadence (ticks): how often the tick
     # loop re-reduces the signature for the flight-recorder frame and
     # the [gameN] recommendation line (the /workload endpoint always
     # reduces fresh on demand)
     SIG_LOG_TICKS = 64
+    # residency windowed-verdict cadence (ticks): how often the frame
+    # carries the bubble p99 of the ticks since the previous window —
+    # the residency_regression trigger's input (utils/flightrec.py)
+    RESIDENCY_WIN_TICKS = 16
 
     def _drive_governor(self):
         """One governor observation per rotated signature window: hand
@@ -673,6 +696,17 @@ class GameServer:
             frame["governor"] = (
                 f"{gov_ev['from']}->{gov_ev['to']} ({gov_ev['reason']})"
             )
+        rt = getattr(w, "residency", None)
+        if rt is not None and tick % self.RESIDENCY_WIN_TICKS == 0:
+            # windowed bubble verdict on a cadence: the p99 of the host
+            # bubble over the ticks since the previous window, vs the
+            # tracker's budget — fires the residency_regression trigger
+            p99, n_win = rt.window_verdict()
+            if p99 is not None and n_win > 0:
+                frame["residency_bubble_p99_ms"] = (
+                    "inf" if p99 == float("inf") else round(p99, 3))
+                frame["residency_bubble_budget_ms"] = rt.bubble_budget_ms
+                frame["residency_window"] = n_win
         if getattr(w, "telemetry_live", False) \
                 and tick % self.SIG_LOG_TICKS == 0:
             sig = w.workload_signature()

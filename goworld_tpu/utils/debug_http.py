@@ -54,6 +54,11 @@ TPU-native analog exposes:
   hop) AND the raw bucket count vectors so the deployment aggregator
   (``tools/obs_aggregate.py`` / ``cli.py watch``) can merge
   histograms exactly; an honest error on processes that age nothing
+* ``/residency`` — the serve-loop residency plane (:mod:`goworld_tpu.
+  utils.residency`): per-world host-bubble/phase percentiles with raw
+  mergeable count vectors, alloc-churn samples, the donation-readiness
+  buffer census and the serve_gap verdict; an honest error on
+  processes that tick no world
 
 Stdlib-only (http.server on a daemon thread), one call to :func:`start`.
 """
@@ -76,7 +81,7 @@ logger = log.get("debug_http")
 _ENDPOINTS = ["/healthz", "/vars", "/ops", "/metrics", "/trace",
               "/tracing", "/clock", "/profile", "/faults", "/overload",
               "/costs", "/workload", "/incidents", "/governor",
-              "/syncage"]
+              "/syncage", "/residency"]
 
 # jax.profiler capture state (one capture at a time per process)
 _profile_lock = threading.Lock()
@@ -286,6 +291,13 @@ class _Handler(BaseHTTPRequestHandler):
             from goworld_tpu.utils import syncage
 
             self._json(syncage.snapshot_all())
+        elif path == "/residency":
+            # serve-loop residency plane (utils/residency registry):
+            # bubble/phase percentiles + mergeable count vectors,
+            # alloc churn, buffer census and serve_gap per world
+            from goworld_tpu.utils import residency
+
+            self._json(residency.snapshot_all())
         elif path == "/incidents":
             # flight-recorder incident bundles (utils/flightrec);
             # ?frames=1 adds the live per-tick frame ring

@@ -101,6 +101,13 @@ class CostReport:
     temp_size: int | None = None
     peak_hbm_bytes: int | None = None
     generated_code_size: int | None = None
+    # donation accounting (ISSUE 16; feeds ROADMAP item 5): bytes the
+    # compiled executable ALREADY aliases input->output, and the upper
+    # bound donate_argnums could still reclaim — the overlap of
+    # argument and output footprints not yet aliased. temp vs arg split
+    # is readable directly off temp_size/argument_size above.
+    alias_size: int | None = None
+    donation_reclaimable: int | None = None
     n: int | None = None
     # multichip mode: device count of the mesh executable (cost figures
     # then cover the WHOLE mesh — divide by n_devices for per-chip)
@@ -181,6 +188,14 @@ def cost_report(fn, *args, name: str = "tick", config: dict | None = None,
             rep.peak_hbm_bytes = (rep.argument_size + rep.output_size
                                   + rep.temp_size)
             rep.generated_code_size = int(ma.generated_code_size_in_bytes)
+            # donation headroom: what input->output aliasing could
+            # still reclaim. alias_size_in_bytes is what XLA already
+            # aliases (0 without donate_argnums); the bound is the
+            # smaller of the two footprints minus that.
+            alias = int(getattr(ma, "alias_size_in_bytes", 0) or 0)
+            rep.alias_size = alias
+            rep.donation_reclaimable = max(
+                0, min(rep.argument_size, rep.output_size) - alias)
     except Exception as exc:
         rep.error = (rep.error or "") + f" memory_analysis: {str(exc)[:200]}"
         rep.error = rep.error.strip()
@@ -332,6 +347,11 @@ def roofline_audit(phase_ms: dict, phase_costs: dict, n: int,
                         (xb - mbytes) / mbytes * 100.0, 1)
             if crd.get("flops") is not None:
                 row["xla_gflops"] = round(crd["flops"] / 1e9, 4)
+            if crd.get("donation_reclaimable") is not None:
+                # bytes input->output aliasing could still reclaim for
+                # this phase's executable (ROADMAP item 5's budget)
+                row["donation_reclaimable_mb"] = round(
+                    crd["donation_reclaimable"] / 1e6, 3)
             if crd.get("error"):
                 row["cost_error"] = crd["error"]
         if name in phase_ms:

@@ -32,7 +32,13 @@ Triggers (the grammar — docs/OBSERVABILITY.md):
   ``sync_age_target_ms``; gate frames, utils/syncage.py) — a client
   saw stale positions even if every device tick made its budget; the
   frame carries the per-hop breakdown (``sync_age_hops``) so the
-  bundle says WHICH hop ate the budget.
+  bundle says WHICH hop ate the budget;
+* ``residency_regression`` — the serve loop's windowed host-bubble p99
+  exceeded its budget (``residency_bubble_p99_ms`` >
+  ``residency_bubble_budget_ms``; game frames, utils/residency.py) —
+  frame time the device sat idle with no host work to show for it,
+  the regression ROADMAP item 5's resident-world runtime exists to
+  prevent.
 
 Every trigger kind is deduped with a per-kind cooldown so one bad
 minute yields a handful of bundles, not thousands. Determinism: the
@@ -155,6 +161,18 @@ class FlightRecorder:
                 fired.append((
                     "sync_age_breach",
                     f"e2e p99 {sa_p99:g} ms > {sa_target:g} ms"))
+            rb_p99 = frame.get("residency_bubble_p99_ms")
+            rb_budget = frame.get("residency_bubble_budget_ms")
+            if rb_p99 == "inf":
+                rb_p99 = float("inf")
+            if rb_p99 is not None and rb_budget is not None \
+                    and rb_p99 > rb_budget:
+                # the serve loop's host bubble regressed past its
+                # budget: frame time the device sat idle for no reason
+                # (utils/residency.py; game frames)
+                fired.append((
+                    "residency_regression",
+                    f"bubble p99 {rb_p99:g} ms > {rb_budget:g} ms"))
             gov = frame.get("governor")
             if gov is not None:
                 # the autotune governor committed a kernel-config swap

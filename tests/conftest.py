@@ -113,6 +113,14 @@ def pytest_configure(config):
         "tests/test_syncage.py); all run in tier-1 on CPU "
         "(docs/OBSERVABILITY.md \"End-to-end sync age\")",
     )
+    config.addinivalue_line(
+        "markers",
+        "residency: serve-loop residency plane suites (host-sync "
+        "bubble accounting, alloc-churn census, the scan-marginal vs "
+        "serve gap, /residency, the residency_regression trigger — "
+        "tests/test_residency.py); all run in tier-1 on CPU "
+        "(docs/OBSERVABILITY.md \"Serve-loop residency\")",
+    )
 
 
 def spawn_on(states, dev, slot, **kw):

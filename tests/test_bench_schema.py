@@ -401,3 +401,82 @@ def test_sync_age_block_shape_caught(tmp_path):
     rec3["sync_age"]["e2e"] = {"p99_ms": 3.0}
     errs = _validate(tmp_path, "BENCH_r15.json", rec3)
     assert any("e2e" in e for e in errs)
+
+
+# =======================================================================
+# r>=16: the serve-loop residency block (ISSUE 16)
+# =======================================================================
+def _residency_block(**extra):
+    pt = {"samples": 90, "p50_ms": 0.5, "p90_ms": 1.0, "p99_ms": 2.0}
+    blk = {
+        "entities": 64,
+        "ticks": 90,
+        "bubble": dict(pt),
+        "tick": {"samples": 90, "p50_ms": 17.0, "p90_ms": 18.0,
+                 "p99_ms": 20.0},
+        "bubble_budget_ms": 4.0,
+        "phases": {p: dict(pt) for p in
+                   ("pre_dispatch", "device_wait", "decode_fanout",
+                    "host_other", "idle", "bubble")},
+        "gc": {"pauses": 2, "total_ms": 1.0, "max_ms": 0.8},
+        "alloc": {"unavailable": "memory_stats unavailable"},
+        "census": {"samples": 5, "lanes": 19, "realloc": ["pos"],
+                   "aliased": [], "opaque": [], "changes": {"pos": 5}},
+        "serve_ms_per_tick": 17.0,
+        "serve_gap": 1.4,
+        "serve_gap_ref": "scan_marginal",
+        "serve_gap_ref_ms": 12.1,
+        "scan_marginal_ms": 12.1,
+        "pass": True,
+        "mark_overhead_us_per_tick": 8.0,
+        "mark_overhead_pct_of_budget": 0.05,
+    }
+    blk.update(extra)
+    return blk
+
+
+def _r16_rec(**extra):
+    """A valid r16 record: r15's contract + the residency block."""
+    rec = _r15_rec(residency=_residency_block())
+    rec.update(extra)
+    return rec
+
+
+def test_residency_block_required_since_r16(tmp_path):
+    rec = _r16_rec()
+    assert _validate(tmp_path, "BENCH_r16.json", rec) == []
+    # missing entirely -> caught at r16, grandfathered at r15
+    rec2 = _r16_rec()
+    del rec2["residency"]
+    errs = _validate(tmp_path, "BENCH_r16.json", rec2)
+    assert any("residency" in e for e in errs)
+    assert _validate(tmp_path, "BENCH_r15.json", rec2) == []
+    # honest skip/error records accepted (the BENCH_RESIDENCY=0 round
+    # and the stage-failed round are both valid artifacts)
+    for blk in ({"skipped": "BENCH_RESIDENCY=0"},
+                {"error": "residency stage never completed"}):
+        rec3 = _r16_rec(residency=blk)
+        assert _validate(tmp_path, "BENCH_r16.json", rec3) == []
+
+
+def test_residency_block_shape_caught(tmp_path):
+    # a present-but-gutted block is malformation, not an honest skip
+    rec = _r16_rec(residency={"bubble": {"p99_ms": 1.0}})
+    errs = _validate(tmp_path, "BENCH_r16.json", rec)
+    assert any("residency" in e for e in errs)
+    # bubble percentiles must be the full p50/p90/p99 + samples shape
+    rec2 = _r16_rec()
+    rec2["residency"]["bubble"] = {"p99_ms": 1.0}
+    errs = _validate(tmp_path, "BENCH_r16.json", rec2)
+    assert any("bubble" in e for e in errs)
+    # the census must carry the donation worklist shape
+    rec3 = _r16_rec()
+    rec3["residency"]["census"] = {"samples": 5}
+    errs = _validate(tmp_path, "BENCH_r16.json", rec3)
+    assert any("census" in e for e in errs)
+    # alloc must be a dict — measured stats or {"unavailable": ...},
+    # never a bare null pretending nothing was supposed to be there
+    rec4 = _r16_rec()
+    rec4["residency"]["alloc"] = None
+    errs = _validate(tmp_path, "BENCH_r16.json", rec4)
+    assert any("alloc" in e for e in errs)

@@ -410,3 +410,97 @@ def test_sync_age_gate_survives_headline_shape_change(tmp_path):
     r2b["sync_age"] = _sa_block(10.5)
     f2b = _write(tmp_path, "BENCH_r02.json", r2b)
     assert TREND.main([f1, f2b]) == 0
+
+
+def _rs_block(p99, gap, entities=64, passed=None):
+    return {
+        "entities": entities,
+        "bubble": {"samples": 90, "p50_ms": p99 / 4, "p90_ms": p99 / 2,
+                   "p99_ms": p99},
+        "tick": {"samples": 90, "p50_ms": 17.0, "p90_ms": 18.0,
+                 "p99_ms": 20.0},
+        "bubble_budget_ms": 4.0,
+        "serve_gap": gap,
+        "serve_gap_ref": "scan_marginal",
+        "pass": (p99 <= 4.0 if passed is None else passed),
+    }
+
+
+def test_residency_series_gated_and_regression_fails(tmp_path):
+    """The residency block's bubble p99 and serve_gap are their own
+    lower-is-better series at the same (entities, platform) shape
+    (ISSUE 16): an injected regression in either fails, skip/error
+    rounds neither gate nor anchor, shape changes are new series."""
+    r1 = _bench_rec(1000.0)
+    r1["residency"] = _rs_block(2.0, 1.4)
+    r2 = _bench_rec(1000.0)
+    r2["residency"] = _rs_block(2.2, 1.5)
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 0
+    # injected bubble regression: headline flat, bubble p99 up 4x
+    r3 = _bench_rec(1000.0)
+    r3["residency"] = _rs_block(8.0, 1.4, passed=False)
+    f3 = _write(tmp_path, "BENCH_r03.json", r3)
+    assert TREND.main([f1, f2, f3]) == 2
+    # injected serve_gap regression with a healthy bubble
+    r3g = _bench_rec(1000.0)
+    r3g["residency"] = _rs_block(2.0, 2.5)
+    f3g = _write(tmp_path, "BENCH_r03.json", r3g)
+    assert TREND.main([f1, f2, f3g]) == 2
+    # an honest skip neither gates nor anchors
+    r3b = _bench_rec(1000.0)
+    r3b["residency"] = {"skipped": "BENCH_RESIDENCY=0"}
+    f3b = _write(tmp_path, "BENCH_r03.json", r3b)
+    assert TREND.main([f1, f2, f3b]) == 0
+    # a different residency shape is a different series
+    r3c = _bench_rec(1000.0)
+    r3c["residency"] = _rs_block(8.0, 2.5, entities=192, passed=False)
+    f3c = _write(tmp_path, "BENCH_r03.json", r3c)
+    assert TREND.main([f1, f2, f3c]) == 0
+
+
+def test_residency_pass_to_fail_and_inf_fail(tmp_path):
+    """A verdict flip pass -> fail at the same shape always fails (the
+    slo-flip rule), and a latest round whose bubble p99 lands past the
+    last bucket ("inf", the ptiles convention) fails against any
+    finite prior."""
+    r1 = _bench_rec(1000.0)
+    r1["residency"] = _rs_block(3.5, 1.4)            # pass, near budget
+    r2 = _bench_rec(1000.0)
+    r2["residency"] = _rs_block(4.2, 1.4, passed=False)  # flip
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 2
+    # "inf" latest vs finite prior: strongest regression, gated
+    r2b = _bench_rec(1000.0)
+    r2b["residency"] = _rs_block(3.5, 1.4, passed=False)
+    r2b["residency"]["bubble"]["p99_ms"] = "inf"
+    f2b = _write(tmp_path, "BENCH_r02.json", r2b)
+    assert TREND.main([f1, f2b]) == 2
+    # zero-bubble prior + sub-slack latest: the 0.25 ms absolute slack
+    # keeps timer noise from gating a healthy round
+    r1c = _bench_rec(1000.0)
+    r1c["residency"] = _rs_block(0.0, 1.4)
+    r2c = _bench_rec(1000.0)
+    r2c["residency"] = _rs_block(0.2, 1.4)
+    f1c = _write(tmp_path, "BENCH_r03.json", r1c)
+    f2c = _write(tmp_path, "BENCH_r04.json", r2c)
+    assert TREND.main([f1c, f2c]) == 0
+
+
+def test_residency_gate_survives_headline_shape_change(tmp_path):
+    """Like the governor/sync_age series: a round that changes the
+    headline entity count must still gate its residency block against
+    prior rounds' — the early headline return must not swallow it."""
+    r1 = _bench_rec(1000.0, entities=1000)
+    r1["residency"] = _rs_block(2.0, 1.4)
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    r2 = _bench_rec(5000.0, entities=4096)
+    r2["residency"] = _rs_block(8.0, 1.4, passed=False)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 2
+    r2b = _bench_rec(5000.0, entities=4096)
+    r2b["residency"] = _rs_block(2.1, 1.45)
+    f2b = _write(tmp_path, "BENCH_r02.json", r2b)
+    assert TREND.main([f1, f2b]) == 0

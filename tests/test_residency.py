@@ -1,0 +1,420 @@
+"""Serve-loop residency plane (ISSUE 16): bubble/phase accounting from
+perf_counter marks on the existing tick structure (transfer-guard-proven
+zero added syncs), the donation-readiness buffer census on the vmapped
+multi-space path, alloc-churn honesty, the ``/residency`` endpoint and
+the deployment aggregator merge, the ``residency_regression``
+flight-recorder trigger, the gc-callback idempotency contract, and the
+<1%-of-frame overhead bound."""
+
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from goworld_tpu.core import WorldConfig
+from goworld_tpu.entity import Entity, Space, World
+from goworld_tpu.ops.aoi import GridSpec
+from goworld_tpu.utils import debug_http, flightrec, metrics, residency
+
+pytestmark = pytest.mark.residency
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registries():
+    """Metric families and the tracker registry are process-global;
+    residency series must start empty per test or cross-test counts
+    leak into bubble/census asserts."""
+    metrics.REGISTRY.reset()
+    residency.reset()
+    yield
+    metrics.REGISTRY.reset()
+    residency.reset()
+
+
+class _Mob(Entity):
+    ATTRS = {"hp": "allclients hot:100"}
+
+
+def _world(n_spaces=1, **kw):
+    cfg = WorldConfig(
+        capacity=32,
+        grid=GridSpec(radius=10.0, extent_x=100.0, extent_z=100.0,
+                      k=8, cell_cap=32, row_block=32),
+        input_cap=32,
+    )
+    w = World(cfg, n_spaces=n_spaces, **kw)
+    w.register_entity("Mob", _Mob)
+    w.register_space("Arena", Space)
+    w.create_nil_space()
+    return w
+
+
+# =======================================================================
+# tracker core: bubble residual + phase lane accounting
+# =======================================================================
+def _mark_cycle(rt, covered_s=0.0, idle_s=0.0):
+    rt.tick_begin()
+    rt.mark_dispatch()
+    rt.mark_fetch()
+    rt.mark_visible()
+    rt.mark_decode_done()
+    if covered_s:
+        rt.add_host(covered_s)
+    if idle_s:
+        rt.add_idle(idle_s)
+
+
+def test_bubble_is_the_uncovered_residual():
+    rt = residency.ResidencyTracker("t", sample_every=1 << 20)
+    _mark_cycle(rt)  # opens the first gap (no verdict yet)
+    assert rt.ticks == 0
+    # an undeclared stall between dispatches IS the bubble...
+    time.sleep(0.02)
+    _mark_cycle(rt)
+    assert rt.ticks == 1
+    assert rt.last_bubble_ms >= 15.0
+    # ...and the same stall declared as pacing sleep is NOT
+    t0 = time.perf_counter()
+    time.sleep(0.02)
+    rt.add_idle(time.perf_counter() - t0)
+    _mark_cycle(rt)
+    assert rt.last_bubble_ms < 15.0
+    # declared covered host work is not a bubble either
+    t0 = time.perf_counter()
+    time.sleep(0.02)
+    rt.add_host(time.perf_counter() - t0)
+    _mark_cycle(rt)
+    assert rt.last_bubble_ms < 15.0
+    snap = rt.snapshot()
+    assert snap["ticks"] == 3
+    assert set(snap["phases"]) == set(residency.PHASES)
+    # raw count vectors ride the payload for exact merging
+    assert len(snap["bubble_counts"]) == len(snap["edges_ms"]) + 1
+    assert sum(snap["bubble_counts"]) == 3
+    assert isinstance(snap["pass"], bool)
+
+
+def test_snapshot_serve_gap_refs_are_honest():
+    rt = residency.ResidencyTracker("t", sample_every=1 << 20)
+    _mark_cycle(rt)
+    time.sleep(0.004)
+    _mark_cycle(rt)
+    rt.observe_device_step(0.002)
+    rt.observe_device_step(0.002)
+    snap = rt.snapshot()
+    # no pinned marginal: the tracker's own device-step p50 backs it
+    assert snap["serve_gap_ref"] == "device_step_p50"
+    assert snap["serve_gap"] > 0
+    rt.set_scan_marginal_ms(2.0)
+    snap = rt.snapshot()
+    assert snap["serve_gap_ref"] == "scan_marginal"
+    assert snap["serve_gap_ref_ms"] == 2.0
+    assert snap["serve_ms_per_tick"] == snap["tick"]["p50_ms"]
+
+
+def test_sample_every_validated_loudly():
+    with pytest.raises(ValueError, match="residency_sample_every"):
+        residency.ResidencyTracker("t", sample_every=0)
+    # the World constructor propagates the knob OUTSIDE any try block:
+    # a bad config fails loudly at construction, never silently off
+    with pytest.raises(ValueError, match="residency_sample_every"):
+        _world(residency_sample_every=-3)
+
+
+def test_window_verdict_deltas():
+    rt = residency.ResidencyTracker("t", sample_every=1 << 20)
+    _mark_cycle(rt)
+    time.sleep(0.01)
+    _mark_cycle(rt)
+    p99, n = rt.window_verdict()  # first call only sets the mark
+    assert (p99, n) == (None, 0)
+    time.sleep(0.01)
+    _mark_cycle(rt)
+    p99, n = rt.window_verdict()
+    assert n == 1 and p99 is not None and p99 > 0
+    # an empty window is honest, not a stale repeat
+    assert rt.window_verdict() == (None, 0)
+
+
+# =======================================================================
+# instrumented tick: zero added syncs + census on the vmapped path
+# =======================================================================
+def test_instrumented_world_ticks_and_marks_are_transfer_free():
+    import jax
+
+    w = _world(n_spaces=1, residency_sample_every=1)
+    rt = w.residency
+    assert rt is not None
+    sp = w.create_space("Arena")
+    for i in range(4):
+        sp.create_entity("Mob", pos=(40.0 + i, 0.0, 40.0))
+    w.tick()  # compile outside the guard
+    w.tick()
+    assert rt.ticks >= 1
+    # every residency operation — marks, census pointer reads, the
+    # snapshot — is host-only: prove it under the strictest guard
+    # (the tick itself legitimately fetches outputs; the PLANE adds
+    # no transfer of its own)
+    with jax.transfer_guard("disallow"):
+        _mark_cycle(rt)
+        rt.sample_census(w.state)
+        rt.window_verdict()
+        snap = rt.snapshot()
+    assert snap["ticks"] >= 2
+
+
+def test_census_stable_and_finds_realloc_on_vmapped_path():
+    w = _world(n_spaces=2, residency_sample_every=1)
+    rt = w.residency
+    sp = w.create_space("Arena")
+    for i in range(4):
+        sp.create_entity("Mob", pos=(40.0 + i, 0.0, 40.0))
+    for _ in range(9):
+        w.tick()
+    census = rt.snapshot()["census"]
+    # sampled every tick: >= 8 pairwise samples over 9 ticks
+    assert census["samples"] >= 8
+    assert census["lanes"] > 0
+    # the partition is exact: every fingerprinted lane is either
+    # re-allocated (donation work) or aliased in place, never both
+    realloc, aliased = set(census["realloc"]), set(census["aliased"])
+    assert realloc.isdisjoint(aliased)
+    assert realloc | aliased == set(census["changes"])
+    # without donation the jitted step rewrites the carry: the census
+    # must find at least one re-allocated lane (the donate_argnums
+    # worklist is nonempty — the whole point of the plane)
+    assert len(realloc) >= 1
+    # lane names are stable pytree paths (the worklist is actionable)
+    assert all(lane for lane in census["changes"])
+    # alloc honesty on CPU: measured dict or an explicit absence
+    alloc = rt.snapshot()["alloc"]
+    assert isinstance(alloc, dict)
+    assert ("bytes_in_use" in alloc) or ("unavailable" in alloc)
+
+
+def test_residency_off_means_no_tracker():
+    w = _world(residency=False)
+    assert w.residency is None
+    w.tick()
+    assert "error" in residency.snapshot_all()
+
+
+# =======================================================================
+# gc-callback idempotency
+# =======================================================================
+def test_gc_callback_never_stacks_under_tracker_churn():
+    import gc as _gc
+
+    # earlier tests' worlds may have died with the shared callback
+    # still installed (dead subscribers vanish from the WeakSet
+    # silently; removal happens on the next unsubscribe) — flush via
+    # one install/uninstall round-trip, then the contract is exact
+    _gc.collect()
+    flush = residency.GcPauseTracker("flush")
+    flush.install()
+    flush.uninstall()
+    assert residency.gc_callback_count() == 0
+    trackers = []
+    for i in range(5):
+        t = residency.GcPauseTracker(f"churn{i}")
+        t.install()
+        t.install()  # double-install must not double-subscribe
+        trackers.append(t)
+        assert residency.gc_callback_count() == 1
+    for t in trackers:
+        t.uninstall()
+        t.uninstall()
+    assert residency.gc_callback_count() == 0
+    # a full tracker close detaches too (the World teardown path)
+    rt = residency.ResidencyTracker("t", sample_every=1 << 20)
+    rt.tick_begin()  # binds + installs on first tick
+    assert residency.gc_callback_count() == 1
+    rt.close()
+    rt.close()
+    assert residency.gc_callback_count() == 0
+
+
+def test_gc_pauses_attributed_to_bound_thread_only():
+    import gc as _gc
+
+    t = residency.GcPauseTracker("gcme")
+    t.bind_thread()
+    t.install()
+    try:
+        _gc.collect()
+        assert t.pauses >= 1
+        seen = t.pauses
+        # collections on OTHER threads never count against the tick
+        import threading
+
+        other = threading.Thread(target=_gc.collect)
+        other.start()
+        other.join()
+        assert t.pauses == seen
+    finally:
+        t.uninstall()
+
+
+# =======================================================================
+# flight-recorder trigger (deterministic replay from frozen frames)
+# =======================================================================
+def test_residency_regression_trigger_fires_and_cools_down():
+    clock = [0.0]
+    rec = flightrec.FlightRecorder(ring=16, cooldown_secs=30.0,
+                                   clock=lambda: clock[0])
+    frame = {"tick": 16, "residency_bubble_p99_ms": 9.5,
+             "residency_bubble_budget_ms": 4.0,
+             "residency_window": 16}
+    out = rec.record(dict(frame))
+    assert len(out) == 1
+    assert out[0]["trigger"] == "residency_regression"
+    assert "9.5" in out[0]["detail"] and "4" in out[0]["detail"]
+    # deterministic replay: the frozen frames carry the exact verdict
+    assert out[0]["frames"][-1]["residency_bubble_p99_ms"] == 9.5
+    assert out[0]["frames"][-1]["residency_window"] == 16
+    # cooldown dedups, then re-arms
+    clock[0] = 5.0
+    assert rec.record(dict(frame, tick=32)) == []
+    clock[0] = 35.0
+    assert len(rec.record(dict(frame, tick=48))) == 1
+    # the "inf" overflow convention is the strongest breach
+    clock[0] = 99.0
+    out = rec.record({"tick": 64, "residency_bubble_p99_ms": "inf",
+                      "residency_bubble_budget_ms": 4.0})
+    assert len(out) == 1 and out[0]["trigger"] == "residency_regression"
+    # under budget: silent
+    clock[0] = 199.0
+    assert rec.record({"tick": 80, "residency_bubble_p99_ms": 1.0,
+                       "residency_bubble_budget_ms": 4.0}) == []
+
+
+# =======================================================================
+# endpoint + scrape + deployment merge
+# =======================================================================
+def test_residency_endpoint_serves_registered_trackers():
+    rt = residency.register(
+        "game7", residency.ResidencyTracker("game7",
+                                            sample_every=1 << 20))
+    _mark_cycle(rt)
+    time.sleep(0.002)
+    _mark_cycle(rt)
+    srv = debug_http.start(0, process_name="game7")
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/residency", timeout=5) as r:
+            payload = json.loads(r.read())
+        assert "game7" in payload
+        snap = payload["game7"]
+        for key in ("bubble", "bubble_counts", "edges_ms", "tick",
+                    "phases", "census", "alloc", "gc"):
+            assert key in snap
+        # weakref registry: a dropped world leaves an honest error
+        residency.unregister("game7")
+        del rt
+        import gc as _gc
+
+        _gc.collect()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/residency", timeout=5) as r:
+            assert "error" in json.loads(r.read())
+    finally:
+        srv.shutdown()
+
+
+_SNAP_SEQ = [0]
+
+
+def _snap_with(bubbles_ms, gap, budget=4.0):
+    # unique tracker label per call: histogram families are
+    # process-global, a reused label would merge the fixtures
+    _SNAP_SEQ[0] += 1
+    rt = residency.ResidencyTracker(f"mock{_SNAP_SEQ[0]}",
+                                    sample_every=1 << 20)
+    for b in bubbles_ms:
+        rt._h_tick.observe(max(b, 0.001) * 2)
+        rt._h_bubble.observe(b)
+        rt.ticks += 1
+    rt.set_scan_marginal_ms(1.0)
+    snap = rt.snapshot()
+    snap["serve_gap"] = gap
+    snap["bubble_budget_ms"] = budget
+    rt.close()
+    return snap
+
+
+def test_aggregator_merges_bubble_counts_and_worst_gap(monkeypatch):
+    import obs_aggregate
+
+    snap_fast = _snap_with([0.1] * 100, gap=1.2)
+    snap_slow = _snap_with([9.0] * 50, gap=2.8)
+
+    def fake_fetch(url, timeout=2.0):
+        if url.startswith("http://g1") and url.endswith("/residency"):
+            return {"game1": snap_fast}
+        if url.startswith("http://g2") and url.endswith("/residency"):
+            return {"game2": snap_slow}
+        raise OSError("down")
+
+    monkeypatch.setattr(obs_aggregate, "_fetch_json", fake_fetch)
+    res = obs_aggregate.aggregate_residency(
+        [("g1", "http://g1"), ("g2", "http://g2"),
+         ("dead", "http://dead")])
+    assert res["worlds"] == ["g1:game1", "g2:game2"]
+    # exact vector merge: every tick from both worlds is in the mass
+    assert res["bubble"]["samples"] == 150
+    # the slow world's 9 ms mass dominates the merged p99
+    assert res["bubble"]["p99_ms"] == "inf" or \
+        res["bubble"]["p99_ms"] > 4.0
+    assert res["pass"] is False
+    assert res["serve_gap_worst"] == 2.8
+    line = obs_aggregate.residency_line({"residency": res})
+    assert "FAIL" in line and "2.8" in line
+    # no contributors -> no line (status stays quiet, never "0 worlds")
+    assert obs_aggregate.residency_line(
+        {"residency": {"worlds": []}}) == ""
+
+
+def test_scrape_residency_lines_render_verdicts(monkeypatch):
+    import scrape_metrics
+
+    snap = _snap_with([0.2] * 40, gap=1.5)
+    lines = scrape_metrics.residency_lines({"game1": {"game1": snap}})
+    assert len(lines) == 1
+    assert "residency bubble p99" in lines[0]
+    assert "serve_gap 1.5" in lines[0]
+    assert "PASS" in lines[0]
+    bad = _snap_with([40.0] * 40, gap=6.0)
+    lines = scrape_metrics.residency_lines({"game2": {"game2": bad}})
+    assert "FAIL" in lines[0]
+
+
+# =======================================================================
+# overhead: the plane must cost <1% of the 60 Hz frame
+# =======================================================================
+def test_mark_overhead_under_one_percent_of_frame():
+    rt = residency.ResidencyTracker("ovh", sample_every=1 << 30)
+    reps = 2000
+    _mark_cycle(rt)  # open the first gap outside the timer
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        rt.tick_begin()
+        rt.mark_dispatch()
+        rt.mark_fetch()
+        rt.mark_visible()
+        rt.add_host(1e-4)
+        rt.observe_device_step(1e-3)
+        rt.mark_decode_done()
+        rt.add_idle(1e-4)
+    per_tick_us = (time.perf_counter() - t0) / reps * 1e6
+    rt.close()
+    budget_us = 1e6 / 60.0  # 16.7 ms frame
+    assert per_tick_us < 0.01 * budget_us, (
+        f"residency marks cost {per_tick_us:.1f} us/tick "
+        f"(>1% of the 60 Hz frame)")

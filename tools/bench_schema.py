@@ -49,7 +49,13 @@ contract):
   the end-to-end device-tick-epoch -> gate-delivery age measured
   through the real game->gate loopback (per-hop + e2e p50/p90/p99,
   the verdict vs the 16 ms target, the measured stamp overhead) —
-  honest ``{"error"/"skipped": ...}`` records accepted.
+  honest ``{"error"/"skipped": ...}`` records accepted;
+* rounds >= 16 (the serve-loop residency era, ISSUE 16): a
+  ``residency`` block — the instrumented-World serve-loop plane
+  (bubble/tick percentiles, phase lanes, the donation-readiness
+  buffer census, alloc churn or its honest absence, serve_gap vs the
+  pinned scan-marginal, the measured mark overhead) — honest
+  ``{"error"/"skipped": ...}`` records accepted.
 
 Exit codes: 0 all valid, 1 usage/missing, 2 schema violations.
 """
@@ -113,6 +119,16 @@ SYNC_AGE_KEYS = ("target_ms", "e2e", "hops", "records_per_tick",
                  "pass", "stamp_overhead_pct_of_budget")
 SYNC_AGE_HOPS = ("device_tick", "drain_decode", "encode",
                  "dispatcher", "gate_flush")
+# the serve-loop residency era (ISSUE 16): every BENCH round stamps
+# the instrumented serve loop's residency plane — the host bubble vs
+# its budget, the phase lanes, the donation-readiness census (the
+# donate_argnums worklist), alloc churn (or its honest absence on
+# backends without memory_stats), serve_gap vs the pinned
+# scan-marginal, and the measured overhead of the always-on marks
+RESIDENCY_SINCE = 16
+RESIDENCY_KEYS = ("bubble", "tick", "phases", "census", "alloc",
+                  "serve_gap", "serve_gap_ref", "scan_marginal_ms",
+                  "bubble_budget_ms", "mark_overhead_pct_of_budget")
 MULTI_HEADLINE_KEYS = ("entity_ticks_per_sec_mesh",
                        "per_chip_efficiency", "n_entities", "platform")
 MULTI_GAUGE_KEYS = ("halo_demand_max", "migrate_demand_max",
@@ -210,6 +226,24 @@ def validate_bench(path: str, doc: dict) -> list[str]:
                         errs.append(f"sync_age missing hop {hop!r}")
             else:
                 errs.append(f"sync_age hops malformed: {hops!r:.120}")
+    if rno >= RESIDENCY_SINCE:
+        _check_block(rec, "residency", RESIDENCY_KEYS, errs)
+        rs = rec.get("residency")
+        if isinstance(rs, dict) and "error" not in rs \
+                and "skipped" not in rs:
+            bub = rs.get("bubble")
+            if not (isinstance(bub, dict)
+                    and {"p50_ms", "p90_ms", "p99_ms", "samples"}
+                    <= set(bub)):
+                errs.append(f"residency bubble malformed: {bub!r:.120}")
+            cen = rs.get("census")
+            if not (isinstance(cen, dict)
+                    and {"samples", "realloc", "aliased"} <= set(cen)):
+                errs.append(f"residency census malformed: {cen!r:.120}")
+            if not isinstance(rs.get("alloc"), dict):
+                # measured stats or {"unavailable": ...} — never absent
+                errs.append(
+                    f"residency alloc malformed: {rs.get('alloc')!r:.120}")
     # per-scenario blocks, wherever present: each needs either a
     # headline-style shape or an honest error
     for sc, blk in (rec.get("scenarios") or {}).items():

@@ -188,6 +188,87 @@ def _check_sync_age_series(rounds: list, latest: dict, name: str,
             f"prior {os.path.basename(prev_path)})")
 
 
+def _check_residency_series(rounds: list, latest: dict, name: str,
+                            threshold: float, problems: list[str],
+                            notes: list[str]) -> None:
+    """The serve-loop residency block (ISSUE 16): its bubble p99 and
+    serve_gap are lower-is-better series of their own, gated against
+    the best (lowest) prior round at the SAME (entities, platform)
+    shape. Skipped/error rounds neither gate nor anchor; a bubble p99
+    of ``"inf"`` (mass past the last bucket, the ptiles convention) is
+    the strongest regression a latest round can stamp but never
+    anchors; a pass->fail flip at the same shape is always a problem
+    (the slo rule)."""
+    def _p99(s) -> float | None:
+        v = (s.get("bubble") or {}).get("p99_ms")
+        if v == "inf":
+            return float("inf")
+        return float(v) if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) else None
+
+    def _rs_ok(s) -> bool:
+        return (isinstance(s, dict) and "error" not in s
+                and "skipped" not in s and _p99(s) is not None
+                and isinstance(s.get("serve_gap"), (int, float)))
+
+    lrs = latest.get("residency")
+    if not _rs_ok(lrs):
+        return
+    rshape = (lrs.get("entities"), latest.get("platform"))
+    rprior = [
+        (p, r["residency"]) for p, r in rounds[:-1]
+        if _rs_ok(r.get("residency"))
+        and (r["residency"].get("entities"),
+             r.get("platform")) == rshape
+    ]
+    if not rprior:
+        notes.append(f"{name}: residency shape {rshape} has no prior "
+                     "round — not gated")
+        return
+    # bubble p99 vs the best (lowest) FINITE prior. The +0.25 ms
+    # absolute slack is one histogram bucket: a zero-bubble prior must
+    # not turn timer noise on an otherwise-healthy round into a gate
+    lp99 = _p99(lrs)
+    finite = [(p, s) for p, s in rprior
+              if _p99(s) != float("inf")]
+    if finite:
+        best_path, best = min(finite, key=lambda pr: _p99(pr[1]))
+        ceil = (1.0 + threshold) * _p99(best) + 0.25
+        if lp99 > ceil:
+            problems.append(
+                f"{name}: residency bubble p99 {lrs['bubble']['p99_ms']}"
+                f" ms > {ceil:.3g} ms "
+                f"({(1 + threshold) * 100:.0f}% of "
+                f"{os.path.basename(best_path)}'s "
+                f"{best['bubble']['p99_ms']} ms + 0.25)")
+        else:
+            notes.append(
+                f"{name}: residency bubble p99 "
+                f"{lrs['bubble']['p99_ms']} ms vs best prior "
+                f"{best['bubble']['p99_ms']} ms — ok")
+    # serve_gap (serve ms/tick over the scan-marginal reference):
+    # lower is better, a pure ratio so no absolute slack needed
+    lgap = lrs["serve_gap"]
+    gbest_path, gbest = min(rprior, key=lambda pr: pr[1]["serve_gap"])
+    gceil = (1.0 + threshold) * gbest["serve_gap"]
+    if lgap > gceil:
+        problems.append(
+            f"{name}: residency serve_gap {lgap} > {gceil:.3g} "
+            f"({(1 + threshold) * 100:.0f}% of "
+            f"{os.path.basename(gbest_path)}'s {gbest['serve_gap']})")
+    else:
+        notes.append(
+            f"{name}: residency serve_gap {lgap} vs best prior "
+            f"{gbest['serve_gap']} — ok")
+    prev_path, prev = rprior[-1]
+    if prev.get("pass") and not lrs.get("pass"):
+        problems.append(
+            f"{name}: residency verdict regressed pass -> fail "
+            f"(bubble p99 {lrs['bubble']['p99_ms']} vs budget "
+            f"{lrs.get('bubble_budget_ms')} ms, prior "
+            f"{os.path.basename(prev_path)})")
+
+
 def check_bench(files: list[str], threshold: float,
                 problems: list[str], notes: list[str]) -> None:
     rounds = []
@@ -215,6 +296,10 @@ def check_bench(files: list[str], threshold: float,
     # headline's
     _check_sync_age_series(rounds, latest, name, threshold,
                            problems, notes)
+    # the serve-loop residency series (ISSUE 16): same hoisting — its
+    # (entities, platform) shape is the BLOCK's, not the headline's
+    _check_residency_series(rounds, latest, name, threshold,
+                            problems, notes)
     prior = [(p, r) for p, r in rounds[:-1]
              if _shape(r) == _shape(latest)]
     if not prior:

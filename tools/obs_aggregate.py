@@ -206,7 +206,88 @@ def aggregate(targets: list[tuple], timeout: float = 2.0,
         out["tick_latency"] = _merged_metric_hist(
             targets, "tick_latency_ms", timeout=timeout)
     out["clock"] = scrape_clock_skew(targets, timeout=timeout)
+    out["residency"] = aggregate_residency(targets, timeout=timeout)
     return out
+
+
+def aggregate_residency(targets: list[tuple],
+                        timeout: float = 2.0) -> dict:
+    """Merge every tracked world's serve-loop residency plane
+    (utils/residency.py, debug_http ``/residency``) into one
+    deployment record: the bubble histograms are vector-added exactly
+    (``add_counts`` over the raw count vectors, the ``/syncage``
+    convention), the serve_gap is reported as the WORST across worlds
+    (the deployment's hidden tax is set by its slowest serve loop),
+    and the verdict judges the merged bubble p99 against the
+    STRICTEST budget. Unreachable/404/tracker-less processes are
+    skipped silently."""
+    bub_hist: metrics.Histogram | None = None
+    edges = None
+    worlds: list[str] = []
+    worst_gap = None
+    budget = None
+    for label, base in targets:
+        try:
+            payload = _fetch_json(f"{base}/residency", timeout=timeout)
+        except (urllib.error.URLError, OSError, ValueError):
+            continue
+        if not isinstance(payload, dict) or "error" in payload:
+            continue
+        for name, snap in sorted(payload.items()):
+            if not isinstance(snap, dict) \
+                    or "bubble_counts" not in snap:
+                continue
+            sedges = snap.get("edges_ms")
+            bub_hist, ok = _merge_counts(bub_hist, sedges,
+                                         snap["bubble_counts"])
+            if not ok:
+                worlds.append(f"{label}:{name} (bucket mismatch)")
+                continue
+            edges = edges or sedges
+            worlds.append(f"{label}:{name}")
+            gap = snap.get("serve_gap")
+            if isinstance(gap, (int, float)) \
+                    and (worst_gap is None or gap > worst_gap):
+                worst_gap = gap
+            b = snap.get("bubble_budget_ms")
+            if isinstance(b, (int, float)):
+                budget = b if budget is None else min(budget, b)
+    out: dict = {"worlds": worlds}
+    if bub_hist is not None and edges is not None:
+        hs = bub_hist.snapshot()
+        out["bubble"] = _ptiles(
+            edges, [c for _u, c in hs["buckets"]] + [hs["inf"]])
+        if budget is not None:
+            out["bubble_budget_ms"] = budget
+            p99 = out["bubble"].get("p99_ms")
+            if isinstance(p99, (int, float)):
+                out["pass"] = bool(p99 <= budget)
+            elif p99 == "inf":
+                out["pass"] = False
+    if worst_gap is not None:
+        out["serve_gap_worst"] = worst_gap
+    return out
+
+
+def residency_line(agg: dict) -> str:
+    """One deployment serve-loop residency line (empty when no world
+    contributed): merged bubble percentiles vs the strictest budget +
+    the worst serve_gap."""
+    res = agg.get("residency") or {}
+    bub = res.get("bubble")
+    if not bub or not bub.get("samples"):
+        return ""
+    verdict = ("PASS" if res.get("pass")
+               else "FAIL" if "pass" in res else "?")
+    line = (f"deployment residency {verdict} bubble "
+            f"p50={bub.get('p50_ms')} p90={bub.get('p90_ms')} "
+            f"p99={bub.get('p99_ms')} ms vs budget "
+            f"{res.get('bubble_budget_ms')} ms "
+            f"({bub['samples']} ticks via "
+            f"{len(res.get('worlds', []))} worlds)")
+    if res.get("serve_gap_worst") is not None:
+        line += f" | worst serve_gap {res['serve_gap_worst']}"
+    return line
 
 
 def _merged_metric_hist(targets: list[tuple], name: str,
@@ -263,8 +344,10 @@ def scrape_process_lines(targets: list[tuple],
     mtargets = [(label, f"{base}/metrics") for label, base in targets]
     wl = scrape_metrics.scrape_workload(mtargets, timeout=timeout)
     gv = scrape_metrics.scrape_governor(mtargets, timeout=timeout)
+    rs = scrape_metrics.scrape_residency(mtargets, timeout=timeout)
     return (scrape_metrics.workload_lines(wl)
-            + scrape_metrics.governor_lines(gv))
+            + scrape_metrics.governor_lines(gv)
+            + scrape_metrics.residency_lines(rs))
 
 
 def verdict_line(agg: dict) -> str:
@@ -311,7 +394,11 @@ def hop_table(agg: dict) -> list[str]:
 
 
 def render(agg: dict) -> str:
-    return "\n".join([verdict_line(agg)] + hop_table(agg))
+    lines = [verdict_line(agg)] + hop_table(agg)
+    rline = residency_line(agg)
+    if rline:
+        lines.append(rline)
+    return "\n".join(lines)
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -257,6 +257,60 @@ def governor_lines(scraped: dict[str, dict]) -> list[str]:
     return lines
 
 
+def scrape_residency(targets: list[tuple[str, str]],
+                     timeout: float = 2.0) -> dict[str, dict]:
+    """Fetch each target's ``/residency`` (utils/residency.py);
+    {label: payload}. Unreachable/404/tracker-less processes are
+    skipped silently — the ``/costs`` convention (gates and
+    dispatchers serve the endpoint but tick no world)."""
+    out: dict[str, dict] = {}
+    for label, url in targets:
+        res_url = url.rsplit("/", 1)[0] + "/residency"
+        try:
+            with urllib.request.urlopen(res_url,
+                                        timeout=timeout) as resp:
+                payload = json.loads(
+                    resp.read().decode("utf-8", "replace"))
+        except (urllib.error.URLError, OSError, ValueError):
+            continue
+        if isinstance(payload, dict) and "error" not in payload:
+            out[label] = payload
+    return out
+
+
+def residency_lines(scraped: dict[str, dict]) -> list[str]:
+    """One serve-loop residency line per tracked world (``cli.py
+    status`` prints these under the governor lines): bubble p99 vs
+    budget, alloc churn (or its honest absence), the serve_gap ratio
+    and any gc pauses on the tick thread."""
+    lines: list[str] = []
+    for label, payload in sorted(scraped.items()):
+        for name, snap in sorted(payload.items()):
+            if not isinstance(snap, dict) or "bubble" not in snap:
+                continue
+            p99 = (snap["bubble"] or {}).get("p99_ms")
+            line = f"{label}: residency bubble p99 {p99} ms"
+            alloc = snap.get("alloc")
+            if isinstance(alloc, dict) and "allocs_per_tick" in alloc:
+                line += f" | allocs/tick {alloc['allocs_per_tick']}"
+            elif isinstance(alloc, dict) and "unavailable" in alloc:
+                line += " | allocs/tick -"
+            gap = snap.get("serve_gap")
+            if gap is not None:
+                line += (f" | serve_gap {gap} "
+                         f"({snap.get('serve_gap_ref', '?')})")
+            gc_snap = snap.get("gc") or {}
+            if gc_snap.get("pauses"):
+                line += (f" | gc {gc_snap['pauses']} pauses "
+                         f"max {gc_snap.get('max_ms')} ms")
+            if "pass" in snap:
+                line += " | " + ("PASS" if snap["pass"] else
+                                 "FAIL (bubble over "
+                                 f"{snap.get('bubble_budget_ms')} ms)")
+            lines.append(line)
+    return lines
+
+
 def slo_lines(costs: dict[str, dict]) -> list[str]:
     """One human line per process: the SLO verdict (or its absence)."""
     lines: list[str] = []
@@ -323,6 +377,12 @@ def main(argv: list[str] | None = None) -> int:
     wl = scrape_workload([t for t in targets if t[0] in results],
                          timeout=args.timeout)
     for line in workload_lines(wl):
+        print(line)
+    # serve-loop residency verdicts (debug_http /residency;
+    # 404/unreachable/tracker-less skipped silently like /costs)
+    res = scrape_residency([t for t in targets if t[0] in results],
+                           timeout=args.timeout)
+    for line in residency_lines(res):
         print(line)
     if args.costs:
         for label, payload in sorted(costs.items()):
