@@ -341,6 +341,9 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
         snapshot_keyframe_every=gc.snapshot_keyframe_every,
         residency=gc.residency,
         residency_sample_every=gc.residency_sample_every,
+        audit=gc.audit,
+        audit_sample_every=gc.audit_sample_every,
+        audit_cohort=gc.audit_cohort,
     )
     # periodic persistence cadence (reference [gameN] save_interval,
     # goworld.ini.sample:45; Entity.go:164-177)
@@ -473,6 +476,7 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
             sync_delta=gc.sync_delta,
             sync_keyframe_every=gc.sync_keyframe_every,
             sync_age=gc.sync_age,
+            audit_scrub_every=gc.audit_scrub_every,
             # online kernel governor (goworld_tpu/autotune): eligible
             # shapes only — megaspace/mesh kernel choice stays the TPU
             # A/B plane's job, said loudly instead of silently ignored

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import asyncio
+import json
 import os
 import signal
 import subprocess
@@ -871,6 +872,12 @@ def cmd_status(server_dir: str) -> int:
                         rline = agg_tool.residency_line(agg)
                         if rline:
                             print(rline)
+                        # deployment conservation (utils/audit.py):
+                        # per-game censuses + in-flight migrations vs
+                        # created − destroyed, named problems indented
+                        aline = agg_tool.audit_line(agg)
+                        if aline:
+                            print(aline)
                     except Exception:
                         pass  # the verdict must never break status
             for e in errors:
@@ -976,6 +983,67 @@ def cmd_trace(server_dir: str, rate: float, seconds: float,
     merged, errors = merger.collect(targets)
     rc = merger.write_and_report(merged, errors, out)
     return 1 if still_armed else rc
+
+
+# =======================================================================
+# incidents (postmortem bundle capture across the live cluster)
+# =======================================================================
+def cmd_incidents(server_dir: str, out: str | None = None,
+                  frames: bool = False) -> int:
+    """Scrape every process's ``/incidents`` (the flight-recorder
+    bundles — SLO breach, overload transition, audit violation …) into
+    one timestamped postmortem bundle directory: ``{label}.json`` per
+    reachable process plus a ``manifest.json`` naming what was
+    captured. ``--frames`` adds each recorder's live per-tick frame
+    ring (``?frames=1``) for tail context around the frozen bundles."""
+    cfg = config_mod.load(_find_config(server_dir))
+    merger = _load_tool("merge_traces")
+    if merger is None:
+        print("tools/merge_traces.py not available in this install",
+              file=sys.stderr)
+        return 1
+    targets = merger.base_targets_from_config(cfg)
+    if not targets:
+        print("no process has an http_port configured — incident "
+              "capture needs the debug-http endpoints", file=sys.stderr)
+        return 1
+    stamp = time.strftime("%Y%m%d_%H%M%S")
+    bundle_dir = os.path.join(out or server_dir, f"incidents_{stamp}")
+    os.makedirs(bundle_dir, exist_ok=True)
+    manifest: dict = {"captured_at": stamp, "frames": bool(frames),
+                      "processes": {}, "unreachable": []}
+    total = 0
+    for label, base in targets:
+        url = f"{base}/incidents" + ("?frames=1" if frames else "")
+        try:
+            payload = merger.fetch_json(url, timeout=3.0)
+        except (OSError, ValueError) as exc:
+            print(f"{label}: {base} unreachable ({exc})",
+                  file=sys.stderr)
+            manifest["unreachable"].append(label)
+            continue
+        path = os.path.join(bundle_dir, f"{label}.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            json.dump(payload, fh, indent=1, default=str)
+        counts = {
+            name: rec.get("incident_count", 0)
+            for name, rec in payload.items() if isinstance(rec, dict)
+        }
+        n = sum(counts.values())
+        total += n
+        manifest["processes"][label] = {"file": f"{label}.json",
+                                        "incidents": counts}
+        print(f"{label}: {n} incident(s) -> {path}")
+    with open(os.path.join(bundle_dir, "manifest.json"), "w",
+              encoding="utf-8") as fh:
+        json.dump(manifest, fh, indent=1, default=str)
+    if not manifest["processes"]:
+        print("no process reachable; is the cluster running?",
+              file=sys.stderr)
+        return 1
+    print(f"bundle: {bundle_dir} ({total} incident(s) from "
+          f"{len(manifest['processes'])}/{len(targets)} processes)")
+    return 0
 
 
 # =======================================================================
@@ -1124,6 +1192,18 @@ def main(argv: list[str] | None = None) -> int:
     pt.add_argument("--seconds", type=float, default=5.0,
                     help="capture window")
     pt.add_argument("--out", default="cluster_trace.json")
+    pi = sub.add_parser(
+        "incidents",
+        help="scrape every process's /incidents flight-recorder "
+             "bundles into a timestamped postmortem directory",
+    )
+    pi.add_argument("server_dir")
+    pi.add_argument("--out", default=None,
+                    help="parent directory for the bundle "
+                         "(default: the server dir)")
+    pi.add_argument("--frames", action="store_true",
+                    help="include each recorder's live per-tick frame "
+                         "ring (?frames=1), not just frozen bundles")
     pb = sub.add_parser("build")
     pb.add_argument("server_dir", nargs="?", default=None)
     pw = sub.add_parser("watchdog")
@@ -1184,6 +1264,9 @@ def main(argv: list[str] | None = None) -> int:
     if args.cmd == "trace":
         return cmd_trace(args.server_dir, rate=args.rate,
                          seconds=args.seconds, out=args.out)
+    if args.cmd == "incidents":
+        return cmd_incidents(args.server_dir, out=args.out,
+                             frames=args.frames)
     if args.cmd == "build":
         return cmd_build(args.server_dir)
     if args.cmd == "watchdog":

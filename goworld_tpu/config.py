@@ -235,6 +235,25 @@ class GameConfig:
     # device.memory_stats() deltas; the timing lanes are always-on.
     # Must be >= 1 (validated loudly at World build).
     residency_sample_every: int = 16
+    # correctness audit plane (utils/audit.py; docs/OBSERVABILITY.md
+    # "Correctness audit"): an independent entity-ownership ledger
+    # (census digests + migrate ownership seqs -> deployment
+    # conservation verdicts), a sampled live AOI oracle judging one
+    # cohort's interest sets brute-force off the hot path, and mirror
+    # consistency probes — served at /audit, violations feed
+    # audit_violations_total{kind} + the audit_violation flight-
+    # recorder trigger. false = off.
+    audit: bool = True
+    # oracle/probe sample cadence (ticks) and cohort size (entities
+    # judged per sample). Must be >= 1 (validated loudly at World
+    # build).
+    audit_sample_every: int = 64
+    audit_cohort: int = 64
+    # SnapshotChain CRC-scrub cadence (ticks; 0 = off): the audit
+    # worker re-reads this game's chain files on this cadence so
+    # latent on-disk corruption is a named violation, not a surprise
+    # at the next -restore boot
+    audit_scrub_every: int = 0
     # online kernel governor (goworld_tpu/autotune; docs/AUTOTUNE.md):
     # the live workload signature hot-swaps the resolved tick config
     # (aoi_skin on/off, sort/sweep impl) between ticks with AOT-warmed
@@ -597,6 +616,15 @@ extent_z = 1000.0
 #                          # residency"; timing only, no device syncs)
 # residency_sample_every = 16  # cadence (ticks) of the buffer census
 #                          # + memory_stats probes; must be >= 1
+# audit = false            # drop the correctness audit plane
+#                          # (default ON: entity-ownership ledger +
+#                          # sampled AOI oracle + mirror probes at
+#                          # /audit — docs/OBSERVABILITY.md
+#                          # "Correctness audit"; zero device syncs)
+# audit_sample_every = 64  # oracle/probe sample cadence (ticks)
+# audit_cohort = 64        # entities judged per sample
+# audit_scrub_every = 1024 # SnapshotChain CRC-scrub cadence (ticks;
+#                          # 0 = off)
 # governor = true          # online kernel governor (docs/AUTOTUNE.md):
 #                          # the live workload signature hot-swaps the
 #                          # tick config (skin on/off, counting sort)

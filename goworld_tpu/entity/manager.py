@@ -162,6 +162,9 @@ class World:
         snapshot_keyframe_every: int = 0,
         residency: bool = True,
         residency_sample_every: int = 16,
+        audit: bool = True,
+        audit_sample_every: int = 64,
+        audit_cohort: int = 64,
     ):
         # delta-compressed snapshot cadence (ISSUE 12, freeze.py
         # SnapshotChain): every Nth checkpoint is a full quantized
@@ -331,6 +334,27 @@ class World:
                 residency_mod.ResidencyTracker(
                     f"game{game_id}",
                     sample_every=residency_sample_every))
+
+        # correctness audit plane (utils/audit.py, ISSUE 17): an
+        # INDEPENDENT entity-ownership ledger fed by the create/
+        # destroy/migrate hooks below, plus a sampled live AOI oracle —
+        # every audit_sample_every ticks one cohort's interest sets are
+        # recomputed brute-force on a background worker against planes
+        # that rode THIS tick's existing fetch-outputs transfer (zero
+        # added device syncs; see the aud_req piggyback in tick()).
+        # Constructed OUTSIDE a try block like residency: bad knobs
+        # fail loudly, only runtime sampling degrades gracefully.
+        self.audit = None
+        self._audit_shard = 0
+        if audit:
+            from goworld_tpu.utils import audit as audit_mod
+
+            self.audit = audit_mod.register(
+                f"game{game_id}",
+                audit_mod.AuditPlane(
+                    f"game{game_id}",
+                    sample_every=audit_sample_every,
+                    cohort=audit_cohort))
 
         # host object model
         self.entities: dict[str, Entity] = {}
@@ -528,6 +552,9 @@ class World:
         self._attach(sp, ids.nil_space_id(self.game_id))
         sp.is_nil_space = True
         self.entities[sp.id] = sp
+        if self.audit is not None:
+            self.audit.ledger.on_create(sp.id, "NilSpace",
+                                        self.tick_count)
         self.spaces[sp.id] = sp
         self.nil_space = sp
         if self.on_entity_created is not None:
@@ -589,6 +616,9 @@ class World:
             sp.shard = shard
         self.entities[sp.id] = sp
         self.spaces[sp.id] = sp
+        if self.audit is not None:
+            self.audit.ledger.on_create(sp.id, type_name,
+                                        self.tick_count)
         # explicit attrs dict first (wire path — attr names there may
         # collide with parameter names), then kwarg sugar
         for k, v in {**(attrs or {}), **kw_attrs}.items():
@@ -627,6 +657,9 @@ class World:
             raise ValueError(f"entity id collision: {new_id}")
         self._attach(e, new_id)
         self.entities[e.id] = e
+        if self.audit is not None:
+            self.audit.ledger.on_create(e.id, type_name,
+                                        self.tick_count)
         if attrs:
             load_into(e.attrs, attrs)
         e.OnInit()
@@ -892,6 +925,10 @@ class World:
         if e.destroyed:
             return
         e.destroyed = True
+        if self.audit is not None:
+            # the ledger tracks LIVE entities; the host object may
+            # linger in self.entities until its leave events drain
+            self.audit.ledger.on_destroy(e.id, self.tick_count)
         try:
             e.OnDestroy()
         except Exception:
@@ -1305,8 +1342,12 @@ class World:
     # ==================================================================
     def get_migrate_data(self, e: Entity) -> dict:
         """Everything needed to recreate the entity on another game: all
-        attrs, client binding, pos/yaw, migration-safe timers."""
-        return {
+        attrs, client binding, pos/yaw, migration-safe timers — plus the
+        audit ownership seq (ISSUE 17) the target's ledger validates
+        against re-delivered or stale ghosts. ``remove_for_migration``
+        commits the matching ledger move; the seqs agree because the
+        two calls run back-to-back on the logic thread."""
+        data = {
             "type": e.type_name,
             "id": e.id,
             "attrs": e.attrs.to_dict(),
@@ -1318,12 +1359,19 @@ class World:
             "yaw": e.yaw,
             "timers": self.timers.dump(list(e.timer_ids)),
         }
+        if self.audit is not None:
+            data["own_seq"] = self.audit.ledger.next_seq(e.id)
+        return data
 
     def remove_for_migration(self, e: Entity) -> None:
         """Tear down the local copy WITHOUT destroy semantics — no
         OnDestroy, no persistence, no client destroy message (the client
         binding travels in the migrate data; reference
         ``destroyEntity(isMigrate=true)``, ``Entity.go:631-651``)."""
+        if self.audit is not None:
+            # ledger move-out: opens an in-flight record the target's
+            # migrate-in must retire within the conservation grace
+            self.audit.ledger.stamp_migrate_out(e.id, self.tick_count)
         e.OnMigrateOut()
         for tid in list(e.timer_ids):
             self.timers.cancel(tid)
@@ -1347,6 +1395,10 @@ class World:
         e._type_desc = desc
         self._attach(e, data["id"])
         self.entities[e.id] = e
+        if self.audit is not None:
+            self.audit.ledger.on_migrate_in(
+                e.id, data["type"], data.get("own_seq", 0),
+                self.tick_count)
         load_into(e.attrs, data["attrs"])
         if data.get("client"):
             # direct assignment = the reference's "re-assign client
@@ -1685,18 +1737,41 @@ class World:
             # the device_tick lane honestly includes the pipeline skew
             age_mark, self._age_pending_mark = \
                 self._age_pending_mark, age_mark
+        # audit-oracle cohort planes (ISSUE 17): on a sample tick the
+        # judged shard's pos/alive/aoi_radius ride the SAME combined
+        # fetch below — the lazy device slices cost nothing to build
+        # and the plane adds zero sync points. Only the single-
+        # controller non-mega shape is judged (a mesh slice would
+        # gather cross-device; the skip is recorded honestly in
+        # _audit_sample).
+        aud_req = None
+        ap = self.audit
+        if (ap is not None and self.mega is None and self.mesh is None
+                and not self.pipeline_decode
+                and ap.want_sample(self.tick_count)):
+            s = self._audit_shard % self.n_spaces
+            aud_req = (self.state.pos[s], self.state.alive[s],
+                       self.state.aoi_radius[s])
         with tl.span("fetch_outputs"):
             acc_host = None
+            aud_host = None
             if rt is not None:
                 rt.mark_fetch()
-            if outs is not None and acc_fetch is not None:
+            fetch = {}
+            if outs is not None:
+                fetch["outs"] = outs
+            if acc_fetch is not None:
                 # the telemetry drain rides the EXISTING fetch: one
                 # combined transfer, zero added sync points per tick
-                outs, acc_host = self._dget((outs, acc_fetch))
-            elif outs is not None:
-                outs = self._dget(outs)
-            elif acc_fetch is not None:
-                acc_host = self._dget(acc_fetch)
+                fetch["acc"] = acc_fetch
+            if aud_req is not None:
+                fetch["aud"] = aud_req
+            if fetch:
+                got = self._dget(fetch)
+                if "outs" in got:
+                    outs = got["outs"]
+                acc_host = got.get("acc")
+                aud_host = got.get("aud")
             if rt is not None:
                 # outputs are host-visible: the device_wait lane ends
                 rt.mark_visible()
@@ -1738,6 +1813,17 @@ class World:
             if outs is not None:
                 self._decode_outputs(outs)
             self.post_q.tick()
+        ap = self.audit
+        if ap is not None and ap.want_sample(self.tick_count):
+            # capture the cohort + frozen interest sets HERE (the
+            # decode above just made them current for this tick), then
+            # hand the oracle math to the audit worker. A capture
+            # failure disables the plane, never the tick.
+            try:
+                self._audit_sample(aud_host)
+            except Exception:
+                logger.exception("audit sampling failed; disabled")
+                self.audit = None
         if rt is not None:
             rt.mark_decode_done()
             if rt.should_sample(self.tick_count):
@@ -1774,6 +1860,134 @@ class World:
         if pending is None:
             return
         self._decode_outputs(self._dget(pending))
+
+    # -- correctness audit sampling (utils/audit.py, ISSUE 17) ----------
+    def _audit_sample(self, aud_host) -> None:
+        """Logic-thread half of one audit sample: decide eligibility
+        (every skip recorded with its reason — a degraded tick must
+        never read as a passed one), run the cheap cohort-bounded
+        mirror probes inline, freeze the cohort's interest sets and
+        ledger census, and hand the O(cohort x n) oracle math to the
+        audit worker. Zero device syncs: ``aud_host`` already rode the
+        tick's combined fetch."""
+        ap = self.audit
+        tick = self.tick_count
+        if self.mega is not None:
+            ap.skip_sample("megaspace", tick)
+            return
+        if self.mesh is not None:
+            ap.skip_sample("mesh", tick)
+            return
+        if self.pipeline_decode:
+            # the decoded interest sets are tick N-1's while state.pos
+            # is tick N's — the oracle would judge mismatched epochs
+            ap.skip_sample("pipeline_decode", tick)
+            return
+        if aud_host is None:
+            ap.skip_sample("no_fetch", tick)
+            return
+        if (self.op_stats.get("aoi_over_k_rows")
+                or self.op_stats.get("aoi_over_cap_cells")):
+            # the check_oracle exactness precondition: a sweep that
+            # overflowed k/cell_cap is only approximate by design —
+            # provisioning, not correctness, is the finding there
+            ap.skip_sample("overflow", tick)
+            return
+        s = self._audit_shard % self.n_spaces
+        self._audit_shard += 1
+        owner = dict(self._slot_owner[s])
+        if not owner:
+            ap.skip_sample("empty", tick)
+            return
+        # slots whose device rows lag the host this tick (staged
+        # spawns/despawns/moves from decode callbacks, in-flight
+        # migrations): judging them would manufacture mismatches
+        pending = {sl for sh, sl, _ in self._staged_spawn if sh == s}
+        pending |= {sl for sh, sl in self._staged_despawn if sh == s}
+        pending |= {sl for sh, sl in self._staged_pos if sh == s}
+        eligible = []
+        for slot, eid in owner.items():
+            if slot in pending:
+                continue
+            e = self.entities.get(eid)
+            if (e is None or e.destroyed or e.slot is None
+                    or e._migrating is not None
+                    or e._pending_pos is not None):
+                continue
+            eligible.append(slot)
+        cohort = ap.next_cohort(eligible)
+        if not cohort:
+            ap.skip_sample("empty", tick)
+            return
+        # mirror consistency probes, inline (cohort-bounded dict/numpy
+        # peeks): slot->eid mirror columns, client binding columns,
+        # interested_by reverse edges
+        probe_bad = 0
+        for slot in cohort:
+            eid = owner[slot]
+            e = self.entities[eid]
+            if self._mir_eid[s, slot] != eid.encode("ascii"):
+                probe_bad += 1
+                ap.ledger.note_violation(
+                    "mirror_slot",
+                    f"slot mirror [{s},{slot}] holds "
+                    f"{self._mir_eid[s, slot]!r}, host says EntityID "
+                    f"{eid} (tick {tick})", tick)
+            cid = e.client.client_id.encode("ascii") \
+                if e.client is not None else b""
+            gid = e.client.gate_id if e.client is not None else -1
+            if (self._mir_cid[s, slot] != cid
+                    or int(self._mir_gate[s, slot]) != gid):
+                probe_bad += 1
+                ap.ledger.note_violation(
+                    "mirror_client",
+                    f"client mirror [{s},{slot}] diverges for EntityID "
+                    f"{eid}: cols ({self._mir_cid[s, slot]!r}, "
+                    f"{int(self._mir_gate[s, slot])}) vs host "
+                    f"({cid!r}, {gid}) (tick {tick})", tick)
+            for jid in e.interested_in:
+                je = self.entities.get(jid)
+                if je is None or eid not in je.interested_by:
+                    probe_bad += 1
+                    ap.ledger.note_violation(
+                        "interest_symmetry",
+                        f"EntityID {eid} watches {jid} but is not in "
+                        f"its interested_by (tick {tick})", tick)
+        ap.note_probe(len(cohort), probe_bad)
+        # ledger-vs-world census cross-check: both sides frozen NOW on
+        # the logic thread (the worker only diffs), so churn between
+        # capture and judgment cannot fake a divergence
+        world_live = {eid for eid, e in self.entities.items()
+                      if not e.destroyed}
+        ledger_live = ap.ledger.live_eids()
+        # frozen interest sets for the cohort (the worker must not
+        # chase live sets the next tick is already mutating)
+        interest = {owner[slot]: set(self.entities[owner[slot]]
+                                     .interested_in)
+                    for slot in cohort}
+        pos, alive, wr = aud_host
+        quant_step = quant_hi = None
+        if self.cfg.grid.precision != "off":
+            quant_step = self.cfg.grid.quant_step
+            quant_hi = (1 << consts.PRECISION_POS_BITS) - 1
+        radius = self.cfg.grid.radius
+        from goworld_tpu.utils import audit as audit_mod
+
+        def _job():
+            diff = sorted(world_live ^ ledger_live)
+            if diff:
+                ap.ledger.note_violation(
+                    "census_divergence",
+                    f"ledger and world census diverge at EntityID "
+                    f"{diff[0]} ({len(diff)} differ; tick {tick})",
+                    tick)
+            ap.judge_sample(
+                tick=tick, pos=pos, alive=alive, watch_radius=wr,
+                radius=radius, cohort_slots=cohort, owner=owner,
+                interest=interest, quant_step=quant_step,
+                quant_hi=quant_hi or 0)
+
+        ap.submit(_job)
 
     # -- staging flush --------------------------------------------------
     def _spmd_guard(self) -> None:

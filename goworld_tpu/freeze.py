@@ -247,6 +247,14 @@ def restore_world(world: World, data: dict) -> None:
             e.timer_ids.add(tid)
         e.OnRestored()
 
+    if world.audit is not None:
+        # the direct rebuilds above bypass the ledger hooks: re-anchor
+        # the audit census on the restored population (ISSUE 17)
+        world.audit.ledger.resync(
+            {e.id: e.type_name for e in world.entities.values()
+             if not e.destroyed},
+            world.tick_count)
+
     logger.info(
         "restored %d spaces + %d entities into game%d",
         len(data["spaces"]), len(data["entities"]), world.game_id,

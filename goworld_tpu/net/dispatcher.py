@@ -209,6 +209,48 @@ class DispatcherService:
         # the hot path is one dict hit + one locked increment
         self._route_counters: dict[int, metrics.Counter] = {}
 
+        # correctness audit census (utils/audit.py, ISSUE 17): the
+        # routing table is the deployment's independent view of entity
+        # ownership — served at /audit as per-game counts + CRC census
+        # digests so the aggregator can cross-check every game's own
+        # ledger without either side shipping an eid list. Weakref'd
+        # like every plane registration: the registry must not pin a
+        # discarded service.
+        import weakref
+
+        from goworld_tpu.utils import audit as audit_mod
+
+        wself = weakref.ref(self)
+
+        def _census(eids: bool = False) -> dict:
+            s = wself()
+            if s is None:
+                return {"error": "dispatcher discarded"}
+            # snapshot the items first: the scrape runs on the http
+            # thread while the event loop mutates the table
+            routes = list(s.entities.items())
+            by_game: dict[int, list[str]] = {}
+            for eid, info in routes:
+                by_game.setdefault(int(info.game_id), []).append(eid)
+            out: dict = {
+                "kind": "dispatcher",
+                "entities": len(routes),
+                "games": {
+                    gid: {"count": len(v), "crc": audit_mod.crc_fold(v)}
+                    for gid, v in sorted(by_game.items())
+                },
+            }
+            if eids:
+                out["eids"] = {
+                    gid: (sorted(v) if len(v) <= audit_mod.EIDS_CAP
+                          else {"truncated": len(v)})
+                    for gid, v in sorted(by_game.items())
+                }
+            return out
+
+        self._audit_probe = audit_mod.register(
+            f"dispatcher{dispatcher_id}", audit_mod.CensusProbe(_census))
+
     # ------------------------------------------------------------------
     async def serve(self) -> None:
         self._server = await asyncio.start_server(

@@ -114,9 +114,16 @@ class GameServer:
         governor_cooldown_windows: int = 4,
         governor_regret_pct: float = 0.25,
         governor_table: str = "",
+        audit_scrub_every: int = 0,
     ):
         self.game_id = game_id
         self.world = world
+        # SnapshotChain CRC-scrub cadence (ticks; 0 = off): every Nth
+        # tick the audit worker walks this game's chain files with
+        # read_freeze_file, turning latent on-disk corruption into a
+        # named snapshot_crc violation instead of a surprise at the
+        # next -restore boot (utils/audit.py, ISSUE 17)
+        self.audit_scrub_every = max(0, int(audit_scrub_every))
         self.gc_freeze_on_boot = gc_freeze_on_boot
         self.boot_entity = boot_entity
         self.ban_boot = ban_boot
@@ -578,6 +585,14 @@ class GameServer:
         with tl.span("fan_out"):
             self._flush_sync_out()
             self._maybe_checkpoint()
+        ap = getattr(self.world, "audit", None)
+        if (ap is not None and self.audit_scrub_every > 0
+                and self.world.tick_count % self.audit_scrub_every == 0):
+            # hand the chain walk to the audit worker — file IO + CRC
+            # math never touch the tick; a busy worker drops the walk
+            gid, fdir, tick = (self.game_id, self.freeze_dir,
+                               self.world.tick_count)
+            ap.submit(lambda: ap.scrub_snapshots(fdir, gid, tick))
         gov_ev = None
         if self.governor is not None:
             # between-ticks commit point: the world's device step for
@@ -696,6 +711,14 @@ class GameServer:
             frame["governor"] = (
                 f"{gov_ev['from']}->{gov_ev['to']} ({gov_ev['reason']})"
             )
+        ap = getattr(w, "audit", None)
+        if ap is not None:
+            # each recorded violation fires the audit_violation trigger
+            # at most once: the ledger tail + cohort diff freeze with
+            # the bundle (utils/flightrec.py)
+            av = ap.take_violation()
+            if av is not None:
+                frame["audit_violation"] = av
         rt = getattr(w, "residency", None)
         if rt is not None and tick % self.RESIDENCY_WIN_TICKS == 0:
             # windowed bubble verdict on a cadence: the p99 of the host
@@ -740,6 +763,12 @@ class GameServer:
         sig = self.world.workload_signature()
         if sig:
             ctx["workload_signature"] = sig
+        ap = getattr(self.world, "audit", None)
+        if ap is not None:
+            # ledger event tail + oracle/probe stats: an
+            # audit_violation incident answers "which EntityID, which
+            # hook sequence" from the bundle alone
+            ctx["audit"] = ap.incident_context()
         if self.governor is not None:
             # the governor's decision context, frozen with the bundle
             # (a governor_swap incident answers "why did it swap" from

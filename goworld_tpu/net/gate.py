@@ -245,6 +245,35 @@ class GateService:
             f"gate{gate_id}",
             syncage.AgeTracker(sync_age_target_ms,
                                name=f"gate{gate_id}"))
+        # correctness audit census probe (utils/audit.py, ISSUE 17):
+        # the client map is the edge's ownership view — client count +
+        # the CRC fold over BOUND player EntityIDs, so the aggregator
+        # can spot a gate still mirroring an entity no game owns
+        from goworld_tpu.utils import audit as audit_mod
+        import weakref as _weakref
+
+        _wgate = _weakref.ref(self)
+
+        def _gate_census(eids: bool = False) -> dict:
+            g = _wgate()
+            if g is None:
+                return {"error": "gate discarded"}
+            bound = [c.owner_eid for c in list(g.clients.values())
+                     if c.owner_eid]
+            out: dict = {
+                "kind": "gate",
+                "clients": len(g.clients),
+                "bound_entities": len(bound),
+                "crc": audit_mod.crc_fold(bound),
+            }
+            if eids:
+                out["eids"] = (sorted(bound)
+                               if len(bound) <= audit_mod.EIDS_CAP
+                               else {"truncated": len(bound)})
+            return out
+
+        self._audit_probe = audit_mod.register(
+            f"gate{gate_id}", audit_mod.CensusProbe(_gate_census))
         # gate-side incident flight recorder: one frame per flush-loop
         # window carrying the window's e2e p99 + per-hop breakdown;
         # a window whose p99 blows the target freezes a

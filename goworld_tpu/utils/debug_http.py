@@ -59,6 +59,13 @@ TPU-native analog exposes:
   mergeable count vectors, alloc-churn samples, the donation-readiness
   buffer census and the serve_gap verdict; an honest error on
   processes that tick no world
+* ``/audit`` — the correctness audit plane (:mod:`goworld_tpu.utils.
+  audit`): per-game entity-ownership census digests (count + CRC fold
+  per type) with the in-flight migration window, sampled AOI-oracle /
+  mirror-probe / snapshot-scrub stats and the violation rings;
+  ``?eids=1`` adds the (bounded) sorted EntityID lists for diffing a
+  census divergence down to the first differing id; an honest error
+  on processes that track no entities
 
 Stdlib-only (http.server on a daemon thread), one call to :func:`start`.
 """
@@ -81,7 +88,7 @@ logger = log.get("debug_http")
 _ENDPOINTS = ["/healthz", "/vars", "/ops", "/metrics", "/trace",
               "/tracing", "/clock", "/profile", "/faults", "/overload",
               "/costs", "/workload", "/incidents", "/governor",
-              "/syncage", "/residency"]
+              "/syncage", "/residency", "/audit"]
 
 # jax.profiler capture state (one capture at a time per process)
 _profile_lock = threading.Lock()
@@ -298,6 +305,17 @@ class _Handler(BaseHTTPRequestHandler):
             from goworld_tpu.utils import residency
 
             self._json(residency.snapshot_all())
+        elif path == "/audit":
+            # correctness audit plane (utils/audit registry): ledger
+            # census digests + in-flight migration window, sampled
+            # oracle/probe/scrub stats, violations; ?eids=1 adds the
+            # (bounded) sorted EntityID list so a census divergence
+            # can be diffed down to the first differing id
+            from goworld_tpu.utils import audit
+
+            want_eids = "eids" in query \
+                and query["eids"][0] not in ("0", "false")
+            self._json(audit.snapshot_all(eids=want_eids))
         elif path == "/incidents":
             # flight-recorder incident bundles (utils/flightrec);
             # ?frames=1 adds the live per-tick frame ring

@@ -38,7 +38,14 @@ Triggers (the grammar — docs/OBSERVABILITY.md):
   ``residency_bubble_budget_ms``; game frames, utils/residency.py) —
   frame time the device sat idle with no host work to show for it,
   the regression ROADMAP item 5's resident-world runtime exists to
-  prevent.
+  prevent;
+* ``audit_violation`` — the correctness audit plane recorded a
+  violation (``audit_violation`` frame key; game frames,
+  utils/audit.py): a lost/duplicated EntityID, a sampled interest set
+  diverging from the brute-force oracle, a slot/client mirror or
+  ``interested_by`` edge out of sync, or a SnapshotChain CRC failure —
+  the detail names the EntityID and the incident context freezes the
+  ledger event tail + cohort diff.
 
 Every trigger kind is deduped with a per-kind cooldown so one bad
 minute yields a handful of bundles, not thousands. Determinism: the
@@ -179,6 +186,13 @@ class FlightRecorder:
                 # this tick (goworld_tpu/autotune); context_fn freezes
                 # the decision context into the bundle
                 fired.append(("governor_swap", str(gov)))
+            av = frame.get("audit_violation")
+            if av is not None:
+                # the correctness audit plane recorded a violation
+                # (utils/audit.py: lost/duplicated entity, oracle
+                # mismatch, mirror divergence, snapshot CRC);
+                # context_fn freezes the ledger tail + cohort diff
+                fired.append(("audit_violation", str(av)))
             self._frames.append(dict(frame))
             self._frames_total += 1
             new = [self._freeze(kind, detail, frame)

@@ -121,6 +121,16 @@ def pytest_configure(config):
         "tests/test_residency.py); all run in tier-1 on CPU "
         "(docs/OBSERVABILITY.md \"Serve-loop residency\")",
     )
+    config.addinivalue_line(
+        "markers",
+        "audit: correctness audit plane suites (entity-ownership "
+        "ledger census/seq semantics, deployment conservation "
+        "verdicts, the sampled live AOI oracle, mirror probes, "
+        "/audit, the audit_violation trigger, the trailer "
+        "coexistence wire contract — tests/test_audit.py); all run "
+        "in tier-1 on CPU (docs/OBSERVABILITY.md \"Correctness "
+        "audit plane\")",
+    )
 
 
 def spawn_on(states, dev, slot, **kw):

@@ -1,0 +1,690 @@
+"""Correctness audit plane (ISSUE 17): the entity-ownership ledger
+(census digests, ownership seq, migration rings), deployment
+conservation verdicts that NAME the lost EntityID, the sampled live
+AOI oracle on a real ticking World, mirror probes, the
+``audit_violation`` flight-recorder trigger, the ``/audit`` endpoint,
+the aggregator / scrape / incident-bundle tooling, and the TRACE+AGE
+trailer coexistence wire contract."""
+
+import importlib.util
+import json
+import os
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from goworld_tpu.net import proto
+from goworld_tpu.net.packet import (
+    AGE_FLAG,
+    TRACE_FLAG,
+    Packet,
+    decode_wire,
+    new_packet,
+    wire_payload,
+)
+from goworld_tpu.utils import audit, debug_http, flightrec, metrics
+
+pytestmark = pytest.mark.audit
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        f"{name}_under_test", os.path.join(REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registries():
+    metrics.REGISTRY.reset()
+    yield
+    metrics.REGISTRY.reset()
+
+
+# =======================================================================
+# census digest
+# =======================================================================
+def test_crc_fold_is_canonical_over_sets():
+    a = audit.crc_fold(["E2", "E1", "E3"])
+    assert a == audit.crc_fold(["E1", "E3", "E2"])  # order-free
+    assert a != audit.crc_fold(["E1", "E2"])        # set-sensitive
+    assert a != audit.crc_fold(["E1", "E3", "E4"])
+    assert audit.crc_fold([]) == 0
+
+
+def test_ledger_create_destroy_census():
+    led = audit.EntityLedger("g1")
+    led.on_create("E1", "Mob", 1)
+    led.on_create("E2", "Mob", 1)
+    led.on_create("E3", "Npc", 2)
+    led.on_destroy("E2", 3)
+    assert (led.created, led.destroyed) == (3, 1)
+    census = led.census()
+    assert census["Mob"]["count"] == 1
+    assert census["Npc"]["count"] == 1
+    assert census["Mob"]["crc"] == audit.crc_fold(["E1"])
+    snap = led.snapshot(tick=3, eids=True)
+    assert snap["kind"] == "game"
+    assert snap["entities"] == 2
+    assert snap["eids"] == ["E1", "E3"]
+    assert snap["crc"] == audit.crc_fold(["E1", "E3"])
+    assert snap["violations_total"] == {}
+
+
+def test_ledger_duplicate_create_and_destroy_unknown():
+    led = audit.EntityLedger("g1")
+    led.on_create("E1", "Mob", 1)
+    led.on_create("E1", "Mob", 2)
+    led.on_destroy("E9", 3)
+    assert led.violations_total == {"duplicate_create": 1,
+                                    "destroy_unknown": 1}
+    kinds = [v["kind"] for v in led.violations]
+    assert kinds == ["duplicate_create", "destroy_unknown"]
+    # the named-EntityID contract
+    assert "E1" in led.violations[0]["detail"]
+    assert "E9" in led.violations[1]["detail"]
+    # counters moved (one per kind)
+    assert metrics.counter("audit_violations_total",
+                           kind="duplicate_create", game="g1").value == 1
+
+
+# =======================================================================
+# migration ownership seq
+# =======================================================================
+def test_cross_ledger_migration_roundtrip_clean():
+    a, b = audit.EntityLedger("g1"), audit.EntityLedger("g2")
+    a.on_create("E1", "Avatar", 1)
+    assert a.next_seq("E1") == 2
+    seq = a.stamp_migrate_out("E1", 5, target=2)
+    assert seq == 2
+    assert "E1" not in a.live_eids()
+    b.on_migrate_in("E1", "Avatar", seq, 6)
+    assert "E1" in b.live_eids()
+    assert not a.violations and not b.violations
+    assert (a.migrated_out, b.migrated_in) == (1, 1)
+    # B now owns the seq: its next migrate-out carries seq+1
+    assert b.next_seq("E1") == seq + 1
+
+
+def test_self_roundtrip_accepted_then_ghost_rejected():
+    """A->B->A through ONE ledger (single-game worlds, tests): the
+    in-record matches the ledger's own open out-record and must be
+    accepted; a RE-delivery of the same (eid, seq) after the record
+    retired is a ghost and must name itself."""
+    led = audit.EntityLedger("g1")
+    led.on_create("E1", "Avatar", 1)
+    seq = led.stamp_migrate_out("E1", 2)
+    led.on_migrate_in("E1", "Avatar", seq, 3)
+    assert not led.violations
+    assert "E1" in led.live_eids()
+    # the out-record was retired by the accepted round trip
+    assert led.snapshot(tick=3)["in_flight"] == []
+    # E1 migrates out AGAIN (seq bumps, old record long retired); a
+    # re-delivery of the OLD hop's packet is now a ghost: stale seq
+    # and no matching open out-record
+    seq2 = led.stamp_migrate_out("E1", 4)
+    assert seq2 == seq + 1
+    led.on_migrate_in("E1", "Avatar", seq, 5)
+    assert led.violations_total == {"stale_migrate": 1}
+    assert "E1" in led.violations[-1]["detail"]
+
+
+def test_duplicate_entity_and_stale_seq_rejected():
+    led = audit.EntityLedger("g2")
+    led.on_migrate_in("E1", "Avatar", 5, 1)
+    # migrate-in of a LIVE entity = duplicated owner
+    led.on_migrate_in("E1", "Avatar", 6, 2)
+    assert led.violations_total == {"duplicate_entity": 1}
+    # E1 hops onward (seq 7 stamped and remembered); a replay of the
+    # seq-5 delivery is stale
+    led.stamp_migrate_out("E1", 3)
+    led.on_migrate_in("E1", "Avatar", 5, 4)
+    assert led.violations_total == {"duplicate_entity": 1,
+                                    "stale_migrate": 1}
+
+
+def test_seq_zero_pre_stamp_peer_accepted():
+    led = audit.EntityLedger("g2")
+    led.on_migrate_in("E1", "Avatar", 0, 1)
+    assert not led.violations and "E1" in led.live_eids()
+    # accepted and re-anchored: the next out-stamp is monotone
+    assert led.next_seq("E1") >= 2
+
+
+def test_resync_restores_conservation_identity():
+    led = audit.EntityLedger("g1")
+    led.on_create("E1", "Mob", 1)
+    led.on_create("E2", "Mob", 1)
+    led.on_destroy("E1", 2)
+    led.resync({"E7": "Mob", "E8": "Npc"}, 10)
+    s = led.snapshot(tick=10)
+    assert s["entities"] == 2
+    # live == created - destroyed - out + in must hold post-restore
+    assert s["entities"] == (s["created"] - s["destroyed"]
+                             - s["migrated_out"] + s["migrated_in"])
+
+
+# =======================================================================
+# deployment conservation verdict
+# =======================================================================
+def _game_snap(led, tick):
+    return led.snapshot(tick=tick)
+
+
+def test_conservation_clean_and_in_flight_window():
+    a, b = audit.EntityLedger("g1"), audit.EntityLedger("g2")
+    for i in range(4):
+        a.on_create(f"E{i}", "Mob", 1)
+    seq = a.stamp_migrate_out("E0", 10, target=2)
+    # mid-flight, inside grace: in_flight bridges the census gap
+    v = audit.conservation_verdict([_game_snap(a, 12),
+                                    _game_snap(b, 12)])
+    assert v["ok"], v["problems"]
+    assert v["in_flight"] == 1 and v["live"] == 3
+    # delivered: the in-record retires the window
+    b.on_migrate_in("E0", "Mob", seq, 13)
+    v = audit.conservation_verdict([_game_snap(a, 14),
+                                    _game_snap(b, 14)])
+    assert v["ok"] and v["in_flight"] == 0 and v["live"] == 4
+
+
+def test_conservation_names_lost_entity_after_grace():
+    a = audit.EntityLedger("g1")
+    a.on_create("Elost", "Avatar", 1)
+    a.stamp_migrate_out("Elost", 10, target=2)
+    v = audit.conservation_verdict([_game_snap(a, 30)], grace_ticks=8)
+    assert not v["ok"]
+    assert any("lost EntityID Elost" in p for p in v["problems"])
+    assert v["lost"][0]["eid"] == "Elost"
+    # the balance problem is ALSO reported (live 0 + in-flight 1 ok —
+    # the lost record is still outstanding, so balance holds; only
+    # the age names it)
+    assert v["in_flight"] == 1
+
+
+def test_conservation_balance_breach_and_violation_rollup():
+    a = audit.EntityLedger("g1")
+    a.on_create("E1", "Mob", 1)
+    a.created = 3  # simulate a bookkeeping hole
+    v = audit.conservation_verdict([_game_snap(a, 2)])
+    assert not v["ok"]
+    assert any("conservation broken" in p for p in v["problems"])
+    b = audit.EntityLedger("g2")
+    b.on_destroy("E9", 1)  # records destroy_unknown
+    v = audit.conservation_verdict([_game_snap(b, 2)])
+    assert any("destroy_unknown" in p for p in v["problems"])
+
+
+def test_conservation_dispatcher_drift_cross_check():
+    a = audit.EntityLedger("g1")
+    for i in range(3):
+        a.on_create(f"E{i}", "Mob", 1)
+    disp_ok = {"kind": "dispatcher", "entities": 3, "games": {}}
+    v = audit.conservation_verdict([_game_snap(a, 2)],
+                                   dispatcher=disp_ok)
+    assert v["ok"] and v["dispatcher_entities"] == 3
+    disp_bad = {"kind": "dispatcher", "entities": 9, "games": {}}
+    v = audit.conservation_verdict([_game_snap(a, 2)],
+                                   dispatcher=disp_bad)
+    assert not v["ok"]
+    assert any("dispatcher routes 9" in p for p in v["problems"])
+
+
+def test_first_divergent_eid():
+    assert audit.first_divergent_eid(["E1", "E2"], ["E1", "E3"]) == "E2"
+    assert audit.first_divergent_eid(["E1"], ["E1"]) is None
+    assert audit.first_divergent_eid({"truncated": 99}, ["E1"]) is None
+
+
+# =======================================================================
+# AuditPlane: knobs, cohort rotation, oracle math
+# =======================================================================
+def test_audit_plane_knob_validation_is_loud():
+    with pytest.raises(ValueError, match="audit_sample_every"):
+        audit.AuditPlane("bad", sample_every=0)
+    with pytest.raises(ValueError, match="audit_cohort"):
+        audit.AuditPlane("bad", cohort=0)
+
+
+def test_next_cohort_rotates_and_covers_every_slot():
+    ap = audit.AuditPlane("rot", sample_every=1, cohort=3)
+    try:
+        slots = [5, 1, 9, 3, 7]
+        seen = set()
+        picks = [ap.next_cohort(slots) for _ in range(4)]
+        for p in picks:
+            assert len(p) == 3 == len(set(p))  # no wrap duplication
+            seen.update(p)
+        assert seen == set(slots)  # full coverage within one lap+
+        assert ap.next_cohort([]) == []
+    finally:
+        ap.close()
+
+
+def test_cohort_oracle_matches_full_bruteforce():
+    rng = np.random.default_rng(7)
+    n = 40
+    pos = np.zeros((n, 3), np.float64)
+    pos[:, 0] = rng.uniform(0, 100, n)
+    pos[:, 2] = rng.uniform(0, 100, n)
+    alive = rng.uniform(size=n) > 0.2
+    wr = np.where(rng.uniform(size=n) > 0.3, 25.0, 0.0)
+    rows = audit.cohort_oracle(pos, alive, 25.0, range(n),
+                               watch_radius=wr)
+    for i in range(n):
+        want = set()
+        if alive[i] and wr[i] > 0:
+            for j in range(n):
+                if j == i or not (alive[j] and wr[j] > 0):
+                    continue
+                d = max(abs(pos[j, 0] - pos[i, 0]),
+                        abs(pos[j, 2] - pos[i, 2]))
+                if d <= min(wr[i], 25.0):
+                    want.add(j)
+        assert rows[i] == want, f"slot {i}"
+
+
+def test_judge_sample_flags_divergent_interest_set():
+    ap = audit.AuditPlane("jud", sample_every=1, cohort=8)
+    try:
+        pos = np.zeros((3, 3), np.float32)
+        pos[1, 0] = 5.0   # within radius of slot 0
+        pos[2, 0] = 90.0  # far away
+        alive = np.ones(3, bool)
+        owner = {0: "E0", 1: "E1", 2: "E2"}
+        good = {"E0": {"E1"}, "E1": {"E0"}, "E2": set()}
+        ap.judge_sample(tick=1, pos=pos, alive=alive,
+                        watch_radius=None, radius=10.0,
+                        cohort_slots=[0, 1, 2], owner=owner,
+                        interest=good)
+        assert ap.oracle_stats["mismatches"] == 0
+        assert not ap.ledger.violations
+        bad = {"E0": {"E1", "E2"}, "E1": set(), "E2": set()}
+        ap.judge_sample(tick=2, pos=pos, alive=alive,
+                        watch_radius=None, radius=10.0,
+                        cohort_slots=[0, 1, 2], owner=owner,
+                        interest=bad)
+        assert ap.oracle_stats["mismatches"] == 2
+        kinds = {v["kind"] for v in ap.ledger.violations}
+        assert kinds == {"aoi_oracle"}
+        details = " ".join(v["detail"] for v in ap.ledger.violations)
+        assert "E0" in details and "extra ['E2']" in details
+        assert "E1" in details and "missing ['E0']" in details
+        snap = ap.snapshot(tick=2)
+        assert snap["oracle"]["samples"] == 2
+        assert snap["oracle"]["entities_checked"] == 6
+    finally:
+        ap.close()
+
+
+def test_skip_sample_records_honest_reasons():
+    ap = audit.AuditPlane("skp", sample_every=4, cohort=8)
+    try:
+        assert ap.want_sample(8) and not ap.want_sample(9)
+        ap.skip_sample("overflow", 8)
+        ap.skip_sample("overflow", 12)
+        ap.skip_sample("pipeline_decode", 16)
+        snap = ap.snapshot(tick=16)
+        assert snap["oracle"]["skipped"] == {"overflow": 2,
+                                             "pipeline_decode": 1}
+        assert snap["oracle"]["samples"] == 0
+    finally:
+        ap.close()
+
+
+def test_take_violation_fires_once_per_note():
+    ap = audit.AuditPlane("tv", sample_every=1, cohort=1)
+    try:
+        assert ap.take_violation() is None
+        ap.ledger.note_violation("aoi_oracle", "EntityID EX diverged", 3)
+        v = ap.take_violation()
+        assert v is not None and v.startswith("aoi_oracle:")
+        assert ap.take_violation() is None  # consumed
+    finally:
+        ap.close()
+
+
+def test_registry_weakref_and_census_probe():
+    ap = audit.AuditPlane("wk", sample_every=1, cohort=1)
+    audit.register("wk", ap)
+    probe = audit.CensusProbe(
+        lambda eids: {"kind": "dispatcher", "entities": 2, "games": {}})
+    audit.register("disp", probe)
+    snap = audit.snapshot_all()
+    assert snap["wk"]["kind"] == "game"
+    assert snap["disp"]["entities"] == 2
+    # a failing provider serves an honest error, never raises
+    bad = audit.CensusProbe(lambda eids: 1 / 0)
+    assert "error" in bad.snapshot()
+    audit.unregister("disp")
+    ap.close()
+    del ap
+    import gc
+
+    gc.collect()
+    # the registry holds weak references: dropping the plane removes
+    # its entry with no unregister call (other suites' still-alive
+    # planes may remain registered — only OUR names must be gone)
+    after = audit.snapshot_all()
+    assert "wk" not in after and "disp" not in after
+
+
+# =======================================================================
+# flight-recorder trigger
+# =======================================================================
+def test_audit_violation_trigger_freezes_with_context():
+    led = audit.EntityLedger("trg")
+    clock = [0.0]
+    rec = flightrec.FlightRecorder(
+        ring=16, cooldown_secs=30.0, clock=lambda: clock[0],
+        context_fn=lambda: {"audit": led.incident_context()})
+    led.on_create("E1", "Mob", 1)
+    led.on_destroy("E9", 2)  # destroy_unknown
+    frame = {"tick": 2, "audit_violation": led.take_violation()}
+    out = rec.record(frame)
+    assert len(out) == 1 and out[0]["trigger"] == "audit_violation"
+    assert "E9" in out[0]["detail"]
+    ctx = out[0]["context"]["audit"]
+    assert any(ev[2] == "destroy_unknown" for ev in ctx["tail"]
+               if ev[1] == "VIOLATION")
+    # no pending violation -> no trigger
+    assert rec.record({"tick": 3}) == []
+    # cooldown dedups a repeat inside the window
+    led.on_destroy("E9", 4)
+    clock[0] = 5.0
+    assert rec.record({"tick": 4,
+                       "audit_violation": led.take_violation()}) == []
+
+
+# =======================================================================
+# live world: oracle exactness + migration round trip, zero device
+# syncs beyond the tick's own fetch
+# =======================================================================
+@pytest.fixture(scope="module")
+def audited_world():
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.entity.entity import Entity
+    from goworld_tpu.entity.manager import World
+    from goworld_tpu.entity.space import Space
+    from goworld_tpu.ops.aoi import GridSpec
+
+    class Mob(Entity):
+        ATTRS = {"hp": "allclients hot:0"}
+
+    cfg = WorldConfig(
+        capacity=64,
+        grid=GridSpec(radius=30.0, extent_x=200.0, extent_z=200.0),
+        input_cap=64,
+    )
+    w = World(cfg, n_spaces=1, game_id=931, audit=True,
+              audit_sample_every=1, audit_cohort=64)
+    w.register_entity("Mob", Mob)
+    w.register_space("Arena", Space)
+    w.create_nil_space()
+    sp = w.create_space("Arena")
+    rng = np.random.default_rng(31)
+    ents = []
+    for _ in range(12):
+        x, z = rng.uniform(20.0, 180.0, 2)
+        ents.append(sp.create_entity("Mob",
+                                     pos=(float(x), 0.0, float(z))))
+    for _ in range(6):
+        w.tick()
+    yield w, ents
+    audit.unregister("game931")
+    w.audit.close()
+
+
+def test_live_world_oracle_is_clean(audited_world):
+    w, _ = audited_world
+    ap = w.audit
+    ap.drain()
+    snap = ap.snapshot(tick=w.tick_count)
+    assert snap["oracle"]["samples"] > 0
+    assert snap["oracle"]["entities_checked"] > 0
+    assert snap["oracle"]["mismatches"] == 0
+    assert snap["probes"]["mismatches"] == 0
+    assert snap["violations_total"] == {}
+    v = audit.conservation_verdict([snap])
+    assert v["ok"], v["problems"]
+
+
+def test_live_world_migration_roundtrip_stamps_seq(audited_world):
+    w, ents = audited_world
+    ap = w.audit
+    e = next(x for x in ents
+             if not x.destroyed and x._migrating is None)
+    data = w.get_migrate_data(e)
+    assert data["own_seq"] >= 2  # created at 1, bumped for the hop
+    before_out = ap.ledger.migrated_out
+    w.remove_for_migration(e)
+    assert ap.ledger.migrated_out == before_out + 1
+    moved = w.restore_from_migration(data)
+    assert moved.id == e.id
+    w.tick()
+    ap.drain()
+    snap = ap.snapshot(tick=w.tick_count)
+    assert snap["violations_total"] == {}
+    assert snap["in_flight"] == []  # round trip retired the record
+    v = audit.conservation_verdict([snap])
+    assert v["ok"], v["problems"]
+
+
+# =======================================================================
+# /audit endpoint
+# =======================================================================
+def test_audit_endpoint_serves_registered_planes():
+    ap = audit.AuditPlane("game44", sample_every=4, cohort=8)
+    audit.register("game44", ap)
+    ap.ledger.on_create("E1", "Mob", 1)
+    srv = debug_http.start(0, process_name="game44")
+    try:
+        port = srv.server_address[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/audit", timeout=5) as r:
+            payload = json.loads(r.read())
+        snap = payload["game44"]
+        for key in ("kind", "entities", "crc", "census", "in_flight",
+                    "oracle", "probes", "scrub", "violations_total"):
+            assert key in snap
+        assert snap["entities"] == 1
+        # ?eids=1 ships the bounded list
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/audit?eids=1",
+                timeout=5) as r:
+            assert json.loads(r.read())["game44"]["eids"] == ["E1"]
+        audit.unregister("game44")
+        ap.close()
+        del ap
+        import gc
+
+        gc.collect()
+        # weakref registry: the dropped plane is gone (other tests'
+        # module-scoped worlds may still be registered, so check the
+        # name, not emptiness)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/audit", timeout=5) as r:
+            after = json.loads(r.read())
+        assert "game44" not in after
+    finally:
+        srv.shutdown()
+
+
+# =======================================================================
+# tooling: aggregator line, strict scraping, incident bundles
+# =======================================================================
+def test_obs_aggregate_audit_line_formats_verdict():
+    agg_tool = _load_tool("obs_aggregate")
+    a = audit.EntityLedger("g1")
+    for i in range(3):
+        a.on_create(f"E{i}", "Mob", 1)
+    v = audit.conservation_verdict([a.snapshot(tick=2)])
+    v["oracle_samples"] = 7
+    line = agg_tool.audit_line({"audit": v})
+    assert line.startswith("deployment conservation PASS live=3")
+    assert "7 oracle samples" in line
+    a.stamp_migrate_out("E0", 2)
+    bad = audit.conservation_verdict([a.snapshot(tick=60)])
+    line = agg_tool.audit_line({"audit": bad})
+    assert line.startswith("deployment conservation FAIL")
+    assert "\n  audit: lost EntityID E0" in line
+    assert agg_tool.audit_line({"audit": {"games": 0}}) == ""
+
+
+def test_scrape_audit_strict_collects_unreachable():
+    scrape = _load_tool("scrape_metrics")
+    targets = [("game1", "http://127.0.0.1:9/metrics")]  # dead port
+    assert scrape.scrape_audit(targets) == {}  # silent default
+    errors: list = []
+    assert scrape.scrape_audit(targets, errors=errors) == {}
+    assert len(errors) == 1 and errors[0].startswith(
+        "game1: http://127.0.0.1:9/audit failed")
+
+
+def test_scrape_audit_lines_format():
+    scrape = _load_tool("scrape_metrics")
+    led = audit.EntityLedger("game2")
+    led.on_create("E1", "Mob", 1)
+    snap = led.snapshot(tick=1)
+    snap["oracle"] = {"samples": 4, "mismatches": 0}
+    scraped = {
+        "game1": {"game2": snap},
+        "dispatcher1": {"dispatcher1": {
+            "kind": "dispatcher", "entities": 1,
+            "games": {"2": {"count": 1}}}},
+    }
+    lines = scrape.audit_lines(scraped)
+    assert any("game1: audit game2 live=1" in ln
+               and "oracle 4 samples" in ln and ln.endswith("OK")
+               for ln in lines)
+    assert any("dispatcher1: audit routes 1 entities over 1 games"
+               in ln for ln in lines)
+
+
+def test_cmd_incidents_writes_postmortem_bundle(tmp_path):
+    from goworld_tpu import cli
+
+    led = audit.EntityLedger("game1")
+    rec = flightrec.FlightRecorder(
+        ring=16, context_fn=lambda: {"audit": led.incident_context()})
+    flightrec.register("game1", rec)
+    led.on_destroy("Egone", 2)
+    frozen = rec.record({"tick": 2,
+                         "audit_violation": led.take_violation()})
+    assert frozen  # the incident the bundle must capture
+    srv = debug_http.start(0, process_name="game1")
+    try:
+        port = srv.server_address[1]
+        ini = tmp_path / "goworld.ini"
+        ini.write_text(
+            "[dispatcher1]\nport = 14391\n"
+            f"[game1]\nhttp_port = {port}\n"
+            "[gate1]\nport = 15391\n")
+        out = tmp_path / "bundles"
+        assert cli.cmd_incidents(str(tmp_path), out=str(out)) == 0
+        bundle = next(p for p in out.iterdir()
+                      if p.name.startswith("incidents_"))
+        manifest = json.loads((bundle / "manifest.json").read_text())
+        (label, entry), = manifest["processes"].items()
+        assert sum(entry["incidents"].values()) >= 1
+        payload = json.loads((bundle / entry["file"]).read_text())
+        inc = payload["game1"]["incidents"][-1]
+        assert inc["trigger"] == "audit_violation"
+        assert "Egone" in inc["detail"]
+    finally:
+        srv.shutdown()
+        flightrec.unregister("game1")
+
+
+def test_cmd_incidents_unreachable_cluster_fails(tmp_path):
+    from goworld_tpu import cli
+
+    (tmp_path / "goworld.ini").write_text(
+        "[dispatcher1]\nport = 14392\n"
+        "[game1]\nhttp_port = 9\n"        # dead port
+        "[gate1]\nport = 15392\n")
+    assert cli.cmd_incidents(str(tmp_path)) == 1
+
+
+# =======================================================================
+# trailer coexistence: TRACE (bit 15) + AGE (bit 14) on one packet
+# =======================================================================
+def _sync_packet() -> Packet:
+    p = new_packet(proto.MT_SYNC_POSITION_YAW_ON_CLIENTS)
+    p.append_u16(1)
+    p.append_bytes(b"y" * 64)
+    return p
+
+
+def test_both_trailers_ride_one_packet_any_attach_order():
+    from goworld_tpu.utils import syncage, tracing
+
+    legacy = wire_payload(_sync_packet())
+
+    def build(order):
+        p = _sync_packet()
+        for attr in order:
+            if attr == "age":
+                p.age = syncage.SyncAgeStamp(3, 10, 20, 30, 40, 0)
+            else:
+                p.trace = tracing.TraceContext(b"\x11" * 16,
+                                               b"\x22" * 8, 1)
+        return wire_payload(p)
+
+    w1 = build(("age", "trace"))
+    w2 = build(("trace", "age"))
+    # attach order is irrelevant: the wire layout is fixed (age inner,
+    # trace outermost) so both orders serialize byte-identically
+    assert w1 == w2
+    head = int.from_bytes(w1[:2], "little")
+    assert head & AGE_FLAG and head & TRACE_FLAG
+    mt, back = decode_wire(w1)
+    assert mt == proto.MT_SYNC_POSITION_YAW_ON_CLIENTS
+    assert back.age is not None and back.age.seq == 3
+    assert back.trace is not None and back.trace.trace_id == b"\x11" * 16
+    # handlers see the exact unstamped payload
+    assert bytes(back.buf) == legacy
+    # and with both planes off the wire is byte-identical legacy
+    assert wire_payload(_sync_packet()) == legacy
+    assert not int.from_bytes(legacy[:2], "little") & (AGE_FLAG
+                                                       | TRACE_FLAG)
+
+
+def test_live_flush_carries_both_trailers_under_audit(audited_world):
+    """The audited world's GameServer flush emits an AGE-stamped sync
+    packet; adding a trace context on top must coexist and strip back
+    to the identical payload — the satellite's live loopback."""
+    from goworld_tpu.net.game import GameServer
+    from goworld_tpu.utils import tracing
+
+    w, _ = audited_world
+
+    class _Cap:
+        def __init__(self):
+            self.wires = []
+
+        def send(self, p):
+            p.trace = tracing.TraceContext(b"\x07" * 16, b"\x08" * 8, 1)
+            self.wires.append(wire_payload(p))
+            p.release()
+
+    gs = GameServer(95, w, [], gc_freeze_on_boot=False)
+    conn = _Cap()
+    gs.cluster.select_by_gate_id = lambda gid: conn
+    cids = np.asarray([b"C%015d" % i for i in range(3)], "S16")
+    eids = np.asarray([b"E%015d" % i for i in range(3)], "S16")
+    gs._sync_sink(1, cids, eids, np.ones((3, 4), np.float32))
+    gs._flush_sync_out()
+    assert len(conn.wires) == 1
+    head = int.from_bytes(conn.wires[0][:2], "little")
+    assert head & AGE_FLAG and head & TRACE_FLAG
+    mt, back = decode_wire(conn.wires[0])
+    assert mt == proto.MT_SYNC_POSITION_YAW_ON_CLIENTS
+    assert back.age is not None and back.trace is not None
+    assert back.age.seq == w.sync_age_anchor[0]

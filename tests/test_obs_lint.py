@@ -1,0 +1,146 @@
+"""tools/obs_lint.py — observability drift lint, in tier-1 (jax-free).
+
+Two live contracts plus proof the lint can actually catch drift:
+
+* the REAL repo is clean (every `debug_http._ENDPOINTS` entry has its
+  docs/OBSERVABILITY.md table row, every conftest marker appears in
+  README.md) — this test IS the drift gate;
+* synthetic repos with a missing doc row / undocumented marker /
+  stale doc row exit 2 with a problem naming the offender.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+pytestmark = pytest.mark.audit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+spec = importlib.util.spec_from_file_location(
+    "obs_lint_under_test",
+    os.path.join(REPO, "tools", "obs_lint.py"))
+LINT = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(LINT)
+
+
+# ---------------------------------------------------------------- parsers
+
+def test_parse_endpoints_reads_the_literal():
+    src = ('X = 1\n_ENDPOINTS = ["/healthz",\n    "/metrics",\n'
+           '    "/audit"]\nY = 2\n')
+    assert LINT.parse_endpoints(src) == ["/healthz", "/metrics",
+                                         "/audit"]
+
+
+def test_parse_endpoints_missing_list_is_empty():
+    assert LINT.parse_endpoints("ENDPOINTS = None\n") == []
+
+
+def test_parse_doc_endpoints_first_cell_only():
+    doc = ("| path | content |\n"
+           "|---|---|\n"
+           "| `/metrics` | counters |\n"
+           "| `/audit` | see `/metrics` for the counter mirror |\n"
+           "prose mentioning `/ghost` outside a table\n")
+    # /ghost (prose) and the second-cell /metrics mention must NOT
+    # count as documentation rows
+    assert LINT.parse_doc_endpoints(doc) == ["/metrics", "/audit"]
+
+
+def test_parse_markers_reads_registrations():
+    src = ('    config.addinivalue_line(\n        "markers",\n'
+           '        "soak: long-running load tests",\n    )\n'
+           '    config.addinivalue_line(\n        "markers",\n'
+           '        "audit: correctness audit plane suites",\n    )\n')
+    assert LINT.parse_markers(src) == ["soak", "audit"]
+
+
+def test_marker_documented_forms():
+    readme = "run `-m soak` or select the `audit` suite"
+    assert LINT.marker_documented("soak", readme)
+    assert LINT.marker_documented("audit", readme)
+    assert not LINT.marker_documented("ghost", readme)
+
+
+# ---------------------------------------------------------- the live gate
+
+def test_real_repo_is_clean():
+    problems, facts = LINT.lint(REPO)
+    assert problems == [], problems
+    assert facts["endpoints"] >= 17  # the full debug-http map
+    assert facts["markers"] >= 15
+
+
+def test_cli_exits_zero_on_repo(capsys):
+    assert LINT.main(["--repo", REPO]) == 0
+    assert "obs_lint: ok" in capsys.readouterr().out
+
+
+# ----------------------------------------------------- drift is caught
+
+def _write_repo(root, *, endpoints, doc_rows, markers, readme):
+    os.makedirs(os.path.join(root, "goworld_tpu", "utils"))
+    os.makedirs(os.path.join(root, "docs"))
+    os.makedirs(os.path.join(root, "tests"))
+    eps = ", ".join(f'"{e}"' for e in endpoints)
+    with open(os.path.join(root, "goworld_tpu", "utils",
+                           "debug_http.py"), "w") as fh:
+        fh.write(f"_ENDPOINTS = [{eps}]\n")
+    rows = "\n".join(f"| `{e}` | docs |" for e in doc_rows)
+    with open(os.path.join(root, "docs", "OBSERVABILITY.md"),
+              "w") as fh:
+        fh.write(f"| path | content |\n|---|---|\n{rows}\n")
+    regs = "".join(
+        f'    config.addinivalue_line(\n        "markers",\n'
+        f'        "{m}: something",\n    )\n' for m in markers)
+    with open(os.path.join(root, "tests", "conftest.py"), "w") as fh:
+        fh.write(f"def pytest_configure(config):\n{regs}")
+    with open(os.path.join(root, "README.md"), "w") as fh:
+        fh.write(readme)
+
+
+def test_missing_doc_row_is_drift(tmp_path, capsys):
+    root = str(tmp_path / "r")
+    _write_repo(root, endpoints=["/metrics", "/audit"],
+                doc_rows=["/metrics"], markers=["soak"],
+                readme="`-m soak`\n")
+    problems, _ = LINT.lint(root)
+    assert any("/audit" in p and "no row" in p for p in problems)
+    assert LINT.main(["--repo", root]) == 2
+    assert "/audit" in capsys.readouterr().err
+
+
+def test_stale_doc_row_is_drift(tmp_path):
+    root = str(tmp_path / "r")
+    _write_repo(root, endpoints=["/metrics"],
+                doc_rows=["/metrics", "/deleted"], markers=["soak"],
+                readme="`-m soak`\n")
+    problems, _ = LINT.lint(root)
+    assert any("/deleted" in p and "does not serve" in p
+               for p in problems)
+
+
+def test_undocumented_marker_is_drift(tmp_path):
+    root = str(tmp_path / "r")
+    _write_repo(root, endpoints=["/metrics"], doc_rows=["/metrics"],
+                markers=["soak", "ghost"], readme="`-m soak`\n")
+    problems, _ = LINT.lint(root)
+    assert any("'ghost'" in p and "README" in p for p in problems)
+
+
+def test_clean_synthetic_repo_passes(tmp_path):
+    root = str(tmp_path / "r")
+    _write_repo(root, endpoints=["/metrics", "/audit"],
+                doc_rows=["/metrics", "/audit"],
+                markers=["soak", "audit"],
+                readme="run `-m soak` and `-m audit`\n")
+    problems, facts = LINT.lint(root)
+    assert problems == []
+    assert facts == {"endpoints": 2, "documented_endpoints": 2,
+                     "markers": 2}
+
+
+def test_missing_input_file_is_loud(tmp_path):
+    problems, _ = LINT.lint(str(tmp_path))
+    assert problems and "unreadable" in problems[0]

@@ -55,6 +55,12 @@ contract):
   (bubble/tick percentiles, phase lanes, the donation-readiness
   buffer census, alloc churn or its honest absence, serve_gap vs the
   pinned scan-marginal, the measured mark overhead) — honest
+  ``{"error"/"skipped": ...}`` records accepted;
+* rounds >= 17 (the correctness-audit era, ISSUE 17): an ``audit``
+  block — the entity-ownership ledger census + deployment
+  conservation verdict, the sampled AOI-oracle progress, by-kind
+  violation totals (the zero-violation gate) and the measured A/B
+  overhead of the plane vs the 60 Hz tick budget — honest
   ``{"error"/"skipped": ...}`` records accepted.
 
 Exit codes: 0 all valid, 1 usage/missing, 2 schema violations.
@@ -129,6 +135,14 @@ RESIDENCY_SINCE = 16
 RESIDENCY_KEYS = ("bubble", "tick", "phases", "census", "alloc",
                   "serve_gap", "serve_gap_ref", "scan_marginal_ms",
                   "bubble_budget_ms", "mark_overhead_pct_of_budget")
+# the correctness-audit era (ISSUE 17): every BENCH round stamps the
+# audit plane's block — ledger census + conservation verdict, AOI
+# oracle sample/mismatch counts, the by-kind violation totals (the
+# zero-violation gate) and the measured A/B overhead of the plane vs
+# the 60 Hz tick budget (the <1% criterion)
+AUDIT_SINCE = 17
+AUDIT_KEYS = ("ledger", "oracle", "violations_total", "conservation",
+              "overhead_pct_of_budget", "pass")
 MULTI_HEADLINE_KEYS = ("entity_ticks_per_sec_mesh",
                        "per_chip_efficiency", "n_entities", "platform")
 MULTI_GAUGE_KEYS = ("halo_demand_max", "migrate_demand_max",
@@ -244,6 +258,24 @@ def validate_bench(path: str, doc: dict) -> list[str]:
                 # measured stats or {"unavailable": ...} — never absent
                 errs.append(
                     f"residency alloc malformed: {rs.get('alloc')!r:.120}")
+    if rno >= AUDIT_SINCE:
+        _check_block(rec, "audit", AUDIT_KEYS, errs)
+        au = rec.get("audit")
+        if isinstance(au, dict) and "error" not in au \
+                and "skipped" not in au:
+            vt = au.get("violations_total")
+            if not isinstance(vt, dict):
+                errs.append(f"audit violations_total malformed: "
+                            f"{vt!r:.120}")
+            orc = au.get("oracle")
+            if not (isinstance(orc, dict)
+                    and {"samples", "entities_checked", "mismatches"}
+                    <= set(orc)):
+                errs.append(f"audit oracle malformed: {orc!r:.120}")
+            con = au.get("conservation")
+            if not (isinstance(con, dict) and "ok" in con):
+                errs.append(f"audit conservation malformed: "
+                            f"{con!r:.120}")
     # per-scenario blocks, wherever present: each needs either a
     # headline-style shape or an honest error
     for sc, blk in (rec.get("scenarios") or {}).items():

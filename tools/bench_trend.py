@@ -269,6 +269,68 @@ def _check_residency_series(rounds: list, latest: dict, name: str,
             f"{os.path.basename(prev_path)})")
 
 
+def _check_audit_series(rounds: list, latest: dict, name: str,
+                        threshold: float, problems: list[str],
+                        notes: list[str]) -> None:
+    """The correctness-audit block (ISSUE 17): any recorded violation
+    in a real latest block is ALWAYS a problem (the zero-violation
+    gate needs no prior — a lost entity is a bug, not a trend); the
+    measured plane overhead is a lower-is-better series gated against
+    the best prior at the same (entities, platform) shape with a
+    small absolute slack (timer noise on a sub-percent number); a
+    conservation pass->fail flip at the same shape is always a
+    problem (the slo rule)."""
+    def _au_ok(s) -> bool:
+        return (isinstance(s, dict) and "error" not in s
+                and "skipped" not in s
+                and isinstance(s.get("overhead_pct_of_budget"),
+                               (int, float)))
+
+    lau = latest.get("audit")
+    if not _au_ok(lau):
+        return
+    viol = sum((lau.get("violations_total") or {}).values())
+    if viol:
+        kinds = ", ".join(sorted((lau.get("violations_total")
+                                  or {}).keys()))
+        problems.append(
+            f"{name}: audit recorded {viol} violation(s) ({kinds}) — "
+            "the bench soak must be violation-free")
+    if not (lau.get("conservation") or {}).get("ok", True):
+        problems.append(f"{name}: audit conservation verdict FAILED")
+    ashape = (lau.get("entities"), latest.get("platform"))
+    aprior = [
+        (p, r["audit"]) for p, r in rounds[:-1]
+        if _au_ok(r.get("audit"))
+        and (r["audit"].get("entities"), r.get("platform")) == ashape
+    ]
+    if not aprior:
+        notes.append(f"{name}: audit shape {ashape} has no prior "
+                     "round — overhead not gated")
+        return
+    # overhead vs the best (lowest) prior; +0.1 pct-point absolute
+    # slack keeps timer noise on a ~0.x% number from gating
+    lov = lau["overhead_pct_of_budget"]
+    best_path, best = min(aprior,
+                          key=lambda pr: pr[1]["overhead_pct_of_budget"])
+    ceil = (1.0 + threshold) * best["overhead_pct_of_budget"] + 0.1
+    if lov > ceil:
+        problems.append(
+            f"{name}: audit overhead {lov}% of budget > {ceil:.3g}% "
+            f"({(1 + threshold) * 100:.0f}% of "
+            f"{os.path.basename(best_path)}'s "
+            f"{best['overhead_pct_of_budget']}% + 0.1)")
+    else:
+        notes.append(
+            f"{name}: audit overhead {lov}% of budget vs best prior "
+            f"{best['overhead_pct_of_budget']}% — ok")
+    prev_path, prev = aprior[-1]
+    if prev.get("pass") and not lau.get("pass"):
+        problems.append(
+            f"{name}: audit verdict regressed pass -> fail "
+            f"(prior {os.path.basename(prev_path)})")
+
+
 def check_bench(files: list[str], threshold: float,
                 problems: list[str], notes: list[str]) -> None:
     rounds = []
@@ -300,6 +362,10 @@ def check_bench(files: list[str], threshold: float,
     # (entities, platform) shape is the BLOCK's, not the headline's
     _check_residency_series(rounds, latest, name, threshold,
                             problems, notes)
+    # the correctness-audit series (ISSUE 17): same hoisting — the
+    # zero-violation gate must fire even on a headline-shape change
+    _check_audit_series(rounds, latest, name, threshold,
+                        problems, notes)
     prior = [(p, r) for p, r in rounds[:-1]
              if _shape(r) == _shape(latest)]
     if not prior:

@@ -26,6 +26,15 @@ faults are active, then scrape the game's ``/overload`` ladder and the
 (reached SHEDDING), the critical/rpc classes shed nothing, and the
 process RETURNED to NORMAL after the flood stopped.
 
+``governor`` (ISSUE 13) and ``audit`` (ISSUE 17) run IN-PROCESS (no
+cluster): the governor soak hot-swaps kernel configs under a
+scenario-switching schedule; the audit soak proves the correctness
+plane — a clean churn + migration-storm phase must record ZERO
+violations, then an injected entity drop (migrate-out, restore
+suppressed) must be detected by the conservation verdict within <= 8
+ticks, naming the EntityID and freezing an ``audit_violation``
+flight-recorder bundle (``run_audit``).
+
 Running either scenario TWICE with the same ``--seed`` must produce
 byte-identical fault/transition behavior — the seeded-replay guarantee
 (tests/test_chaos.py::test_chaos_soak_same_seed_replays_identical_log
@@ -595,6 +604,156 @@ def run_governor(seed: int, phases: tuple = ("flock", "teleport",
     return report
 
 
+# audit soak knobs: clean-churn length, migration-storm cadence, and
+# the verdict's in-flight grace — 6 ticks so the injected drop is
+# judged lost at age 7, inside the <= 8-tick detection criterion
+AUDIT_SOAK_N = 96
+AUDIT_SOAK_CLEAN_TICKS = 48
+AUDIT_SOAK_GRACE = 6
+
+
+def run_audit(seed: int, n: int = AUDIT_SOAK_N,
+              clean_ticks: int = AUDIT_SOAK_CLEAN_TICKS,
+              grace_ticks: int = AUDIT_SOAK_GRACE) -> dict:
+    """The ISSUE-17 audit scenario, in-process like the governor soak
+    (the assertions need direct World + ledger access). Two phases:
+
+    1. CLEAN soak: a live world with the audit plane sampling the AOI
+       oracle EVERY tick, under create/destroy churn plus a
+       migration storm (full out->in round-trips through the real
+       ``get_migrate_data``/``remove_for_migration``/
+       ``restore_from_migration`` protocol). Must end with ZERO
+       violations of any kind, zero oracle mismatches and a passing
+       conservation verdict — the plane must not cry wolf.
+    2. INJECTED drop: one more migrate-out whose restore is
+       deliberately suppressed (the lost-update every migration bug
+       taxonomy fears). The conservation verdict must name the
+       dropped EntityID within <= 8 ticks, and routing the finding
+       back through the ledger's violation path must freeze an
+       ``audit_violation`` flight-recorder bundle carrying the ledger
+       tail.
+
+    ``converged`` = both phases held. Same-seed reruns replay the same
+    world evolution (the seeded-replay guarantee)."""
+    from goworld_tpu.scenarios.runner import build_world
+    from goworld_tpu.scenarios.spec import get_scenario
+    from goworld_tpu.utils import audit as audit_mod
+    from goworld_tpu.utils import flightrec
+
+    report: dict = {"scenario": "audit", "seed": seed, "n": n,
+                    "clean_ticks": clean_ticks,
+                    "grace_ticks": grace_ticks, "converged": False}
+    w, ents, clients = build_world(
+        get_scenario("mixed"), n=n, skin=4.0, client_frac=0.15,
+        seed=seed)
+    ap = w.audit
+    if ap is None:
+        report["error"] = "world built without an audit plane"
+        return report
+    ap.sample_every = 1  # soak-grade scrutiny: oracle every tick
+    rec = flightrec.FlightRecorder(ring=64,
+                                   context_fn=ap.incident_context)
+    incidents: list = []
+
+    def tick_and_record() -> None:
+        w.tick()
+        frame = {"tick": w.tick_count}
+        av = ap.take_violation()
+        if av is not None:
+            frame["audit_violation"] = av
+        incidents.extend(rec.record(frame))
+
+    def verdict() -> dict:
+        ap.drain()
+        return audit_mod.conservation_verdict(
+            [ap.snapshot(tick=w.tick_count)], grace_ticks=grace_ticks)
+
+    try:
+        # ---- phase 1: clean churn + migration storm ------------------
+        alive = [e for e in ents if not e.destroyed]
+        storm = 0
+        for t in range(clean_ticks):
+            if t % 4 == 2 and alive:
+                # one full migration round-trip through the real
+                # protocol: out-record opened, in-record retires it
+                e = alive[t % len(alive)]
+                if not e.destroyed and e._migrating is None:
+                    data = w.get_migrate_data(e)
+                    w.remove_for_migration(e)
+                    moved = w.restore_from_migration(data)
+                    alive[t % len(alive)] = moved
+                    storm += 1
+            tick_and_record()
+        clean = verdict()
+        snap = ap.snapshot(tick=w.tick_count)
+        report["migration_round_trips"] = storm
+        report["oracle"] = snap["oracle"]
+        report["violations_total"] = snap["violations_total"]
+        report["clean_verdict"] = {
+            k: clean.get(k) for k in ("ok", "live", "in_flight",
+                                      "created", "destroyed",
+                                      "problems")
+        }
+        clean_ok = (
+            clean.get("ok") is True
+            and not any(snap["violations_total"].values())
+            and snap["oracle"]["mismatches"] == 0
+            and snap["oracle"]["samples"] > 0
+            and not incidents
+        )
+        report["clean_ok"] = clean_ok
+
+        # ---- phase 2: injected entity drop ---------------------------
+        victim = next(e for e in alive
+                      if not e.destroyed and e._migrating is None)
+        report["dropped_eid"] = victim.id
+        w.get_migrate_data(victim)        # stamps the outgoing seq
+        w.remove_for_migration(victim)    # ... and the restore never
+        drop_tick = w.tick_count          # happens: the entity is lost
+        detected_at = None
+        problem = ""
+        for _ in range(grace_ticks + 4):
+            tick_and_record()
+            v = verdict()
+            named = [p for p in v.get("problems", [])
+                     if victim.id in p]
+            if not v.get("ok") and named:
+                detected_at = w.tick_count - drop_tick
+                problem = named[0]
+                break
+        report["detected_after_ticks"] = detected_at
+        report["problem"] = problem
+        detect_ok = detected_at is not None and detected_at <= 8
+        report["detect_ok"] = detect_ok
+
+        # the finding routes back through the ledger's violation path
+        # (the aggregator's role in production): counter bumped, tail
+        # annotated, and the flightrec trigger freezes the bundle
+        bundle_ok = False
+        if detect_ok:
+            ap.ledger.note_violation("lost_entity", problem,
+                                     w.tick_count)
+            tick_and_record()
+            frozen = [i for i in incidents
+                      if i.get("trigger") == "audit_violation"]
+            bundle_ok = bool(
+                frozen and victim.id in frozen[-1].get("detail", "")
+                and "tail" in (frozen[-1].get("context") or {}))
+            report["incident"] = {
+                "trigger": frozen[-1]["trigger"],
+                "detail": frozen[-1]["detail"],
+                "tick": frozen[-1]["tick"],
+            } if frozen else None
+        report["bundle_ok"] = bundle_ok
+        report["converged"] = bool(clean_ok and detect_ok and bundle_ok)
+        return report
+    except Exception as exc:
+        report["error"] = f"{type(exc).__name__}: {str(exc)[:300]}"
+        return report
+    finally:
+        audit_mod.unregister(f"game{w.game_id}")
+
+
 def _ini_port(server_dir: str, section: str, key: str) -> int:
     import configparser
 
@@ -605,10 +764,13 @@ def _ini_port(server_dir: str, section: str, key: str) -> int:
 
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--dir", required=True,
-                    help="throwaway server dir (created)")
+    ap.add_argument("--dir", default=None,
+                    help="throwaway server dir (created); required for "
+                         "the cluster scenarios (kill, overload), "
+                         "unused by the in-process ones "
+                         "(governor, audit)")
     ap.add_argument("--scenario",
-                    choices=("kill", "overload", "governor"),
+                    choices=("kill", "overload", "governor", "audit"),
                     default="kill")
     ap.add_argument("--seed", type=int, default=77)
     ap.add_argument("--deposits", type=int, default=25)
@@ -624,16 +786,22 @@ def main() -> int:
                          "homogeneous random_walk")
     ap.add_argument("--out", default="chaos_report.json")
     args = ap.parse_args()
-    if args.scenario == "governor":
+    if args.scenario in ("governor", "audit"):
         # in-process (no cluster dir needed): the oracle + entity
         # audits need direct World access; --dir is accepted but
         # unused for symmetry with the other scenarios
-        report = run_governor(args.seed)
-        report["workload"] = "governor-schedule"
+        if args.scenario == "governor":
+            report = run_governor(args.seed)
+            report["workload"] = "governor-schedule"
+        else:
+            report = run_audit(args.seed)
+            report["workload"] = "audit-churn"
         with open(args.out, "w") as f:
             json.dump(report, f, indent=2)
         print(json.dumps(report, indent=2))
         return 0 if report.get("converged") else 1
+    if not args.dir:
+        ap.error(f"--dir is required for the {args.scenario} scenario")
     server_dir, _, _ = build_server_dir(
         args.dir, overload_knobs=args.scenario == "overload",
         workload=args.workload)

@@ -207,7 +207,76 @@ def aggregate(targets: list[tuple], timeout: float = 2.0,
             targets, "tick_latency_ms", timeout=timeout)
     out["clock"] = scrape_clock_skew(targets, timeout=timeout)
     out["residency"] = aggregate_residency(targets, timeout=timeout)
+    out["audit"] = aggregate_audit(targets, timeout=timeout)
     return out
+
+
+def aggregate_audit(targets: list[tuple], timeout: float = 2.0) -> dict:
+    """Scrape every process's ``/audit`` plane (utils/audit.py) and
+    prove deployment-wide entity conservation: the per-game ledger
+    censuses + the unmatched in-flight migration window must equal
+    created - destroyed exactly (``audit.conservation_verdict`` — the
+    same function the chaos audit scenario gates on). The dispatcher's
+    routing census cross-checks the games' own ledgers; a violation
+    names its first EntityID. Unreachable/plane-less processes are
+    skipped silently (the ``/costs`` convention)."""
+    from goworld_tpu.utils import audit as audit_mod
+
+    games: list[dict] = []
+    disp: dict | None = None
+    gate_probes = 0
+    sources: list[str] = []
+    for label, base in targets:
+        try:
+            payload = _fetch_json(f"{base}/audit", timeout=timeout)
+        except (urllib.error.URLError, OSError, ValueError):
+            continue
+        if not isinstance(payload, dict) or "error" in payload:
+            continue
+        for name, snap in sorted(payload.items()):
+            if not isinstance(snap, dict):
+                continue
+            kind = snap.get("kind")
+            if kind == "game":
+                games.append(snap)
+                sources.append(f"{label}:{name}")
+            elif kind == "dispatcher":
+                disp = snap
+                sources.append(f"{label}:{name}")
+            elif kind == "gate":
+                gate_probes += 1
+    if not games:
+        return {"games": 0, "sources": sources}
+    out = audit_mod.conservation_verdict(games, dispatcher=disp)
+    out["sources"] = sources
+    out["gate_probes"] = gate_probes
+    out["oracle_samples"] = sum(
+        (g.get("oracle") or {}).get("samples", 0) for g in games)
+    out["oracle_mismatches"] = sum(
+        (g.get("oracle") or {}).get("mismatches", 0) for g in games)
+    return out
+
+
+def audit_line(agg: dict) -> str:
+    """One deployment conservation line (empty when no game ledger
+    contributed): the census balance verdict with any named problems
+    indented under it."""
+    a = agg.get("audit") or {}
+    if not a.get("games"):
+        return ""
+    verdict = "PASS" if a.get("ok") else "FAIL"
+    line = (f"deployment conservation {verdict} "
+            f"live={a.get('live')} + in_flight={a.get('in_flight')} "
+            f"vs created={a.get('created')} - "
+            f"destroyed={a.get('destroyed')} "
+            f"({a['games']} games, "
+            f"{a.get('oracle_samples', 0)} oracle samples")
+    if "dispatcher_entities" in a:
+        line += f", dispatcher routes {a['dispatcher_entities']}"
+    line += ")"
+    for p in (a.get("problems") or [])[:4]:
+        line += f"\n  audit: {p}"
+    return line
 
 
 def aggregate_residency(targets: list[tuple],
@@ -398,7 +467,25 @@ def render(agg: dict) -> str:
     rline = residency_line(agg)
     if rline:
         lines.append(rline)
+    aline = audit_line(agg)
+    if aline:
+        lines.append(aline)
     return "\n".join(lines)
+
+
+def probe_targets(targets: list[tuple],
+                  timeout: float = 2.0) -> list[str]:
+    """``--strict`` reachability sweep: every configured process must
+    answer ``/healthz``; returns the failures as ``label: reason``
+    lines (empty = all reachable)."""
+    failures: list[str] = []
+    for label, base in targets:
+        try:
+            _fetch_json(f"{base}/healthz", timeout=timeout)
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            failures.append(f"{label}: {base}/healthz unreachable "
+                            f"({exc})")
+    return failures
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -413,6 +500,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--timeout", type=float, default=2.0)
     ap.add_argument("--json", action="store_true",
                     help="emit the raw merged record instead of text")
+    ap.add_argument("--strict", action="store_true",
+                    help="list unreachable configured processes and "
+                         "exit nonzero instead of skipping them "
+                         "silently (CI mode)")
     args = ap.parse_args(argv)
 
     try:
@@ -425,7 +516,14 @@ def main(argv: list[str] | None = None) -> int:
               "configured, or --url", file=sys.stderr)
         return 1
 
+    strict_rc = 0
     while True:
+        if args.strict:
+            failures = probe_targets(targets, timeout=args.timeout)
+            for f in failures:
+                print(f"STRICT: {f}", file=sys.stderr)
+            if failures:
+                strict_rc = 1
         agg = aggregate(targets, timeout=args.timeout)
         if args.json:
             print(json.dumps(agg, indent=2, default=str))
@@ -441,7 +539,7 @@ def main(argv: list[str] | None = None) -> int:
         except KeyboardInterrupt:
             break
         print()
-    return 0
+    return strict_rc
 
 
 if __name__ == "__main__":

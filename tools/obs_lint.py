@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""Observability drift lint (ISSUE 17 satellite): the docs must keep
+up with the debug plane, mechanically.
+
+Two contracts, both checked TEXTUALLY (this tool is jax-free and runs
+as a tier-1 test, tests/test_obs_lint.py):
+
+1. every endpoint in ``debug_http._ENDPOINTS`` has a row in the
+   docs/OBSERVABILITY.md endpoint table (a markdown table row whose
+   first cell backticks the path), and every documented path is a
+   real endpoint — a doc row for a deleted endpoint is drift too;
+2. every pytest marker registered in tests/conftest.py
+   (``config.addinivalue_line("markers", "<name>: ...")``) appears in
+   README.md (as ``-m <name>`` or a backticked ``<name>``) — an
+   undocumented marker is a test suite nobody knows how to select.
+
+Exit codes: 0 clean, 1 usage/missing file, 2 drift found.
+
+Usage::
+
+    python tools/obs_lint.py            # lint the repo this file is in
+    python tools/obs_lint.py --repo DIR
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def parse_endpoints(debug_http_src: str) -> list[str]:
+    """The ``_ENDPOINTS = [...]`` literal, textually (importing
+    debug_http would drag in the serving stack; the lint must stay
+    dependency-free)."""
+    m = re.search(r"_ENDPOINTS\s*=\s*\[([^\]]*)\]", debug_http_src,
+                  re.S)
+    if m is None:
+        return []
+    return re.findall(r'"(/[a-z_]+)"', m.group(1))
+
+
+def parse_doc_endpoints(doc_src: str) -> list[str]:
+    """Every path documented in a markdown table row: lines starting
+    with ``|`` whose FIRST cell carries a backticked ``/path``."""
+    out: list[str] = []
+    for line in doc_src.splitlines():
+        if not line.lstrip().startswith("|"):
+            continue
+        first_cell = line.lstrip().lstrip("|").split("|", 1)[0]
+        m = re.search(r"`(/[a-z_]+)`", first_cell)
+        if m is not None:
+            out.append(m.group(1))
+    return out
+
+
+def parse_markers(conftest_src: str) -> list[str]:
+    """Every registered pytest marker name: the word before the first
+    colon in the string literal following ``"markers"``."""
+    return re.findall(
+        r'addinivalue_line\(\s*"markers",\s*"(\w+):', conftest_src)
+
+
+def marker_documented(name: str, readme_src: str) -> bool:
+    return f"-m {name}" in readme_src \
+        or f"`{name}`" in readme_src
+
+
+def lint(repo: str) -> tuple[list[str], dict]:
+    """Returns (drift problems, summary facts). A missing input file
+    is a problem too — the contract can't be silently vacuous."""
+    problems: list[str] = []
+    paths = {
+        "debug_http": os.path.join(repo, "goworld_tpu", "utils",
+                                   "debug_http.py"),
+        "doc": os.path.join(repo, "docs", "OBSERVABILITY.md"),
+        "conftest": os.path.join(repo, "tests", "conftest.py"),
+        "readme": os.path.join(repo, "README.md"),
+    }
+    src: dict[str, str] = {}
+    for key, p in paths.items():
+        try:
+            with open(p, encoding="utf-8") as fh:
+                src[key] = fh.read()
+        except OSError as exc:
+            problems.append(f"unreadable {p}: {exc}")
+            return problems, {}
+
+    endpoints = parse_endpoints(src["debug_http"])
+    documented = parse_doc_endpoints(src["doc"])
+    markers = parse_markers(src["conftest"])
+    if not endpoints:
+        problems.append("no _ENDPOINTS list found in debug_http.py "
+                        "(parser drift?)")
+    if not markers:
+        problems.append("no markers found in tests/conftest.py "
+                        "(parser drift?)")
+    for ep in endpoints:
+        if ep not in documented:
+            problems.append(
+                f"endpoint {ep} (debug_http._ENDPOINTS) has no row in "
+                "the docs/OBSERVABILITY.md endpoint table")
+    for ep in documented:
+        if ep not in endpoints:
+            problems.append(
+                f"docs/OBSERVABILITY.md documents {ep} but "
+                "debug_http._ENDPOINTS does not serve it")
+    for name in markers:
+        if not marker_documented(name, src["readme"]):
+            problems.append(
+                f"pytest marker '{name}' (tests/conftest.py) is not "
+                "documented in README.md")
+    return problems, {
+        "endpoints": len(endpoints),
+        "documented_endpoints": len(set(documented)),
+        "markers": len(markers),
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="lint debug-http endpoints and pytest markers "
+                    "against their docs")
+    ap.add_argument("--repo", default=REPO)
+    args = ap.parse_args(argv)
+    if not os.path.isdir(args.repo):
+        print(f"no such repo dir: {args.repo}", file=sys.stderr)
+        return 1
+    problems, facts = lint(args.repo)
+    for p in problems:
+        print(f"DRIFT: {p}", file=sys.stderr)
+    if problems:
+        return 2
+    print(f"obs_lint: ok ({facts.get('endpoints', 0)} endpoints "
+          f"documented, {facts.get('markers', 0)} markers documented)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

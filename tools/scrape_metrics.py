@@ -135,11 +135,12 @@ def _cell(v: float | None) -> str:
 # /costs: per-process SLO verdicts + cost reports (utils/devprof)
 # ----------------------------------------------------------------------
 def scrape_costs(targets: list[tuple[str, str]], timeout: float = 2.0,
-                 ) -> dict[str, dict]:
+                 errors: list[str] | None = None) -> dict[str, dict]:
     """Fetch each target's ``/costs`` (derived from its /metrics url);
     {label: payload}. Unreachable processes or processes predating the
     endpoint (404) are skipped — the metric scrape already reports
-    reachability."""
+    reachability — unless the caller passes ``errors`` (``--strict``):
+    then every failure is appended there as a ``label: reason`` line."""
     out: dict[str, dict] = {}
     for label, url in targets:
         costs_url = url.rsplit("/", 1)[0] + "/costs"
@@ -148,7 +149,9 @@ def scrape_costs(targets: list[tuple[str, str]], timeout: float = 2.0,
                                         timeout=timeout) as resp:
                 payload = json.loads(
                     resp.read().decode("utf-8", "replace"))
-        except (urllib.error.URLError, OSError, ValueError):
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            if errors is not None:
+                errors.append(f"{label}: {costs_url} failed ({e})")
             continue
         if isinstance(payload, dict) and "error" not in payload:
             out[label] = payload
@@ -258,11 +261,13 @@ def governor_lines(scraped: dict[str, dict]) -> list[str]:
 
 
 def scrape_residency(targets: list[tuple[str, str]],
-                     timeout: float = 2.0) -> dict[str, dict]:
+                     timeout: float = 2.0,
+                     errors: list[str] | None = None) -> dict[str, dict]:
     """Fetch each target's ``/residency`` (utils/residency.py);
     {label: payload}. Unreachable/404/tracker-less processes are
     skipped silently — the ``/costs`` convention (gates and
-    dispatchers serve the endpoint but tick no world)."""
+    dispatchers serve the endpoint but tick no world) — unless the
+    caller passes ``errors`` (``--strict``)."""
     out: dict[str, dict] = {}
     for label, url in targets:
         res_url = url.rsplit("/", 1)[0] + "/residency"
@@ -271,11 +276,72 @@ def scrape_residency(targets: list[tuple[str, str]],
                                         timeout=timeout) as resp:
                 payload = json.loads(
                     resp.read().decode("utf-8", "replace"))
-        except (urllib.error.URLError, OSError, ValueError):
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            if errors is not None:
+                errors.append(f"{label}: {res_url} failed ({e})")
             continue
         if isinstance(payload, dict) and "error" not in payload:
             out[label] = payload
     return out
+
+
+def scrape_audit(targets: list[tuple[str, str]], timeout: float = 2.0,
+                 errors: list[str] | None = None) -> dict[str, dict]:
+    """Fetch each target's ``/audit`` (utils/audit.py correctness
+    plane); {label: payload}. Unreachable/404/plane-less processes
+    are skipped silently — the ``/costs`` convention — unless the
+    caller passes ``errors`` (``--strict``): then every failure is
+    appended there so a misconfigured audit rollout is visible
+    instead of quietly shrinking the census."""
+    out: dict[str, dict] = {}
+    for label, url in targets:
+        aud_url = url.rsplit("/", 1)[0] + "/audit"
+        try:
+            with urllib.request.urlopen(aud_url,
+                                        timeout=timeout) as resp:
+                payload = json.loads(
+                    resp.read().decode("utf-8", "replace"))
+        except (urllib.error.URLError, OSError, ValueError) as e:
+            if errors is not None:
+                errors.append(f"{label}: {aud_url} failed ({e})")
+            continue
+        if isinstance(payload, dict) and "error" not in payload:
+            out[label] = payload
+    return out
+
+
+def audit_lines(scraped: dict[str, dict]) -> list[str]:
+    """One entity-ownership line per audited process (``cli.py
+    status`` prints the cluster-level conservation verdict; these are
+    the per-process raw censuses): live count, census CRC, lifetime
+    create/destroy/migrate counters, violation total and oracle
+    sample progress."""
+    lines: list[str] = []
+    for label, payload in sorted(scraped.items()):
+        for name, snap in sorted(payload.items()):
+            if not isinstance(snap, dict):
+                continue
+            if snap.get("kind") == "game" and "census" in snap:
+                viol = sum((snap.get("violations_total") or {}).values())
+                oracle = snap.get("oracle") or {}
+                line = (f"{label}: audit {name} live="
+                        f"{snap.get('entities', 0)} "
+                        f"crc={snap.get('crc', 0):08x} | "
+                        f"created {snap.get('created', 0)} "
+                        f"destroyed {snap.get('destroyed', 0)} "
+                        f"migrated {snap.get('migrated_out', 0)}out/"
+                        f"{snap.get('migrated_in', 0)}in | "
+                        f"oracle {oracle.get('samples', 0)} samples "
+                        f"{oracle.get('mismatches', 0)} mismatches | "
+                        + ("OK" if viol == 0 else
+                           f"{viol} VIOLATIONS"))
+                lines.append(line)
+            elif snap.get("kind") == "dispatcher":
+                games = snap.get("games") or {}
+                lines.append(f"{label}: audit routes "
+                             f"{snap.get('entities', 0)} entities "
+                             f"over {len(games)} games")
+    return lines
 
 
 def residency_lines(scraped: dict[str, dict]) -> list[str]:
@@ -341,6 +407,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--costs", action="store_true",
                     help="also dump each process's registered cost "
                          "reports (/costs), not just the SLO verdict")
+    ap.add_argument("--strict", action="store_true",
+                    help="list every unreachable/404 sub-endpoint "
+                         "(costs, residency, audit) on stderr and exit "
+                         "nonzero instead of silently skipping it")
     ap.add_argument("--timeout", type=float, default=2.0)
     args = ap.parse_args(argv)
 
@@ -364,10 +434,14 @@ def main(argv: list[str] | None = None) -> int:
 
     results, errors = scrape_all(targets, timeout=args.timeout)
     print(merged_table(results, include_buckets=args.buckets))
+    # --strict: sub-endpoint failures become findings instead of
+    # silent skips (the default stays quiet — old processes are not
+    # noise during a rolling upgrade)
+    strict_errors: list[str] | None = [] if args.strict else None
     # only re-probe processes the metric scrape already reached — a
     # dead target would otherwise stall a second full timeout here
     costs = scrape_costs([t for t in targets if t[0] in results],
-                         timeout=args.timeout)
+                         timeout=args.timeout, errors=strict_errors)
     if costs:
         print()
         for line in slo_lines(costs):
@@ -381,8 +455,13 @@ def main(argv: list[str] | None = None) -> int:
     # serve-loop residency verdicts (debug_http /residency;
     # 404/unreachable/tracker-less skipped silently like /costs)
     res = scrape_residency([t for t in targets if t[0] in results],
-                           timeout=args.timeout)
+                           timeout=args.timeout, errors=strict_errors)
     for line in residency_lines(res):
+        print(line)
+    # entity-ownership censuses (debug_http /audit; utils/audit.py)
+    aud = scrape_audit([t for t in targets if t[0] in results],
+                       timeout=args.timeout, errors=strict_errors)
+    for line in audit_lines(aud):
         print(line)
     if args.costs:
         for label, payload in sorted(costs.items()):
@@ -391,7 +470,9 @@ def main(argv: list[str] | None = None) -> int:
                       f"{json.dumps(rep, default=str)}")
     for e in errors:
         print(e, file=sys.stderr)
-    return 1 if errors else 0
+    for e in strict_errors or ():
+        print(f"STRICT: {e}", file=sys.stderr)
+    return 1 if errors or strict_errors else 0
 
 
 if __name__ == "__main__":
