@@ -81,6 +81,18 @@ the sampled live AOI oracle measured on a REAL churning World
 plus the strict A/B overhead of the plane vs the 60 Hz budget (< 1%
 is the criterion). BENCH_AUDIT=0 skips (recorded honestly);
 BENCH_AUDIT_ENTITIES (default 192) / _TICKS (96) shape it.
+
+Hot-standby failover block (ISSUE 18): every round stamps a
+``failover`` block — a REAL primary streaming SnapshotChain frames
+through the bounded replication worker into a live standby world,
+killed at a deterministic tick and promoted through the
+kvreg-arbitrated claim (goworld_tpu/replication/). Reports
+replication bytes/tick NEXT TO the client-sync bytes/tick the same
+workload ships, standby apply ms/tick, and the promotion latency in
+ticks; the gate is zero lost/duplicated EntityIDs, a clean stream, a
+byte-replayable decision log, and a window inside the lag budget.
+BENCH_FAILOVER=0 skips (recorded honestly); BENCH_FAILOVER_ENTITIES
+(default 128) / _TICKS (48) shape it.
 """
 
 import argparse
@@ -1683,6 +1695,252 @@ def measure_audit(n: int) -> dict:
         audit_mod.unregister("game91")
 
 
+def measure_failover(n: int) -> dict:
+    """Hot-standby failover block (ISSUE 18): a REAL primary world
+    under pose churn streams SnapshotChain frames through the bounded
+    off-thread :class:`ReplicationWorker` into a live
+    :class:`StandbyApplier` world, then dies at a deterministic tick
+    and the standby promotes through the kvreg-arbitrated claim. The
+    block reports the replication stream's wire cost NEXT TO the
+    client-sync wire volume the same workload generates (the
+    paper-facing contrast: continuous replication rides the same
+    order of magnitude as what the primary already ships to clients),
+    the standby's per-tick apply cost, and the promotion latency in
+    TICKS (staleness behind the dead primary at the kill + the one
+    resume tick).
+
+    The gate: zero lost / zero duplicated EntityIDs across promotion,
+    no torn frames, an arbitrated single winner whose decision log
+    replays byte-for-byte, and a promotion window within the standby
+    lag budget."""
+    import shutil
+    import statistics
+    import tempfile
+
+    import numpy as np
+
+    from goworld_tpu import freeze as freeze_mod
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.entity.entity import Entity, GameClient
+    from goworld_tpu.entity.manager import World
+    from goworld_tpu.entity.space import Space
+    from goworld_tpu.net import codec as net_codec
+    from goworld_tpu.ops.aoi import GridSpec
+    from goworld_tpu.replication.promote import (
+        DecisionLog, adjudicate, claim_key, claim_value,
+        replay_decisions)
+    from goworld_tpu.replication.standby import (
+        StandbyApplier, StandbyTracker)
+    from goworld_tpu.replication.worker import ReplicationWorker
+    from goworld_tpu.utils import audit as audit_mod
+
+    ents = min(int(n),
+               int(os.environ.get("BENCH_FAILOVER_ENTITIES", 128)))
+    ticks = int(os.environ.get("BENCH_FAILOVER_TICKS", 48))
+    keyframe_every = 8
+
+    class _FoMob(Entity):
+        ATTRS = {"hp": "allclients hot:0"}
+
+    capacity = 64
+    while capacity < 2 * ents:
+        capacity *= 2
+
+    cfg = WorldConfig(
+        capacity=capacity,
+        grid=GridSpec(radius=20.0, extent_x=200.0, extent_z=200.0),
+        input_cap=256,
+    )
+    primary = World(cfg, n_spaces=1, game_id=93)
+    primary.register_entity("Mob", _FoMob)
+    primary.register_space("Arena", Space)
+    primary.create_nil_space()
+    sp = primary.create_space("Arena")
+    rng = np.random.default_rng(23)
+    pool = []
+    for i in range(ents):
+        x, z = rng.uniform(10.0, 190.0, 2)
+        e = sp.create_entity("Mob", pos=(float(x), 0.0, float(z)))
+        e.attrs["hp"] = i
+        pool.append(e)
+    # a client cohort so the primary generates REAL downstream sync
+    # wire bytes — the denominator of the replication-cost contrast
+    n_clients = max(1, ents // 4)
+    for i in range(n_clients):
+        pool[i].set_client(GameClient(1, f"fo-c{i}", primary))
+    sync_acc = {"bytes": 0}
+
+    def _client_sync_sink(gate_id, cids, eids, vals) -> None:
+        # the exact full-wire body the game server ships per gate per
+        # tick (net/game.py _flush_sync_out, non-delta leg)
+        cid_b = np.asarray(cids, "S16")
+        if cid_b.size == 0:
+            return
+        body = net_codec.encode_client_sync_batch(
+            cid_b, np.asarray(eids, "S16"),
+            np.asarray(vals, np.float32).reshape(-1, 4))
+        sync_acc["bytes"] += len(body)
+
+    primary.sync_sink = _client_sync_sink
+
+    # the standby: a bare world sharing the type registry, pre-warmed
+    # the way net/game.py _standby_tick does — compile the jit'd tick
+    # program on the still-empty world (SoA shapes are capacity-static,
+    # so it is the same program the promoted tick runs; without it the
+    # "warm" promotion pays seconds of compile)
+    standby = World(cfg, n_spaces=1, game_id=94)
+    standby.register_entity("Mob", _FoMob)
+    standby.register_space("Arena", Space)
+    standby.tick()
+    standby.tick_count = 0
+    tracker = StandbyTracker(94, 93, tick_hz=60.0)
+    applier = StandbyApplier(standby, 93, tracker=tracker)
+
+    tmpdir = tempfile.mkdtemp(prefix="bench_failover_")
+    frames: list = []
+
+    def send_fn(blob: bytes, kind: str, tick: int) -> None:
+        frames.append((blob, kind, tick))
+
+    chain = freeze_mod.SnapshotChain(primary, tmpdir,
+                                     keyframe_every=keyframe_every)
+    worker = ReplicationWorker(chain, game_id=93, queue_max=4,
+                               send_fn=send_fn)
+
+    def _census(w) -> set:
+        out = {e.id for e in w.entities.values() if not e.destroyed}
+        if w.nil_space is not None:
+            out.discard(w.nil_space.id)
+        return out
+
+    census_by_tick: dict[int, set] = {}
+    try:
+        # warmup outside the clock: jit compile + the spawn flush
+        for _ in range(3):
+            primary.tick()
+        sync_acc["bytes"] = 0
+        repl_bytes = 0
+        applied = rejected = keyframes = 0
+        apply_ms: list[float] = []
+        tick_ms: list[float] = []
+        for _ in range(ticks):
+            for e in pool:
+                if e.destroyed:
+                    continue
+                x, z = rng.uniform(10.0, 190.0, 2)
+                primary.stage_pose(e, (float(x), 0.0, float(z)),
+                                   yaw=float(rng.uniform(0.0, 6.28)))
+            t1 = time.perf_counter()
+            primary.tick()
+            tick_ms.append((time.perf_counter() - t1) * 1e3)
+            census_by_tick[primary.tick_count] = _census(primary)
+            worker.submit(chain.capture(), to_disk=True,
+                          to_stream=True)
+            worker.drain()  # deterministic measurement: no drops
+            batch, frames[:] = frames[:], []
+            for blob, kind, _tk in batch:
+                repl_bytes += len(blob)
+                if kind == "key":
+                    keyframes += 1
+                t2 = time.perf_counter()
+                out = applier.apply(blob)
+                apply_ms.append((time.perf_counter() - t2) * 1e3)
+                if out["ok"]:
+                    applied += 1
+                else:
+                    rejected += 1
+        if applied == 0:
+            return {"error": "no frames reached the standby"}
+
+        # deterministic kill at the last streamed tick; the standby
+        # claims through the dispatcher's exact first-writer-wins kvreg
+        # semantics (net/dispatcher.py _h_kvreg), emulated locally
+        kill_tick = primary.tick_count
+        applied_tick = applier.decoder.applied_tick
+        applied_seq = applier.decoder.applied_seq
+        kvreg: dict[str, str] = {}
+
+        def kv_register(key: str, val: str, force: bool = False) -> str:
+            if key not in kvreg or force:
+                kvreg[key] = val
+            return kvreg[key]
+
+        key = claim_key(93)
+        mine = claim_value(94, 1, applied_seq)
+        dlog = DecisionLog()
+        dlog.note("claim", key=key, value=mine, epoch=1,
+                  applied_seq=applied_seq, applied_tick=applied_tick)
+        t_warm0 = time.perf_counter()
+        winner = kv_register(key, mine)
+        verdict = adjudicate(winner, mine)
+        dlog.note("adjudicate", winner=winner, mine=mine,
+                  verdict=verdict)
+        promote_ok = verdict == "won"
+        standby.tick_count = max(standby.tick_count, applied_tick)
+        standby.tick()  # first served tick from the mirrored state
+        warm_secs = time.perf_counter() - t_warm0
+        promotion_latency_ticks = (kill_tick - max(0, applied_tick)) + 1
+        tracker.note_promoted(1, applied_tick)
+        replay_ok = replay_decisions(dlog.inputs) == dlog.dump()
+
+        # conservation across promotion: the promoted census must equal
+        # the primary's census at the last APPLIED frame
+        want = census_by_tick.get(applied_tick, set())
+        got = _census(standby)
+        lost = len(want - got)
+        dup = len(got - want)
+
+        repl_per_tick = repl_bytes / max(1, ticks)
+        sync_per_tick = sync_acc["bytes"] / max(1, ticks)
+        budget = tracker.lag_budget_ticks
+        out = {
+            "entities": ents,
+            "capacity": capacity,
+            "ticks": ticks,
+            "keyframe_every": keyframe_every,
+            "clients": n_clients,
+            "frames_applied": applied,
+            "frames_rejected": rejected,
+            "keyframes": keyframes,
+            "replication_bytes_per_tick": round(repl_per_tick, 1),
+            "client_sync_bytes_per_tick": round(sync_per_tick, 1),
+            "replication_vs_client_sync": (
+                round(repl_per_tick / sync_per_tick, 3)
+                if sync_per_tick > 0 else None),
+            "standby_apply_ms_per_tick": round(
+                sum(apply_ms) / max(1, ticks), 3),
+            "primary_tick_ms": round(statistics.median(tick_ms), 3),
+            "promotion_latency_ticks": promotion_latency_ticks,
+            "promotion_secs": round(warm_secs, 4),
+            "lag_budget_ticks": budget,
+            "entities_expected": len(want),
+            "entities_promoted": len(got),
+            "entities_lost": lost,
+            "entities_duplicated": dup,
+            "decision_log_replay_ok": replay_ok,
+            "worker": worker.stats(),
+            # the acceptance gate: conservation across promotion, a
+            # clean stream, a single arbitrated winner with a
+            # byte-replayable log, inside the lag budget
+            "pass": (lost == 0 and dup == 0 and rejected == 0
+                     and promote_ok and replay_ok
+                     and promotion_latency_ticks <= budget),
+        }
+        log(f"failover: {applied} frames ({keyframes} keys) "
+            f"{out['replication_bytes_per_tick']} repl B/tick vs "
+            f"{out['client_sync_bytes_per_tick']} sync B/tick, "
+            f"apply {out['standby_apply_ms_per_tick']} ms/tick, "
+            f"promoted in {promotion_latency_ticks} ticks "
+            f"({lost} lost, {dup} dup) "
+            f"({'PASS' if out['pass'] else 'FAIL'})")
+        return out
+    finally:
+        worker.close()
+        audit_mod.unregister("game93")
+        audit_mod.unregister("game94")
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def measure(n: int, ticks: int, client_frac: float, phases: bool,
             grid_overrides: dict | None = None) -> dict:
     import jax
@@ -2977,6 +3235,18 @@ def child_main(args) -> int:
                 aud = {"error": str(exc)[:300]}
             aud["stage"] = "audit"
             print(json.dumps(aud), flush=True)
+        if name == "full" \
+                and os.environ.get("BENCH_FAILOVER", "1") == "1":
+            # the hot-standby failover plane (ISSUE 18), AFTER the
+            # headline line is safely on stdout (same contract: a
+            # replication/promotion wedge must never zero the round)
+            try:
+                fov = measure_failover(n)
+            except Exception as exc:
+                log(f"failover stage failed: {exc}")
+                fov = {"error": str(exc)[:300]}
+            fov["stage"] = "failover"
+            print(json.dumps(fov), flush=True)
         if name == "full" and p99_args is not None \
                 and os.environ.get("BENCH_SKIP_P99") != "1":
             # separate stage AFTER the headline line is on stdout: a
@@ -3139,6 +3409,7 @@ def parent_main() -> int:
     sage = None          # the sync-age loopback block (ISSUE 15)
     resid = None         # the serve-loop residency block (ISSUE 16)
     audt = None          # the correctness-audit block (ISSUE 17)
+    fovr = None          # the hot-standby failover block (ISSUE 18)
     variants = {}        # config-5 behavior variants (btree/mlp)
 
     live_stages: list = []   # current child's streamed stages
@@ -3151,7 +3422,7 @@ def parent_main() -> int:
         child count too (they are per-line complete results)."""
         b, sb, pt = best, suspect_best, partial
         cp99, cp99s, csc, cgov, csage = p99, p99_shard, scen, gov, sage
-        cres, caud = resid, audt
+        cres, caud, cfov = resid, audt, fovr
         if b is None:
             for s in list(live_stages):
                 st = s.get("stage")
@@ -3174,6 +3445,8 @@ def parent_main() -> int:
                     cres = s
                 elif st == "audit":
                     caud = s
+                elif st == "failover":
+                    cfov = s
                 elif pt is None:
                     pt = s
         chosen = b or sb or pt
@@ -3188,6 +3461,7 @@ def parent_main() -> int:
             csage = None
             cres = None
             caud = None
+            cfov = None
         if chosen is not None and cp99 is not None:
             chosen = dict(chosen)
             for k in ("tick_p50_ms", "tick_p99_ms",
@@ -3279,6 +3553,19 @@ def parent_main() -> int:
                 }
             else:
                 chosen["audit"] = {"skipped": "BENCH_AUDIT=0"}
+            # the failover block is ALWAYS stamped from r18 on (the
+            # bench_schema contract): the measured hot-standby plane
+            # when the stage ran, an honest skip/error record otherwise
+            if cfov is not None:
+                chosen["failover"] = {
+                    k: v for k, v in cfov.items() if k != "stage"
+                }
+            elif os.environ.get("BENCH_FAILOVER", "1") == "1":
+                chosen["failover"] = {
+                    "error": "failover stage never completed"
+                }
+            else:
+                chosen["failover"] = {"skipped": "BENCH_FAILOVER=0"}
         result = {
             "metric": "entity_ticks_per_sec_per_chip",
             "value": 0.0,
@@ -3361,6 +3648,7 @@ def parent_main() -> int:
         child_sage = None
         child_resid = None
         child_aud = None
+        child_fov = None
         got_best = False
         for s in stages:
             if s.get("stage") == "p99":
@@ -3383,6 +3671,9 @@ def parent_main() -> int:
                 continue
             if s.get("stage") == "audit":
                 child_aud = s
+                continue
+            if s.get("stage") == "failover":
+                child_fov = s
                 continue
             partial = s
             if s.get("stage") == "full":
@@ -3407,6 +3698,7 @@ def parent_main() -> int:
             sage = child_sage
             resid = child_resid
             audt = child_aud
+            fovr = child_fov
         attempts_log.append({
             "attempt": i + 1, "env": {},
             "stages": [s.get("stage") for s in stages],
@@ -3456,6 +3748,7 @@ def parent_main() -> int:
         child_sage = None
         child_resid = None
         child_aud = None
+        child_fov = None
         got_best = False
         for s in stages:
             if s.get("stage") == "p99":
@@ -3472,6 +3765,8 @@ def parent_main() -> int:
                 child_resid = s
             elif s.get("stage") == "audit":
                 child_aud = s
+            elif s.get("stage") == "failover":
+                child_fov = s
             elif s.get("stage") == "full":
                 # same rule as the TPU loop: a full stage that failed its
                 # 2x-scale self-check never becomes the headline
@@ -3489,6 +3784,7 @@ def parent_main() -> int:
         sage = child_sage if got_best else None
         resid = child_resid if got_best else None
         audt = child_aud if got_best else None
+        fovr = child_fov if got_best else None
 
     # BASELINE config 5 (fused NPC behavior kernels): once a TPU headline
     # is in hand, time the btree and mlp behaviors at the same N so the
@@ -3593,6 +3889,8 @@ def selftest_main() -> int:
         "BENCH_RESIDENCY_TICKS": "36",
         "BENCH_AUDIT_ENTITIES": "64",
         "BENCH_AUDIT_TICKS": "24",
+        "BENCH_FAILOVER_ENTITIES": "48",
+        "BENCH_FAILOVER_TICKS": "20",
     }
     failures: list[str] = []
     report: dict = {}
@@ -3853,6 +4151,34 @@ def selftest_main() -> int:
             check("full.audit.overhead",
                   au.get("overhead_pct_of_budget", 100.0) < 1.0,
                   str(au.get("overhead_pct_of_budget")))
+        # the hot-standby failover block (ISSUE 18; r>=18 schema rule):
+        # on the selftest shape the stream + promotion must land — an
+        # {"error": ...} record here IS harness rot
+        fo = art.get("failover", {})
+        check("full.failover", isinstance(fo, dict)
+              and {"replication_bytes_per_tick",
+                   "client_sync_bytes_per_tick",
+                   "standby_apply_ms_per_tick",
+                   "promotion_latency_ticks", "entities_lost",
+                   "pass"} <= set(fo), str(fo)[:200])
+        if "entities_lost" in fo:
+            check("full.failover.conservation",
+                  fo.get("entities_lost") == 0
+                  and fo.get("entities_duplicated") == 0,
+                  str({k: fo.get(k) for k in
+                       ("entities_lost", "entities_duplicated")}))
+            check("full.failover.stream",
+                  fo.get("frames_applied", 0) > 0
+                  and fo.get("frames_rejected") == 0,
+                  str({k: fo.get(k) for k in
+                       ("frames_applied", "frames_rejected")}))
+            check("full.failover.window",
+                  fo.get("promotion_latency_ticks", 10**9)
+                  <= fo.get("lag_budget_ticks", 0),
+                  str(fo.get("promotion_latency_ticks")))
+            check("full.failover.replay",
+                  fo.get("decision_log_replay_ok") is True,
+                  str(fo.get("decision_log_replay_ok")))
         check("full.p99", "tick_p99_ms" in art, "missing p99 keys")
         check("full.p99_gate", "p99_suspect" not in art,
               art.get("p99_suspect", ""))

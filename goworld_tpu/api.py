@@ -487,6 +487,12 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
             governor_cooldown_windows=gc.governor_cooldown_windows,
             governor_regret_pct=gc.governor_regret_pct,
             governor_table=gc.governor_table,
+            # hot-standby replication (ISSUE 18): nonzero standby_of
+            # makes this process a warm mirror of game N
+            standby_of=gc.standby_of,
+            replication_keyframe_every=gc.replication_keyframe_every,
+            replication_queue=gc.replication_queue,
+            replication_lag_budget_ticks=gc.replication_lag_budget_ticks,
         )
 
     restoring = args.restore and \
@@ -506,11 +512,20 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
             restoring = False
     if not restoring:
         world.create_nil_space()
-        for cb in _boot_callbacks:
-            try:
-                cb(world)
-            except Exception:
-                logger.exception("on_boot callback failed")
+        if gc.standby_of:
+            # a standby boots EMPTY: its population arrives as
+            # replication frames from the primary — running the boot
+            # callbacks here would spawn a second, conflicting world
+            logger.info(
+                "game%d: standby of game%d — skipping boot callbacks, "
+                "mirroring the primary's stream", gid, gc.standby_of,
+            )
+        else:
+            for cb in _boot_callbacks:
+                try:
+                    cb(world)
+                except Exception:
+                    logger.exception("on_boot callback failed")
         server = _mk_server(False)
     svc = server.setup_services()
     _apply_registrations(world, svc=svc, services_only=True)

@@ -498,6 +498,46 @@ class RestartBackoff:
         self._state[label] = (fails, now + delay, now, delay)
 
 
+def _standby_for(cfg: config_mod.ClusterConfig, gid: int) -> int | None:
+    """The configured hot standby of game ``gid`` (``[gameN]
+    standby_of = gid``), or None. First configured wins — one standby
+    per primary is the supported topology."""
+    for sgid in sorted(cfg.games):
+        if sgid != gid and getattr(cfg.games[sgid], "standby_of", 0) == gid:
+            return sgid
+    return None
+
+
+def _promote_standby(server_dir: str, cfg: config_mod.ClusterConfig,
+                     gid: int, sgid: int, timeout: float = 3.0) -> bool:
+    """Try to turn game ``gid``'s crash into a warm failover: poke the
+    live standby's debug-http ``/standby?promote=1``. The standby
+    stages a kvreg-arbitrated claim on its logic thread (single-winner
+    — a zombie primary can never split-brain) and resumes ticking from
+    its last applied frame. Returns True iff the standby accepted the
+    request; the caller falls back to cold restore otherwise. The
+    epoch is derived by the standby from the last observed promotion
+    round in kvreg, so repeated scans stay monotonic without
+    supervisor-side state."""
+    import json as _json
+    import urllib.request
+
+    gc = cfg.games.get(sgid)
+    if gc is None or getattr(gc, "http_port", 0) <= 0:
+        return False
+    _n, labels = _group_labels(cfg, sgid)
+    if not all(_alive(_read_pid(server_dir, "game", lb))
+               for lb in labels):
+        return False  # the standby is dead too: cold restore it is
+    url = (f"http://127.0.0.1:{gc.http_port}/standby?promote=1")
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            out = _json.loads(resp.read().decode("utf-8", "replace"))
+    except (OSError, ValueError):
+        return False
+    return isinstance(out, dict) and "error" not in out
+
+
 def watch_once(server_dir: str,
                backoff: "RestartBackoff | None" = None) -> list[str]:
     """One supervision scan over the cluster. Dead dispatchers and gates
@@ -510,6 +550,10 @@ def watch_once(server_dir: str,
     whole group restarts with ``-restore`` from the freshest snapshot
     (a reload's freeze file or the periodic ``checkpoint_interval``
     checkpoint, whichever is newer — ``freeze.latest_snapshot_path``).
+    Exception: a dead game with a configured LIVE hot standby
+    (``[gameN] standby_of``) is recovered by warm promotion instead —
+    the standby already mirrors the state in memory, so failover costs
+    ticks, not a process boot (``_promote_standby``).
     Returns a list of action strings (empty = everything healthy)."""
     from goworld_tpu import freeze as freeze_mod
 
@@ -582,6 +626,33 @@ def watch_once(server_dir: str,
             if stragglers:
                 _stop_role(server_dir, "game", stragglers,
                            signal.SIGKILL, timeout=10)
+        # hot standby (replication/): a configured live mirror turns
+        # the crash into a WARM promotion — sub-tick state already on
+        # the standby — instead of a cold restore from disk. The dead
+        # primary is NOT restarted (its EntityIDs now route to the
+        # promoted standby; a restart would re-claim them) — its
+        # pidfiles are cleared so later scans treat it as cleanly
+        # stopped.
+        sgid = _standby_for(cfg, gid)
+        if sgid is not None and _promote_standby(server_dir, cfg,
+                                                 gid, sgid):
+            for lb in labels:
+                try:
+                    os.unlink(_pid_path(server_dir, "game", lb))
+                except OSError:
+                    pass
+            if backoff is not None:
+                backoff.attempted(f"game{gid}", True)
+            actions.append(
+                f"game{gid}: standby game{sgid} PROMOTED "
+                "(warm failover; primary not restarted)"
+            )
+            continue
+        if sgid is not None:
+            actions.append(
+                f"game{gid}: standby game{sgid} unreachable; "
+                "falling back to cold restore"
+            )
         snap = freeze_mod.latest_snapshot_path(gid, server_dir)
         ok = _start_game_group(server_dir, cfg, gid, entry, py, rel_cfg,
                                force_restore=snap is not None)
@@ -878,6 +949,12 @@ def cmd_status(server_dir: str) -> int:
                         aline = agg_tool.audit_line(agg)
                         if aline:
                             print(aline)
+                        # one replication line per hot standby
+                        # (replication/standby.py, debug_http
+                        # /standby): lag ticks vs budget, stream
+                        # bytes/tick, last keyframe age
+                        for sline in agg_tool.standby_lines(agg):
+                            print(sline)
                     except Exception:
                         pass  # the verdict must never break status
             for e in errors:

@@ -278,6 +278,25 @@ class GameConfig:
     # default; labels: the SCENARIO_KERNEL_CANDIDATES keys). Default:
     # seeded from the checked-in per-scenario best_kernel stamps.
     governor_table: str = ""
+    # hot-standby replication (goworld_tpu/replication/; docs/
+    # ROBUSTNESS.md "Hot-standby worlds"): nonzero makes THIS game a
+    # warm standby of game N — it boots empty (no boot entities, never
+    # chosen for clients), subscribes to game N's frame stream through
+    # the dispatcher, mirrors its world live, and is promoted by the
+    # supervisor when game N dies (kvreg-arbitrated, split-brain-safe).
+    # 0 = a normal primary.
+    standby_of: int = 0
+    # primary-side stream cadence: every Nth streamed frame is a full
+    # keyframe (deltas between). Also the disk-chain cadence when a
+    # standby is attached; defaults to snapshot_keyframe_every when 0.
+    replication_keyframe_every: int = 0
+    # bounded replication-worker queue (captures). Full queue = the
+    # capture is DROPPED (loud counter) and the next accepted one is
+    # forced to a keyframe — backlog degrades cadence, never the tick.
+    replication_queue: int = 4
+    # standby staleness budget: /standby's verdict fails when the time
+    # since the last applied frame exceeds this many primary ticks
+    replication_lag_budget_ticks: int = 16
 
 
 @dataclasses.dataclass
@@ -636,6 +655,17 @@ extent_z = 1000.0
 # governor_regret_pct = 0.25   # post-swap p90 worsening that reverts
 # governor_table = teleport_like:skin=0;density:sort=counting,skin=0
 #                          # mapping override (class:label;...)
+# standby_of = 1           # make THIS game a hot standby of game 1:
+#                          # boots empty, mirrors game 1's frame stream
+#                          # live, promoted by the supervisor on game 1
+#                          # death (docs/ROBUSTNESS.md "Hot-standby
+#                          # worlds"); 0 = a normal primary
+# replication_keyframe_every = 8  # stream keyframe cadence (frames);
+#                          # 0 = inherit snapshot_keyframe_every
+# replication_queue = 4    # bounded replication-worker queue; full =
+#                          # drop capture + force next keyframe
+# replication_lag_budget_ticks = 16  # /standby verdict fails past this
+#                          # staleness (primary ticks)
 
 [game1]
 

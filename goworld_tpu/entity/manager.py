@@ -993,6 +993,21 @@ class World:
         if e.slot is not None and e.shard is not None:
             self._staged_pos[(e.shard, e.slot)] = e
 
+    def stage_pose(self, e: Entity, pos, yaw: float,
+                   moving: bool | None = None) -> None:
+        """Overwrite an entity's authoritative pose from a snapshot or
+        replication record and stage the device-row write (the restore
+        / standby-apply path; flushed with the vectorized pos-set
+        scatter on the next tick). ``moving=None`` leaves the moving
+        flag unstaged — callers pass it only on change, because
+        ``_staged_moving`` is an append-only per-tick list and a
+        standby applies many frames between ticks."""
+        e._pending_pos = tuple(map(float, pos))
+        e._pending_yaw = float(yaw)
+        self.stage_pos_set(e)
+        if moving is not None:
+            self.set_moving(e, bool(moving))
+
     def _sync_pos_index(self) -> tuple:
         """eid -> (shard, slot) intern index over client-bound live
         slots, rebuilt lazily after any client (re)bind/unbind or slot

@@ -241,8 +241,7 @@ def restore_world(world: World, data: dict) -> None:
         world._enter_space_local(
             e, target, tuple(ed["pos"]), moving=bool(ed.get("moving"))
         )
-        e._pending_yaw = float(ed.get("yaw", 0.0))
-        world.stage_pos_set(e)
+        world.stage_pose(e, ed["pos"], float(ed.get("yaw", 0.0)))
         for tid in world.timers.restore(ed.get("timers", [])):
             e.timer_ids.add(tid)
         e.OnRestored()
@@ -670,10 +669,19 @@ class SnapshotChain:
     attrs, timers, bindings) still serializes whole each write,
     because attrs mutate outside any dirty tracking this writer can
     see — attr-heavy worlds keep correctness but less of the byte
-    win. Writes run on the caller's (tick) thread: the delta diff
-    needs the in-memory keyframe; the knob is opt-in and its cadence
-    is the operator's latency-budget call (an off-thread plane write
-    is the staged follow-up)."""
+    win.
+
+    Threading: ``write()`` stays the synchronous whole path (tests,
+    multihost leaders). The production game routes chain writes
+    through the bounded replication worker instead
+    (goworld_tpu/replication/worker.py — retiring the PR 12 tradeoff
+    of diffing on the tick thread): the tick thread calls
+    :meth:`capture` (cheap — host records with deferred plane refs),
+    the worker calls :meth:`complete_capture` (the device fetch),
+    :meth:`build` (quantize + diff) and :meth:`write_record` (disk).
+    The keyframe memory (``_key_planes``/``_key_rows``) is touched
+    only by build(), so exactly ONE thread may build — the worker's,
+    or the caller's via write(), never both."""
 
     def __init__(self, world: World, directory: str = ".",
                  keyframe_every: int = 8):
@@ -693,12 +701,62 @@ class SnapshotChain:
         self._key_crcs: dict | None = None
         self._key_rows: dict | None = None   # eid -> keyframe row
 
+    def capture(self) -> tuple:
+        """Tick-thread half of an off-thread chain write: host records
+        with (shard, slot) plane refs deferred (no device read) plus
+        the immutable state pytree to fetch them from later. Pair with
+        :meth:`complete_capture` on the worker thread."""
+        state_ref = self.world.state
+        data = freeze_world(self.world, _snap=_DEFER, run_hooks=False)
+        return data, state_ref, int(self.world.tick_count)
+
+    @staticmethod
+    def complete_capture(captured: tuple) -> tuple[dict, int]:
+        """Worker-thread half: one batched device fetch of the captured
+        planes, patched into the deferred records (the checkpoint_async
+        worker's exact dance). Returns ``(data, tick)`` ready for
+        :meth:`build`."""
+        data, state_ref, tick = captured
+        snap = jax.device_get({
+            "pos": state_ref.pos,
+            "yaw": state_ref.yaw,
+            "npc_moving": state_ref.npc_moving,
+        })
+        for rec in data["entities"]:
+            ref = rec.pop("_slot", None)
+            if ref is not None:
+                sh, sl = ref
+                rec["pos"] = [float(v) for v in snap["pos"][sh, sl]]
+                rec["yaw"] = float(snap["yaw"][sh, sl])
+                rec["moving"] = bool(snap["npc_moving"][sh, sl])
+        return data, tick
+
     def write(self) -> str:
         data = freeze_world(self.world, run_hooks=False)
+        kind, rec = self.build(data)
+        return self.write_record(kind, rec)
+
+    def write_record(self, kind: str, rec: dict) -> str:
+        """Write one built record to its chain file (atomic, same
+        tmp+rename path as every snapshot)."""
+        name = chain_key_filename(self.world.game_id) if kind == "key" \
+            else chain_delta_filename(self.world.game_id)
+        path = os.path.join(self.directory, name)
+        write_freeze_file(path, rec)
+        return path
+
+    def build(self, data: dict, force_key: bool = False
+              ) -> tuple[str, dict]:
+        """Quantize + diff one captured v1 freeze dict into a chain
+        record — ``("key"|"delta", record)`` — WITHOUT touching disk
+        (the replication stream ships the same records in-band).
+        Mutates the keyframe memory: single-builder-thread contract
+        (class docstring). ``force_key`` forces a keyframe out of
+        cadence (standby attach, CRC resync, backlog collapse)."""
         planes = _extract_planes(data, self.step,   # pops pos/yaw/moving
                                  self.origin)
         eids = [e["id"] for e in data["entities"]]
-        is_key = (self._key_planes is None
+        is_key = (force_key or self._key_planes is None
                   or self._count % self.keyframe_every == 0)
         self._count += 1
         if is_key:
@@ -709,13 +767,10 @@ class SnapshotChain:
                           "origin": list(self.origin)},
                 "planes": planes, "plane_crcs": crcs, "host": data,
             }
-            path = os.path.join(self.directory,
-                                chain_key_filename(self.world.game_id))
-            write_freeze_file(path, rec)
             self._key_planes = planes
             self._key_crcs = crcs
             self._key_rows = {eid: i for i, eid in enumerate(eids)}
-            return path
+            return "key", rec
         # delta vs the remembered keyframe: a row is a REFERENCE when
         # the entity existed at the keyframe with identical quantized
         # planes, else its values ship in the sparse section
@@ -750,7 +805,4 @@ class SnapshotChain:
             },
             "rows": rows.tobytes(), "sparse": sparse, "host": data,
         }
-        path = os.path.join(self.directory,
-                            chain_delta_filename(self.world.game_id))
-        write_freeze_file(path, rec)
-        return path
+        return "delta", rec

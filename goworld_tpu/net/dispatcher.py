@@ -390,6 +390,10 @@ class DispatcherService:
             proto.MT_CANCEL_MIGRATE: self._h_cancel_migrate,
             proto.MT_CALL_NIL_SPACES: self._h_broadcast_games,
             proto.MT_START_FREEZE_GAME: self._h_start_freeze,
+            # replication leg: both messages lead with the TARGET game
+            # id — forward verbatim, body stays opaque
+            proto.MT_REPLICATION_SUBSCRIBE: self._h_to_game,
+            proto.MT_REPLICATION_FRAME: self._h_to_game,
         }.get(msgtype)
         if handler is None:
             logger.warning("dispatcher%d: unhandled msgtype %d",
@@ -756,6 +760,22 @@ class DispatcherService:
     def _h_filtered_broadcast(self, conn, role, msgtype, pkt: Packet) -> None:
         for g in self.gates.values():
             g.send(Packet(bytes(pkt.buf)), release=False)
+
+    def _h_to_game(self, conn, role, msgtype, pkt: Packet) -> None:
+        """Forward a game-targeted packet verbatim (leading u16 = the
+        target game id; the replication leg). A dead/unknown target is
+        dropped loudly — replication self-heals by keyframe, so a lost
+        frame costs lag, never correctness."""
+        target = pkt.read_u16()
+        gi = self.games.get(target)
+        if gi is None:
+            logger.warning(
+                "dispatcher%d: msgtype %d for unknown game%d dropped",
+                self.id, msgtype, target,
+            )
+            return
+        pkt.rpos = 2
+        gi.send(pkt, release=False)
 
     def _h_kvreg(self, conn, role, msgtype, pkt: Packet) -> None:
         """First-writer-wins registry write + broadcast (reference

@@ -53,6 +53,11 @@ MT_REAL_MIGRATE = 28
 MT_CANCEL_MIGRATE = 29
 MT_CALL_NIL_SPACES = 30
 MT_GAME_READY = 31
+# hot-standby replication leg (goworld_tpu/replication/): both lead
+# with the TARGET game id so the dispatcher forwards verbatim without
+# decoding the body (the create-anywhere idiom)
+MT_REPLICATION_SUBSCRIBE = 32   # standby -> primary: attach / resync
+MT_REPLICATION_FRAME = 33       # primary -> standby: one stream frame
 
 # --- redirect range (1000-1499): forwarded verbatim to the client -------
 MT_REDIRECT_TO_GATEPROXY_MSG_TYPE_START = 1000
@@ -271,6 +276,29 @@ def pack_kvreg_register(key: str, val: str, force: bool) -> Packet:
     p.append_var_str(key)
     p.append_var_str(val)
     p.append_bool(force)
+    return p
+
+
+def pack_replication_subscribe(primary_gid: int, standby_gid: int) -> Packet:
+    """Standby -> (dispatcher) -> primary: attach to the replication
+    stream, or request a keyframe resync after a torn stream. Leading
+    u16 is the ROUTING target (the primary)."""
+    p = new_packet(MT_REPLICATION_SUBSCRIBE)
+    p.append_u16(primary_gid)
+    p.append_u16(standby_gid)
+    return p
+
+
+def pack_replication_frame(standby_gid: int, primary_gid: int,
+                           frame: bytes) -> Packet:
+    """Primary -> (dispatcher) -> standby: one framed stream record
+    (goworld_tpu/replication/frames.py wire format, opaque here).
+    Leading u16 is the ROUTING target (the standby)."""
+    p = new_packet(MT_REPLICATION_FRAME)
+    p.append_u16(standby_gid)
+    p.append_u16(primary_gid)
+    p.append_u32(len(frame))
+    p.append_bytes(frame)
     return p
 
 

@@ -227,13 +227,18 @@ class EntityLedger:
             self._out.pop((eid, seq), None)
             self.tail.append((tick, "migrate_in", eid, f"seq={seq}"))
 
-    def resync(self, live: dict[str, str], tick: int) -> None:
-        """Bulk re-anchor after a snapshot restore (freeze.py rebuilds
+    def resync(self, live: dict[str, str], tick: int) -> dict:
+        """Bulk re-anchor after a snapshot restore or a replicated
+        frame apply (freeze.py and replication/standby.py rebuild
         ``world.entities`` directly, bypassing the per-entity hooks).
         ``created`` is re-derived so the local conservation identity
         ``live == created - destroyed - migrated_out + migrated_in``
-        holds from the restored census onward."""
+        holds from the re-anchored census onward. Returns the census
+        delta (``{"added": n, "removed": n}``) — the standby tracker
+        and promotion decision log stamp it."""
         with self._lock:
+            added = sum(1 for eid in live if eid not in self._eids)
+            removed = sum(1 for eid in self._eids if eid not in live)
             self._eids = dict(live)
             for eid in live:
                 self._own_seq.setdefault(eid, 1)
@@ -241,6 +246,7 @@ class EntityLedger:
                             + self.migrated_out - self.migrated_in)
             self.tail.append((tick, "resync", "",
                               f"{len(live)} entities restored"))
+            return {"added": added, "removed": removed}
 
     # -- violations ----------------------------------------------------
     def _violate(self, kind: str, detail: str, tick: int) -> None:

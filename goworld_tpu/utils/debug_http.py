@@ -66,6 +66,12 @@ TPU-native analog exposes:
   ``?eids=1`` adds the (bounded) sorted EntityID lists for diffing a
   census divergence down to the first differing id; an honest error
   on processes that track no entities
+* ``/standby`` — the hot-standby replication plane (:mod:`goworld_tpu.
+  replication.standby`): per-standby applied seq/tick, stream bytes,
+  reject counts by torn-stream reason, last-keyframe age and a
+  sync-age-style staleness verdict (lag ticks vs budget);
+  ``?promote=1`` (optionally ``&epoch=E``) is the supervisor's
+  promotion poke; an honest error on processes that mirror nothing
 
 Stdlib-only (http.server on a daemon thread), one call to :func:`start`.
 """
@@ -88,7 +94,7 @@ logger = log.get("debug_http")
 _ENDPOINTS = ["/healthz", "/vars", "/ops", "/metrics", "/trace",
               "/tracing", "/clock", "/profile", "/faults", "/overload",
               "/costs", "/workload", "/incidents", "/governor",
-              "/syncage", "/residency", "/audit"]
+              "/syncage", "/residency", "/audit", "/standby"]
 
 # jax.profiler capture state (one capture at a time per process)
 _profile_lock = threading.Lock()
@@ -316,6 +322,21 @@ class _Handler(BaseHTTPRequestHandler):
             want_eids = "eids" in query \
                 and query["eids"][0] not in ("0", "false")
             self._json(audit.snapshot_all(eids=want_eids))
+        elif path == "/standby":
+            # hot-standby replication plane (goworld_tpu/replication/
+            # standby registry): per-standby lag/bytes/reject stats
+            # with a sync-age-style staleness verdict; ?promote=1
+            # (optionally &epoch=E) drives the supervisor's promotion
+            # poke — the claim itself runs on the game's logic thread
+            from goworld_tpu.replication import standby
+
+            if "promote" in query \
+                    and query["promote"][0] not in ("0", "false"):
+                ep = query.get("epoch", [None])[0]
+                self._json(standby.request_promotion(
+                    int(ep) if ep not in (None, "") else None))
+            else:
+                self._json(standby.snapshot_all())
         elif path == "/incidents":
             # flight-recorder incident bundles (utils/flightrec);
             # ?frames=1 adds the live per-tick frame ring

@@ -45,7 +45,13 @@ Triggers (the grammar — docs/OBSERVABILITY.md):
   diverging from the brute-force oracle, a slot/client mirror or
   ``interested_by`` edge out of sync, or a SnapshotChain CRC failure —
   the detail names the EntityID and the incident context freezes the
-  ledger event tail + cohort diff.
+  ledger event tail + cohort diff;
+* ``standby_promoted`` — a hot standby won its kvreg-arbitrated
+  promotion claim and took over a dead primary
+  (``goworld_tpu/replication/``; the ``standby_promoted`` frame key
+  names game/epoch/frame-seq/tick): the bundle freezes the
+  promotion-side context, pairing with the primary's bundle frozen at
+  its crash.
 
 Every trigger kind is deduped with a per-kind cooldown so one bad
 minute yields a handful of bundles, not thousands. Determinism: the
@@ -193,6 +199,14 @@ class FlightRecorder:
                 # mismatch, mirror divergence, snapshot CRC);
                 # context_fn freezes the ledger tail + cohort diff
                 fired.append(("audit_violation", str(av)))
+            sbp = frame.get("standby_promoted")
+            if sbp is not None:
+                # a standby won its promotion claim and took over a
+                # dead primary (goworld_tpu/replication/): the frame
+                # names game/epoch/seq/tick; the bundle freezes the
+                # promotion-side context (the primary's ring froze at
+                # its crash — both sides of the failover keep bundles)
+                fired.append(("standby_promoted", str(sbp)))
             self._frames.append(dict(frame))
             self._frames_total += 1
             new = [self._freeze(kind, detail, frame)

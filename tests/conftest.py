@@ -131,6 +131,17 @@ def pytest_configure(config):
         "in tier-1 on CPU (docs/OBSERVABILITY.md \"Correctness "
         "audit plane\")",
     )
+    config.addinivalue_line(
+        "markers",
+        "replication: hot-standby replication suites (stream frame "
+        "CRC chaining + torn-stream taxonomy, double-apply lattice "
+        "determinism, the bounded replication worker's "
+        "never-block-the-tick contract, standby apply/mirror "
+        "semantics, kvreg promotion arbitration incl. both "
+        "stale-claim race orders, /standby — "
+        "tests/test_replication.py); all run in tier-1 on CPU "
+        "(docs/ROBUSTNESS.md \"Hot-standby & promotion\")",
+    )
 
 
 def spawn_on(states, dev, slot, **kw):

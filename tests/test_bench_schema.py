@@ -480,3 +480,83 @@ def test_residency_block_shape_caught(tmp_path):
     rec4["residency"]["alloc"] = None
     errs = _validate(tmp_path, "BENCH_r16.json", rec4)
     assert any("alloc" in e for e in errs)
+
+
+# =======================================================================
+# r>=18: the hot-standby failover block (ISSUE 18)
+# =======================================================================
+def _audit_block(**extra):
+    blk = {
+        "entities": 64,
+        "ledger": {"entities": 64, "crc": 1, "created": 70,
+                   "destroyed": 6, "migrated_out": 0, "migrated_in": 0},
+        "oracle": {"samples": 12, "entities_checked": 700,
+                   "mismatches": 0},
+        "violations_total": {},
+        "conservation": {"ok": True, "live": 64, "in_flight": 0,
+                         "created": 70, "destroyed": 6, "problems": []},
+        "overhead_pct_of_budget": 0.2,
+        "pass": True,
+    }
+    blk.update(extra)
+    return blk
+
+
+def _failover_block(**extra):
+    blk = {
+        "entities": 48,
+        "ticks": 20,
+        "keyframe_every": 8,
+        "replication_bytes_per_tick": 5163.3,
+        "client_sync_bytes_per_tick": 1214.4,
+        "standby_apply_ms_per_tick": 0.9,
+        "promotion_latency_ticks": 1,
+        "lag_budget_ticks": 16,
+        "entities_lost": 0,
+        "entities_duplicated": 0,
+        "frames_applied": 20,
+        "frames_rejected": 0,
+        "decision_log_replay_ok": True,
+        "pass": True,
+    }
+    blk.update(extra)
+    return blk
+
+
+def _r18_rec(**extra):
+    """A valid r18 record: r17's contract (the audit block) + the
+    hot-standby failover block."""
+    rec = _r16_rec(audit=_audit_block(), failover=_failover_block())
+    rec.update(extra)
+    return rec
+
+
+def test_failover_block_required_since_r18(tmp_path):
+    rec = _r18_rec()
+    assert _validate(tmp_path, "BENCH_r18.json", rec) == []
+    # missing entirely -> caught at r18, grandfathered at r17
+    rec2 = _r18_rec()
+    del rec2["failover"]
+    errs = _validate(tmp_path, "BENCH_r18.json", rec2)
+    assert any("failover" in e for e in errs)
+    assert _validate(tmp_path, "BENCH_r17.json", rec2) == []
+    # honest skip/error records accepted (the BENCH_FAILOVER=0 round
+    # and the stage-failed round are both valid artifacts)
+    for blk in ({"skipped": "BENCH_FAILOVER=0"},
+                {"error": "failover stage never completed"}):
+        rec3 = _r18_rec(failover=blk)
+        assert _validate(tmp_path, "BENCH_r18.json", rec3) == []
+
+
+def test_failover_block_shape_caught(tmp_path):
+    # a present-but-gutted block is malformation, not an honest skip
+    rec = _r18_rec(failover={"promotion_latency_ticks": 1})
+    errs = _validate(tmp_path, "BENCH_r18.json", rec)
+    assert any("failover missing key" in e for e in errs)
+    assert any("entities_lost" in e for e in errs)
+    # a non-numeric conservation count is malformation (a bool True
+    # would make `if lost` lie, a string would break the trend gate)
+    rec2 = _r18_rec()
+    rec2["failover"]["entities_lost"] = "none"
+    errs = _validate(tmp_path, "BENCH_r18.json", rec2)
+    assert any("entities_lost malformed" in e for e in errs)

@@ -504,3 +504,121 @@ def test_residency_gate_survives_headline_shape_change(tmp_path):
     r2b["residency"] = _rs_block(2.1, 1.45)
     f2b = _write(tmp_path, "BENCH_r02.json", r2b)
     assert TREND.main([f1, f2b]) == 0
+
+
+def _fo_block(latency, lost=0, dup=0, rejected=0, entities=48,
+              replay_ok=True, passed=None):
+    return {
+        "entities": entities,
+        "replication_bytes_per_tick": 5163.3,
+        "client_sync_bytes_per_tick": 1214.4,
+        "standby_apply_ms_per_tick": 0.9,
+        "promotion_latency_ticks": latency,
+        "lag_budget_ticks": 16,
+        "entities_lost": lost,
+        "entities_duplicated": dup,
+        "frames_applied": 20,
+        "frames_rejected": rejected,
+        "decision_log_replay_ok": replay_ok,
+        "pass": ((lost == 0 and dup == 0 and latency <= 16)
+                 if passed is None else passed),
+    }
+
+
+def test_failover_entity_loss_always_fails(tmp_path):
+    """ISSUE 18: ANY lost or duplicated EntityID across promotion
+    fails unconditionally — conservation needs no prior round (a lost
+    entity is a bug, not a trend), and a flat headline must not hide
+    it. Torn frames and a failed decision-log replay gate the same
+    way."""
+    r1 = _bench_rec(1000.0)  # prior round without a failover block
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    r2 = _bench_rec(1000.0)
+    r2["failover"] = _fo_block(1, lost=2, passed=False)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 2
+    r2b = _bench_rec(1000.0)
+    r2b["failover"] = _fo_block(1, dup=1, passed=False)
+    f2b = _write(tmp_path, "BENCH_r02.json", r2b)
+    assert TREND.main([f1, f2b]) == 2
+    r2c = _bench_rec(1000.0)
+    r2c["failover"] = _fo_block(1, rejected=3, passed=False)
+    f2c = _write(tmp_path, "BENCH_r02.json", r2c)
+    assert TREND.main([f1, f2c]) == 2
+    r2d = _bench_rec(1000.0)
+    r2d["failover"] = _fo_block(1, replay_ok=False, passed=False)
+    f2d = _write(tmp_path, "BENCH_r02.json", r2d)
+    assert TREND.main([f1, f2d]) == 2
+    # a clean block with no prior is a new anchor, not a gate
+    r2e = _bench_rec(1000.0)
+    r2e["failover"] = _fo_block(1)
+    f2e = _write(tmp_path, "BENCH_r02.json", r2e)
+    assert TREND.main([f1, f2e]) == 0
+
+
+def test_failover_promotion_latency_lower_is_better(tmp_path):
+    """The promotion latency gates against the best (lowest) prior at
+    the same (entities, platform) shape with a 1-tick absolute slack;
+    skip rounds neither gate nor anchor; shape changes are new
+    series."""
+    r1 = _bench_rec(1000.0)
+    r1["failover"] = _fo_block(1)
+    r2 = _bench_rec(1000.0)
+    r2["failover"] = _fo_block(2)  # within 1.3x + 1 tick slack
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 0
+    # injected latency regression: headline flat, promotion 5x slower
+    r3 = _bench_rec(1000.0)
+    r3["failover"] = _fo_block(5)
+    f3 = _write(tmp_path, "BENCH_r03.json", r3)
+    assert TREND.main([f1, f2, f3]) == 2
+    # an honest skip neither gates nor anchors
+    r3b = _bench_rec(1000.0)
+    r3b["failover"] = {"skipped": "BENCH_FAILOVER=0"}
+    f3b = _write(tmp_path, "BENCH_r03.json", r3b)
+    assert TREND.main([f1, f2, f3b]) == 0
+    # a different harness shape is a different series
+    r3c = _bench_rec(1000.0)
+    r3c["failover"] = _fo_block(5, entities=192)
+    f3c = _write(tmp_path, "BENCH_r03.json", r3c)
+    assert TREND.main([f1, f2, f3c]) == 0
+
+
+def test_failover_pass_to_fail_transition_fails(tmp_path):
+    """A verdict flip pass -> fail at the same shape always fails,
+    even when every individual number stays inside its band (the
+    slo-flip rule)."""
+    r1 = _bench_rec(1000.0)
+    r1["failover"] = _fo_block(1)
+    r2 = _bench_rec(1000.0)
+    r2["failover"] = _fo_block(2, passed=False)
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 2
+    # fail -> fail is the recorded status quo, not a regression
+    r1b = _bench_rec(1000.0)
+    r1b["failover"] = _fo_block(2, passed=False)
+    r2b = _bench_rec(1000.0)
+    r2b["failover"] = _fo_block(2, passed=False)
+    f1b = _write(tmp_path, "BENCH_r03.json", r1b)
+    f2b = _write(tmp_path, "BENCH_r04.json", r2b)
+    assert TREND.main([f1b, f2b]) == 0
+
+
+def test_failover_gate_survives_headline_shape_change(tmp_path):
+    """Like the governor/sync_age/residency series: a round that
+    changes the headline entity count must still gate its failover
+    block against prior rounds' — the early headline return must not
+    swallow the conservation check."""
+    r1 = _bench_rec(1000.0, entities=1000)
+    r1["failover"] = _fo_block(1)
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    r2 = _bench_rec(5000.0, entities=4096)
+    r2["failover"] = _fo_block(1, lost=1, passed=False)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 2
+    r2b = _bench_rec(5000.0, entities=4096)
+    r2b["failover"] = _fo_block(1)
+    f2b = _write(tmp_path, "BENCH_r02.json", r2b)
+    assert TREND.main([f1, f2b]) == 0

@@ -61,6 +61,13 @@ contract):
   conservation verdict, the sampled AOI-oracle progress, by-kind
   violation totals (the zero-violation gate) and the measured A/B
   overhead of the plane vs the 60 Hz tick budget — honest
+  ``{"error"/"skipped": ...}`` records accepted;
+* rounds >= 18 (the hot-standby era, ISSUE 18): a ``failover`` block
+  — the streamed primary->standby replication cost (bytes/tick, next
+  to the client-sync bytes/tick the same workload ships), the
+  standby's apply cost, the promotion latency in ticks and the
+  conservation counts across the arbitrated promotion (zero lost /
+  zero duplicated EntityIDs is the gate) — honest
   ``{"error"/"skipped": ...}`` records accepted.
 
 Exit codes: 0 all valid, 1 usage/missing, 2 schema violations.
@@ -143,6 +150,18 @@ RESIDENCY_KEYS = ("bubble", "tick", "phases", "census", "alloc",
 AUDIT_SINCE = 17
 AUDIT_KEYS = ("ledger", "oracle", "violations_total", "conservation",
               "overhead_pct_of_budget", "pass")
+# the hot-standby era (ISSUE 18): every BENCH round stamps the
+# failover block — replication stream bytes/tick next to the
+# client-sync bytes/tick the same workload ships, the standby's apply
+# cost, the promotion latency in ticks and the conservation counts
+# across the promotion (zero lost / zero duplicated is the gate)
+FAILOVER_SINCE = 18
+FAILOVER_KEYS = ("replication_bytes_per_tick",
+                 "client_sync_bytes_per_tick",
+                 "standby_apply_ms_per_tick",
+                 "promotion_latency_ticks", "entities_lost",
+                 "entities_duplicated", "frames_applied",
+                 "frames_rejected", "decision_log_replay_ok", "pass")
 MULTI_HEADLINE_KEYS = ("entity_ticks_per_sec_mesh",
                        "per_chip_efficiency", "n_entities", "platform")
 MULTI_GAUGE_KEYS = ("halo_demand_max", "migrate_demand_max",
@@ -276,6 +295,16 @@ def validate_bench(path: str, doc: dict) -> list[str]:
             if not (isinstance(con, dict) and "ok" in con):
                 errs.append(f"audit conservation malformed: "
                             f"{con!r:.120}")
+    if rno >= FAILOVER_SINCE:
+        _check_block(rec, "failover", FAILOVER_KEYS, errs)
+        fo = rec.get("failover")
+        if isinstance(fo, dict) and "error" not in fo \
+                and "skipped" not in fo:
+            for k in ("entities_lost", "entities_duplicated",
+                      "promotion_latency_ticks"):
+                if k in fo and not _is_num(fo[k]):
+                    errs.append(f"failover {k} malformed: "
+                                f"{fo.get(k)!r:.120}")
     # per-scenario blocks, wherever present: each needs either a
     # headline-style shape or an honest error
     for sc, blk in (rec.get("scenarios") or {}).items():

@@ -331,6 +331,73 @@ def _check_audit_series(rounds: list, latest: dict, name: str,
             f"(prior {os.path.basename(prev_path)})")
 
 
+def _check_failover_series(rounds: list, latest: dict, name: str,
+                           threshold: float, problems: list[str],
+                           notes: list[str]) -> None:
+    """The hot-standby failover block (ISSUE 18): any lost or
+    duplicated EntityID across promotion in a real latest block is
+    ALWAYS a problem (conservation needs no prior — a lost entity is
+    a bug, not a trend), as is any torn frame or a failed decision-log
+    replay; the promotion latency is a lower-is-better series gated
+    against the best prior at the same (entities, platform) shape
+    with a 1-tick absolute slack (the resume tick quantizes it)."""
+    def _fo_ok(s) -> bool:
+        return (isinstance(s, dict) and "error" not in s
+                and "skipped" not in s
+                and isinstance(s.get("promotion_latency_ticks"),
+                               (int, float)))
+
+    lfo = latest.get("failover")
+    if not _fo_ok(lfo):
+        return
+    lost = lfo.get("entities_lost", 0) or 0
+    dup = lfo.get("entities_duplicated", 0) or 0
+    if lost or dup:
+        problems.append(
+            f"{name}: failover lost {lost} / duplicated {dup} "
+            "entity id(s) across promotion — conservation must hold")
+    if lfo.get("frames_rejected", 0):
+        problems.append(
+            f"{name}: failover rejected "
+            f"{lfo['frames_rejected']} torn frame(s) on a clean "
+            "loopback stream")
+    if lfo.get("decision_log_replay_ok") is False:
+        problems.append(
+            f"{name}: failover decision log failed byte replay")
+    fshape = (lfo.get("entities"), latest.get("platform"))
+    fprior = [
+        (p, r["failover"]) for p, r in rounds[:-1]
+        if _fo_ok(r.get("failover"))
+        and (r["failover"].get("entities"),
+             r.get("platform")) == fshape
+    ]
+    if not fprior:
+        notes.append(f"{name}: failover shape {fshape} has no prior "
+                     "round — promotion latency not gated")
+        return
+    # promotion latency vs the best (lowest) prior; +1 tick absolute
+    # slack (the +1 resume tick quantizes the number)
+    lat = lfo["promotion_latency_ticks"]
+    best_path, best = min(
+        fprior, key=lambda pr: pr[1]["promotion_latency_ticks"])
+    ceil = ((1.0 + threshold) * best["promotion_latency_ticks"]) + 1
+    if lat > ceil:
+        problems.append(
+            f"{name}: failover promotion latency {lat} ticks > "
+            f"{ceil:.3g} ({(1 + threshold) * 100:.0f}% of "
+            f"{os.path.basename(best_path)}'s "
+            f"{best['promotion_latency_ticks']} + 1)")
+    else:
+        notes.append(
+            f"{name}: failover promotion latency {lat} ticks vs best "
+            f"prior {best['promotion_latency_ticks']} — ok")
+    prev_path, prev = fprior[-1]
+    if prev.get("pass") and not lfo.get("pass"):
+        problems.append(
+            f"{name}: failover verdict regressed pass -> fail "
+            f"(prior {os.path.basename(prev_path)})")
+
+
 def check_bench(files: list[str], threshold: float,
                 problems: list[str], notes: list[str]) -> None:
     rounds = []
@@ -366,6 +433,10 @@ def check_bench(files: list[str], threshold: float,
     # zero-violation gate must fire even on a headline-shape change
     _check_audit_series(rounds, latest, name, threshold,
                         problems, notes)
+    # the hot-standby failover series (ISSUE 18): same hoisting — the
+    # conservation gate must fire even on a headline-shape change
+    _check_failover_series(rounds, latest, name, threshold,
+                           problems, notes)
     prior = [(p, r) for p, r in rounds[:-1]
              if _shape(r) == _shape(latest)]
     if not prior:

@@ -26,14 +26,22 @@ faults are active, then scrape the game's ``/overload`` ladder and the
 (reached SHEDDING), the critical/rpc classes shed nothing, and the
 process RETURNED to NORMAL after the flood stopped.
 
-``governor`` (ISSUE 13) and ``audit`` (ISSUE 17) run IN-PROCESS (no
-cluster): the governor soak hot-swaps kernel configs under a
-scenario-switching schedule; the audit soak proves the correctness
-plane — a clean churn + migration-storm phase must record ZERO
-violations, then an injected entity drop (migrate-out, restore
-suppressed) must be detected by the conservation verdict within <= 8
-ticks, naming the EntityID and freezing an ``audit_violation``
-flight-recorder bundle (``run_audit``).
+``governor`` (ISSUE 13), ``audit`` (ISSUE 17) and ``failover``
+(ISSUE 18) run IN-PROCESS (no cluster): the governor soak hot-swaps
+kernel configs under a scenario-switching schedule; the audit soak
+proves the correctness plane — a clean churn + migration-storm phase
+must record ZERO violations, then an injected entity drop
+(migrate-out, restore suppressed) must be detected by the
+conservation verdict within <= 8 ticks, naming the EntityID and
+freezing an ``audit_violation`` flight-recorder bundle
+(``run_audit``); the failover soak streams a primary under
+churn-and-migration into a hot standby, kills the primary at a
+deterministic tick, promotes through the kvreg-arbitrated protocol
+(both stale-claim race orders replayed and refused, decision log
+byte-replayable), proves ZERO lost/duplicated EntityIDs by census +
+conservation verdict, and times the warm promotion against a cold
+chain restore of the same crash (must be >= 10x faster —
+``run_failover``).
 
 Running either scenario TWICE with the same ``--seed`` must produce
 byte-identical fault/transition behavior — the seeded-replay guarantee
@@ -754,6 +762,313 @@ def run_audit(seed: int, n: int = AUDIT_SOAK_N,
         audit_mod.unregister(f"game{w.game_id}")
 
 
+FAILOVER_SOAK_N = 96
+FAILOVER_SOAK_TICKS = 40
+FAILOVER_KEYFRAME_EVERY = 8
+
+
+def _mirror_world(spec, cfg, game_id: int, seed: int):
+    """A bare world sharing the primary's type registry (the shape a
+    standby process boots with: classes registered, no population)."""
+    from goworld_tpu.entity.entity import Entity
+    from goworld_tpu.entity.manager import World
+    from goworld_tpu.entity.space import Space
+
+    _INF = float("inf")
+    w = World(cfg, n_spaces=1, seed=seed, game_id=game_id)
+    w.register_space("ScnSpace", type("ScnSpace", (Space,), {}))
+    for i, (r, _f) in enumerate(spec.radius_mix):
+        tname = f"Scn{i}"
+        w.register_entity(
+            tname, type(tname, (Entity,), {}),
+            aoi_distance=0.0 if r == _INF else float(r))
+    return w
+
+
+def _census(w) -> set:
+    """Live EntityIDs minus the world's OWN nil space (each game's nil
+    space id is deterministic from ITS game_id and never replicated)."""
+    out = {e.id for e in w.entities.values() if not e.destroyed}
+    if w.nil_space is not None:
+        out.discard(w.nil_space.id)
+    return out
+
+
+def run_failover(seed: int, n: int = FAILOVER_SOAK_N,
+                 ticks: int = FAILOVER_SOAK_TICKS,
+                 keyframe_every: int = FAILOVER_KEYFRAME_EVERY) -> dict:
+    """The ISSUE-18 failover scenario, in-process like the audit soak
+    (the conservation assertions need direct World + ledger access on
+    BOTH sides). One run proves the whole hot-standby story:
+
+    1. STREAM: a primary world under churn + a migration storm
+       replicates through the real path — ``SnapshotChain.capture`` on
+       the tick thread, the bounded :class:`ReplicationWorker` building
+       key/delta records off-thread (disk chain riding the same jobs),
+       ``StreamEncoder`` framing, ``StandbyApplier`` reconciling every
+       frame into a live standby world with per-frame ledger resync.
+    2. KILL: the primary dies at a deterministic tick (mid-churn,
+       mid-migration — the worst case).
+    3. PROMOTE: the standby claims through the kvreg-arbitrated
+       protocol (first-writer-wins + epoch guard, emulated with the
+       dispatcher's exact register semantics), wins, resumes ticking
+       from its last applied frame. Both stale-claim race orders are
+       replayed against the arbitration and must be refused, and the
+       decision log must replay byte-for-byte
+       (:func:`goworld_tpu.replication.promote.replay_decisions`).
+    4. VERDICT: the promoted census must equal the primary's census at
+       the last applied frame — zero lost, zero duplicated EntityIDs —
+       and the standby's own conservation verdict must pass.
+    5. A/B: the same crash recovered COLD (fresh World + chain restore
+       from the disk records the worker wrote) is timed against the
+       warm promotion; the paper's claim is >= 10x. The cold time is a
+       LOWER bound (a real cold restore also pays process boot).
+
+    Same-seed reruns replay the same world evolution and the same
+    decision log (the seeded-replay guarantee)."""
+    from goworld_tpu import freeze as freeze_mod
+    from goworld_tpu.replication.promote import (
+        DecisionLog, adjudicate, claim_key, claim_value,
+        replay_decisions)
+    from goworld_tpu.replication.standby import (
+        StandbyApplier, StandbyTracker)
+    from goworld_tpu.replication.worker import ReplicationWorker
+    from goworld_tpu.scenarios.runner import build_world
+    from goworld_tpu.scenarios.spec import get_scenario
+    from goworld_tpu.utils import audit as audit_mod
+
+    import tempfile
+
+    report: dict = {"scenario": "failover", "seed": seed, "n": n,
+                    "ticks": ticks, "keyframe_every": keyframe_every,
+                    "converged": False}
+    spec = get_scenario("mixed")
+    kill_tick = ticks  # deterministic: the last streamed tick
+    tmpdir = tempfile.mkdtemp(prefix="failover_soak_")
+    primary, ents, _clients = build_world(
+        spec, n=n, skin=4.0, client_frac=0.15, seed=seed)
+    standby = _mirror_world(spec, primary.cfg, game_id=2, seed=seed)
+    # the standby's attach-time warmup (net/game.py _standby_tick):
+    # compile the jit'd tick program on the still-empty world — SoA
+    # shapes are capacity-static, so this is the same program the
+    # promoted tick runs; without it the "warm" promotion would pay
+    # seconds of compile, the exact cost hot standby exists to avoid
+    standby.tick()
+    standby.tick_count = 0
+    tracker = StandbyTracker(2, primary.game_id, tick_hz=60.0)
+    applier = StandbyApplier(standby, primary.game_id,
+                             tracker=tracker)
+    frames: list = []
+    lock = threading.Lock()
+
+    def send_fn(blob: bytes, kind: str, tick: int) -> None:
+        with lock:
+            frames.append((blob, kind, tick))
+
+    chain = freeze_mod.SnapshotChain(primary, tmpdir,
+                                     keyframe_every=keyframe_every)
+    worker = ReplicationWorker(chain, game_id=primary.game_id,
+                               queue_max=4, send_fn=send_fn)
+    census_by_tick: dict[int, set] = {}
+    try:
+        # ---- phase 1: stream under churn + migration storm -----------
+        alive = [e for e in ents if not e.destroyed]
+        storm = 0
+        applied = rejected = 0
+        bytes_stream = 0
+        apply_ms = 0.0
+        for t in range(ticks):
+            if t % 4 == 2 and alive:
+                e = alive[t % len(alive)]
+                if not e.destroyed and e._migrating is None:
+                    data = primary.get_migrate_data(e)
+                    primary.remove_for_migration(e)
+                    moved = primary.restore_from_migration(data)
+                    alive[t % len(alive)] = moved
+                    storm += 1
+            primary.tick()
+            census_by_tick[primary.tick_count] = _census(primary)
+            worker.submit(chain.capture(), to_disk=True,
+                          to_stream=True)
+            worker.drain()  # deterministic soak: no backlog drops
+            with lock:
+                batch, frames[:] = frames[:], []
+            for blob, _kind, _tick in batch:
+                t0 = time.perf_counter()
+                out = applier.apply(blob)
+                apply_ms += (time.perf_counter() - t0) * 1e3
+                bytes_stream += len(blob)
+                if out["ok"]:
+                    applied += 1
+                else:
+                    rejected += 1
+        report["migration_round_trips"] = storm
+        report["frames_applied"] = applied
+        report["frames_rejected"] = rejected
+        report["replication_bytes_per_tick"] = round(
+            bytes_stream / max(1, ticks), 1)
+        report["standby_apply_ms_per_tick"] = round(
+            apply_ms / max(1, ticks), 3)
+        report["worker"] = worker.stats()
+        stream_ok = applied > 0 and rejected == 0
+        report["stream_ok"] = stream_ok
+
+        # ---- phase 2: deterministic kill + arbitrated promotion ------
+        # the primary is dead from here on: nothing submits, nothing
+        # streams. The standby promotes from its last APPLIED frame.
+        applied_tick = applier.decoder.applied_tick
+        applied_seq = applier.decoder.applied_seq
+        report["kill_tick"] = kill_tick
+        report["applied_tick_at_kill"] = applied_tick
+
+        kvreg: dict[str, str] = {}
+
+        def kv_register(key: str, val: str, force: bool = False) -> str:
+            # the dispatcher's exact first-writer-wins semantics
+            # (net/dispatcher.py _h_kvreg): a later non-force register
+            # gets the existing value broadcast back
+            if key not in kvreg or force:
+                kvreg[key] = val
+            return kvreg[key]
+
+        key = claim_key(primary.game_id)
+        epoch = 1
+        mine = claim_value(2, epoch, applied_seq)
+        log = DecisionLog()
+        log.note("claim", key=key, value=mine, epoch=epoch,
+                 applied_seq=applied_seq, applied_tick=applied_tick)
+        t_warm0 = time.perf_counter()
+        winner = kv_register(key, mine)
+        verdict = adjudicate(winner, mine)
+        log.note("adjudicate", winner=winner, mine=mine,
+                 verdict=verdict)
+        promote_ok = verdict == "won"
+        standby.tick_count = max(standby.tick_count, applied_tick)
+        log.note("promoted", epoch=epoch, tick=standby.tick_count,
+                 seq=applied_seq, entities=len(_census(standby)))
+        standby.tick()  # first served tick: staged mirror state
+        warm_secs = time.perf_counter() - t_warm0  # flushes to device
+        # promotion latency in TICKS: staleness at the kill (frames
+        # behind the dead primary) + the one resume tick
+        promotion_latency_ticks = (kill_tick - max(0, applied_tick)) + 1
+        tracker.note_promoted(epoch, applied_tick)
+        report["promotion_latency_ticks"] = promotion_latency_ticks
+        report["promotion_secs"] = round(warm_secs, 4)
+        report["promote_ok"] = promote_ok
+
+        # both stale-claim race orders must be refused:
+        # (a) stale-second — a zombie replays an OLD claim after the
+        #     live winner registered: first-writer-wins broadcasts the
+        #     live winner; the zombie adjudicates "lost"
+        stale = claim_value(7, 0, 3)
+        zl = DecisionLog()
+        zl.note("claim", key=key, value=stale, epoch=0, applied_seq=3,
+                applied_tick=-1)
+        zw = kv_register(key, stale)
+        zv = adjudicate(zw, stale)
+        zl.note("adjudicate", winner=zw, mine=stale, verdict=zv)
+        stale_second_refused = zv == "lost" and kvreg[key] == mine
+        # (b) stale-first — the replay lands BEFORE the live claim on a
+        #     fresh key: the live claimant sees a lower-epoch winner
+        #     ("stale_winner"), force-re-registers (legitimate exactly
+        #     then), and wins the next broadcast
+        key2 = claim_key(99)
+        kv_register(key2, claim_value(7, 0, 3))  # zombie lands first
+        mine2 = claim_value(2, 1, applied_seq)
+        fl = DecisionLog()
+        w1 = kv_register(key2, mine2)
+        v1 = adjudicate(w1, mine2)
+        fl.note("adjudicate", winner=w1, mine=mine2, verdict=v1)
+        stale_first_named = v1 == "stale_winner"
+        w2 = kv_register(key2, mine2, force=True)
+        v2 = adjudicate(w2, mine2)
+        fl.note("force_reregister", winner=w2, mine=mine2, verdict=v2)
+        stale_first_recovered = v2 == "won"
+        arbitration_ok = bool(stale_second_refused and stale_first_named
+                              and stale_first_recovered)
+        report["arbitration"] = {
+            "stale_second_refused": stale_second_refused,
+            "stale_first_named": stale_first_named,
+            "stale_first_recovered": stale_first_recovered,
+        }
+        report["arbitration_ok"] = arbitration_ok
+        # the decision logs must replay byte-for-byte from their inputs
+        replay_ok = all(
+            replay_decisions(d.inputs) == d.dump()
+            for d in (log, zl, fl))
+        report["decision_log_replay_ok"] = replay_ok
+        report["decision_log"] = log.lines
+
+        # ---- phase 3: conservation verdict ---------------------------
+        want = census_by_tick.get(applied_tick, set())
+        got = _census(standby)
+        lost = sorted(want - got)
+        extra = sorted(got - want)
+        report["entities_expected"] = len(want)
+        report["entities_promoted"] = len(got)
+        report["entities_lost"] = len(lost)
+        report["entities_duplicated"] = len(extra)
+        report["lost_eids"] = lost[:8]
+        report["duplicated_eids"] = extra[:8]
+        ap2 = standby.audit
+        conservation_ok = False
+        if ap2 is not None:
+            ap2.drain()
+            v = audit_mod.conservation_verdict(
+                [ap2.snapshot(tick=standby.tick_count)])
+            report["conservation_verdict"] = {
+                k: v.get(k) for k in ("ok", "live", "in_flight",
+                                      "created", "destroyed",
+                                      "problems")}
+            conservation_ok = v.get("ok") is True
+        census_ok = not lost and not extra
+        report["census_ok"] = census_ok
+        report["conservation_ok"] = conservation_ok
+
+        # ---- phase 4: cold-restore A/B -------------------------------
+        # the SAME crash recovered the pre-standby way: fresh World,
+        # chain records resolved from disk (the worker wrote them),
+        # restore_world, first tick. A real cold restore ALSO pays
+        # process boot + jit warmup, so this is a conservative floor.
+        t_cold0 = time.perf_counter()
+        snap_path = freeze_mod.latest_snapshot_path(
+            primary.game_id, tmpdir)
+        cold_ok = False
+        if snap_path is not None:
+            data = freeze_mod.read_freeze_file(snap_path)
+            cold = _mirror_world(spec, primary.cfg, game_id=3,
+                                 seed=seed)
+            try:
+                freeze_mod.restore_world(cold, data)
+                cold.tick()
+                cold_ok = True
+            finally:
+                audit_mod.unregister("game3")
+        cold_secs = time.perf_counter() - t_cold0
+        report["cold_restore_secs"] = round(cold_secs, 4)
+        report["cold_restore_ok"] = cold_ok
+        speedup = cold_secs / max(warm_secs, 1e-9)
+        report["warm_vs_cold_speedup"] = round(speedup, 1)
+        ab_ok = cold_ok and speedup >= 10.0
+        report["ab_ok"] = ab_ok
+
+        report["standby"] = tracker.snapshot()
+        report["converged"] = bool(
+            stream_ok and promote_ok and arbitration_ok and replay_ok
+            and census_ok and conservation_ok and ab_ok)
+        return report
+    except Exception as exc:
+        report["error"] = f"{type(exc).__name__}: {str(exc)[:300]}"
+        return report
+    finally:
+        worker.close()
+        audit_mod.unregister(f"game{primary.game_id}")
+        audit_mod.unregister("game2")
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
 def _ini_port(server_dir: str, section: str, key: str) -> int:
     import configparser
 
@@ -770,7 +1085,8 @@ def main() -> int:
                          "unused by the in-process ones "
                          "(governor, audit)")
     ap.add_argument("--scenario",
-                    choices=("kill", "overload", "governor", "audit"),
+                    choices=("kill", "overload", "governor", "audit",
+                             "failover"),
                     default="kill")
     ap.add_argument("--seed", type=int, default=77)
     ap.add_argument("--deposits", type=int, default=25)
@@ -786,13 +1102,16 @@ def main() -> int:
                          "homogeneous random_walk")
     ap.add_argument("--out", default="chaos_report.json")
     args = ap.parse_args()
-    if args.scenario in ("governor", "audit"):
+    if args.scenario in ("governor", "audit", "failover"):
         # in-process (no cluster dir needed): the oracle + entity
         # audits need direct World access; --dir is accepted but
         # unused for symmetry with the other scenarios
         if args.scenario == "governor":
             report = run_governor(args.seed)
             report["workload"] = "governor-schedule"
+        elif args.scenario == "failover":
+            report = run_failover(args.seed)
+            report["workload"] = "failover-churn"
         else:
             report = run_audit(args.seed)
             report["workload"] = "audit-churn"

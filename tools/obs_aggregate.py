@@ -5,8 +5,8 @@ metric the paper is graded on.
 Every process's ``/costs`` verdict covers its own device tick; the
 sync-age plane (utils/syncage.py) measures what a CLIENT observes —
 device-tick epoch to gate delivery. This tool scrapes every process's
-``/syncage``, ``/metrics``, ``/clock``, ``/workload``, ``/governor``
-and ``/incidents`` endpoints, merges the fixed-bucket histograms
+``/syncage``, ``/metrics``, ``/clock``, ``/workload``, ``/governor``,
+``/incidents`` and ``/standby`` endpoints, merges the fixed-bucket histograms
 exactly (``metrics.Histogram.add_counts`` over the raw count vectors
 — never re-derived from percentiles), and prints one deployment
 verdict::
@@ -208,7 +208,62 @@ def aggregate(targets: list[tuple], timeout: float = 2.0,
     out["clock"] = scrape_clock_skew(targets, timeout=timeout)
     out["residency"] = aggregate_residency(targets, timeout=timeout)
     out["audit"] = aggregate_audit(targets, timeout=timeout)
+    out["standby"] = aggregate_standby(targets, timeout=timeout)
     return out
+
+
+def aggregate_standby(targets: list[tuple],
+                      timeout: float = 2.0) -> dict:
+    """Scrape every process's ``/standby`` plane (replication/standby.py)
+    and collect one record per hot-standby mirror: role, replication
+    lag (wall time since the last applied frame in primary ticks, the
+    sync-age convention), bytes/tick of stream cost, and last-keyframe
+    age. Processes without a tracker answer an honest error dict and
+    are skipped silently (the ``/costs`` convention)."""
+    standbys: list[dict] = []
+    for label, base in targets:
+        try:
+            payload = _fetch_json(f"{base}/standby", timeout=timeout)
+        except (urllib.error.URLError, OSError, ValueError):
+            continue
+        if not isinstance(payload, dict) or "error" in payload:
+            continue
+        for name, snap in sorted(payload.items()):
+            if not isinstance(snap, dict) or "role" not in snap:
+                continue
+            standbys.append({"source": f"{label}:{name}", **snap})
+    out: dict = {"standbys": standbys}
+    verdicts = [s["pass"] for s in standbys if "pass" in s]
+    if verdicts:
+        out["pass"] = all(verdicts)
+    return out
+
+
+def standby_lines(agg: dict) -> list[str]:
+    """One replication line per hot standby (empty when none
+    contributed): lag ticks vs budget, stream bytes/tick, and the age
+    of the last keyframe (the resync anchor — a stale keyframe means a
+    torn stream could not self-heal yet)."""
+    lines: list[str] = []
+    for s in (agg.get("standby") or {}).get("standbys", []):
+        verdict = ("PASS" if s.get("pass")
+                   else "FAIL" if "pass" in s else "?")
+        lag = s.get("lag_ticks")
+        line = (f"standby game{s.get('standby_game')} of "
+                f"game{s.get('primary_game')} {verdict} "
+                f"lag={'-' if lag is None else lag} ticks vs budget "
+                f"{s.get('lag_budget_ticks')} | "
+                f"{s.get('bytes_per_tick')} B/tick | last keyframe "
+                f"{s.get('last_keyframe_age_s', '-')}s ago "
+                f"({s.get('frames')} frames, role {s.get('role')})")
+        rej = sum((s.get("rejects") or {}).values())
+        if rej:
+            line += f" | {rej} torn frames rejected"
+        if s.get("role") == "promoted":
+            line += (f" | promoted epoch {s.get('promoted_epoch')} at "
+                     f"tick {s.get('promoted_at_tick')}")
+        lines.append(line)
+    return lines
 
 
 def aggregate_audit(targets: list[tuple], timeout: float = 2.0) -> dict:
@@ -470,6 +525,7 @@ def render(agg: dict) -> str:
     aline = audit_line(agg)
     if aline:
         lines.append(aline)
+    lines += standby_lines(agg)
     return "\n".join(lines)
 
 
