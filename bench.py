@@ -93,6 +93,18 @@ ticks; the gate is zero lost/duplicated EntityIDs, a clean stream, a
 byte-replayable decision log, and a window inside the lag budget.
 BENCH_FAILOVER=0 skips (recorded honestly); BENCH_FAILOVER_ENTITIES
 (default 128) / _TICKS (48) shape it.
+
+Self-healing rebalance block (ISSUE 19): every round stamps a
+``rebalance`` block — a REAL donor world under pose churn trips the
+sustained-DEGRADED proxy and the production rebalance stack
+(goworld_tpu/rebalance/) hands a space-affine cohort to an
+underloaded receiver through the migration protocol. Reports the
+donor's tick p99 BEFORE and AFTER the handoff, entities moved vs the
+batch cap, abort count, and the donor recovery latency in observation
+windows (bench_trend's lower-is-better series); the gate is zero
+lost/duplicated EntityIDs across the move and a byte-identical
+DecisionLog replay. BENCH_REBALANCE=0 skips (recorded honestly);
+BENCH_REBALANCE_ENTITIES (default 96) / _TICKS (32) shape it.
 """
 
 import argparse
@@ -1941,6 +1953,217 @@ def measure_failover(n: int) -> dict:
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+def measure_rebalance(n: int) -> dict:
+    """Self-healing rebalance block (ISSUE 19): a REAL donor world
+    under pose churn trips the sustained-DEGRADED occupancy proxy and
+    the production rebalance stack (:class:`RebalancePolicy` +
+    :class:`HandoffExecutor` + :class:`RebalanceController`) hands a
+    space-affine cohort to an underloaded receiver world through the
+    migration protocol. The block reports the donor's tick p99 BEFORE
+    and AFTER the handoff (the self-healing claim is that shedding a
+    cohort buys the donor tick time back), the entities moved vs the
+    batch cap, the abort count, and the donor recovery latency in
+    observation windows — the lower-is-better series bench_trend
+    gates.
+
+    The gate: zero lost / zero duplicated EntityIDs across the move
+    (census partition: donor_final and moved_final must partition the
+    original set exactly) and a byte-identical DecisionLog replay."""
+    import numpy as np
+
+    from goworld_tpu.core.state import WorldConfig
+    from goworld_tpu.entity.entity import Entity
+    from goworld_tpu.entity.manager import World
+    from goworld_tpu.entity.space import Space
+    from goworld_tpu.ops.aoi import GridSpec
+    from goworld_tpu.rebalance.controller import RebalanceController
+    from goworld_tpu.rebalance.executor import HandoffExecutor
+    from goworld_tpu.rebalance.policy import RebalancePolicy
+    from goworld_tpu.utils import audit as audit_mod
+
+    ents = min(int(n),
+               int(os.environ.get("BENCH_REBALANCE_ENTITIES", 96)))
+    m_ticks = int(os.environ.get("BENCH_REBALANCE_TICKS", 32))
+    batch = max(4, min(24, ents // 4))
+    hold_windows, cooldown_windows = 2, 8
+    windows_budget = 24
+
+    class _RbMob(Entity):
+        ATTRS = {"hp": "allclients hot:0"}
+
+    capacity = 64
+    while capacity < 2 * ents:
+        capacity *= 2
+    cfg = WorldConfig(
+        capacity=capacity,
+        grid=GridSpec(radius=20.0, extent_x=200.0, extent_z=200.0),
+        input_cap=256,
+    )
+    donor = World(cfg, n_spaces=1, game_id=95)
+    donor.register_entity("Mob", _RbMob)
+    donor.register_space("Arena", Space)
+    donor.create_nil_space()
+    dsp = donor.create_space("Arena")
+    rng = np.random.default_rng(29)
+    pool = []
+    for _i in range(ents):
+        x, z = rng.uniform(10.0, 190.0, 2)
+        pool.append(dsp.create_entity(
+            "Mob", pos=(float(x), 0.0, float(z))))
+    # the receiver: an underloaded mirror world sharing the registry,
+    # jit-warmed off the measured path
+    recv = World(cfg, n_spaces=1, game_id=96)
+    recv.register_entity("Mob", _RbMob)
+    recv.register_space("Arena", Space)
+    recv.create_nil_space()
+    rsp = recv.create_space("Arena")
+    recv.tick()
+    recv.tick_count = 0
+
+    def _census(w) -> set:
+        out = {e.id for e in w.entities.values() if not e.destroyed}
+        if w.nil_space is not None:
+            out.discard(w.nil_space.id)
+        return out
+
+    def _churn() -> None:
+        for e in pool:
+            if e.destroyed:
+                continue
+            x, z = rng.uniform(10.0, 190.0, 2)
+            donor.stage_pose(e, (float(x), 0.0, float(z)),
+                             yaw=float(rng.uniform(0.0, 6.28)))
+
+    def _measured_ticks(k: int) -> list[float]:
+        out = []
+        for _ in range(k):
+            _churn()
+            t1 = time.perf_counter()
+            donor.tick()
+            out.append((time.perf_counter() - t1) * 1e3)
+        return out
+
+    try:
+        for _ in range(3):  # warmup outside the clock: jit compile
+            donor.tick()
+        before_ms = _measured_ticks(m_ticks)
+
+        original = _census(donor)
+        recv_base = _census(recv)
+        c0 = len(original)
+        # occupancy-proxy overload stage, same construction as the
+        # chaos_soak rebalance scenario: DEGRADED while the census
+        # holds at least (c0 - batch/2), so the COMPLETED handoff of
+        # `batch` flips the donor NORMAL
+        hot_threshold = c0 - batch // 2
+
+        def stage_of(w, base: set) -> str:
+            return ("DEGRADED"
+                    if len(_census(w) - base) >= hot_threshold
+                    else "NORMAL")
+
+        policy = RebalancePolicy(hold_windows=hold_windows,
+                                 batch=batch,
+                                 cooldown_windows=cooldown_windows)
+        agent = HandoffExecutor(donor, game_id=donor.game_id,
+                                batch=batch)
+
+        def transport(action):
+            # zero-latency wire: the bench measures the donor's tick
+            # cost around the handoff, not transport in-flight windows
+            # (chaos_soak owns that) — deliver and ack inline
+            def send(eid, data) -> None:
+                recv.restore_from_migration(data, space=rsp)
+                agent.ack(eid)
+            return send
+
+        ctl = RebalanceController(
+            policy, agents={"game95": agent}, transport=transport,
+            rate=max(1, batch // 2), timeout_windows=4)
+
+        commit_window = recovered_window = None
+        windows_used = 0
+        for w_i in range(1, windows_budget + 1):
+            windows_used = w_i
+            _churn()
+            donor.tick()
+            recv.tick()
+            obs = {
+                "game95": {"stage": stage_of(donor, set()),
+                           "entities": len(_census(donor)),
+                           "present": True},
+                "game96": {"stage": stage_of(recv, recv_base),
+                           "entities":
+                               len(_census(recv) - recv_base),
+                           "present": True},
+            }
+            if (commit_window is not None
+                    and recovered_window is None
+                    and obs["game95"]["stage"] == "NORMAL"):
+                recovered_window = w_i
+            action = ctl.step(obs)
+            if action is not None and commit_window is None:
+                commit_window = w_i
+            if recovered_window is not None \
+                    and agent.completed + agent.aborted > 0:
+                break
+
+        after_ms = _measured_ticks(m_ticks)
+
+        donor_final = _census(donor)
+        moved_final = _census(recv) - recv_base
+        lost = len(original - (donor_final | moved_final))
+        dup = (len(donor_final & moved_final)
+               + len((donor_final | moved_final) - original))
+        replay_ok = RebalancePolicy.replay(
+            policy.log.inputs, hold_windows=hold_windows,
+            batch=batch, cooldown_windows=cooldown_windows,
+        ) == policy.log.dump()
+        recovery = (None if commit_window is None
+                    or recovered_window is None
+                    else recovered_window - commit_window)
+        p99 = (lambda xs:
+               round(float(np.percentile(np.asarray(xs), 99)), 3))
+        out = {
+            "entities": ents,
+            "capacity": capacity,
+            "measure_ticks": m_ticks,
+            "donor_p50_before_ms": round(
+                float(np.percentile(np.asarray(before_ms), 50)), 3),
+            "donor_p99_before_ms": p99(before_ms),
+            "donor_p50_after_ms": round(
+                float(np.percentile(np.asarray(after_ms), 50)), 3),
+            "donor_p99_after_ms": p99(after_ms),
+            "batch": batch,
+            "commit_window": commit_window,
+            "windows_used": windows_used,
+            "entities_moved": len(moved_final),
+            "aborts": agent.aborted,
+            "donor_recovery_windows": recovery,
+            "entities_lost": lost,
+            "entities_duplicated": dup,
+            "decision_log_replay_ok": replay_ok,
+            # the acceptance gate: one clean committed handoff of the
+            # full batch, conservation across the move, a
+            # byte-replayable decision log, a recovered donor
+            "pass": (commit_window is not None
+                     and len(moved_final) == batch
+                     and agent.aborted == 0
+                     and lost == 0 and dup == 0
+                     and replay_ok and recovery is not None),
+        }
+        log(f"rebalance: moved {out['entities_moved']}/{batch} at "
+            f"window {commit_window}, donor p99 "
+            f"{out['donor_p99_before_ms']} -> "
+            f"{out['donor_p99_after_ms']} ms, recovered in "
+            f"{recovery} window(s) ({lost} lost, {dup} dup) "
+            f"({'PASS' if out['pass'] else 'FAIL'})")
+        return out
+    finally:
+        audit_mod.unregister("game95")
+        audit_mod.unregister("game96")
+
+
 def measure(n: int, ticks: int, client_frac: float, phases: bool,
             grid_overrides: dict | None = None) -> dict:
     import jax
@@ -3247,6 +3470,18 @@ def child_main(args) -> int:
                 fov = {"error": str(exc)[:300]}
             fov["stage"] = "failover"
             print(json.dumps(fov), flush=True)
+        if name == "full" \
+                and os.environ.get("BENCH_REBALANCE", "1") == "1":
+            # the self-healing rebalance plane (ISSUE 19), AFTER the
+            # headline line is safely on stdout (same contract: a
+            # handoff wedge must never zero the round)
+            try:
+                rbl = measure_rebalance(n)
+            except Exception as exc:
+                log(f"rebalance stage failed: {exc}")
+                rbl = {"error": str(exc)[:300]}
+            rbl["stage"] = "rebalance"
+            print(json.dumps(rbl), flush=True)
         if name == "full" and p99_args is not None \
                 and os.environ.get("BENCH_SKIP_P99") != "1":
             # separate stage AFTER the headline line is on stdout: a
@@ -3410,6 +3645,7 @@ def parent_main() -> int:
     resid = None         # the serve-loop residency block (ISSUE 16)
     audt = None          # the correctness-audit block (ISSUE 17)
     fovr = None          # the hot-standby failover block (ISSUE 18)
+    rbal = None          # the self-healing rebalance block (ISSUE 19)
     variants = {}        # config-5 behavior variants (btree/mlp)
 
     live_stages: list = []   # current child's streamed stages
@@ -3422,7 +3658,7 @@ def parent_main() -> int:
         child count too (they are per-line complete results)."""
         b, sb, pt = best, suspect_best, partial
         cp99, cp99s, csc, cgov, csage = p99, p99_shard, scen, gov, sage
-        cres, caud, cfov = resid, audt, fovr
+        cres, caud, cfov, crbl = resid, audt, fovr, rbal
         if b is None:
             for s in list(live_stages):
                 st = s.get("stage")
@@ -3447,6 +3683,8 @@ def parent_main() -> int:
                     caud = s
                 elif st == "failover":
                     cfov = s
+                elif st == "rebalance":
+                    crbl = s
                 elif pt is None:
                     pt = s
         chosen = b or sb or pt
@@ -3462,6 +3700,7 @@ def parent_main() -> int:
             cres = None
             caud = None
             cfov = None
+            crbl = None
         if chosen is not None and cp99 is not None:
             chosen = dict(chosen)
             for k in ("tick_p50_ms", "tick_p99_ms",
@@ -3566,6 +3805,19 @@ def parent_main() -> int:
                 }
             else:
                 chosen["failover"] = {"skipped": "BENCH_FAILOVER=0"}
+            # the rebalance block is ALWAYS stamped from r19 on (the
+            # bench_schema contract): the measured self-healing plane
+            # when the stage ran, an honest skip/error record otherwise
+            if crbl is not None:
+                chosen["rebalance"] = {
+                    k: v for k, v in crbl.items() if k != "stage"
+                }
+            elif os.environ.get("BENCH_REBALANCE", "1") == "1":
+                chosen["rebalance"] = {
+                    "error": "rebalance stage never completed"
+                }
+            else:
+                chosen["rebalance"] = {"skipped": "BENCH_REBALANCE=0"}
         result = {
             "metric": "entity_ticks_per_sec_per_chip",
             "value": 0.0,
@@ -3649,6 +3901,7 @@ def parent_main() -> int:
         child_resid = None
         child_aud = None
         child_fov = None
+        child_rbl = None
         got_best = False
         for s in stages:
             if s.get("stage") == "p99":
@@ -3675,6 +3928,9 @@ def parent_main() -> int:
             if s.get("stage") == "failover":
                 child_fov = s
                 continue
+            if s.get("stage") == "rebalance":
+                child_rbl = s
+                continue
             partial = s
             if s.get("stage") == "full":
                 if s.get("timing_suspect"):
@@ -3699,6 +3955,7 @@ def parent_main() -> int:
             resid = child_resid
             audt = child_aud
             fovr = child_fov
+            rbal = child_rbl
         attempts_log.append({
             "attempt": i + 1, "env": {},
             "stages": [s.get("stage") for s in stages],
@@ -3749,6 +4006,7 @@ def parent_main() -> int:
         child_resid = None
         child_aud = None
         child_fov = None
+        child_rbl = None
         got_best = False
         for s in stages:
             if s.get("stage") == "p99":
@@ -3767,6 +4025,8 @@ def parent_main() -> int:
                 child_aud = s
             elif s.get("stage") == "failover":
                 child_fov = s
+            elif s.get("stage") == "rebalance":
+                child_rbl = s
             elif s.get("stage") == "full":
                 # same rule as the TPU loop: a full stage that failed its
                 # 2x-scale self-check never becomes the headline
@@ -3785,6 +4045,7 @@ def parent_main() -> int:
         resid = child_resid if got_best else None
         audt = child_aud if got_best else None
         fovr = child_fov if got_best else None
+        rbal = child_rbl if got_best else None
 
     # BASELINE config 5 (fused NPC behavior kernels): once a TPU headline
     # is in hand, time the btree and mlp behaviors at the same N so the
@@ -3891,6 +4152,8 @@ def selftest_main() -> int:
         "BENCH_AUDIT_TICKS": "24",
         "BENCH_FAILOVER_ENTITIES": "48",
         "BENCH_FAILOVER_TICKS": "20",
+        "BENCH_REBALANCE_ENTITIES": "48",
+        "BENCH_REBALANCE_TICKS": "12",
     }
     failures: list[str] = []
     report: dict = {}
@@ -4179,6 +4442,29 @@ def selftest_main() -> int:
             check("full.failover.replay",
                   fo.get("decision_log_replay_ok") is True,
                   str(fo.get("decision_log_replay_ok")))
+        # the self-healing rebalance block (ISSUE 19; r>=19 schema
+        # rule): on the selftest shape the committed handoff must land
+        # — an {"error": ...} record here IS harness rot
+        rb = art.get("rebalance", {})
+        check("full.rebalance", isinstance(rb, dict)
+              and {"donor_p99_before_ms", "donor_p99_after_ms",
+                   "entities_moved", "batch", "aborts",
+                   "entities_lost", "pass"} <= set(rb),
+              str(rb)[:200])
+        if "entities_lost" in rb:
+            check("full.rebalance.conservation",
+                  rb.get("entities_lost") == 0
+                  and rb.get("entities_duplicated") == 0,
+                  str({k: rb.get(k) for k in
+                       ("entities_lost", "entities_duplicated")}))
+            check("full.rebalance.moved",
+                  rb.get("entities_moved") == rb.get("batch")
+                  and rb.get("aborts") == 0,
+                  str({k: rb.get(k) for k in
+                       ("entities_moved", "batch", "aborts")}))
+            check("full.rebalance.replay",
+                  rb.get("decision_log_replay_ok") is True,
+                  str(rb.get("decision_log_replay_ok")))
         check("full.p99", "tick_p99_ms" in art, "missing p99 keys")
         check("full.p99_gate", "p99_suspect" not in art,
               art.get("p99_suspect", ""))

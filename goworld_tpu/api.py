@@ -493,6 +493,12 @@ def run(argv: list[str] | None = None, *, block: bool = True) -> _Runtime:
             replication_keyframe_every=gc.replication_keyframe_every,
             replication_queue=gc.replication_queue,
             replication_lag_budget_ticks=gc.replication_lag_budget_ticks,
+            # self-healing rebalance plane (ISSUE 19): a DEPLOYMENT
+            # knob ([deployment] rebalance) — every game hosts a
+            # handoff agent so any of them can donate or receive;
+            # standbys mirror, they don't trade entities
+            rebalance_enabled=cfg.rebalance and not gc.standby_of,
+            rebalance_batch=cfg.rebalance_batch,
         )
 
     restoring = args.restore and \

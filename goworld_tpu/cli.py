@@ -955,6 +955,12 @@ def cmd_status(server_dir: str) -> int:
                         # bytes/tick, last keyframe age
                         for sline in agg_tool.standby_lines(agg):
                             print(sline)
+                        # one self-healing line per handoff agent with
+                        # live/finished work plus the controller's
+                        # decision state (goworld_tpu/rebalance,
+                        # debug_http /rebalance)
+                        for rbline in agg_tool.rebalance_lines(agg):
+                            print(rbline)
                     except Exception:
                         pass  # the verdict must never break status
             for e in errors:

@@ -378,6 +378,18 @@ class ClusterConfig:
     # grammar in docs/ROBUSTNESS.md; env GOWORLD_FAULTS[_SEED] override)
     faults: str = ""
     faults_seed: int = 0
+    # self-healing rebalance plane ([deployment] rebalance*;
+    # goworld_tpu/rebalance/, docs/ROBUSTNESS.md "Elastic
+    # rebalancing"): a game holding DEGRADED-or-worse for
+    # rebalance_hold_windows observation windows while a peer has
+    # headroom hands a bounded cohort (rebalance_batch entities per
+    # window) to the underloaded game; committed (donor, target)
+    # pairs then cool down for rebalance_cooldown_secs before the
+    # pair can move again (ping-pong suppression)
+    rebalance: bool = False
+    rebalance_hold_windows: int = 3
+    rebalance_batch: int = 64
+    rebalance_cooldown_secs: float = 30.0
     dispatchers: dict[int, DispatcherConfig] = dataclasses.field(
         default_factory=dict)
     games: dict[int, GameConfig] = dataclasses.field(default_factory=dict)
@@ -461,6 +473,17 @@ def load(path: str | None = None) -> ClusterConfig:
         cfg.faults = dep.get("faults", cfg.faults)
         if "faults_seed" in dep:
             cfg.faults_seed = int(dep["faults_seed"])
+        if "rebalance" in dep:
+            cfg.rebalance = dep["rebalance"].strip().lower() in (
+                "1", "true", "yes", "on")
+        if "rebalance_hold_windows" in dep:
+            cfg.rebalance_hold_windows = int(
+                dep["rebalance_hold_windows"])
+        if "rebalance_batch" in dep:
+            cfg.rebalance_batch = int(dep["rebalance_batch"])
+        if "rebalance_cooldown_secs" in dep:
+            cfg.rebalance_cooldown_secs = float(
+                dep["rebalance_cooldown_secs"])
         # reference semantics: [deployment] declares DESIRED COUNTS
         # (read_config.go:40-118): counts beyond the explicit numbered
         # sections auto-create defaults from the *_common section, and
@@ -570,6 +593,13 @@ def dumps_sample() -> str:
 #                    # seeded fault-injection schedule (chaos testing;
 # faults_seed = 42   # grammar in docs/ROBUSTNESS.md; env
 #                    # GOWORLD_FAULTS / GOWORLD_FAULTS_SEED override)
+# rebalance = true   # self-healing entity rebalancing: a game holding
+#                    # DEGRADED-or-worse hands a bounded cohort to an
+#                    # underloaded peer (docs/ROBUSTNESS.md "Elastic
+#                    # rebalancing"; served live at /rebalance)
+# rebalance_hold_windows = 3    # sustained windows before a move plans
+# rebalance_batch = 64          # entities per handoff send window
+# rebalance_cooldown_secs = 30  # per-(donor,target) pair cooldown
 
 [dispatcher1]
 host = 127.0.0.1

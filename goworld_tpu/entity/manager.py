@@ -130,6 +130,14 @@ def _make_local_tick(cfg: WorldConfig, n_spaces: int = 1):
     return step
 
 
+class AdmissionPausedError(RuntimeError):
+    """``create_entity`` into a space whose admission a rebalance
+    handoff paused mid-move (goworld_tpu/rebalance/). Callers place
+    the entity elsewhere or retry after the move completes — silent
+    placement into a draining space would refill the cohort under
+    the handoff."""
+
+
 class World:
     """Hosts every entity of one game process (= one device or one mesh).
 
@@ -359,6 +367,10 @@ class World:
         # host object model
         self.entities: dict[str, Entity] = {}
         self.spaces: dict[str, Space] = {}
+        # spaces currently refusing NEW entity admission (a rebalance
+        # handoff pauses its donor space mid-move so the cohort it is
+        # draining cannot refill under it; goworld_tpu/rebalance/)
+        self._admission_paused: set[str] = set()
         self._slot_owner: list[dict[int, str]] = [
             {} for _ in range(n_spaces)
         ]
@@ -647,6 +659,10 @@ class World:
         moving: bool = False,
     ) -> Entity:
         """Reference ``createEntity`` (``EntityManager.go:201``)."""
+        if space is not None and space.id in self._admission_paused:
+            raise AdmissionPausedError(
+                f"space {space.id} is draining a rebalance handoff; "
+                f"admission paused")
         desc = self.registry.get(type_name)
         if desc.is_space:
             raise TypeError(f"use create_space for space type {type_name}")
@@ -1378,15 +1394,40 @@ class World:
             data["own_seq"] = self.audit.ledger.next_seq(e.id)
         return data
 
-    def remove_for_migration(self, e: Entity) -> None:
+    def pause_admission(self, space_id: str, paused: bool = True
+                        ) -> None:
+        """Pause (or resume) NEW-entity admission into a space — the
+        rebalance handoff's mid-move guard. ``create_entity`` into a
+        paused space raises :class:`AdmissionPausedError`; existing
+        entities and migration restores are unaffected (an abort must
+        be able to put the cohort back)."""
+        if paused:
+            self._admission_paused.add(space_id)
+        else:
+            self._admission_paused.discard(space_id)
+
+    def admission_allowed(self, space_id: str) -> bool:
+        return space_id not in self._admission_paused
+
+    def remove_for_migration(self, e: Entity, target: int = 0,
+                             out_tick: int | None = None) -> None:
         """Tear down the local copy WITHOUT destroy semantics — no
         OnDestroy, no persistence, no client destroy message (the client
         binding travels in the migrate data; reference
-        ``destroyEntity(isMigrate=true)``, ``Entity.go:631-651``)."""
+        ``destroyEntity(isMigrate=true)``, ``Entity.go:631-651``).
+
+        ``target`` names the destination game in the ledger's
+        in-flight record; ``out_tick`` lets a batched handoff stamp
+        each entity at its OWN send tick (default: the current tick) —
+        the per-record anchor the burst-aware conservation verdict
+        ages from (ISSUE 19)."""
         if self.audit is not None:
             # ledger move-out: opens an in-flight record the target's
             # migrate-in must retire within the conservation grace
-            self.audit.ledger.stamp_migrate_out(e.id, self.tick_count)
+            self.audit.ledger.stamp_migrate_out(
+                e.id,
+                self.tick_count if out_tick is None else int(out_tick),
+                target=int(target))
         e.OnMigrateOut()
         for tid in list(e.timer_ids):
             self.timers.cancel(tid)

@@ -97,7 +97,8 @@ class _GameInfo:
     per class in ``shed_total{class,stage="dispatcher_pend"}``."""
 
     __slots__ = ("game_id", "conn", "blocked_until", "pending", "load",
-                 "ban_boot", "pending_count", "pending_bytes")
+                 "ban_boot", "pending_count", "pending_bytes",
+                 "rebalance_paused")
 
     def __init__(self, game_id: int):
         self.game_id = game_id
@@ -110,6 +111,10 @@ class _GameInfo:
         self.pending_bytes = 0
         self.load = 0.0   # CPU% analog reported via MT_GAME_LBC_INFO
         self.ban_boot = False
+        # a donor game mid-handoff pauses its own NEW-entity admission
+        # deployment-wide via the kvreg key rebalance/pause/gameN
+        # (goworld_tpu/rebalance/); _choose_game skips it while set
+        self.rebalance_paused = False
 
     @property
     def blocked(self) -> bool:
@@ -554,6 +559,12 @@ class DispatcherService:
             g for g in self.games.values()
             if g.conn is not None and not (boot and g.ban_boot)
         ]
+        # a donor mid-handoff stops taking NEW entities (rebalance
+        # admission pause) — unless every live game is paused, in
+        # which case placement beats refusal
+        unpaused = [g for g in live if not g.rebalance_paused]
+        if unpaused:
+            live = unpaused
         if not live:
             return None
         if boot:
@@ -787,6 +798,17 @@ class DispatcherService:
             val = self.kvreg[key]  # lost the race: broadcast the winner
         else:
             self.kvreg[key] = val
+        if key.startswith("rebalance/pause/game"):
+            # the rebalance admission-pause lane (goworld_tpu/
+            # rebalance/): a donor mid-handoff takes itself out of
+            # boot/min-load placement until the move resolves
+            try:
+                gid = int(key[len("rebalance/pause/game"):])
+            except ValueError:
+                gid = 0
+            gi = self.games.get(gid)
+            if gi is not None:
+                gi.rebalance_paused = val not in ("", "0", "false")
         out = proto.pack_kvreg_register(key, val, False)
         self._broadcast_to_games(out)
 

@@ -119,6 +119,8 @@ class GameServer:
         replication_keyframe_every: int = 0,
         replication_queue: int = 4,
         replication_lag_budget_ticks: int = 16,
+        rebalance_enabled: bool = False,
+        rebalance_batch: int = 64,
     ):
         self.game_id = game_id
         self.world = world
@@ -383,6 +385,28 @@ class GameServer:
             self.standby_tracker.on_promote = self._request_promotion
             self.kvreg_watchers.append(self._on_promotion_kvreg)
 
+        # self-healing rebalance plane (ISSUE 19, goworld_tpu/
+        # rebalance/): a per-game handoff agent drives bounded entity
+        # cohorts to an underloaded peer through the PRODUCTION
+        # migration protocol (wire mode: the agent only initiates
+        # _remote_enter_space; the QUERY_SPACE -> MIGRATE_REQUEST ->
+        # REAL_MIGRATE handlers do the removal, so an abandoned move
+        # leaves the entity live on the source by construction). The
+        # agent also answers the /rebalance?handoff= manual drain.
+        self.rebalance_enabled = bool(rebalance_enabled)
+        self.rebalance_agent = None
+        self._rebalance_pub_tick = 0
+        self._rebalance_paused_pub = False
+        if self.rebalance_enabled:
+            from goworld_tpu import rebalance as _rebalance
+
+            self.rebalance_agent = _rebalance.register(
+                f"game{game_id}",
+                _rebalance.HandoffExecutor(
+                    world, game_id=game_id,
+                    batch=max(1, int(rebalance_batch))))
+            _rebalance.set_handoff_hook(self._request_handoff)
+
         # wire the world's pluggable edges to the cluster
         w = world
         w.client_sink = self._client_sink
@@ -638,6 +662,11 @@ class GameServer:
             self._flush_sync_out()
             self._maybe_checkpoint()
             self._replication_pump()
+        if self.rebalance_agent is not None:
+            try:
+                self._rebalance_service()
+            except Exception:  # must never break a tick
+                logger.exception("rebalance service failed")
         ap = getattr(self.world, "audit", None)
         if (ap is not None and self.audit_scrub_every > 0
                 and self.world.tick_count % self.audit_scrub_every == 0):
@@ -676,6 +705,80 @@ class GameServer:
     # carries the bubble p99 of the ticks since the previous window —
     # the residency_regression trigger's input (utils/flightrec.py)
     RESIDENCY_WIN_TICKS = 16
+    # rebalance send-window cadence (ticks): a busy handoff agent
+    # initiates at most one rate-limited batch window per this many
+    # ticks, so the migration path never becomes its own overload
+    REBALANCE_PUMP_TICKS = 16
+    # kvreg advert cadence for this game's receiving space
+    REBALANCE_PUB_TICKS = 64
+
+    def _rebalance_service(self) -> None:
+        """Per-tick rebalance housekeeping (logic thread): advertise
+        this game's receiving space in kvreg, pump the active handoff
+        one send window on its cadence, observe wire completions, and
+        publish/clear the deployment-wide admission pause."""
+        agent = self.rebalance_agent
+        w = self.world
+        tick = w.tick_count
+        if self._rebalance_pub_tick == 0 \
+                or tick - self._rebalance_pub_tick \
+                >= self.REBALANCE_PUB_TICKS:
+            self._rebalance_pub_tick = max(1, tick)
+            nil_id = getattr(w.nil_space, "id", None)
+            sid = next(
+                (s for s in sorted(w.spaces) if s != nil_id), None)
+            if sid is not None:
+                self.kvreg_register(
+                    f"rebalance/space/game{self.game_id}", sid,
+                    force=True)
+        if agent.busy:
+            if tick % self.REBALANCE_PUMP_TICKS == 0:
+                agent.pump()
+            agent.wire_poll(self._migrating_out)
+        paused = agent.busy
+        if paused != self._rebalance_paused_pub:
+            self._rebalance_paused_pub = paused
+            self.kvreg_register(
+                f"rebalance/pause/game{self.game_id}",
+                "1" if paused else "0", force=True)
+
+    def _request_handoff(self, target: int,
+                         batch: int | None = None) -> dict:
+        """The ``/rebalance?handoff=GAMEID`` poke (debug-http thread):
+        validate against the kvreg mirror, then post the actual start
+        onto the logic thread — the world is single-threaded."""
+        agent = self.rebalance_agent
+        if agent is None:
+            return {"error": "rebalance disabled on this process"}
+        if int(target) == self.game_id:
+            return {"error": "cannot hand off to self"}
+        space = self.kvreg.get(f"rebalance/space/game{int(target)}")
+        if not space:
+            return {"error":
+                    f"game{int(target)} advertises no receiving space"}
+        if agent.busy:
+            return {"error": "a handoff is already in flight"}
+        tgt, sp = int(target), space
+
+        def _start() -> None:
+            if agent.busy:
+                return
+            try:
+                n = agent.start(
+                    tgt, "manual",
+                    send=lambda eid, e: self._remote_enter_space(
+                        e, sp, tuple(e.position)),
+                    batch=batch, detach=False)
+                logger.info(
+                    "game%d: manual handoff of %d entities to game%d "
+                    "(space %s)", self.game_id, n, tgt, sp)
+            except Exception:
+                logger.exception("game%d: manual handoff failed",
+                                 self.game_id)
+
+        self.world.post_q.post(_start)
+        return {"requested": True, "target": f"game{tgt}",
+                "space": sp, "batch": int(batch or agent.batch)}
 
     def _drive_governor(self):
         """One governor observation per rotated signature window: hand
@@ -772,6 +875,12 @@ class GameServer:
             av = ap.take_violation()
             if av is not None:
                 frame["audit_violation"] = av
+        if self.rebalance_agent is not None:
+            # each terminal handoff transition (start/done/abort) fires
+            # the rebalance_action trigger at most once
+            ra = self.rebalance_agent.take_action_note()
+            if ra is not None:
+                frame["rebalance"] = ra
         rt = getattr(w, "residency", None)
         if rt is not None and tick % self.RESIDENCY_WIN_TICKS == 0:
             # windowed bubble verdict on a cadence: the p99 of the host
@@ -1929,7 +2038,10 @@ class GameServer:
         data = self.world.get_migrate_data(e)
         data["space_id"] = space_id
         data["pos"] = list(pos)
-        self.world.remove_for_migration(e)
+        # target stamped into the ledger's in-flight record: the
+        # conservation verdict and the /audit plane can then name
+        # WHERE an unmatched out-record was headed
+        self.world.remove_for_migration(e, target=game_id)
         p = proto.pack_real_migrate(eid, game_id, data)
         self._send(self.cluster.select_by_entity_id(eid), p)
 

@@ -428,10 +428,27 @@ def conservation_verdict(games: list[dict],
     destroyed = sum(int(g.get("destroyed", 0)) for g in games)
     ins = {(r["eid"], r["seq"])
            for g in games for r in g.get("in_records", [])}
-    outstanding = [r for g in games for r in g.get("in_flight", [])
-                   if (r["eid"], r["seq"]) not in ins]
+    outstanding = []
+    for g in games:
+        snap_tick = int(g.get("tick", 0))
+        for r in g.get("in_flight", []):
+            if (r["eid"], r["seq"]) in ins:
+                continue
+            # burst-aware grace (ISSUE 19): age each record from its
+            # OWN migrate-out tick against the owning game's snapshot
+            # tick — never from a precomputed age a batched scraper
+            # may have anchored at the batch head. A rate-limited
+            # rebalance of rebalance_batch entities straddling the
+            # verdict then judges every record by how long IT has
+            # been in flight, not how old the batch is.
+            r = dict(r)
+            if "tick" in r:
+                r["age_ticks"] = max(0, snap_tick - int(r["tick"]))
+            else:
+                r["age_ticks"] = int(r.get("age_ticks", 0))
+            outstanding.append(r)
     lost = [r for r in outstanding
-            if int(r.get("age_ticks", 0)) > int(grace_ticks)]
+            if int(r["age_ticks"]) > int(grace_ticks)]
     in_flight = len(outstanding)
     violations: dict[str, int] = {}
     for g in games:
@@ -441,7 +458,7 @@ def conservation_verdict(games: list[dict],
     for r in lost:
         problems.append(
             f"lost EntityID {r['eid']} (seq {r['seq']}, migrated out "
-            f"at tick {r['tick']}, unmatched for "
+            f"at tick {r.get('tick', '?')}, unmatched for "
             f"{r['age_ticks']} ticks)")
     balance = live + in_flight - (created - destroyed)
     if balance != 0:

@@ -72,6 +72,12 @@ TPU-native analog exposes:
   sync-age-style staleness verdict (lag ticks vs budget);
   ``?promote=1`` (optionally ``&epoch=E``) is the supervisor's
   promotion poke; an honest error on processes that mirror nothing
+* ``/rebalance`` — the self-healing rebalance plane (:mod:`goworld_tpu.
+  rebalance`): per-game handoff agents (active job, queue/unacked
+  depth, move/abort counters by cause) and the controller's policy
+  state + decision-log tail where one runs; ``?handoff=GAMEID``
+  (optionally ``&batch=N``) pokes a bounded manual cohort drain on
+  this process's agent
 
 Stdlib-only (http.server on a daemon thread), one call to :func:`start`.
 """
@@ -94,7 +100,8 @@ logger = log.get("debug_http")
 _ENDPOINTS = ["/healthz", "/vars", "/ops", "/metrics", "/trace",
               "/tracing", "/clock", "/profile", "/faults", "/overload",
               "/costs", "/workload", "/incidents", "/governor",
-              "/syncage", "/residency", "/audit", "/standby"]
+              "/syncage", "/residency", "/audit", "/standby",
+              "/rebalance"]
 
 # jax.profiler capture state (one capture at a time per process)
 _profile_lock = threading.Lock()
@@ -337,6 +344,29 @@ class _Handler(BaseHTTPRequestHandler):
                     int(ep) if ep not in (None, "") else None))
             else:
                 self._json(standby.snapshot_all())
+        elif path == "/rebalance":
+            # self-healing rebalance plane (goworld_tpu/rebalance
+            # registry): per-game handoff agents (active job, move/
+            # abort counters) and, on the controller's host, the
+            # policy state + decision log tail; ?handoff=GAMEID
+            # (optionally &batch=N) pokes a bounded cohort handoff on
+            # this process's agent — the operator's manual drain knob,
+            # same bookkeeping as an automated move
+            from goworld_tpu import rebalance
+
+            if "handoff" in query and query["handoff"][0]:
+                try:
+                    target = int(query["handoff"][0])
+                except ValueError:
+                    self._json({"error": "handoff wants a game id"},
+                               400)
+                    return
+                batch_q = query.get("batch", [None])[0]
+                self._json(rebalance.request_handoff(
+                    target,
+                    int(batch_q) if batch_q not in (None, "") else None))
+            else:
+                self._json(rebalance.snapshot())
         elif path == "/incidents":
             # flight-recorder incident bundles (utils/flightrec);
             # ?frames=1 adds the live per-tick frame ring

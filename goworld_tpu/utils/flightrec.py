@@ -51,7 +51,13 @@ Triggers (the grammar — docs/OBSERVABILITY.md):
   (``goworld_tpu/replication/``; the ``standby_promoted`` frame key
   names game/epoch/frame-seq/tick): the bundle freezes the
   promotion-side context, pairing with the primary's bundle frozen at
-  its crash.
+  its crash;
+* ``rebalance_action`` — the self-healing rebalance plane took a
+  topology action this tick (``goworld_tpu/rebalance/``; the
+  ``rebalance`` frame key): a bounded entity-cohort handoff started,
+  completed, or aborted — the detail names the target game, cohort
+  size and (on abort) the cause, so a post-mortem can line the move
+  up against the overload stages that triggered it.
 
 Every trigger kind is deduped with a per-kind cooldown so one bad
 minute yields a handful of bundles, not thousands. Determinism: the
@@ -207,6 +213,14 @@ class FlightRecorder:
                 # promotion-side context (the primary's ring froze at
                 # its crash — both sides of the failover keep bundles)
                 fired.append(("standby_promoted", str(sbp)))
+            rba = frame.get("rebalance")
+            if rba is not None:
+                # the rebalance plane took a topology action this tick
+                # (goworld_tpu/rebalance/): a handoff started,
+                # completed or aborted; the detail carries the action
+                # note (target, cohort, cause) and the bundle freezes
+                # the decision context around the move
+                fired.append(("rebalance_action", str(rba)))
             self._frames.append(dict(frame))
             self._frames_total += 1
             new = [self._freeze(kind, detail, frame)

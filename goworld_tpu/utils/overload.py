@@ -51,6 +51,7 @@ logger = log.get("overload")
 
 __all__ = [
     "NORMAL", "DEGRADED", "SHEDDING", "REJECTING", "STATE_NAMES",
+    "state_rank",
     "CLASS_CRITICAL", "CLASS_RPC", "CLASS_SYNC", "CLASS_EVENTS",
     "CLASS_NOISE", "CLASS_NAMES", "classify", "shed_counter",
     "OverloadGovernor", "ClassQueues", "TokenBucket", "CircuitBreaker",
@@ -65,6 +66,17 @@ DEGRADED = 1
 SHEDDING = 2
 REJECTING = 3
 STATE_NAMES = ("NORMAL", "DEGRADED", "SHEDDING", "REJECTING")
+
+
+def state_rank(name: str) -> int:
+    """Severity rank of a governor state NAME (the scraped ``/overload``
+    payload ships names, not ints). Unknown names rank as NORMAL — a
+    scrape gap or version skew must never synthesize load, only miss
+    it (the rebalance policy's donor test is ``rank >= DEGRADED``)."""
+    try:
+        return STATE_NAMES.index(str(name))
+    except ValueError:
+        return NORMAL
 
 # =======================================================================
 # traffic classes (priority order; LOWER number = more important)

@@ -142,6 +142,18 @@ def pytest_configure(config):
         "tests/test_replication.py); all run in tier-1 on CPU "
         "(docs/ROBUSTNESS.md \"Hot-standby & promotion\")",
     )
+    config.addinivalue_line(
+        "markers",
+        "rebalance: self-healing deployment rebalance suites "
+        "(goworld_tpu/rebalance — sustained-DEGRADED hold/hysteresis "
+        "policy, ping-pong cooldown suppression, plan-window "
+        "cancellation, byte-identical decision-log replay, bounded "
+        "cohort handoff + abort restore through the migration "
+        "protocol, admission pause, the burst-aware conservation "
+        "grace, /rebalance, the rebalance_action trigger — "
+        "tests/test_rebalance.py); all run in tier-1 on CPU "
+        "(docs/ROBUSTNESS.md \"Elastic rebalancing\")",
+    )
 
 
 def spawn_on(states, dev, slot, **kw):

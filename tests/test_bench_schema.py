@@ -560,3 +560,64 @@ def test_failover_block_shape_caught(tmp_path):
     rec2["failover"]["entities_lost"] = "none"
     errs = _validate(tmp_path, "BENCH_r18.json", rec2)
     assert any("entities_lost malformed" in e for e in errs)
+
+
+# =======================================================================
+# r>=19: the self-healing rebalance block (ISSUE 19)
+# =======================================================================
+def _rebalance_block(**extra):
+    blk = {
+        "donor_p99_before_ms": 12.1,
+        "donor_p99_after_ms": 10.4,
+        "entities_moved": 24,
+        "batch": 24,
+        "aborts": 0,
+        "donor_recovery_windows": 2,
+        "entities_lost": 0,
+        "entities_duplicated": 0,
+        "decision_log_replay_ok": True,
+        "pass": True,
+    }
+    blk.update(extra)
+    return blk
+
+
+def _r19_rec(**extra):
+    """A valid r19 record: r18's contract + the rebalance block."""
+    rec = _r18_rec(rebalance=_rebalance_block())
+    rec.update(extra)
+    return rec
+
+
+def test_rebalance_block_required_since_r19(tmp_path):
+    rec = _r19_rec()
+    assert _validate(tmp_path, "BENCH_r19.json", rec) == []
+    # missing entirely -> caught at r19, grandfathered at r18
+    rec2 = _r19_rec()
+    del rec2["rebalance"]
+    errs = _validate(tmp_path, "BENCH_r19.json", rec2)
+    assert any("rebalance" in e for e in errs)
+    assert _validate(tmp_path, "BENCH_r18.json", rec2) == []
+    # honest skip/error records accepted
+    for blk in ({"skipped": "BENCH_REBALANCE=0"},
+                {"error": "rebalance stage never completed"}):
+        rec3 = _r19_rec(rebalance=blk)
+        assert _validate(tmp_path, "BENCH_r19.json", rec3) == []
+
+
+def test_rebalance_block_shape_caught(tmp_path):
+    # a present-but-gutted block is malformation, not an honest skip
+    rec = _r19_rec(rebalance={"entities_moved": 24})
+    errs = _validate(tmp_path, "BENCH_r19.json", rec)
+    assert any("rebalance missing key" in e for e in errs)
+    assert any("entities_lost" in e for e in errs)
+    # non-numeric conservation counts are malformation
+    rec2 = _r19_rec()
+    rec2["rebalance"]["entities_duplicated"] = "zero"
+    errs = _validate(tmp_path, "BENCH_r19.json", rec2)
+    assert any("entities_duplicated malformed" in e for e in errs)
+    # an aborted round's recovery latency is honestly None — accepted
+    rec3 = _r19_rec()
+    rec3["rebalance"]["donor_recovery_windows"] = None
+    rec3["rebalance"]["pass"] = False
+    assert _validate(tmp_path, "BENCH_r19.json", rec3) == []

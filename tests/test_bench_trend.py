@@ -622,3 +622,100 @@ def test_failover_gate_survives_headline_shape_change(tmp_path):
     r2b["failover"] = _fo_block(1)
     f2b = _write(tmp_path, "BENCH_r02.json", r2b)
     assert TREND.main([f1, f2b]) == 0
+
+
+def _rb_block(recovery, lost=0, dup=0, moved=24, replay_ok=True,
+              passed=None):
+    return {
+        "entities": 96,
+        "donor_p99_before_ms": 12.1,
+        "donor_p99_after_ms": 10.4,
+        "batch": 24,
+        "entities_moved": moved,
+        "aborts": 0,
+        "donor_recovery_windows": recovery,
+        "entities_lost": lost,
+        "entities_duplicated": dup,
+        "decision_log_replay_ok": replay_ok,
+        "pass": ((lost == 0 and dup == 0 and recovery is not None)
+                 if passed is None else passed),
+    }
+
+
+def test_rebalance_entity_loss_always_fails(tmp_path):
+    """ISSUE 19: ANY lost or duplicated entity across the automated
+    handoff fails unconditionally — conservation needs no prior round
+    — and a failed DecisionLog byte replay gates the same way."""
+    r1 = _bench_rec(1000.0)  # prior round without a rebalance block
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    r2 = _bench_rec(1000.0)
+    r2["rebalance"] = _rb_block(2, lost=3, passed=False)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 2
+    r2b = _bench_rec(1000.0)
+    r2b["rebalance"] = _rb_block(2, dup=1, passed=False)
+    f2b = _write(tmp_path, "BENCH_r02.json", r2b)
+    assert TREND.main([f1, f2b]) == 2
+    r2c = _bench_rec(1000.0)
+    r2c["rebalance"] = _rb_block(2, replay_ok=False, passed=False)
+    f2c = _write(tmp_path, "BENCH_r02.json", r2c)
+    assert TREND.main([f1, f2c]) == 2
+    # a clean block with no prior is a new anchor, not a gate
+    r2d = _bench_rec(1000.0)
+    r2d["rebalance"] = _rb_block(2)
+    f2d = _write(tmp_path, "BENCH_r02.json", r2d)
+    assert TREND.main([f1, f2d]) == 0
+
+
+def test_rebalance_recovery_latency_lower_is_better(tmp_path):
+    """Donor recovery latency gates against the best (lowest) prior
+    at the same (entities_moved, platform) shape with a 1-window
+    absolute slack; an aborted round (recovery None) and an honest
+    skip neither gate nor anchor; a different moved-count is a
+    different series."""
+    r1 = _bench_rec(1000.0)
+    r1["rebalance"] = _rb_block(2)
+    r2 = _bench_rec(1000.0)
+    r2["rebalance"] = _rb_block(3)  # within 1.3x + 1 window slack
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 0
+    # injected regression: headline flat, recovery 4x slower
+    r3 = _bench_rec(1000.0)
+    r3["rebalance"] = _rb_block(8)
+    f3 = _write(tmp_path, "BENCH_r03.json", r3)
+    assert TREND.main([f1, f2, f3]) == 2
+    # an aborted round carries recovery None: no gate, no anchor
+    r3b = _bench_rec(1000.0)
+    r3b["rebalance"] = _rb_block(None, moved=12, passed=False)
+    r3b["rebalance"]["aborts"] = 1
+    f3b = _write(tmp_path, "BENCH_r03.json", r3b)
+    assert TREND.main([f1, f2, f3b]) == 0
+    # an honest skip neither gates nor anchors
+    r3c = _bench_rec(1000.0)
+    r3c["rebalance"] = {"skipped": "BENCH_REBALANCE=0"}
+    f3c = _write(tmp_path, "BENCH_r03.json", r3c)
+    assert TREND.main([f1, f2, f3c]) == 0
+    # a different moved-count is a different series
+    r3d = _bench_rec(1000.0)
+    r3d["rebalance"] = _rb_block(8, moved=48)
+    f3d = _write(tmp_path, "BENCH_r03.json", r3d)
+    assert TREND.main([f1, f2, f3d]) == 0
+
+
+def test_rebalance_pass_to_fail_and_shape_change(tmp_path):
+    """A verdict flip pass -> fail at the same shape always fails;
+    the conservation gate survives a headline-shape change (the early
+    headline return must not swallow it)."""
+    r1 = _bench_rec(1000.0)
+    r1["rebalance"] = _rb_block(2)
+    r2 = _bench_rec(1000.0)
+    r2["rebalance"] = _rb_block(2, passed=False)
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 2
+    # headline shape change + a lost entity: still gated
+    r2b = _bench_rec(5000.0, entities=4096)
+    r2b["rebalance"] = _rb_block(2, lost=1, passed=False)
+    f2b = _write(tmp_path, "BENCH_r02.json", r2b)
+    assert TREND.main([f1, f2b]) == 2

@@ -1,0 +1,91 @@
+"""tools/ci_gate.py — the one-command pre-merge gate, in tier-1
+(jax-free).
+
+The contract under test:
+
+* the REAL repo is green through all three chained gates (obs_lint +
+  bench_schema + bench_trend) — this test IS the pre-merge check;
+* a single failing gate turns the whole chain non-zero (drift can
+  never ride through on a green neighbour);
+* an unimportable gate counts as FAILED, never silently skipped.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+pytestmark = pytest.mark.rebalance
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+spec = importlib.util.spec_from_file_location(
+    "ci_gate_under_test",
+    os.path.join(REPO, "tools", "ci_gate.py"))
+GATE = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(GATE)
+
+
+def test_gate_order_is_the_documented_chain():
+    assert GATE.GATES == ("obs_lint", "bench_schema", "bench_trend")
+
+
+def test_real_repo_is_green(capsys):
+    assert GATE.main([]) == 0
+    out = capsys.readouterr().out
+    # every gate actually ran (no silent skip) and the verdict printed
+    for name in GATE.GATES:
+        assert f"== {name} ==" in out
+    assert "ci_gate: ok (3 gates green)" in out
+
+
+def test_threshold_is_forwarded_to_bench_trend_only(monkeypatch):
+    seen = {}
+
+    class _Fake:
+        def __init__(self, name):
+            self.name = name
+
+        def main(self, argv):
+            seen[self.name] = list(argv)
+            return 0
+
+    monkeypatch.setattr(
+        GATE.importlib, "import_module", lambda n: _Fake(n))
+    assert GATE.main(["--threshold", "0.25"]) == 0
+    assert seen["obs_lint"] == []
+    assert seen["bench_schema"] == []
+    assert seen["bench_trend"] == ["--threshold", "0.25"]
+
+
+def test_one_failing_gate_fails_the_chain(monkeypatch, capsys):
+    class _Fake:
+        def __init__(self, name):
+            self.name = name
+
+        def main(self, argv):
+            return 2 if self.name == "bench_schema" else 0
+
+    monkeypatch.setattr(
+        GATE.importlib, "import_module", lambda n: _Fake(n))
+    assert GATE.main([]) == 2
+    assert "bench_schema (rc=2)" in capsys.readouterr().out
+
+
+def test_unimportable_gate_is_a_failure_not_a_skip(monkeypatch,
+                                                   capsys):
+    def _boom(name):
+        if name == "bench_trend":
+            raise ImportError("gate deleted")
+
+        class _Ok:
+            @staticmethod
+            def main(argv):
+                return 0
+
+        return _Ok
+
+    monkeypatch.setattr(GATE.importlib, "import_module", _boom)
+    assert GATE.main([]) == 2
+    out = capsys.readouterr().out
+    assert "bench_trend: import failed" in out
+    assert "bench_trend (rc=-1)" in out

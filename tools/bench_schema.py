@@ -68,7 +68,15 @@ contract):
   standby's apply cost, the promotion latency in ticks and the
   conservation counts across the arbitrated promotion (zero lost /
   zero duplicated EntityIDs is the gate) — honest
-  ``{"error"/"skipped": ...}`` records accepted.
+  ``{"error"/"skipped": ...}`` records accepted;
+* rounds >= 19 (the self-healing rebalance era, ISSUE 19): a
+  ``rebalance`` block — donor tick p99 before/after the automated
+  handoff, entities moved vs the batch cap, abort count, the donor
+  recovery latency in observation windows (the lower-is-better trend
+  series) and the conservation counts across the move (zero lost /
+  zero duplicated is the unconditional gate), plus the byte-identical
+  DecisionLog replay verdict — honest ``{"error"/"skipped": ...}``
+  records accepted.
 
 Exit codes: 0 all valid, 1 usage/missing, 2 schema violations.
 """
@@ -162,6 +170,18 @@ FAILOVER_KEYS = ("replication_bytes_per_tick",
                  "promotion_latency_ticks", "entities_lost",
                  "entities_duplicated", "frames_applied",
                  "frames_rejected", "decision_log_replay_ok", "pass")
+# the self-healing rebalance era (ISSUE 19): every BENCH round stamps
+# the rebalance block — donor tick p99 before/after the handoff,
+# entities moved vs the batch cap, abort count, donor recovery
+# latency in observation windows (the lower-is-better trend series)
+# and the conservation counts across the move (zero lost / zero
+# duplicated is the unconditional gate)
+REBALANCE_SINCE = 19
+REBALANCE_KEYS = ("donor_p99_before_ms", "donor_p99_after_ms",
+                  "entities_moved", "batch", "aborts",
+                  "donor_recovery_windows", "entities_lost",
+                  "entities_duplicated", "decision_log_replay_ok",
+                  "pass")
 MULTI_HEADLINE_KEYS = ("entity_ticks_per_sec_mesh",
                        "per_chip_efficiency", "n_entities", "platform")
 MULTI_GAUGE_KEYS = ("halo_demand_max", "migrate_demand_max",
@@ -305,6 +325,18 @@ def validate_bench(path: str, doc: dict) -> list[str]:
                 if k in fo and not _is_num(fo[k]):
                     errs.append(f"failover {k} malformed: "
                                 f"{fo.get(k)!r:.120}")
+    if rno >= REBALANCE_SINCE:
+        _check_block(rec, "rebalance", REBALANCE_KEYS, errs)
+        rb = rec.get("rebalance")
+        if isinstance(rb, dict) and "error" not in rb \
+                and "skipped" not in rb:
+            for k in ("entities_lost", "entities_duplicated",
+                      "entities_moved", "aborts",
+                      "donor_recovery_windows"):
+                if k in rb and rb[k] is not None \
+                        and not _is_num(rb[k]):
+                    errs.append(f"rebalance {k} malformed: "
+                                f"{rb.get(k)!r:.120}")
     # per-scenario blocks, wherever present: each needs either a
     # headline-style shape or an honest error
     for sc, blk in (rec.get("scenarios") or {}).items():

@@ -28,6 +28,12 @@ regression introduced by the change under test):
   comparable round is an informational NOTE, never a gate (the
   signature describes the workload, not the implementation — but a
   drift next to a perf swing is the first thing to read);
+* ``rebalance`` (ISSUE 19): any lost/duplicated entity across the
+  automated handoff or a failed DecisionLog byte replay in a real
+  latest block is an UNCONDITIONAL failure (conservation needs no
+  prior); ``donor_recovery_windows`` is a lower-is-better series
+  gated against the best prior at the same (entities_moved,
+  platform) shape with +1 window absolute slack;
 * MULTICHIP: the latest record must keep ``ok`` (when any prior round
   had it) and ``rc == 0``; measured mesh headlines (r >= 10) gate
   ``entity_ticks_per_sec_mesh`` against the best prior at the same
@@ -398,6 +404,73 @@ def _check_failover_series(rounds: list, latest: dict, name: str,
             f"(prior {os.path.basename(prev_path)})")
 
 
+def _check_rebalance_series(rounds: list, latest: dict, name: str,
+                            threshold: float, problems: list[str],
+                            notes: list[str]) -> None:
+    """The self-healing rebalance block (ISSUE 19): any lost or
+    duplicated entity across the automated handoff in a real latest
+    block is ALWAYS a problem (conservation needs no prior), as is a
+    failed DecisionLog byte replay; the donor recovery latency (in
+    observation windows, None on an aborted round) is a
+    lower-is-better series gated against the best prior at the same
+    (entities_moved, platform) shape with a 1-window absolute slack
+    (the observe cadence quantizes it)."""
+    def _rb_ok(s) -> bool:
+        return (isinstance(s, dict) and "error" not in s
+                and "skipped" not in s)
+
+    lrb = latest.get("rebalance")
+    if not _rb_ok(lrb):
+        return
+    lost = lrb.get("entities_lost", 0) or 0
+    dup = lrb.get("entities_duplicated", 0) or 0
+    if lost or dup:
+        problems.append(
+            f"{name}: rebalance lost {lost} / duplicated {dup} "
+            "entity id(s) across handoff — conservation must hold")
+    if lrb.get("decision_log_replay_ok") is False:
+        problems.append(
+            f"{name}: rebalance decision log failed byte replay")
+    lat = lrb.get("donor_recovery_windows")
+    if not isinstance(lat, (int, float)):
+        notes.append(f"{name}: rebalance donor recovery latency "
+                     "absent (aborted/degenerate round) — not gated")
+        return
+    rshape = (lrb.get("entities_moved"), latest.get("platform"))
+    rprior = [
+        (p, r["rebalance"]) for p, r in rounds[:-1]
+        if _rb_ok(r.get("rebalance"))
+        and isinstance(r["rebalance"].get("donor_recovery_windows"),
+                       (int, float))
+        and (r["rebalance"].get("entities_moved"),
+             r.get("platform")) == rshape
+    ]
+    if not rprior:
+        notes.append(f"{name}: rebalance shape {rshape} has no prior "
+                     "round — recovery latency not gated")
+        return
+    # recovery latency vs the best (lowest) prior; +1 window absolute
+    # slack (the observe cadence quantizes the number)
+    best_path, best = min(
+        rprior, key=lambda pr: pr[1]["donor_recovery_windows"])
+    ceil = ((1.0 + threshold) * best["donor_recovery_windows"]) + 1
+    if lat > ceil:
+        problems.append(
+            f"{name}: rebalance donor recovery {lat} windows > "
+            f"{ceil:.3g} ({(1 + threshold) * 100:.0f}% of "
+            f"{os.path.basename(best_path)}'s "
+            f"{best['donor_recovery_windows']} + 1)")
+    else:
+        notes.append(
+            f"{name}: rebalance donor recovery {lat} windows vs best "
+            f"prior {best['donor_recovery_windows']} — ok")
+    prev_path, prev = rprior[-1]
+    if prev.get("pass") and not lrb.get("pass"):
+        problems.append(
+            f"{name}: rebalance verdict regressed pass -> fail "
+            f"(prior {os.path.basename(prev_path)})")
+
+
 def check_bench(files: list[str], threshold: float,
                 problems: list[str], notes: list[str]) -> None:
     rounds = []
@@ -437,6 +510,10 @@ def check_bench(files: list[str], threshold: float,
     # conservation gate must fire even on a headline-shape change
     _check_failover_series(rounds, latest, name, threshold,
                            problems, notes)
+    # the self-healing rebalance series (ISSUE 19): same hoisting —
+    # the zero-loss gate must fire even on a headline-shape change
+    _check_rebalance_series(rounds, latest, name, threshold,
+                            problems, notes)
     prior = [(p, r) for p, r in rounds[:-1]
              if _shape(r) == _shape(latest)]
     if not prior:

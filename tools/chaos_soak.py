@@ -43,6 +43,19 @@ conservation verdict, and times the warm promotion against a cold
 chain restore of the same crash (must be >= 10x faster —
 ``run_failover``).
 
+``rebalance`` (ISSUE 19) also runs IN-PROCESS: a donor world under
+sustained-DEGRADED load and an underloaded receiver are watched by the
+real :class:`RebalancePolicy` + :class:`HandoffExecutor` stack; one
+run proves BOTH variants — the clean handoff (fires after
+``hold_windows`` sustained windows, rate-limited cohort moves through
+the production migration hooks, donor recovers to NORMAL within the
+report's window budget, zero entities lost or duplicated, the
+deployment conservation verdict green EVERY window including
+mid-batch, the decision log byte-replayable) and the target-kill abort
+(the receiver dies mid-handoff with a batch in flight; the timeout
+abort must restore every unacked entity LIVE on the source and the
+census must account for every original EntityID) — ``run_rebalance``.
+
 Running either scenario TWICE with the same ``--seed`` must produce
 byte-identical fault/transition behavior — the seeded-replay guarantee
 (tests/test_chaos.py::test_chaos_soak_same_seed_replays_identical_log
@@ -1069,6 +1082,271 @@ def run_failover(seed: int, n: int = FAILOVER_SOAK_N,
         shutil.rmtree(tmpdir, ignore_errors=True)
 
 
+REBALANCE_SOAK_N = 96
+REBALANCE_SOAK_BATCH = 24
+REBALANCE_SOAK_WINDOWS = 20
+REBALANCE_HOLD_WINDOWS = 3
+REBALANCE_COOLDOWN_WINDOWS = 12
+REBALANCE_TIMEOUT_WINDOWS = 4
+# windows from the commit to the donor OBSERVING NORMAL again — the
+# report's recovery budget (clean variant)
+REBALANCE_RECOVERY_BUDGET = 6
+
+
+def _run_rebalance_variant(seed: int, kill_target: bool,
+                           n: int = REBALANCE_SOAK_N,
+                           batch: int = REBALANCE_SOAK_BATCH,
+                           windows: int = REBALANCE_SOAK_WINDOWS
+                           ) -> dict:
+    """One donor/receiver pair driven through the REAL rebalance stack
+    (:class:`RebalancePolicy` + :class:`HandoffExecutor` +
+    :class:`RebalanceController`): the donor world holds a
+    sustained-DEGRADED occupancy proxy, the receiver is an underloaded
+    mirror world, and the transport delivers each pump window's sends
+    one window later (a one-window wire). ``kill_target=False`` proves
+    the clean handoff; ``kill_target=True`` kills the receiver after
+    the first delivered sub-batch — the remaining sends vanish into
+    the dead target, the executor's idle-window timeout must abort,
+    and every unacked entity must come back LIVE on the source with
+    the deployment conservation verdict green the whole way."""
+    from goworld_tpu.rebalance.controller import RebalanceController
+    from goworld_tpu.rebalance.executor import HandoffExecutor
+    from goworld_tpu.rebalance.policy import RebalancePolicy
+    from goworld_tpu.scenarios.runner import build_world
+    from goworld_tpu.scenarios.spec import get_scenario
+    from goworld_tpu.utils import audit as audit_mod
+    from goworld_tpu.utils import flightrec
+
+    variant = "target_kill" if kill_target else "clean"
+    rep: dict = {"variant": variant, "seed": seed, "n": n,
+                 "batch": batch, "windows": windows,
+                 "converged": False}
+    spec = get_scenario("mixed")
+    donor, _ents, _clients = build_world(
+        spec, n=n, skin=4.0, client_frac=0.15, seed=seed)
+    recv = _mirror_world(spec, donor.cfg, game_id=2, seed=seed)
+    recv.create_nil_space()
+    recv_space = recv.create_space("ScnSpace")
+    recv.tick()  # jit warmup off the measured path
+    recv.tick_count = 0
+    try:
+        dap, rap = donor.audit, recv.audit
+        if dap is None or rap is None:
+            rep["error"] = "world built without an audit plane"
+            return rep
+        original = _census(donor)
+        recv_base = _census(recv)  # the receiver's own space entities
+        c0 = len(original)
+        # occupancy-proxy overload stage: DEGRADED while the census
+        # holds at least (c0 - batch/2) entities, so a COMPLETED
+        # handoff of `batch` flips the donor NORMAL and an aborted one
+        # (half the cohort restored) does not — the stage is a pure
+        # deterministic function of world state, seeded-replay safe
+        hot_threshold = c0 - batch // 2
+        rep["hot_threshold"] = hot_threshold
+
+        def stage_of(w) -> str:
+            return ("DEGRADED" if len(_census(w)) >= hot_threshold
+                    else "NORMAL")
+
+        policy = RebalancePolicy(
+            hold_windows=REBALANCE_HOLD_WINDOWS, batch=batch,
+            cooldown_windows=REBALANCE_COOLDOWN_WINDOWS)
+        agent = HandoffExecutor(donor, game_id=donor.game_id,
+                                batch=batch)
+        donor_name = f"game{donor.game_id}"
+        mailbox: list = []
+        receiver_alive = True
+        recv_dead_snap: dict | None = None
+        dropped = delivered = 0
+
+        def transport(action):
+            # the committed action's send callable: one-window wire
+            return lambda eid, data: mailbox.append((eid, data))
+
+        ctl = RebalanceController(
+            policy, agents={donor_name: agent}, transport=transport,
+            rate=max(1, batch // 2),
+            timeout_windows=REBALANCE_TIMEOUT_WINDOWS)
+
+        def deliver() -> None:
+            nonlocal dropped, delivered
+            arriving, mailbox[:] = mailbox[:], []
+            for eid, data in arriving:
+                if not receiver_alive:
+                    dropped += 1  # the dead target never acks
+                    continue
+                recv.restore_from_migration(data, space=recv_space)
+                agent.ack(eid)
+                delivered += 1
+
+        def recv_snapshot() -> dict:
+            # a dead game's planes stop answering; the aggregator (and
+            # this verdict) judges from its LAST scrape
+            if recv_dead_snap is not None:
+                return recv_dead_snap
+            rap.drain()
+            return rap.snapshot(tick=recv.tick_count)
+
+        def verdict() -> dict:
+            dap.drain()
+            return audit_mod.conservation_verdict(
+                [dap.snapshot(tick=donor.tick_count), recv_snapshot()])
+
+        rec = flightrec.FlightRecorder(
+            ring=64, context_fn=dap.incident_context)
+        incidents: list = []
+        verdict_ok_all = True
+        max_in_flight = 0
+        commit_window = recovered_window = None
+        for w_i in range(1, windows + 1):
+            deliver()  # last window's sends arrive on the wire
+            if kill_target and receiver_alive and delivered > 0:
+                # the receiver dies with a sub-batch still queued on
+                # the donor: the worst case — mid-handoff, after acks
+                recv_dead_snap = rap.snapshot(tick=recv.tick_count)
+                receiver_alive = False
+                rep["killed_at_window"] = w_i
+                rep["acked_before_kill"] = delivered
+            donor.tick()
+            if receiver_alive:
+                recv.tick()
+            obs = {
+                donor_name: {"stage": stage_of(donor),
+                             "entities": len(_census(donor)),
+                             "present": True},
+                "game2": {"stage": stage_of(recv),
+                          "entities":
+                              len(_census(recv) - recv_base),
+                          "present": receiver_alive},
+            }
+            if (commit_window is not None and recovered_window is None
+                    and obs[donor_name]["stage"] == "NORMAL"):
+                recovered_window = w_i  # donor OBSERVED healthy again
+            action = ctl.step(obs)
+            if action is not None and commit_window is None:
+                commit_window = w_i
+            v = verdict()
+            max_in_flight = max(max_in_flight, int(v["in_flight"]))
+            if not v["ok"]:
+                verdict_ok_all = False
+                rep.setdefault("verdict_problems", v["problems"])
+            frame = {"tick": donor.tick_count}
+            note = agent.take_action_note()
+            if note is not None:
+                frame["rebalance"] = note
+            incidents.extend(rec.record(frame))
+
+        # ---- the verdicts --------------------------------------------
+        results = [dict(f) for ev, f in policy.log.inputs
+                   if ev == "result"]
+        aborts = [r for r in results if r.get("kind") == "abort"]
+        dones = [r for r in results if r.get("kind") == "done"]
+        donor_final = _census(donor)
+        moved_final = _census(recv) - recv_base
+        lost = sorted(original - (donor_final | moved_final))
+        dup = sorted(donor_final & moved_final)
+        ghosts = sorted((donor_final | moved_final) - original)
+        replay_ok = RebalancePolicy.replay(
+            policy.log.inputs,
+            hold_windows=REBALANCE_HOLD_WINDOWS, batch=batch,
+            cooldown_windows=REBALANCE_COOLDOWN_WINDOWS,
+        ) == policy.log.dump()
+        trigger_fired = sum(
+            1 for i in incidents
+            if i.get("trigger") == "rebalance_action")
+        rep.update({
+            "handoff_fired": commit_window is not None,
+            "commit_window": commit_window,
+            "committed": policy.committed,
+            "entities_moved": len(moved_final),
+            "entities_lost": len(lost),
+            "entities_duplicated": len(dup) + len(ghosts),
+            "lost_eids": lost[:8],
+            "duplicated_eids": (dup + ghosts)[:8],
+            "sends_dropped": dropped,
+            "conservation_ok_all_windows": verdict_ok_all,
+            "max_in_flight_seen": max_in_flight,
+            "decision_log_replay_ok": replay_ok,
+            "rebalance_action_triggers": trigger_fired,
+            "moves_total": agent.snapshot()["moves_total"],
+            "aborts_total": dict(agent.aborts_total),
+            "decision_log": list(policy.log.lines),
+        })
+        zero_loss = not lost and not dup and not ghosts
+        if kill_target:
+            abort = aborts[0] if aborts else {}
+            rep["abort_cause"] = abort.get("cause")
+            rep["entities_restored"] = int(abort.get("restored", 0))
+            rep["converged"] = bool(
+                commit_window is not None
+                and agent.aborted == 1 and not dones
+                and abort.get("cause") == "timeout"
+                # mid-batch: some of the cohort was acked before the
+                # kill, the rest must be restored live on the source
+                and 0 < len(moved_final) < batch
+                and rep["entities_restored"] == batch
+                - len(moved_final)
+                and zero_loss and verdict_ok_all and replay_ok
+                and trigger_fired > 0)
+        else:
+            rep["donor_recovery_windows"] = (
+                None if recovered_window is None or commit_window
+                is None else recovered_window - commit_window)
+            rep["converged"] = bool(
+                commit_window is not None
+                and policy.committed == 1 and agent.completed == 1
+                and not aborts
+                and len(moved_final) == batch
+                and rep["donor_recovery_windows"] is not None
+                and rep["donor_recovery_windows"]
+                <= REBALANCE_RECOVERY_BUDGET
+                and zero_loss and verdict_ok_all
+                # the verdict judged a window with a batch in flight
+                and max_in_flight > 0
+                and replay_ok and trigger_fired > 0)
+        return rep
+    except Exception as exc:
+        rep["error"] = f"{type(exc).__name__}: {str(exc)[:300]}"
+        return rep
+    finally:
+        from goworld_tpu.utils import audit as audit_mod
+
+        audit_mod.unregister(f"game{donor.game_id}")
+        audit_mod.unregister("game2")
+
+
+def run_rebalance(seed: int) -> dict:
+    """The ISSUE-19 self-healing rebalance scenario, in-process like
+    the audit and failover soaks. ONE run proves BOTH halves of the
+    story on the same seed:
+
+    - ``clean``: sustained DEGRADED fires exactly one rate-limited
+      cohort handoff through the production migration machinery, the
+      donor recovers to NORMAL within the recovery budget, zero
+      entities are lost or duplicated, the deployment conservation
+      verdict is green EVERY window (including mid-batch, with the
+      cohort in flight), and the decision log replays byte-for-byte.
+    - ``target_kill``: the receiver dies mid-handoff with a sub-batch
+      unacked; the executor's timeout abort must restore every unacked
+      entity LIVE on the source (ledger out-record/seq machinery —
+      the self-round-trip retires the record), already-acked entities
+      stay moved, and the donor + receiver censuses still partition
+      the original entity set exactly.
+
+    Same-seed reruns replay the same observation stream and therefore
+    the same decision log (the seeded-replay guarantee)."""
+    report: dict = {"scenario": "rebalance", "seed": seed,
+                    "converged": False}
+    report["clean"] = _run_rebalance_variant(seed, kill_target=False)
+    report["target_kill"] = _run_rebalance_variant(
+        seed, kill_target=True)
+    report["converged"] = bool(
+        report["clean"].get("converged")
+        and report["target_kill"].get("converged"))
+    return report
+
+
 def _ini_port(server_dir: str, section: str, key: str) -> int:
     import configparser
 
@@ -1083,10 +1361,10 @@ def main() -> int:
                     help="throwaway server dir (created); required for "
                          "the cluster scenarios (kill, overload), "
                          "unused by the in-process ones "
-                         "(governor, audit)")
+                         "(governor, audit, failover, rebalance)")
     ap.add_argument("--scenario",
                     choices=("kill", "overload", "governor", "audit",
-                             "failover"),
+                             "failover", "rebalance"),
                     default="kill")
     ap.add_argument("--seed", type=int, default=77)
     ap.add_argument("--deposits", type=int, default=25)
@@ -1102,7 +1380,8 @@ def main() -> int:
                          "homogeneous random_walk")
     ap.add_argument("--out", default="chaos_report.json")
     args = ap.parse_args()
-    if args.scenario in ("governor", "audit", "failover"):
+    if args.scenario in ("governor", "audit", "failover",
+                         "rebalance"):
         # in-process (no cluster dir needed): the oracle + entity
         # audits need direct World access; --dir is accepted but
         # unused for symmetry with the other scenarios
@@ -1112,6 +1391,9 @@ def main() -> int:
         elif args.scenario == "failover":
             report = run_failover(args.seed)
             report["workload"] = "failover-churn"
+        elif args.scenario == "rebalance":
+            report = run_rebalance(args.seed)
+            report["workload"] = "rebalance-handoff"
         else:
             report = run_audit(args.seed)
             report["workload"] = "audit-churn"

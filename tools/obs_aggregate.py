@@ -209,6 +209,7 @@ def aggregate(targets: list[tuple], timeout: float = 2.0,
     out["residency"] = aggregate_residency(targets, timeout=timeout)
     out["audit"] = aggregate_audit(targets, timeout=timeout)
     out["standby"] = aggregate_standby(targets, timeout=timeout)
+    out["rebalance"] = aggregate_rebalance(targets, timeout=timeout)
     return out
 
 
@@ -262,6 +263,88 @@ def standby_lines(agg: dict) -> list[str]:
         if s.get("role") == "promoted":
             line += (f" | promoted epoch {s.get('promoted_epoch')} at "
                      f"tick {s.get('promoted_at_tick')}")
+        lines.append(line)
+    return lines
+
+
+def aggregate_rebalance(targets: list[tuple],
+                        timeout: float = 2.0) -> dict:
+    """Scrape every process's ``/rebalance`` plane
+    (goworld_tpu/rebalance/) and collect one record per handoff
+    executor agent plus the deployment controller's snapshot (at most
+    one process hosts it). Processes without the plane answer an
+    honest error dict and are skipped silently (the ``/costs``
+    convention)."""
+    agents: list[dict] = []
+    controller: dict | None = None
+    for label, base in targets:
+        try:
+            payload = _fetch_json(f"{base}/rebalance",
+                                  timeout=timeout)
+        except (urllib.error.URLError, OSError, ValueError):
+            continue
+        if not isinstance(payload, dict) or "error" in payload:
+            continue
+        for name, snap in sorted(
+                (payload.get("agents") or {}).items()):
+            if isinstance(snap, dict):
+                agents.append({"source": f"{label}:{name}", **snap})
+        ctl = payload.get("controller")
+        if isinstance(ctl, dict) and controller is None:
+            controller = {"source": label, **ctl}
+    out: dict = {
+        "agents": agents,
+        "busy": sum(1 for a in agents if a.get("busy")),
+        "moves_total": sum(
+            sum((a.get("moves_total") or {}).values())
+            for a in agents),
+        "aborts_total": sum(
+            sum((a.get("aborts_total") or {}).values())
+            for a in agents),
+    }
+    if controller is not None:
+        out["controller"] = controller
+    return out
+
+
+def rebalance_lines(agg: dict) -> list[str]:
+    """One line per handoff agent with live work or history, plus the
+    controller's decision state (empty when no process carries the
+    plane): a BUSY agent shows the in-flight job (target, acked/sent,
+    unacked backlog — the entities whose loss an abort must undo)."""
+    lines: list[str] = []
+    rb = agg.get("rebalance") or {}
+    for a in rb.get("agents", []):
+        moved = sum((a.get("moves_total") or {}).values())
+        if not (a.get("busy") or a.get("handoffs") or moved):
+            continue  # an idle agent with no history is just wiring
+        line = (f"rebalance {a.get('game')} "
+                f"{'BUSY' if a.get('busy') else 'idle'} | "
+                f"{a.get('handoffs', 0)} handoff(s), "
+                f"{a.get('completed', 0)} done, "
+                f"{a.get('aborted', 0)} aborted")
+        if moved:
+            line += f" | {moved} entities moved"
+        job = a.get("job")
+        if job:
+            line += (f" | -> {job.get('target')} "
+                     f"{job.get('acked')}/{job.get('sent')} acked, "
+                     f"{job.get('unacked')} in flight "
+                     f"({job.get('reason')})")
+        lines.append(line)
+    ctl = rb.get("controller")
+    if ctl:
+        pol = ctl.get("policy") or {}
+        line = (f"rebalance controller ({ctl.get('source')}): "
+                f"window {pol.get('window')}, "
+                f"{pol.get('committed', 0)} committed / "
+                f"{pol.get('planned', 0)} planned")
+        if pol.get("pending"):
+            line += f" | pending {pol['pending']}"
+        if pol.get("runs"):
+            runs = ", ".join(f"{n}:{r}" for n, r in
+                             sorted(pol["runs"].items()))
+            line += f" | hot runs {runs}"
         lines.append(line)
     return lines
 
@@ -526,6 +609,7 @@ def render(agg: dict) -> str:
     if aline:
         lines.append(aline)
     lines += standby_lines(agg)
+    lines += rebalance_lines(agg)
     return "\n".join(lines)
 
 

@@ -260,6 +260,65 @@ def governor_lines(scraped: dict[str, dict]) -> list[str]:
     return lines
 
 
+def scrape_rebalance(targets: list[tuple[str, str]],
+                     timeout: float = 2.0) -> dict[str, dict]:
+    """Fetch each target's ``/rebalance`` (goworld_tpu/rebalance);
+    {label: payload}. Unreachable/404/plane-less processes are
+    skipped silently — the ``/costs`` convention."""
+    out: dict[str, dict] = {}
+    for label, url in targets:
+        rb_url = url.rsplit("/", 1)[0] + "/rebalance"
+        try:
+            with urllib.request.urlopen(rb_url,
+                                        timeout=timeout) as resp:
+                payload = json.loads(
+                    resp.read().decode("utf-8", "replace"))
+        except (urllib.error.URLError, OSError, ValueError):
+            continue
+        if isinstance(payload, dict) and "error" not in payload:
+            out[label] = payload
+    return out
+
+
+def rebalance_lines(scraped: dict[str, dict]) -> list[str]:
+    """One self-healing line per process whose handoff agent has live
+    or historical work (``cli.py status`` prints these under the
+    standby lines); idle agents with no history stay silent — the
+    plane is wiring on every game, news only when a move happened."""
+    lines: list[str] = []
+    for label, payload in sorted(scraped.items()):
+        for name, a in sorted((payload.get("agents") or {}).items()):
+            if not isinstance(a, dict):
+                continue
+            moved = sum((a.get("moves_total") or {}).values())
+            if not (a.get("busy") or a.get("handoffs") or moved):
+                continue
+            line = (f"{label}: rebalance {a.get('game', name)} "
+                    f"{'BUSY' if a.get('busy') else 'idle'} | "
+                    f"{a.get('handoffs', 0)} handoff(s), "
+                    f"{a.get('completed', 0)} done, "
+                    f"{a.get('aborted', 0)} aborted")
+            if moved:
+                line += f" | {moved} entities moved"
+            job = a.get("job")
+            if job:
+                line += (f" | -> {job.get('target')} "
+                         f"{job.get('acked')}/{job.get('sent')} "
+                         f"acked, {job.get('unacked')} in flight")
+            lines.append(line)
+        ctl = payload.get("controller")
+        if isinstance(ctl, dict):
+            pol = ctl.get("policy") or {}
+            line = (f"{label}: rebalance controller window "
+                    f"{pol.get('window')}, "
+                    f"{pol.get('committed', 0)} committed / "
+                    f"{pol.get('planned', 0)} planned")
+            if pol.get("pending"):
+                line += f" | pending {pol['pending']}"
+            lines.append(line)
+    return lines
+
+
 def scrape_residency(targets: list[tuple[str, str]],
                      timeout: float = 2.0,
                      errors: list[str] | None = None) -> dict[str, dict]:
