@@ -337,6 +337,7 @@ def _build_world(gc: config_mod.GameConfig, gid: int) -> World:
         halo_impl=gc.halo_impl,
         pipeline_decode=gc.pipeline_decode and mesh is None
         and not gc.megaspace,
+        resident=gc.resident,
         telemetry_live=gc.telemetry_live,
         snapshot_keyframe_every=gc.snapshot_keyframe_every,
         residency=gc.residency,

@@ -93,10 +93,17 @@ class KernelGovernor:
             down_windows=down_windows,
             cooldown_windows=cooldown_windows,
         )
+        resident = bool(getattr(world, "resident", False))
         self.warmset = WarmSet(
             world.cfg, world.n_spaces, world.policy,
             candidates=candidates,
             telemetry=getattr(world, "telemetry_live", False),
+            # candidate executables carry the World's donation
+            # contract (ISSUE 20) so a swap never changes aliasing;
+            # the fold gating mirrors _init_live_telemetry's
+            donate=resident,
+            donate_fold=resident and not getattr(
+                world, "pipeline_decode", False),
         )
         self.regret_pct = float(regret_pct)
         self.regret_pin_windows = int(regret_pin_windows)

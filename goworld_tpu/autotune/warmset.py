@@ -76,7 +76,14 @@ def carry_state(state, old_cfg, new_cfg, *, stacked: bool = True):
     Only the Verlet cache is config-shaped; everything else carries
     bit-identically. A fresh cache is allocated INVALID, so the first
     tick under the new config rebuilds the front half — the swap is
-    exact from its very first tick."""
+    exact from its very first tick.
+
+    Resident-world note (ISSUE 20): this runs BETWEEN dispatches on
+    the carry the last tick RETURNED (apply_tick_config rebinds
+    ``world.state`` to the result), so under carry donation every leaf
+    read here is live — the deleted buffers are the PREVIOUS tick's
+    inputs, which this function never sees. Callers must not pass a
+    state reference captured before an intervening tick."""
     import jax
     import jax.numpy as jnp
 
@@ -125,7 +132,9 @@ class WarmSet:
 
     def __init__(self, cfg, n_spaces: int, policy=None, *,
                  candidates=DEFAULT_CANDIDATES,
-                 telemetry: bool = True):
+                 telemetry: bool = True,
+                 donate: bool = False,
+                 donate_fold: bool = False):
         if n_spaces != 1:
             raise ValueError(
                 "WarmSet serves the single-shard production shape "
@@ -136,6 +145,14 @@ class WarmSet:
         self.policy = policy
         self.candidates = tuple(candidates)
         self.telemetry = telemetry
+        # resident-world donation (ISSUE 20): every candidate
+        # executable is compiled with the SAME donation contract as
+        # the World it will swap into — AOT lower().compile()
+        # preserves donate_argnums, so a swap never changes the
+        # carry's aliasing behavior. donate_fold mirrors the World's
+        # fold gating (off under pipeline_decode).
+        self.donate = donate
+        self.donate_fold = donate_fold
         self._entries: dict[str, WarmEntry] = {}
         self._lock = threading.Lock()
         self._inflight: set[str] = set()
@@ -266,7 +283,8 @@ class WarmSet:
                 self.base_cfg, candidate_overrides(label,
                                                    self.candidates))
             entry = WarmEntry(label=label, cfg=cfg2)
-            step = _make_local_tick(cfg2, self.n_spaces)
+            step = _make_local_tick(cfg2, self.n_spaces,
+                                    donate=self.donate)
             # templates, never real arrays: eval_shape gives the exact
             # avals the live tick passes (fixed shapes by construction)
             tstate = jax.eval_shape(
@@ -319,10 +337,12 @@ class WarmSet:
             n_tiles=self.n_spaces)
         half_skin = entry.half_skin
 
-        @jax.jit
         def _fold(acc, outs):
             return telem.telemetry_update_live(
                 acc, outs, mega=False, half_skin=half_skin)
+
+        _fold = jax.jit(
+            _fold, donate_argnums=(0,) if self.donate_fold else ())
 
         # the fold's outs aval is the step's own output template
         _, touts = jax.eval_shape(step, tstate, tinputs, tpolicy)

@@ -142,6 +142,13 @@ class GameConfig:
     # their sum. Cost: client-visible events lag one tick (~one
     # position-sync interval).
     pipeline_decode: bool = False
+    # resident-world runtime (ISSUE 20): donate the SpaceState carry
+    # into the tick so XLA aliases it in place — zero steady-state
+    # HBM allocation on the serve loop. Bit-identical to off (donation
+    # is an aliasing hint, not a numerics change); snapshot/freeze
+    # paths fall back LOUDLY to an explicit device copy of the planes
+    # they read across ticks. Default on.
+    resident: bool = True
     extent_x: float = 1000.0
     extent_z: float = 1000.0
     mesh_devices: int = 0  # 0 = single-device vmap path (GLOBAL count
@@ -629,6 +636,11 @@ extent_z = 1000.0
 # pipeline_decode = true   # overlap host event decode with the device
 #                          # step (single-controller non-mesh games;
 #                          # client events lag one tick)
+# resident = true          # carry donation: XLA aliases the SpaceState
+#                          # in place, zero steady-state HBM allocation
+#                          # (default ON; bit-identical either way —
+#                          # snapshot capture falls back loudly to a
+#                          # device copy of the planes it pins)
 # http_port = 16000        # debug/metrics endpoint (multihost ranks
 #                          # bind http_port + rank)
 # gc_freeze = false        # keep boot objects in the cyclic GC (the

@@ -93,7 +93,8 @@ def _type_aoi_radius(desc) -> float:
     return float("inf")
 
 
-def _make_local_tick(cfg: WorldConfig, n_spaces: int = 1):
+def _make_local_tick(cfg: WorldConfig, n_spaces: int = 1,
+                     donate: bool = False):
     """Stacked-spaces step on ONE device — the single-process analog of
     the mesh's shard_map step. n_spaces == 1 (the common production
     shape) calls tick_body directly on the squeezed state, so runtime
@@ -101,9 +102,19 @@ def _make_local_tick(cfg: WorldConfig, n_spaces: int = 1):
     tiers AND the Verlet skin's rebuild-vs-reuse dispatch both work.
     n_spaces > 1 vmaps, where cond batches to select_n (both branches
     execute every tick) — the adaptive tiers and the skin are cleared
-    there because each would be a strict pessimization under vmap."""
+    there because each would be a strict pessimization under vmap.
+
+    donate=True marks the SpaceState carry (arg 0) as donated: XLA
+    aliases the output carry onto the input buffers (the resident-world
+    contract), which DELETES the caller's old carry after dispatch —
+    every host-side reader must use the returned state or an explicit
+    device copy taken before the call. keep_unused rides donation:
+    lanes the behavior doesn't read (e.g. old nbr_cnt under
+    random_walk) would otherwise be PRUNED from the computation and
+    lose their donation source — fresh buffers every tick for exactly
+    those lanes."""
+    dn = (0,) if donate else ()
     if n_spaces == 1:
-        @jax.jit
         def step1(state, inputs, policy):
             s1, out = tick_body(
                 cfg,
@@ -114,20 +125,44 @@ def _make_local_tick(cfg: WorldConfig, n_spaces: int = 1):
             return (jax.tree.map(lambda x: x[None], s1),
                     jax.tree.map(lambda x: x[None], out))
 
-        return step1
+        return jax.jit(step1, donate_argnums=dn, keep_unused=donate)
 
     cfg = dataclasses.replace(
         cfg, adaptive_extract=False,
         grid=dataclasses.replace(cfg.grid, skin=0.0),
     )
 
-    @jax.jit
     def step(state, inputs, policy):
         return jax.vmap(
             lambda s, i: tick_body(cfg, s, i, policy)
         )(state, inputs)
 
-    return step
+    return jax.jit(step, donate_argnums=dn, keep_unused=donate)
+
+
+def _start_host_copy(tree) -> None:
+    """Double-buffered output drain (ISSUE 20): kick off the async D2H
+    copy of every leaf in ``tree`` NOW, so the transfer of tick T's
+    parked output lanes (TickOutputs, telemetry accumulator, sync-age
+    anchor rides them) overlaps the device's compute of tick T+1 —
+    next tick's blocking fetch then finds the bytes already staged
+    host-side. Best-effort: a backend without copy_to_host_async just
+    keeps the old serial fetch.
+
+    Skipped entirely on the CPU backend: the buffers are already
+    host-resident there, and copy_to_host_async on a still-executing
+    output WAITS for the producing computation — the prefetch would
+    serialize the very overlap it exists to buy."""
+    if tree is None or jax.default_backend() == "cpu":
+        return
+    for leaf in jax.tree.leaves(tree):
+        start = getattr(leaf, "copy_to_host_async", None)
+        if start is None:
+            continue
+        try:
+            start()
+        except Exception:
+            return
 
 
 class AdmissionPausedError(RuntimeError):
@@ -166,6 +201,7 @@ class World:
         halo_impl: str = "ppermute",
         mega_shape: tuple[int, int] | None = None,
         pipeline_decode: bool = False,
+        resident: bool = True,
         telemetry_live: bool = True,
         snapshot_keyframe_every: int = 0,
         residency: bool = True,
@@ -206,6 +242,16 @@ class World:
                 "non-megaspace World"
             )
         self.pipeline_decode = pipeline_decode
+        # resident-world runtime (ISSUE 20): donate the SpaceState carry
+        # into the tick so XLA aliases it in place — zero steady-state
+        # HBM allocation on the serve loop. The old carry is DELETED
+        # after every dispatch; planes that capture a state reference
+        # across a tick (freeze/snapshot) fence with an explicit device
+        # copy instead (loud one-time copy-mode log). Bit-identical to
+        # resident=False by construction: donation is an allocator
+        # aliasing hint, never a numerics change.
+        self.resident = resident
+        self._resident_copy_warned = False
         self._pending_outs = None
         if mesh is not None and mesh.devices.size != n_spaces:
             raise ValueError(
@@ -239,7 +285,8 @@ class World:
             self.state = shard_state(
                 create_mega_state(self.mega, seed=seed), mesh
             )
-            self._step = make_mega_tick(self.mega, mesh)
+            self._step = make_mega_tick(self.mega, mesh,
+                                        donate=resident)
         else:
             state_cfg = cfg
             if mesh is None and n_spaces > 1 and cfg.grid.skin > 0:
@@ -260,10 +307,12 @@ class World:
 
                 self.state = shard_state(self.state, mesh)
                 self._step = make_multi_tick(
-                    cfg, mesh, migrate_cap=migrate_cap
+                    cfg, mesh, migrate_cap=migrate_cap,
+                    donate=resident,
                 )
             else:
-                self._step = _make_local_tick(cfg, n_spaces)
+                self._step = _make_local_tick(cfg, n_spaces,
+                                              donate=resident)
 
         # device-plane cost observability (utils/devprof, served at
         # debug_http /costs): register the compiled step as a LAZY
@@ -416,6 +465,23 @@ class World:
         self._batch_pos_vals: np.ndarray | None = None
         self._batch_pos_any = False
         self._sync_index: tuple | None = None
+        # pinned host staging (ISSUE 20): the flush-staging scatter and
+        # the sync-record fan-out reuse these preallocated host buffers
+        # instead of fresh numpy allocations per tick — together with
+        # carry donation this makes the steady-state serve loop
+        # allocation-free on the host side too. The input-staging
+        # trio is zeroed before reuse (the device consumer reads only
+        # rows < counts, but zero-fill keeps the transfer deterministic);
+        # the sync scratch is gather-overwritten up to sn each flush and
+        # never escapes _process_outputs (boolean-masked COPIES go to
+        # the sync sink).
+        ic = cfg.input_cap
+        self._pin_idx = np.zeros((n_spaces, ic), np.int32)
+        self._pin_vals = np.zeros((n_spaces, ic, 4), np.float32)
+        self._pin_counts = np.zeros((n_spaces,), np.int32)
+        self._scr_cid = np.zeros((cfg.sync_cap,), "S16")
+        self._scr_gate = np.zeros((cfg.sync_cap,), np.int32)
+        self._scr_eid = np.zeros((cfg.sync_cap,), "S16")
         # (src_shard, src_slot, dst_shard, eid) — device-migration requests
         self._staged_migrate: list[tuple[int, int, int, str]] = []
         self._migrate_tags: dict[int, tuple[str, int, int]] = {}
@@ -1513,12 +1579,18 @@ class World:
             skin_on, mega=mega, occupancy=True, n_tiles=self.n_spaces)
         half_skin = self._telem_half_skin
 
-        @jax.jit
         def _fold(acc, outs):
             return telem.telemetry_update_live(
                 acc, outs, mega=mega, half_skin=half_skin)
 
-        self._telem_fn = _fold
+        # resident worlds donate the accumulator carry too — EXCEPT
+        # under pipeline_decode, where the fold of tick N consumes
+        # acc_{N-1} while _pending_telem still owes that same buffer to
+        # the next tick's host fetch (donating would delete it mid-
+        # flight)
+        fold_dn = (0,) if (self.resident and not self.pipeline_decode) \
+            else ()
+        self._telem_fn = jax.jit(_fold, donate_argnums=fold_dn)
 
     def _ingest_telemetry(self, acc_host) -> None:
         """Host half of the live lanes (called with the accumulator
@@ -1793,6 +1865,11 @@ class World:
             # the device_tick lane honestly includes the pipeline skew
             age_mark, self._age_pending_mark = \
                 self._age_pending_mark, age_mark
+            # double-buffered drain (ISSUE 20): the lanes just parked
+            # above (this tick's outs + accumulator) start their D2H
+            # immediately so the copy overlaps the NEXT tick's compute
+            _start_host_copy(self._pending_outs)
+            _start_host_copy(self._pending_telem)
         # audit-oracle cohort planes (ISSUE 17): on a sample tick the
         # judged shard's pos/alive/aoi_radius ride the SAME combined
         # fetch below — the lazy device slices cost nothing to build
@@ -2269,11 +2346,17 @@ class World:
             )
             self._staged_client.clear()
 
-        # position-sync inputs -> TickInputs [S, IC]
+        # position-sync inputs -> TickInputs [S, IC]: pinned host
+        # staging (ISSUE 20) — the preallocated trio is zeroed and
+        # refilled in place instead of three fresh numpy allocations
+        # per tick
         ic = cfg.input_cap
-        idx = np.zeros((self.n_spaces, ic), np.int32)
-        vals = np.zeros((self.n_spaces, ic, 4), np.float32)
-        counts = np.zeros((self.n_spaces,), np.int32)
+        idx = self._pin_idx
+        vals = self._pin_vals
+        counts = self._pin_counts
+        idx.fill(0)
+        vals.fill(0)
+        counts.fill(0)
         entries = list(self._staged_pos.items())
         # a set_position without set_yaw must keep the current device yaw
         # (apply_pos_inputs scatters all four lanes); batch-gather the
@@ -2346,10 +2429,13 @@ class World:
                 )
             self._batch_pos_any = bool(bm.any())
 
+        # jnp.array (NOT asarray): asarray may zero-copy-alias the host
+        # buffer on CPU backends, and the pinned trio is overwritten
+        # next tick while the device step could still be reading it
         base = TickInputs(
-            pos_sync_idx=jnp.asarray(idx),
-            pos_sync_vals=jnp.asarray(vals),
-            pos_sync_n=jnp.asarray(counts),
+            pos_sync_idx=jnp.array(idx),
+            pos_sync_vals=jnp.array(vals),
+            pos_sync_n=jnp.array(counts),
         )
         self.state = st
 
@@ -2556,8 +2642,15 @@ class World:
                     # at 1M-entity sync volumes would rival the device
                     # tick itself (the reference's per-entity Go loop,
                     # Entity.go:1208-1267, has the same shape)
-                    cids = self._mir_cid[shard, ws]
-                    gates = self._mir_gate[shard, ws]
+                    # pinned staging (ISSUE 20): gather into the
+                    # preallocated scratch (sn <= sync_cap by
+                    # construction) — the boolean-masked selections
+                    # below COPY, so the scratch never escapes this
+                    # method
+                    cids = np.take(self._mir_cid[shard], ws,
+                                   out=self._scr_cid[:sn])
+                    gates = np.take(self._mir_gate[shard], ws,
+                                    out=self._scr_gate[:sn])
                     if self.mega is not None:
                         tiles = js // cfg.capacity
                         ok_sub = tiles < self.n_spaces
@@ -2567,7 +2660,8 @@ class World:
                         ]
                     else:
                         ok_sub = np.ones(len(js), bool)
-                        jeids = self._mir_eid[shard, js]
+                        jeids = np.take(self._mir_eid[shard], js,
+                                        out=self._scr_eid[:sn])
                     ok = (cids != b"") & (jeids != b"") & ok_sub
                     for gate_id in np.unique(gates[ok]):
                         m = ok & (gates == gate_id)

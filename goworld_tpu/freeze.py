@@ -411,6 +411,36 @@ class CheckpointHandle:
         return self
 
 
+def _pin_snapshot_planes(world):
+    """Resident-world fence (ISSUE 20): background snapshot workers
+    fetch pos/yaw/npc_moving from a state reference captured on the
+    tick thread — under carry donation the NEXT tick DELETES those
+    buffers, and the deferred ``jax.device_get`` would raise on a
+    deleted array mid-write. When the world is resident, pin the three
+    planes with an explicit device copy taken NOW (between ticks, on
+    the tick thread): the copies are fresh buffers the donated step
+    never sees, so they survive any number of subsequent ticks.
+    Non-resident worlds keep the zero-copy capture of the immutable
+    state pytree. The fallback is loud once per world — an operator
+    sizing snapshot cost should know the copy-mode tax exists."""
+    state = world.state
+    if not getattr(world, "resident", False):
+        return state
+    if not getattr(world, "_resident_copy_warned", True):
+        world._resident_copy_warned = True
+        logger.info(
+            "resident world %s: snapshot capture pins pos/yaw/"
+            "npc_moving with a device copy (carry donation deletes "
+            "the live buffers next tick)", world.game_id)
+    from types import SimpleNamespace
+
+    return SimpleNamespace(
+        pos=jax.numpy.copy(state.pos),
+        yaw=jax.numpy.copy(state.yaw),
+        npc_moving=jax.numpy.copy(state.npc_moving),
+    )
+
+
 def checkpoint_async(world: World, directory: str = ".") -> CheckpointHandle:
     """Snapshot a RUNNING world without stalling its tick loop.
 
@@ -441,7 +471,9 @@ def checkpoint_async(world: World, directory: str = ".") -> CheckpointHandle:
         # calls come from the logic thread, so a plain flag suffices
         raise RuntimeError("a checkpoint is already in flight")
     world._ckpt_inflight = True
-    state_ref = world.state            # immutable pytree: the snapshot
+    state_ref = _pin_snapshot_planes(world)  # the snapshot (pinned
+    # device copies when the world donates its carry, else the
+    # immutable pytree itself)
     data = freeze_world(world, _snap=_DEFER, run_hooks=False)
     path = os.path.join(directory, checkpoint_filename(world.game_id))
     handle = CheckpointHandle()
@@ -704,9 +736,10 @@ class SnapshotChain:
     def capture(self) -> tuple:
         """Tick-thread half of an off-thread chain write: host records
         with (shard, slot) plane refs deferred (no device read) plus
-        the immutable state pytree to fetch them from later. Pair with
-        :meth:`complete_capture` on the worker thread."""
-        state_ref = self.world.state
+        the captured planes to fetch them from later (pinned device
+        copies on a resident world — see :func:`_pin_snapshot_planes`).
+        Pair with :meth:`complete_capture` on the worker thread."""
+        state_ref = _pin_snapshot_planes(self.world)
         data = freeze_world(self.world, _snap=_DEFER, run_hooks=False)
         return data, state_ref, int(self.world.tick_count)
 

@@ -203,11 +203,13 @@ def create_mega_state(mc: MegaConfig, seed: int = 0) -> SpaceState:
     )
 
 
-def make_mega_tick(mc: MegaConfig, mesh: Mesh):
+def make_mega_tick(mc: MegaConfig, mesh: Mesh, donate: bool = False):
     """Build the jitted megaspace step. Signature matches make_multi_tick:
     ``step(states, inputs, policy) -> (states, MegaTickOutputs)`` with
     leading [n_dev] axes; ``inputs.migrate_target`` is ignored (tile
-    migration is automatic from position)."""
+    migration is automatic from position). donate=True donates the state
+    carry (arg 0): XLA aliases output shards in place and deletes the
+    caller's old carry (resident-world contract, entity/manager.py)."""
     cfg = mc.cfg
     n = cfg.capacity
     n_dev = mc.n_dev
@@ -449,4 +451,7 @@ def make_mega_tick(mc: MegaConfig, mesh: Mesh):
         in_specs=(P(SPACE_AXIS), P(SPACE_AXIS), P()),
         out_specs=(P(SPACE_AXIS), P(SPACE_AXIS)),
     )
-    return jax.jit(mapped)
+    # keep_unused: behavior-dead carry lanes must stay parameters or
+    # they lose their donation source (see _make_local_tick)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else (),
+                   keep_unused=donate)

@@ -60,11 +60,15 @@ class MultiTickOutputs:
     global_alive: jax.Array    # i32[n_dev] (identical on every shard; psum)
 
 
-def make_multi_tick(cfg: WorldConfig, mesh: Mesh, migrate_cap: int = 256):
+def make_multi_tick(cfg: WorldConfig, mesh: Mesh, migrate_cap: int = 256,
+                    donate: bool = False):
     """Build the jitted multi-space step over ``mesh``.
 
     Returns ``step(states, inputs, policy) -> (states, outputs)`` where
     every array carries a leading [n_dev] axis sharded over "space".
+    donate=True donates the state carry (arg 0) so XLA aliases the
+    output shards in place — the caller's old carry is deleted after
+    dispatch (resident-world contract, see entity/manager.py).
     """
     n_dev = mesh.devices.size
 
@@ -117,4 +121,7 @@ def make_multi_tick(cfg: WorldConfig, mesh: Mesh, migrate_cap: int = 256):
         in_specs=(P(SPACE_AXIS), P(SPACE_AXIS), P()),
         out_specs=(P(SPACE_AXIS), P(SPACE_AXIS)),
     )
-    return jax.jit(mapped)
+    # keep_unused: behavior-dead carry lanes must stay parameters or
+    # they lose their donation source (see _make_local_tick)
+    return jax.jit(mapped, donate_argnums=(0,) if donate else (),
+                   keep_unused=donate)

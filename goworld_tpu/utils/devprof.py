@@ -106,7 +106,14 @@ class CostReport:
     # bound donate_argnums could still reclaim — the overlap of
     # argument and output footprints not yet aliased. temp vs arg split
     # is readable directly off temp_size/argument_size above.
+    # donation_applied (ISSUE 20) is the "did reclaim" column next to
+    # donation_reclaimable's "could reclaim": the actual aliased bytes
+    # from the executable's input-output aliasing — 0 on a
+    # non-resident world, ~= the carry footprint once donate_argnums
+    # is threaded (alias_size under a different, operator-facing name
+    # so /costs and the bench cost_report read as a pair).
     alias_size: int | None = None
+    donation_applied: int | None = None
     donation_reclaimable: int | None = None
     n: int | None = None
     # multichip mode: device count of the mesh executable (cost figures
@@ -194,6 +201,7 @@ def cost_report(fn, *args, name: str = "tick", config: dict | None = None,
             # smaller of the two footprints minus that.
             alias = int(getattr(ma, "alias_size_in_bytes", 0) or 0)
             rep.alias_size = alias
+            rep.donation_applied = alias
             rep.donation_reclaimable = max(
                 0, min(rep.argument_size, rep.output_size) - alias)
     except Exception as exc:
@@ -352,6 +360,11 @@ def roofline_audit(phase_ms: dict, phase_costs: dict, n: int,
                 # this phase's executable (ROADMAP item 5's budget)
                 row["donation_reclaimable_mb"] = round(
                     crd["donation_reclaimable"] / 1e6, 3)
+            if crd.get("donation_applied") is not None:
+                # ...and what donation ALREADY reclaimed (ISSUE 20):
+                # could-vs-did as a pair
+                row["donation_applied_mb"] = round(
+                    crd["donation_applied"] / 1e6, 3)
             if crd.get("error"):
                 row["cost_error"] = crd["error"]
         if name in phase_ms:

@@ -86,10 +86,23 @@ DEFAULT_SAMPLE_EVERY = 16
 DEFAULT_BUBBLE_BUDGET_MS = 4.0
 
 
-def _leaf_pointer(leaf) -> int | None:
-    """Device buffer address of one pytree leaf, or None when the leaf
-    has no inspectable buffer (sharded across devices, non-array, ...).
-    Reads the address only — no transfer, no sync."""
+# sentinel for a DELETED leaf (carry donation consumed it): distinct
+# from None/opaque — the census counts these honestly instead of
+# treating a dead buffer as uninspectable
+_DELETED = object()
+
+
+def _leaf_pointer(leaf):
+    """Device buffer address of one pytree leaf; ``_DELETED`` when the
+    buffer was consumed by donation (``unsafe_buffer_pointer`` would
+    raise); None when the leaf has no inspectable buffer (sharded
+    across devices, non-array, ...). Reads the address only — no
+    transfer, no sync."""
+    try:
+        if leaf.is_deleted():
+            return _DELETED
+    except Exception:
+        pass
     try:
         return int(leaf.unsafe_buffer_pointer())
     except Exception:
@@ -276,6 +289,10 @@ class ResidencyTracker:
         self._census_changes: dict[str, int] = {}
         self._census_opaque: set[str] = set()
         self._census_samples = 0
+        # lanes seen deleted (donation consumed the sampled handle —
+        # a caller passed the OLD carry); counted honestly, never a
+        # crash: this plane is the one that judges donation
+        self._census_skipped_deleted = 0
         # window mark for the flight-recorder regression trigger
         self._win_mark: list[int] | None = None
 
@@ -396,7 +413,13 @@ class ResidencyTracker:
         device buffer address. Lanes whose address changes between
         samples are re-allocated by XLA each tick — the worklist
         ``donate_argnums`` will consume; stable addresses are already
-        aliased in place. Address reads only — no transfer, no sync."""
+        aliased in place. Address reads only — no transfer, no sync.
+
+        Donation-safe: callers pass the POST-dispatch carry (the state
+        the step returned), whose buffers are live by construction.
+        A deleted leaf (someone sampled an old carry donation already
+        consumed) is counted in ``census_skipped_deleted`` and skipped
+        — the plane that judges donation must never crash on it."""
         try:
             import jax
 
@@ -404,14 +427,18 @@ class ResidencyTracker:
         except Exception:
             return
         ptrs: dict[str, int] = {}
+        skipped = 0
         for path, leaf in leaves:
             lane = jax.tree_util.keystr(path).lstrip(".")
             p = _leaf_pointer(leaf)
-            if p is None:
+            if p is _DELETED:
+                skipped += 1
+            elif p is None:
                 self._census_opaque.add(lane)
             else:
                 ptrs[lane] = p
         with self._lock:
+            self._census_skipped_deleted += skipped
             prev, self._census_prev = self._census_prev, ptrs
             if prev is None:
                 return
@@ -456,12 +483,14 @@ class ResidencyTracker:
             changes = dict(self._census_changes)
             samples = self._census_samples
             opaque = sorted(self._census_opaque)
+            skipped = self._census_skipped_deleted
         return {
             "samples": samples,
             "lanes": len(changes),
             "realloc": sorted(l for l, c in changes.items() if c > 0),
             "aliased": sorted(l for l, c in changes.items() if c == 0),
             "opaque": opaque,
+            "skipped_deleted": skipped,
             "changes": {l: c for l, c in sorted(changes.items())},
         }
 

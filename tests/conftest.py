@@ -144,6 +144,16 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "resident: resident-world runtime suites (carry donation "
+        "deleted-buffer fencing on freeze/census/governor paths, "
+        "donation on/off bit-parity across the skin/precision/vmap "
+        "matrix, mid-churn governor swap exactness under donation, "
+        "the 0-realloc census verdict, the resident_ab trend gate — "
+        "tests/test_resident.py); all run in tier-1 on CPU "
+        "(docs/OBSERVABILITY.md \"Serve-loop residency\")",
+    )
+    config.addinivalue_line(
+        "markers",
         "rebalance: self-healing deployment rebalance suites "
         "(goworld_tpu/rebalance — sustained-DEGRADED hold/hysteresis "
         "policy, ping-pong cooldown suppression, plan-window "

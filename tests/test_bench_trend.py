@@ -719,3 +719,100 @@ def test_rebalance_pass_to_fail_and_shape_change(tmp_path):
     r2b["rebalance"] = _rb_block(2, lost=1, passed=False)
     f2b = _write(tmp_path, "BENCH_r02.json", r2b)
     assert TREND.main([f1, f2b]) == 2
+
+
+def _ra_block(ratio=0.96, on_realloc=0, off_realloc=19, passed=None):
+    return {
+        "entities": 192,
+        "capacity": 1024,
+        "windows": 6,
+        "ticks_per_window": 24,
+        "tick_hz": 30.0,
+        "on_ms_per_tick": round(30.0 * ratio, 3),
+        "off_ms_per_tick": 30.0,
+        "ratio": ratio,
+        "on_census": {"samples": 12, "realloc": on_realloc,
+                      "aliased": 19 - on_realloc,
+                      "skipped_deleted": 0},
+        "off_census": {"samples": 12, "realloc": off_realloc,
+                       "aliased": 19 - off_realloc,
+                       "skipped_deleted": 0},
+        "pass": ((on_realloc == 0 and off_realloc >= 1
+                  and ratio < 1.0) if passed is None else passed),
+    }
+
+
+def test_resident_ab_on_arm_realloc_always_fails(tmp_path):
+    """ISSUE 20: ANY re-allocated carry lane in the donation-on arm's
+    census fails unconditionally — the resident runtime's contract is
+    zero steady-state allocation and needs no prior round; an off arm
+    that also reads zero means the A/B measured nothing."""
+    r1 = _bench_rec(1000.0)  # prior round without a resident_ab block
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    r2 = _bench_rec(1000.0)
+    r2["resident_ab"] = _ra_block(on_realloc=3, passed=False)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 2
+    # a list-typed census realloc (the raw snapshot form) gates too
+    r2b = _bench_rec(1000.0)
+    r2b["resident_ab"] = _ra_block(passed=False)
+    r2b["resident_ab"]["on_census"]["realloc"] = ["pos", "vel"]
+    f2b = _write(tmp_path, "BENCH_r02.json", r2b)
+    assert TREND.main([f1, f2b]) == 2
+    # an off arm with zero churn measured nothing: flagged
+    r2c = _bench_rec(1000.0)
+    r2c["resident_ab"] = _ra_block(off_realloc=0, passed=False)
+    f2c = _write(tmp_path, "BENCH_r02.json", r2c)
+    assert TREND.main([f1, f2c]) == 2
+    # a clean block with no prior is a new anchor, not a gate
+    r2d = _bench_rec(1000.0)
+    r2d["resident_ab"] = _ra_block()
+    f2d = _write(tmp_path, "BENCH_r02.json", r2d)
+    assert TREND.main([f1, f2d]) == 0
+
+
+def test_resident_ab_ratio_lower_is_better(tmp_path):
+    """The on/off ratio gates against the best (lowest) prior at the
+    same (entities, platform) shape — a pure ratio, no absolute
+    slack; an honest skip neither gates nor anchors."""
+    r1 = _bench_rec(1000.0)
+    r1["resident_ab"] = _ra_block(ratio=0.90)
+    r2 = _bench_rec(1000.0)
+    r2["resident_ab"] = _ra_block(ratio=0.96)  # within 1.3x of 0.90
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 0
+    # injected regression: headline flat, on arm now 1.5x the off arm
+    r3 = _bench_rec(1000.0)
+    r3["resident_ab"] = _ra_block(ratio=1.5, passed=False)
+    f3 = _write(tmp_path, "BENCH_r03.json", r3)
+    assert TREND.main([f1, f2, f3]) == 2
+    # an honest skip neither gates nor anchors
+    r3b = _bench_rec(1000.0)
+    r3b["resident_ab"] = {"skipped": "BENCH_RESIDENT_AB=0"}
+    f3b = _write(tmp_path, "BENCH_r03.json", r3b)
+    assert TREND.main([f1, f2, f3b]) == 0
+    # a different entity count is a different series
+    r3c = _bench_rec(1000.0)
+    r3c["resident_ab"] = _ra_block(ratio=1.5, passed=False)
+    r3c["resident_ab"]["entities"] = 48
+    f3c = _write(tmp_path, "BENCH_r03.json", r3c)
+    assert TREND.main([f1, f2, f3c]) == 0
+
+
+def test_resident_ab_pass_to_fail_and_shape_change(tmp_path):
+    """A verdict flip pass -> fail at the same shape always fails;
+    the zero-realloc gate survives a headline-shape change (the early
+    headline return must not swallow it)."""
+    r1 = _bench_rec(1000.0)
+    r1["resident_ab"] = _ra_block()
+    r2 = _bench_rec(1000.0)
+    r2["resident_ab"] = _ra_block(passed=False)
+    f1 = _write(tmp_path, "BENCH_r01.json", r1)
+    f2 = _write(tmp_path, "BENCH_r02.json", r2)
+    assert TREND.main([f1, f2]) == 2
+    # headline shape change + an on-arm realloc: still gated
+    r2b = _bench_rec(5000.0, entities=4096)
+    r2b["resident_ab"] = _ra_block(on_realloc=2, passed=False)
+    f2b = _write(tmp_path, "BENCH_r02.json", r2b)
+    assert TREND.main([f1, f2b]) == 2

@@ -76,7 +76,15 @@ contract):
   series) and the conservation counts across the move (zero lost /
   zero duplicated is the unconditional gate), plus the byte-identical
   DecisionLog replay verdict — honest ``{"error"/"skipped": ...}``
-  records accepted.
+  records accepted;
+* rounds >= 20 (the resident-world era, ISSUE 20): a ``resident_ab``
+  block — serve-loop ms/tick with carry donation + the
+  double-buffered drain on vs off at the same shape (the
+  interleaved paced-window protocol), the residency census counts
+  for BOTH arms (0 re-allocated lanes on the donated arm is the
+  trend gate; >= 1 on the copy arm proves the A/B measured the
+  knob) and allocs/tick where the backend serves memory_stats —
+  honest ``{"error"/"skipped": ...}`` records accepted.
 
 Exit codes: 0 all valid, 1 usage/missing, 2 schema violations.
 """
@@ -182,6 +190,15 @@ REBALANCE_KEYS = ("donor_p99_before_ms", "donor_p99_after_ms",
                   "donor_recovery_windows", "entities_lost",
                   "entities_duplicated", "decision_log_replay_ok",
                   "pass")
+# the resident-world era (ISSUE 20): every BENCH round stamps the
+# donation + double-buffered-drain A/B — serve-loop ms/tick on vs off
+# at the same shape, the residency census counts on BOTH arms (the
+# donated arm's 0-realloc verdict is the trend gate) and allocs/tick
+# where the backend serves memory_stats
+RESIDENT_AB_SINCE = 20
+RESIDENT_AB_KEYS = ("on_ms_per_tick", "off_ms_per_tick", "ratio",
+                    "on_census", "off_census", "windows",
+                    "ticks_per_window", "pass")
 MULTI_HEADLINE_KEYS = ("entity_ticks_per_sec_mesh",
                        "per_chip_efficiency", "n_entities", "platform")
 MULTI_GAUGE_KEYS = ("halo_demand_max", "migrate_demand_max",
@@ -337,6 +354,22 @@ def validate_bench(path: str, doc: dict) -> list[str]:
                         and not _is_num(rb[k]):
                     errs.append(f"rebalance {k} malformed: "
                                 f"{rb.get(k)!r:.120}")
+    if rno >= RESIDENT_AB_SINCE:
+        _check_block(rec, "resident_ab", RESIDENT_AB_KEYS, errs)
+        ra = rec.get("resident_ab")
+        if isinstance(ra, dict) and "error" not in ra \
+                and "skipped" not in ra:
+            for k in ("on_ms_per_tick", "off_ms_per_tick", "ratio"):
+                if not _is_num(ra.get(k)):
+                    errs.append(f"resident_ab {k} malformed: "
+                                f"{ra.get(k)!r:.120}")
+            for arm in ("on_census", "off_census"):
+                cen = ra.get(arm)
+                if not (isinstance(cen, dict)
+                        and {"samples", "realloc", "aliased"}
+                        <= set(cen)):
+                    errs.append(f"resident_ab {arm} malformed: "
+                                f"{cen!r:.120}")
     # per-scenario blocks, wherever present: each needs either a
     # headline-style shape or an honest error
     for sc, blk in (rec.get("scenarios") or {}).items():

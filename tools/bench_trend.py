@@ -34,6 +34,14 @@ regression introduced by the change under test):
   prior); ``donor_recovery_windows`` is a lower-is-better series
   gated against the best prior at the same (entities_moved,
   platform) shape with +1 window absolute slack;
+* ``resident_ab`` (ISSUE 20): any re-allocated carry lane in the
+  donation-on arm's census of a real latest block is an UNCONDITIONAL
+  failure (the resident runtime's whole contract is zero steady-state
+  allocation — no prior needed, like the audit's zero-violation
+  gate); the on/off ``ratio`` (serve ms/tick with donation+overlap
+  over without, lower is better, a pure ratio so no absolute slack)
+  gates against the best prior at the same (entities, platform)
+  shape; a pass->fail flip at the same shape is always a problem;
 * MULTICHIP: the latest record must keep ``ok`` (when any prior round
   had it) and ``rc == 0``; measured mesh headlines (r >= 10) gate
   ``entity_ticks_per_sec_mesh`` against the best prior at the same
@@ -471,6 +479,90 @@ def _check_rebalance_series(rounds: list, latest: dict, name: str,
             f"(prior {os.path.basename(prev_path)})")
 
 
+def _check_resident_series(rounds: list, latest: dict, name: str,
+                           threshold: float, problems: list[str],
+                           notes: list[str]) -> None:
+    """The resident-world A/B block (ISSUE 20): a re-allocated carry
+    lane in the donation-ON arm's census of a real latest block is
+    ALWAYS a problem (the resident runtime's contract is zero
+    steady-state allocation — it needs no prior, like the audit's
+    zero-violation gate); an OFF arm that ALSO reads zero realloc
+    means the A/B measured nothing and is flagged too; the on/off
+    ``ratio`` (serve ms/tick with donation+overlap over without,
+    lower is better, a pure ratio so no absolute slack) gates against
+    the best prior at the same (entities, platform) shape; a
+    pass->fail flip at the same shape is always a problem (the slo
+    rule). Skipped/error rounds neither gate nor anchor."""
+    def _realloc(cen) -> int | None:
+        if not isinstance(cen, dict):
+            return None
+        v = cen.get("realloc")
+        # the stamped block stores a count; the raw census snapshot
+        # stores the lane list — accept both so a hand-rolled round
+        # never slips the gate on a type mismatch
+        if isinstance(v, bool):
+            return None
+        if isinstance(v, int):
+            return v
+        if isinstance(v, list):
+            return len(v)
+        return None
+
+    def _ra_ok(s) -> bool:
+        return (isinstance(s, dict) and "error" not in s
+                and "skipped" not in s
+                and _realloc(s.get("on_census")) is not None
+                and isinstance(s.get("ratio"), (int, float))
+                and not isinstance(s.get("ratio"), bool))
+
+    lra = latest.get("resident_ab")
+    if not _ra_ok(lra):
+        return
+    on_re = _realloc(lra["on_census"])
+    if on_re:
+        problems.append(
+            f"{name}: resident_ab donation-on census re-allocated "
+            f"{on_re} carry lane(s) — the resident serve loop must "
+            "alias every lane in place (MUST be zero)")
+    off_re = _realloc(lra.get("off_census"))
+    if off_re == 0:
+        problems.append(
+            f"{name}: resident_ab donation-off census read 0 "
+            "re-allocated lanes — the control arm shows no churn, so "
+            "the A/B measured nothing")
+    rshape = (lra.get("entities"), latest.get("platform"))
+    rprior = [
+        (p, r["resident_ab"]) for p, r in rounds[:-1]
+        if _ra_ok(r.get("resident_ab"))
+        and (r["resident_ab"].get("entities"),
+             r.get("platform")) == rshape
+    ]
+    if not rprior:
+        notes.append(f"{name}: resident_ab shape {rshape} has no "
+                     "prior round — ratio not gated")
+        return
+    # on/off ratio vs the best (lowest) prior: lower is better, a
+    # pure ratio so no absolute slack needed (the two arms share one
+    # box and one window, so machine speed divides out)
+    lratio = lra["ratio"]
+    best_path, best = min(rprior, key=lambda pr: pr[1]["ratio"])
+    ceil = (1.0 + threshold) * best["ratio"]
+    if lratio > ceil:
+        problems.append(
+            f"{name}: resident_ab ratio {lratio} > {ceil:.3g} "
+            f"({(1 + threshold) * 100:.0f}% of "
+            f"{os.path.basename(best_path)}'s {best['ratio']})")
+    else:
+        notes.append(
+            f"{name}: resident_ab ratio {lratio} vs best prior "
+            f"{best['ratio']} — ok")
+    prev_path, prev = rprior[-1]
+    if prev.get("pass") and not lra.get("pass"):
+        problems.append(
+            f"{name}: resident_ab verdict regressed pass -> fail "
+            f"(prior {os.path.basename(prev_path)})")
+
+
 def check_bench(files: list[str], threshold: float,
                 problems: list[str], notes: list[str]) -> None:
     rounds = []
@@ -514,6 +606,10 @@ def check_bench(files: list[str], threshold: float,
     # the zero-loss gate must fire even on a headline-shape change
     _check_rebalance_series(rounds, latest, name, threshold,
                             problems, notes)
+    # the resident-world A/B series (ISSUE 20): same hoisting — the
+    # zero-realloc gate must fire even on a headline-shape change
+    _check_resident_series(rounds, latest, name, threshold,
+                           problems, notes)
     prior = [(p, r) for p, r in rounds[:-1]
              if _shape(r) == _shape(latest)]
     if not prior:

@@ -13,7 +13,8 @@ moment any of them reports drift:
 3. ``tools/bench_trend.py`` — the LATEST round does not regress
    against its comparable predecessors (headline, splits, SLO, and
    the per-plane series: governor, sync-age, residency, audit,
-   failover, rebalance).
+   failover, rebalance, resident_ab — the last with the
+   MUST-BE-ZERO gate on the donation-on arm's census realloc).
 
 All three are imported in-process (they are jax-free by contract;
 this gate runs in milliseconds on a laptop or a bare CI runner). A

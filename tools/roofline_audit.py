@@ -104,8 +104,11 @@ def audit_for(rec: dict, live: bool) -> dict:
 def print_table(path: str, block: dict) -> None:
     print(f"\n== {os.path.basename(path)} "
           f"(n={block.get('n')}, platform={block.get('platform')})")
+    # donate MB = donation_applied_mb (bytes aliasing DID reclaim),
+    # reclaim MB = donation_reclaimable_mb (bytes it still could)
     hdr = f"{'phase':<12}{'model MB':>10}{'xla MB':>10}" \
-          f"{'drift %':>9}{'meas ms':>9}{'v5e ms':>8}"
+          f"{'drift %':>9}{'meas ms':>9}{'v5e ms':>8}" \
+          f"{'donate MB':>11}{'reclaim MB':>12}"
     print(hdr)
     for name, row in block.get("phases", {}).items():
         print(f"{name:<12}"
@@ -113,7 +116,9 @@ def print_table(path: str, block: dict) -> None:
               f"{row.get('xla_mb', '-'):>10}"
               f"{row.get('drift_pct', '-'):>9}"
               f"{row.get('measured_ms', '-'):>9}"
-              f"{row.get('model_ms_v5e', '-'):>8}")
+              f"{row.get('model_ms_v5e', '-'):>8}"
+              f"{row.get('donation_applied_mb', '-'):>11}"
+              f"{row.get('donation_reclaimable_mb', '-'):>12}")
     if "total_drift_pct" in block:
         print(f"{'TOTAL':<12}{block['total_model_mb']:>10}"
               f"{block.get('total_xla_mb', '-'):>10}"
